@@ -1,0 +1,1 @@
+from . import controlplane  # noqa: F401

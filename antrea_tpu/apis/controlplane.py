@@ -1,0 +1,201 @@
+"""Internal control-plane wire types.
+
+Semantic analogs of the reference's internal (controller->agent) API objects in
+/root/reference/pkg/apis/controlplane/types.go:
+  GroupMember (:80), AddressGroup (:154), AppliedToGroup (:32),
+  NetworkPolicy (:221), NetworkPolicyRule (:248), Service (:299),
+  NetworkPolicyPeer (:358), IPBlock (:376).
+
+These are the objects the central controller computes and disseminates to
+agents (span-filtered), and the input to the rule compiler.  They are plain
+dataclasses — serialization to protobuf happens at the dissemination boundary,
+not here.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+# -- protocols ---------------------------------------------------------------
+
+PROTO_ICMP = 1
+PROTO_TCP = 6
+PROTO_UDP = 17
+PROTO_SCTP = 132
+
+PROTO_BY_NAME = {"ICMP": PROTO_ICMP, "TCP": PROTO_TCP, "UDP": PROTO_UDP, "SCTP": PROTO_SCTP}
+
+
+class Direction(str, enum.Enum):
+    """Ref: controlplane.Direction{In,Out} (types.go:244-246)."""
+
+    IN = "In"
+    OUT = "Out"
+
+
+class RuleAction(str, enum.Enum):
+    """Ref: crd/v1beta1.RuleAction — Allow/Drop/Reject/Pass."""
+
+    ALLOW = "Allow"
+    DROP = "Drop"
+    REJECT = "Reject"
+    PASS = "Pass"
+
+
+class NetworkPolicyType(str, enum.Enum):
+    """Ref: controlplane.NetworkPolicyType (types.go:200-218)."""
+
+    K8S = "K8sNetworkPolicy"
+    ACNP = "AntreaClusterNetworkPolicy"
+    ANNP = "AntreaNetworkPolicy"
+    ADMIN = "AdminNetworkPolicy"
+
+
+# Tier priorities; lower value = evaluated earlier.  Ref: default tiers created
+# by the controller (pkg/controller/networkpolicy: Emergency..Baseline) — the
+# Baseline tier is special-cased to evaluate AFTER K8s NetworkPolicies.
+TIER_EMERGENCY = 50
+TIER_SECURITYOPS = 100
+TIER_NETWORKOPS = 150
+TIER_PLATFORM = 200
+TIER_APPLICATION = 250
+TIER_BASELINE = 253
+
+
+@dataclass(frozen=True)
+class IPBlock:
+    """CIDR with holes. Ref: types.go:376."""
+
+    cidr: str
+    excepts: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class GroupMember:
+    """A pod/external endpoint in a group. Ref: types.go:80.
+
+    The reference carries Pod/ExternalEntity references + IPs + ports; the
+    datapath cares about IPs (and node placement for span computation).
+    """
+
+    ip: str
+    node: str = ""
+    pod_namespace: str = ""
+    pod_name: str = ""
+
+
+@dataclass
+class AddressGroup:
+    """Set of peer addresses shared across rules. Ref: types.go:154."""
+
+    name: str
+    members: list[GroupMember] = field(default_factory=list)
+    ip_blocks: list[IPBlock] = field(default_factory=list)
+
+    def ranges(self) -> list[tuple[int, int]]:
+        from ..utils import ip as iputil
+
+        ranges = [iputil.cidr_to_range(m.ip) for m in self.members]
+        for b in self.ip_blocks:
+            ranges.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
+        ranges.sort()
+        merged: list[tuple[int, int]] = []
+        for lo, hi in ranges:
+            if merged and lo <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+            else:
+                merged.append((lo, hi))
+        return merged
+
+
+@dataclass
+class AppliedToGroup:
+    """Set of pods a policy applies to. Ref: types.go:32."""
+
+    name: str
+    members: list[GroupMember] = field(default_factory=list)
+
+    def node_span(self) -> set[str]:
+        return {m.node for m in self.members if m.node}
+
+
+@dataclass(frozen=True)
+class Service:
+    """One port/protocol entry of a rule. Ref: types.go:299.
+
+    protocol None means any protocol; port None means any port;
+    end_port extends port to a range [port, end_port].
+    """
+
+    protocol: Optional[int] = None
+    port: Optional[int] = None
+    end_port: Optional[int] = None
+
+
+@dataclass
+class NetworkPolicyPeer:
+    """Rule peer: address groups and/or literal IP blocks. Ref: types.go:358."""
+
+    address_groups: list[str] = field(default_factory=list)
+    ip_blocks: list[IPBlock] = field(default_factory=list)
+
+    @property
+    def is_any(self) -> bool:
+        return not self.address_groups and not self.ip_blocks
+
+
+@dataclass
+class NetworkPolicyRule:
+    """One direction-scoped rule. Ref: types.go:248.
+
+    `services` empty means all traffic (any proto/port).
+    `priority` is the rule's index within its policy (lower = first) for
+    Antrea-native policies; -1 for K8s NP rules (which have no ordering).
+    """
+
+    direction: Direction
+    from_peer: NetworkPolicyPeer = field(default_factory=NetworkPolicyPeer)
+    to_peer: NetworkPolicyPeer = field(default_factory=NetworkPolicyPeer)
+    services: list[Service] = field(default_factory=list)
+    action: RuleAction = RuleAction.ALLOW
+    priority: int = -1
+    name: str = ""
+    # Rule-level appliedTo override (ANNP supports per-rule appliedTo;
+    # ref: types.go:248 NetworkPolicyRule.AppliedToGroups). Empty = inherit
+    # the policy-level appliedToGroups.
+    applied_to_groups: list[str] = field(default_factory=list)
+
+    @property
+    def peer(self) -> NetworkPolicyPeer:
+        return self.from_peer if self.direction == Direction.IN else self.to_peer
+
+
+@dataclass
+class NetworkPolicy:
+    """Internal computed NetworkPolicy. Ref: types.go:221."""
+
+    uid: str
+    name: str
+    namespace: str = ""  # empty for cluster-scoped
+    type: NetworkPolicyType = NetworkPolicyType.K8S
+    rules: list[NetworkPolicyRule] = field(default_factory=list)
+    applied_to_groups: list[str] = field(default_factory=list)
+    # K8s NP only: directions in spec.policyTypes. A pod selected by a K8s NP
+    # is *isolated* in those directions even if the policy has zero rules
+    # (upstream K8s semantics; enforced by the reference via default-deny
+    # flows in the IngressDefaultRule/EgressDefaultRule tables,
+    # ref: pkg/agent/openflow/pipeline.go).
+    policy_types: list[Direction] = field(default_factory=list)
+    # Antrea-native only:
+    tier_priority: Optional[int] = None  # None for K8s NP
+    priority: Optional[float] = None  # policy priority within tier
+
+    @property
+    def is_k8s(self) -> bool:
+        return self.type == NetworkPolicyType.K8S
+
+    @property
+    def is_baseline(self) -> bool:
+        return self.tier_priority == TIER_BASELINE

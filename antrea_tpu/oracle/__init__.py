@@ -1,0 +1,1 @@
+from .interpreter import Oracle, DirectionVerdict, Verdict, VerdictCode  # noqa: F401

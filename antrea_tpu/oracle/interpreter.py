@@ -1,0 +1,171 @@
+"""Scalar reference interpreter: packet -> verdict.
+
+This is the executable *specification* of the datapath: it walks one packet
+through the same decision procedure the reference's OVS pipeline implements
+with flow tables, and every batched TPU kernel must agree with it bit-for-bit
+(the verdict-parity requirement in BASELINE.md).
+
+Evaluation order per direction, mirroring the OVS tables
+(/root/reference/pkg/agent/openflow/pipeline.go:114-195 and
+/root/reference/docs/design/ovs-pipeline.md:1685-1760):
+
+  1. AntreaPolicy{Ingress,Egress}Rule — Antrea-native rules from non-Baseline
+     tiers, in (tier priority, policy priority, rule index) order; the first
+     matching rule decides: Allow / Drop / Reject are final, Pass falls
+     through to the K8s phase.
+  2. {Ingress,Egress}Rule — K8s NetworkPolicy allow rules (unordered; any
+     match allows), combined with {Ingress,Egress}DefaultRule isolation:
+     a pod selected by any K8s NP in this direction is default-deny, so
+     "isolated and no allow rule matched" => Drop, final.  K8s isolation
+     cannot be overridden by Baseline-tier rules (upstream K8s semantics).
+  3. Baseline-tier rules (installed in the DefaultRule tables below the K8s
+     default-deny in the reference), first match decides; Pass means "no
+     opinion" and falls to:
+  4. default Allow.
+
+A packet's final verdict combines the egress evaluation at its source pod and
+the ingress evaluation at its destination: any Drop/Reject wins over Allow.
+Service DNAT happens *before* policy evaluation (PreRouting stage precedes
+EgressSecurity, pipeline.go stages), so callers evaluating post-LB traffic
+pass the DNAT-ed destination; the full-pipeline oracle in
+antrea_tpu.oracle.pipeline composes that ordering.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+from ..apis.controlplane import (
+    PROTO_SCTP,
+    PROTO_TCP,
+    PROTO_UDP,
+    Direction,
+    NetworkPolicy,
+    NetworkPolicyRule,
+    RuleAction,
+    Service,
+)
+from ..compiler.ir import PolicySet, rule_id
+from ..packet import Packet
+
+
+class VerdictCode(enum.IntEnum):
+    # Values match the compiled action encoding in compiler/compile.py.
+    ALLOW = 0
+    DROP = 1
+    REJECT = 2
+
+
+@dataclass(frozen=True)
+class DirectionVerdict:
+    code: VerdictCode
+    rule: Optional[str]  # rule_id of the deciding rule; None = default allow
+
+
+@dataclass(frozen=True)
+class Verdict:
+    code: VerdictCode
+    egress: DirectionVerdict
+    ingress: DirectionVerdict
+
+
+def _service_matches(svc: Service, pkt: Packet) -> bool:
+    if svc.protocol is not None and svc.protocol != pkt.proto:
+        return False
+    if svc.port is not None and pkt.proto in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
+        hi = svc.end_port if svc.end_port is not None else svc.port
+        if not (svc.port <= pkt.dst_port <= hi):
+            return False
+    return True
+
+
+class Oracle:
+    def __init__(self, ps: PolicySet):
+        self.ps = ps
+
+    # -- single rule ---------------------------------------------------------
+
+    def _rule_matches(
+        self, policy: NetworkPolicy, rule: NetworkPolicyRule, pkt: Packet
+    ) -> bool:
+        if rule.direction == Direction.IN:
+            pod_ip, peer_ip = pkt.dst_ip, pkt.src_ip
+        else:
+            pod_ip, peer_ip = pkt.src_ip, pkt.dst_ip
+        if not self.ps.applied_to_contains(policy, rule, pod_ip):
+            return False
+        if not self.ps.peer_contains(rule.peer, peer_ip):
+            return False
+        if rule.services and not any(_service_matches(s, pkt) for s in rule.services):
+            return False
+        return True
+
+    # -- one direction -------------------------------------------------------
+
+    def _ordered_antrea_rules(self, direction: Direction, baseline: bool):
+        out = []
+        for p in self.ps.policies:
+            if p.is_k8s or p.is_baseline != baseline:
+                continue
+            for i, r in enumerate(p.rules):
+                if r.direction != direction:
+                    continue
+                out.append(((p.tier_priority, p.priority, r.priority, p.uid), p, i, r))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def evaluate_direction(self, pkt: Packet, direction: Direction) -> DirectionVerdict:
+        # Phase 1: Antrea-native, non-Baseline tiers.
+        passed = False
+        for _, p, i, r in self._ordered_antrea_rules(direction, baseline=False):
+            if self._rule_matches(p, r, pkt):
+                if r.action == RuleAction.PASS:
+                    passed = True
+                    break
+                code = {
+                    RuleAction.ALLOW: VerdictCode.ALLOW,
+                    RuleAction.DROP: VerdictCode.DROP,
+                    RuleAction.REJECT: VerdictCode.REJECT,
+                }[r.action]
+                return DirectionVerdict(code, rule_id(p, i))
+
+        # Phase 2: K8s NetworkPolicies (allow rules + isolation default-deny).
+        pod_ip = pkt.dst_ip if direction == Direction.IN else pkt.src_ip
+        isolated = self.ps.k8s_isolated(pod_ip, direction)
+        if isolated:
+            for p in self.ps.policies:
+                if not p.is_k8s:
+                    continue
+                for i, r in enumerate(p.rules):
+                    if r.direction == direction and self._rule_matches(p, r, pkt):
+                        return DirectionVerdict(VerdictCode.ALLOW, rule_id(p, i))
+            return DirectionVerdict(VerdictCode.DROP, None)
+        del passed  # Pass into an empty K8s phase falls through to baseline.
+
+        # Phase 3: Baseline tier.
+        for _, p, i, r in self._ordered_antrea_rules(direction, baseline=True):
+            if self._rule_matches(p, r, pkt):
+                if r.action == RuleAction.PASS:
+                    break
+                code = {
+                    RuleAction.ALLOW: VerdictCode.ALLOW,
+                    RuleAction.DROP: VerdictCode.DROP,
+                    RuleAction.REJECT: VerdictCode.REJECT,
+                }[r.action]
+                return DirectionVerdict(code, rule_id(p, i))
+
+        # Phase 4: default allow.
+        return DirectionVerdict(VerdictCode.ALLOW, None)
+
+    # -- full packet ---------------------------------------------------------
+
+    def classify(self, pkt: Packet) -> Verdict:
+        eg = self.evaluate_direction(pkt, Direction.OUT)
+        ing = self.evaluate_direction(pkt, Direction.IN)
+        if eg.code != VerdictCode.ALLOW:
+            final = eg.code
+        else:
+            final = ing.code
+        return Verdict(code=final, egress=eg, ingress=ing)

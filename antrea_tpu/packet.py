@@ -1,0 +1,64 @@
+"""Packet models.
+
+A scalar `Packet` for the oracle/spec, and a `PacketBatch` struct-of-arrays
+for the batched kernels.  The batch layout is the TPU-native analog of the
+per-packet NXM register file the reference allocates in
+/root/reference/pkg/agent/openflow/fields.go — each register becomes a (B,)
+column; the classification pipeline transforms columns instead of resubmitting
+a single packet through OVS tables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Packet:
+    """Scalar 5-tuple for the reference interpreter."""
+
+    src_ip: int  # u32
+    dst_ip: int  # u32
+    proto: int  # 1/6/17/132
+    src_port: int = 0  # u16; 0 for ICMP
+    dst_port: int = 0  # u16
+
+
+@dataclass
+class PacketBatch:
+    """Struct-of-arrays batch; all fields shape (B,).
+
+    dtypes are kept as unsigned 32-bit for IPs and int32 for the rest —
+    int32 is the natural TPU integer width; u16 fields live in int32 lanes.
+    """
+
+    src_ip: np.ndarray
+    dst_ip: np.ndarray
+    proto: np.ndarray
+    src_port: np.ndarray
+    dst_port: np.ndarray
+
+    @property
+    def size(self) -> int:
+        return int(self.src_ip.shape[0])
+
+    @staticmethod
+    def from_packets(packets: list[Packet]) -> "PacketBatch":
+        return PacketBatch(
+            src_ip=np.array([p.src_ip for p in packets], dtype=np.uint32),
+            dst_ip=np.array([p.dst_ip for p in packets], dtype=np.uint32),
+            proto=np.array([p.proto for p in packets], dtype=np.int32),
+            src_port=np.array([p.src_port for p in packets], dtype=np.int32),
+            dst_port=np.array([p.dst_port for p in packets], dtype=np.int32),
+        )
+
+    def packet(self, i: int) -> Packet:
+        return Packet(
+            src_ip=int(self.src_ip[i]),
+            dst_ip=int(self.dst_ip[i]),
+            proto=int(self.proto[i]),
+            src_port=int(self.src_port[i]),
+            dst_port=int(self.dst_port[i]),
+        )

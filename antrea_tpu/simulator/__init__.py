@@ -1,0 +1,2 @@
+from .genpolicy import SyntheticCluster, gen_cluster  # noqa: F401
+from .traffic import gen_traffic  # noqa: F401

@@ -1,0 +1,155 @@
+"""Synthetic cluster + policy-set generator.
+
+The analog of the reference's scale-test drivers: the controller perf tests
+(/root/reference/pkg/controller/networkpolicy/networkpolicy_controller_perf_test.go:46)
+build N namespaces x pods x policies with fake clients, and
+antrea-agent-simulator (/root/reference/cmd/antrea-agent-simulator) drives
+scale without a dataplane.  Here the generator emits already-computed internal
+objects (PolicySet) for the datapath benchmarks in BASELINE.md:
+1k exact-match / 10k ACNP+tiers+CIDR / 100k multi-tenant mix.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from ..apis import controlplane as cp
+from ..compiler.ir import PolicySet
+from ..utils import ip as iputil
+
+
+@dataclass
+class SyntheticCluster:
+    ps: PolicySet
+    pod_ips: list[int] = field(default_factory=list)  # u32
+    nodes: list[str] = field(default_factory=list)
+
+
+def _pod_ip(node_idx: int, pod_idx: int) -> str:
+    # podCIDR per node: 10.<n/256>.<n%256>.0/24 (matches the reference's
+    # per-Node podCIDR model; ref: pkg/agent/agent.go initK8sNodeLocalConfig).
+    return f"10.{node_idx // 256}.{node_idx % 256}.{pod_idx + 2}"
+
+
+def gen_cluster(
+    n_rules: int,
+    *,
+    n_nodes: int = 16,
+    pods_per_node: int = 32,
+    pods_per_group: int = 8,
+    rules_per_policy: int = 4,
+    cidr_fraction: float = 0.3,
+    acnp_fraction: float = 0.5,
+    with_tiers: bool = True,
+    seed: int = 0,
+) -> SyntheticCluster:
+    """Generate ~n_rules rules across K8s NPs and ACNPs with shared groups.
+
+    Group sharing mirrors production policy sets (and the reference's
+    conjunctive factoring assumption, SURVEY.md section 2.6): the number of
+    distinct AddressGroups is much smaller than the number of rules.
+    """
+    rng = random.Random(seed)
+    nodes = [f"node-{i}" for i in range(n_nodes)]
+    pod_ips = [
+        iputil.ip_to_u32(_pod_ip(n, p)) for n in range(n_nodes) for p in range(pods_per_node)
+    ]
+
+    ps = PolicySet()
+
+    # Address/appliedTo groups over pods.
+    n_groups = max(4, min(4096, (n_rules // 4) or 4))
+    for gi in range(n_groups):
+        members = []
+        for _ in range(pods_per_group):
+            n = rng.randrange(n_nodes)
+            p = rng.randrange(pods_per_node)
+            members.append(
+                cp.GroupMember(ip=_pod_ip(n, p), node=nodes[n], pod_name=f"pod-{n}-{p}")
+            )
+        ps.address_groups[f"ag-{gi}"] = cp.AddressGroup(name=f"ag-{gi}", members=members)
+        ps.applied_to_groups[f"atg-{gi}"] = cp.AppliedToGroup(name=f"atg-{gi}", members=members)
+
+    tiers = (
+        [cp.TIER_EMERGENCY, cp.TIER_SECURITYOPS, cp.TIER_NETWORKOPS, cp.TIER_PLATFORM,
+         cp.TIER_APPLICATION]
+        if with_tiers
+        else [cp.TIER_APPLICATION]
+    )
+
+    def rand_peer() -> cp.NetworkPolicyPeer:
+        if rng.random() < cidr_fraction:
+            plen = rng.choice([8, 12, 16, 20, 24, 28, 32])
+            base = rng.getrandbits(32)
+            cidr = f"{iputil.u32_to_ip(base)}/{plen}"
+            if rng.random() < 0.2:
+                sub = min(plen + 4, 32)
+                exc = f"{iputil.u32_to_ip(base)}/{sub}"
+                return cp.NetworkPolicyPeer(ip_blocks=[cp.IPBlock(cidr=cidr, excepts=(exc,))])
+            return cp.NetworkPolicyPeer(ip_blocks=[cp.IPBlock(cidr=cidr)])
+        return cp.NetworkPolicyPeer(address_groups=[f"ag-{rng.randrange(n_groups)}"])
+
+    def rand_services() -> list[cp.Service]:
+        r = rng.random()
+        if r < 0.25:
+            return []  # any
+        proto = rng.choice([cp.PROTO_TCP, cp.PROTO_TCP, cp.PROTO_UDP])
+        port = rng.choice([80, 443, 8080, 53, 5432, rng.randrange(1024, 60000)])
+        if r < 0.4:
+            return [cp.Service(protocol=proto, port=port, end_port=port + rng.randrange(1, 64))]
+        return [cp.Service(protocol=proto, port=port)]
+
+    made = 0
+    pi = 0
+    while made < n_rules:
+        k = min(rules_per_policy, n_rules - made)
+        is_acnp = rng.random() < acnp_fraction
+        rules = []
+        for ri in range(k):
+            direction = cp.Direction.IN if rng.random() < 0.6 else cp.Direction.OUT
+            peer = rand_peer()
+            rule = cp.NetworkPolicyRule(
+                direction=direction,
+                from_peer=peer if direction == cp.Direction.IN else cp.NetworkPolicyPeer(),
+                to_peer=peer if direction == cp.Direction.OUT else cp.NetworkPolicyPeer(),
+                services=rand_services(),
+                action=(
+                    rng.choices(
+                        [cp.RuleAction.ALLOW, cp.RuleAction.DROP, cp.RuleAction.REJECT,
+                         cp.RuleAction.PASS],
+                        weights=[0.55, 0.3, 0.05, 0.1],
+                    )[0]
+                    if is_acnp
+                    else cp.RuleAction.ALLOW
+                ),
+                priority=ri if is_acnp else -1,
+            )
+            rules.append(rule)
+        atg = f"atg-{rng.randrange(n_groups)}"
+        if is_acnp:
+            pol = cp.NetworkPolicy(
+                uid=f"acnp-{pi}",
+                name=f"acnp-{pi}",
+                type=cp.NetworkPolicyType.ACNP,
+                rules=rules,
+                applied_to_groups=[atg],
+                tier_priority=rng.choice(tiers + ([cp.TIER_BASELINE] if rng.random() < 0.1 else [])),
+                priority=round(rng.uniform(1, 150), 2),
+            )
+        else:
+            dirs = sorted({r.direction for r in rules}, key=lambda d: d.value)
+            pol = cp.NetworkPolicy(
+                uid=f"knp-{pi}",
+                name=f"knp-{pi}",
+                namespace=f"ns-{rng.randrange(32)}",
+                type=cp.NetworkPolicyType.K8S,
+                rules=rules,
+                applied_to_groups=[atg],
+                policy_types=list(dirs),
+            )
+        ps.policies.append(pol)
+        made += k
+        pi += 1
+
+    return SyntheticCluster(ps=ps, pod_ips=pod_ips, nodes=nodes)

@@ -1,0 +1,55 @@
+"""Synthetic packet-batch generator (the traffic side of the simulator).
+
+Generates batches with controllable flow locality: a Zipf-ish draw over a
+fixed flow universe so the flow-cache/conntrack fast path sees realistic
+repeat-flow ratios (the reference relies on the same property: OVS's megaflow
+cache and kernel conntrack only pay full classification on the first packet
+of a flow; ref: docs/design/ovs-pipeline.md conntrack sections).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..apis.controlplane import PROTO_TCP, PROTO_UDP
+from ..packet import PacketBatch
+
+
+def gen_traffic(
+    pod_ips: list[int],
+    batch: int,
+    *,
+    n_flows: int = 1 << 16,
+    pod_to_pod_fraction: float = 0.8,
+    zipf_a: float = 1.2,
+    seed: int = 0,
+) -> PacketBatch:
+    rng = np.random.default_rng(seed)
+    pods = np.asarray(pod_ips, dtype=np.uint32)
+
+    # Flow universe.
+    f_src = rng.choice(pods, size=n_flows)
+    f_dst = rng.choice(pods, size=n_flows)
+    ext = rng.integers(0, 1 << 32, size=n_flows, dtype=np.uint32)
+    external = rng.random(n_flows) > pod_to_pod_fraction
+    f_src = np.where(external & (rng.random(n_flows) < 0.5), ext, f_src)
+    f_dst = np.where(external & (rng.random(n_flows) >= 0.5), ext, f_dst)
+    f_proto = np.where(rng.random(n_flows) < 0.85, PROTO_TCP, PROTO_UDP).astype(np.int32)
+    f_sport = rng.integers(1024, 65536, size=n_flows, dtype=np.int32)
+    common = np.array([80, 443, 8080, 53, 5432], dtype=np.int32)
+    f_dport = np.where(
+        rng.random(n_flows) < 0.7,
+        rng.choice(common, size=n_flows),
+        rng.integers(1, 65536, size=n_flows),
+    ).astype(np.int32)
+
+    # Zipf draw over flows -> batch indices.
+    idx = (rng.zipf(zipf_a, size=batch) - 1) % n_flows
+
+    return PacketBatch(
+        src_ip=f_src[idx],
+        dst_ip=f_dst[idx],
+        proto=f_proto[idx],
+        src_port=f_sport[idx],
+        dst_port=f_dport[idx],
+    )

@@ -1,0 +1,81 @@
+"""IPv4 helpers used across the compiler, oracle and kernels.
+
+Everything is u32-based: packets carry IPs as unsigned 32-bit ints, CIDRs are
+(base, prefix_len) pairs, and CIDR sets become half-open [lo, hi) ranges over
+the u32 space so membership reduces to interval lookup (the vectorizable LPM
+strategy; ref: pkg/apis/controlplane/types.go:376 IPBlock, and the CIDR match
+flows built in pkg/agent/openflow/network_policy.go).
+
+IPv6 is carried in the reference as 16-byte addresses; this build keeps the
+dataplane IPv4-first (the register-file layout reserves xxreg-style wide slots
+for a later IPv6 column set).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Iterable
+
+U32_MAX = 0xFFFFFFFF
+
+
+def ip_to_u32(ip: str) -> int:
+    """'10.1.2.3' -> u32."""
+    return int(ipaddress.IPv4Address(ip))
+
+
+def u32_to_ip(v: int) -> str:
+    return str(ipaddress.IPv4Address(v & U32_MAX))
+
+
+def parse_cidr(cidr: str) -> tuple[int, int]:
+    """'10.0.0.0/8' -> (base_u32, prefix_len). Bare IPs become /32."""
+    if "/" not in cidr:
+        return ip_to_u32(cidr), 32
+    net = ipaddress.IPv4Network(cidr, strict=False)
+    return int(net.network_address), net.prefixlen
+
+
+def cidr_to_range(cidr: str) -> tuple[int, int]:
+    """CIDR -> half-open [lo, hi) u32 range. hi may be 2**32 (whole-space end)."""
+    base, plen = parse_cidr(cidr)
+    size = 1 << (32 - plen)
+    lo = base & ~(size - 1) & U32_MAX
+    return lo, lo + size
+
+
+def cidrs_to_ranges(cidrs: Iterable[str]) -> list[tuple[int, int]]:
+    """CIDR list -> sorted, merged half-open ranges (set semantics: union)."""
+    ranges = sorted(cidr_to_range(c) for c in cidrs)
+    merged: list[tuple[int, int]] = []
+    for lo, hi in ranges:
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def ipblock_to_ranges(cidr: str, excepts: Iterable[str] = ()) -> list[tuple[int, int]]:
+    """IPBlock {cidr, except[]} -> disjoint ranges (cidr minus excepts).
+
+    Ref semantics: pkg/apis/controlplane/types.go:376 (IPBlock with Except).
+    """
+    lo, hi = cidr_to_range(cidr)
+    holes = cidrs_to_ranges(excepts)
+    out: list[tuple[int, int]] = []
+    cur = lo
+    for hlo, hhi in holes:
+        hlo, hhi = max(hlo, lo), min(hhi, hi)
+        if hlo >= hhi:
+            continue
+        if cur < hlo:
+            out.append((cur, hlo))
+        cur = max(cur, hhi)
+    if cur < hi:
+        out.append((cur, hi))
+    return out
+
+
+def ip_in_ranges(ip_u32: int, ranges: Iterable[tuple[int, int]]) -> bool:
+    return any(lo <= ip_u32 < hi for lo, hi in ranges)

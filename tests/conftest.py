@@ -1,0 +1,9 @@
+"""Test env: force CPU with 8 virtual devices so sharding tests run without
+real multi-chip hardware (the driver's dryrun does the same)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

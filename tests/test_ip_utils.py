@@ -1,0 +1,34 @@
+from antrea_tpu.utils import ip as iputil
+
+
+def test_roundtrip():
+    assert iputil.ip_to_u32("10.0.0.1") == 0x0A000001
+    assert iputil.u32_to_ip(0x0A000001) == "10.0.0.1"
+
+
+def test_cidr_range():
+    assert iputil.cidr_to_range("10.0.0.0/8") == (0x0A000000, 0x0B000000)
+    assert iputil.cidr_to_range("1.2.3.4") == (0x01020304, 0x01020305)
+    assert iputil.cidr_to_range("0.0.0.0/0") == (0, 1 << 32)
+
+
+def test_cidr_nonaligned_base_is_masked():
+    # 10.0.0.7/24 -> 10.0.0.0/24
+    assert iputil.cidr_to_range("10.0.0.7/24") == (0x0A000000, 0x0A000100)
+
+
+def test_merge_ranges():
+    rs = iputil.cidrs_to_ranges(["10.0.0.0/25", "10.0.0.128/25", "192.168.0.0/24"])
+    assert rs == [(0x0A000000, 0x0A000100), (0xC0A80000, 0xC0A80100)]
+
+
+def test_ipblock_except():
+    rs = iputil.ipblock_to_ranges("10.0.0.0/24", ["10.0.0.64/26"])
+    assert rs == [(0x0A000000, 0x0A000040), (0x0A000080, 0x0A000100)]
+    assert iputil.ip_in_ranges(iputil.ip_to_u32("10.0.0.1"), rs)
+    assert not iputil.ip_in_ranges(iputil.ip_to_u32("10.0.0.65"), rs)
+
+
+def test_ipblock_except_outside_cidr_ignored():
+    rs = iputil.ipblock_to_ranges("10.0.0.0/24", ["192.168.0.0/16"])
+    assert rs == [(0x0A000000, 0x0A000100)]

@@ -1,0 +1,340 @@
+"""Oracle semantics tests: hand-built policy sets with known verdicts.
+
+These encode the OVS-pipeline decision procedure from
+docs/design/ovs-pipeline.md (reference) as concrete cases; the batched kernels
+are later tested against the oracle, so this file is the root of the parity
+chain.
+"""
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.oracle import Oracle, VerdictCode
+from antrea_tpu.packet import Packet
+from antrea_tpu.utils import ip as iputil
+
+
+POD_A = "10.0.0.2"  # appliedTo pod
+POD_B = "10.0.1.2"  # peer pod
+POD_C = "10.0.2.2"  # unrelated pod
+
+
+def members(*ips):
+    return [cp.GroupMember(ip=i, node="n0") for i in ips]
+
+
+def base_ps() -> PolicySet:
+    ps = PolicySet()
+    ps.applied_to_groups["atg-a"] = cp.AppliedToGroup("atg-a", members(POD_A))
+    ps.address_groups["ag-b"] = cp.AddressGroup("ag-b", members(POD_B))
+    return ps
+
+
+def pkt(src, dst, proto=cp.PROTO_TCP, dport=80, sport=12345):
+    return Packet(
+        src_ip=iputil.ip_to_u32(src),
+        dst_ip=iputil.ip_to_u32(dst),
+        proto=proto,
+        src_port=sport,
+        dst_port=dport,
+    )
+
+
+def k8s_ingress_allow(ps, uid="knp-1", port=80):
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid=uid,
+            name=uid,
+            namespace="ns",
+            type=cp.NetworkPolicyType.K8S,
+            applied_to_groups=["atg-a"],
+            policy_types=[cp.Direction.IN],
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    from_peer=cp.NetworkPolicyPeer(address_groups=["ag-b"]),
+                    services=[cp.Service(protocol=cp.PROTO_TCP, port=port)],
+                )
+            ],
+        )
+    )
+
+
+def test_default_allow_no_policies():
+    o = Oracle(base_ps())
+    v = o.classify(pkt(POD_B, POD_A))
+    assert v.code == VerdictCode.ALLOW
+    assert v.ingress.rule is None and v.egress.rule is None
+
+
+def test_k8s_isolation_and_allow():
+    ps = base_ps()
+    k8s_ingress_allow(ps)
+    o = Oracle(ps)
+    # allowed peer/port
+    assert o.classify(pkt(POD_B, POD_A, dport=80)).code == VerdictCode.ALLOW
+    # isolated pod, wrong port -> drop
+    assert o.classify(pkt(POD_B, POD_A, dport=81)).code == VerdictCode.DROP
+    # isolated pod, wrong peer -> drop
+    assert o.classify(pkt(POD_C, POD_A, dport=80)).code == VerdictCode.DROP
+    # non-isolated pod as dst -> allow
+    assert o.classify(pkt(POD_A, POD_C)).code == VerdictCode.ALLOW
+
+
+def test_k8s_empty_policy_isolates():
+    ps = base_ps()
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="knp-deny",
+            name="knp-deny",
+            namespace="ns",
+            type=cp.NetworkPolicyType.K8S,
+            applied_to_groups=["atg-a"],
+            policy_types=[cp.Direction.IN],
+            rules=[],
+        )
+    )
+    o = Oracle(ps)
+    assert o.classify(pkt(POD_B, POD_A)).code == VerdictCode.DROP
+
+
+def test_acnp_drop_beats_k8s_allow():
+    ps = base_ps()
+    k8s_ingress_allow(ps)
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="acnp-1",
+            name="acnp-1",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-a"],
+            tier_priority=cp.TIER_SECURITYOPS,
+            priority=5.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    from_peer=cp.NetworkPolicyPeer(address_groups=["ag-b"]),
+                    action=cp.RuleAction.DROP,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    v = o.classify(pkt(POD_B, POD_A, dport=80))
+    assert v.code == VerdictCode.DROP
+    assert v.ingress.rule == "acnp-1/In/0"
+
+
+def test_acnp_pass_falls_to_k8s():
+    ps = base_ps()
+    k8s_ingress_allow(ps)
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="acnp-pass",
+            name="acnp-pass",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-a"],
+            tier_priority=cp.TIER_SECURITYOPS,
+            priority=5.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    from_peer=cp.NetworkPolicyPeer(address_groups=["ag-b"]),
+                    action=cp.RuleAction.PASS,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    assert o.classify(pkt(POD_B, POD_A, dport=80)).code == VerdictCode.ALLOW
+    assert o.classify(pkt(POD_B, POD_A, dport=99)).code == VerdictCode.DROP
+
+
+def test_tier_ordering():
+    ps = base_ps()
+    for uid, tier, action in [
+        ("low", cp.TIER_APPLICATION, cp.RuleAction.DROP),
+        ("high", cp.TIER_EMERGENCY, cp.RuleAction.ALLOW),
+    ]:
+        ps.policies.append(
+            cp.NetworkPolicy(
+                uid=uid,
+                name=uid,
+                type=cp.NetworkPolicyType.ACNP,
+                applied_to_groups=["atg-a"],
+                tier_priority=tier,
+                priority=1.0,
+                rules=[
+                    cp.NetworkPolicyRule(
+                        direction=cp.Direction.IN,
+                        from_peer=cp.NetworkPolicyPeer(address_groups=["ag-b"]),
+                        action=action,
+                        priority=0,
+                    )
+                ],
+            )
+        )
+    o = Oracle(ps)
+    v = o.classify(pkt(POD_B, POD_A))
+    assert v.code == VerdictCode.ALLOW
+    assert v.ingress.rule == "high/In/0"
+
+
+def test_policy_priority_within_tier():
+    ps = base_ps()
+    for uid, prio, action in [("p9", 9.0, cp.RuleAction.DROP), ("p1", 1.0, cp.RuleAction.ALLOW)]:
+        ps.policies.append(
+            cp.NetworkPolicy(
+                uid=uid,
+                name=uid,
+                type=cp.NetworkPolicyType.ACNP,
+                applied_to_groups=["atg-a"],
+                tier_priority=cp.TIER_APPLICATION,
+                priority=prio,
+                rules=[
+                    cp.NetworkPolicyRule(
+                        direction=cp.Direction.IN,
+                        from_peer=cp.NetworkPolicyPeer(address_groups=["ag-b"]),
+                        action=action,
+                        priority=0,
+                    )
+                ],
+            )
+        )
+    o = Oracle(ps)
+    assert o.classify(pkt(POD_B, POD_A)).ingress.rule == "p1/In/0"
+
+
+def test_baseline_cannot_override_k8s_isolation():
+    ps = base_ps()
+    k8s_ingress_allow(ps)  # isolates POD_A ingress; allows only POD_B:80
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="base-allow",
+            name="base-allow",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-a"],
+            tier_priority=cp.TIER_BASELINE,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    action=cp.RuleAction.ALLOW,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    # K8s isolation still drops the non-allowed peer.
+    assert o.classify(pkt(POD_C, POD_A, dport=80)).code == VerdictCode.DROP
+
+
+def test_baseline_applies_when_not_isolated():
+    ps = base_ps()
+    ps.applied_to_groups["atg-c"] = cp.AppliedToGroup(
+        "atg-c", [cp.GroupMember(ip=POD_C, node="n0")]
+    )
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="base-drop",
+            name="base-drop",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-c"],
+            tier_priority=cp.TIER_BASELINE,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    action=cp.RuleAction.DROP,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    assert o.classify(pkt(POD_B, POD_C)).code == VerdictCode.DROP
+    assert o.classify(pkt(POD_B, POD_A)).code == VerdictCode.ALLOW
+
+
+def test_egress_direction():
+    ps = base_ps()
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="acnp-eg",
+            name="acnp-eg",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-a"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.OUT,
+                    to_peer=cp.NetworkPolicyPeer(ip_blocks=[cp.IPBlock(cidr="10.0.1.0/24")]),
+                    action=cp.RuleAction.REJECT,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    v = o.classify(pkt(POD_A, POD_B))  # POD_A egress to 10.0.1.x
+    assert v.code == VerdictCode.REJECT
+    assert v.egress.rule == "acnp-eg/Out/0"
+    # Other destinations unaffected.
+    assert o.classify(pkt(POD_A, "192.168.1.1")).code == VerdictCode.ALLOW
+
+
+def test_service_port_range():
+    ps = base_ps()
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="acnp-ports",
+            name="acnp-ports",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-a"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    services=[cp.Service(protocol=cp.PROTO_TCP, port=8000, end_port=8100)],
+                    action=cp.RuleAction.DROP,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    assert o.classify(pkt(POD_B, POD_A, dport=8050)).code == VerdictCode.DROP
+    assert o.classify(pkt(POD_B, POD_A, dport=8101)).code == VerdictCode.ALLOW
+    assert (
+        o.classify(pkt(POD_B, POD_A, proto=cp.PROTO_UDP, dport=8050)).code == VerdictCode.ALLOW
+    )
+
+
+def test_ipblock_except_in_peer():
+    ps = base_ps()
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="acnp-exc",
+            name="acnp-exc",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-a"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN,
+                    from_peer=cp.NetworkPolicyPeer(
+                        ip_blocks=[cp.IPBlock(cidr="10.0.0.0/8", excepts=("10.0.1.0/24",))]
+                    ),
+                    action=cp.RuleAction.DROP,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    o = Oracle(ps)
+    assert o.classify(pkt(POD_C, POD_A)).code == VerdictCode.DROP  # in cidr
+    assert o.classify(pkt(POD_B, POD_A)).code == VerdictCode.ALLOW  # in except
