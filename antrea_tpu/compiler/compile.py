@@ -1,0 +1,332 @@
+"""Rule compiler: PolicySet -> match tensors.
+
+This is the TPU analog of the reference's flow-generation layer: where
+pkg/agent/openflow/network_policy.go compiles PolicyRules into OVS
+conjunction(id, k/n) flows with shared conjMatchFlowContexts
+(/root/reference/pkg/agent/openflow/network_policy.go:325,:442), we compile
+the same rule structure into:
+
+  * an elementary-interval table over the u32 IP space with a bit-packed
+    per-interval group-membership matrix (the shared, factored address sets —
+    O(|addresses| + |rules|) storage, SURVEY.md section 2.6), and
+  * per-direction rule arrays whose ORDER encodes priority (tier, policy
+    priority, rule index, uid) — the tensor variant of OVS flow priorities,
+    sidestepping the reference's dynamic priority reassignment
+    (network_policy.go:1873 ReassignFlowPriorities) entirely: inserting a
+    rule is a recompile of cheap host-side arrays, not a priority shuffle.
+
+Evaluation phases are contiguous segments of the rule arrays:
+  [0, n_phase0)           Antrea-native non-Baseline rules, priority-sorted
+  [n_phase0, +n_k8s)      K8s NP allow rules (any-match semantics)
+  [.., +n_baseline)       Baseline-tier rules, priority-sorted
+
+Unsigned-compare note: packet IPs use the full u32 range, but TPUs want i32
+lanes; we flip the sign bit (x ^ 0x80000000) on both boundaries and packet
+columns so signed compares give unsigned order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..apis.controlplane import (
+    PROTO_SCTP,
+    PROTO_TCP,
+    PROTO_UDP,
+    Direction,
+    NetworkPolicy,
+    NetworkPolicyPeer,
+    NetworkPolicyRule,
+    RuleAction,
+    Service,
+)
+from ..utils import ip as iputil
+from .ir import PolicySet, rule_id
+
+# Action encoding shared with oracle.VerdictCode (+ PASS).
+ACT_ALLOW = 0
+ACT_DROP = 1
+ACT_REJECT = 2
+ACT_PASS = 3
+
+_ACTION_CODE = {
+    RuleAction.ALLOW: ACT_ALLOW,
+    RuleAction.DROP: ACT_DROP,
+    RuleAction.REJECT: ACT_REJECT,
+    RuleAction.PASS: ACT_PASS,
+}
+
+# Per-rule inline range slots (peers expressed as a few literal CIDR ranges
+# bypass the group bitmap; overflow folds into a content-addressed group).
+PEER_RANGE_SLOTS = 2
+
+FULL_SPACE = ((0, 1 << 32),)
+
+_PORT_PROTOS = (PROTO_TCP, PROTO_UDP, PROTO_SCTP)
+
+
+def _svc_key_ranges(services: list[Service]) -> tuple[tuple[int, int], ...]:
+    """Service list -> merged ranges over the (proto << 16 | dst_port) key.
+
+    Mirrors oracle._service_matches: ports constrain only TCP/UDP/SCTP;
+    other protocols match port-carrying entries unconditionally.
+    Empty list = match-all (types.go:299 Service semantics).
+    """
+    if not services:
+        return FULL_SPACE
+    ranges: list[tuple[int, int]] = []
+
+    def whole_proto(p: int):
+        ranges.append((p << 16, (p + 1) << 16))
+
+    for s in services:
+        protos = [s.protocol] if s.protocol is not None else list(range(256))
+        for p in protos:
+            if s.port is None or p not in _PORT_PROTOS:
+                whole_proto(p)
+            else:
+                hi = s.end_port if s.end_port is not None else s.port
+                # Arithmetic add, not OR: min(hi,65535)+1 can be 0x10000,
+                # which OR'd into p<<16 would corrupt the key for odd protos.
+                ranges.append(((p << 16) + s.port, (p << 16) + min(hi, 65535) + 1))
+    return _merge(ranges)
+
+
+def _merge(ranges: list[tuple[int, int]]) -> tuple[tuple[int, int], ...]:
+    ranges = sorted(ranges)
+    merged: list[tuple[int, int]] = []
+    for lo, hi in ranges:
+        if lo >= hi:
+            continue
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return tuple(merged)
+
+
+class _GroupSpace:
+    """Content-addressed range-set -> dense group-id space.
+
+    The dedup is the tensor analog of the reference's shared
+    conjMatchFlowContext cache (network_policy.go:342-400): identical address
+    sets used by many rules get one bitmap column, not one per rule.
+    """
+
+    def __init__(self) -> None:
+        self._ids: dict[tuple[tuple[int, int], ...], int] = {}
+        self.groups: list[tuple[tuple[int, int], ...]] = []
+        self.empty = self.intern(())
+        self.any = self.intern(FULL_SPACE)
+
+    def intern(self, ranges: tuple[tuple[int, int], ...]) -> int:
+        gid = self._ids.get(ranges)
+        if gid is None:
+            gid = len(self.groups)
+            self._ids[ranges] = gid
+            self.groups.append(ranges)
+        return gid
+
+    def build_tables(self) -> tuple[np.ndarray, np.ndarray]:
+        """-> (bounds (NB,) u64, bitmap (NB+1, ceil(G/32)) u32)."""
+        pts: set[int] = set()
+        for ranges in self.groups:
+            for lo, hi in ranges:
+                pts.add(lo)
+                if hi < (1 << 32):
+                    pts.add(hi)
+        bounds = np.array(sorted(pts), dtype=np.uint64)
+        n_iv = len(bounds) + 1
+        gw = max(1, (len(self.groups) + 31) // 32)
+        bitmap = np.zeros((n_iv, gw), dtype=np.uint32)
+        for gid, ranges in enumerate(self.groups):
+            w, b = gid >> 5, np.uint32(1 << (gid & 31))
+            for lo, hi in ranges:
+                start = int(np.searchsorted(bounds, lo, side="right"))
+                end = int(np.searchsorted(bounds, hi - 1, side="right"))
+                bitmap[start : end + 1, w] |= b
+        return bounds, bitmap
+
+
+@dataclass
+class DirectionTensors:
+    """Rule arrays for one direction; order == evaluation order."""
+
+    at_gid: np.ndarray  # (R,) i32 — appliedTo group (tested vs pod column)
+    peer_gid: np.ndarray  # (R,) i32 — peer group (tested vs peer column)
+    peer_lo: np.ndarray  # (R, PEER_RANGE_SLOTS) sign-flipped i32
+    peer_hi: np.ndarray  # (R, PEER_RANGE_SLOTS) sign-flipped i32, INCLUSIVE
+    # Inline-range match: lo <= ip <= hi (signed compare on flipped values).
+    # Inclusive his sidestep the hi == 2^32 unrepresentability; empty slots
+    # use lo > hi so they never match.
+    svc_gid: np.ndarray  # (R,) i32
+    action: np.ndarray  # (R,) i32
+    n_phase0: int
+    n_k8s: int
+    n_baseline: int
+    rule_ids: list[str] = field(default_factory=list)
+
+    @property
+    def n_rules(self) -> int:
+        return int(self.at_gid.shape[0])
+
+
+@dataclass
+class CompiledPolicySet:
+    """Everything the classification kernel needs, as host numpy arrays."""
+
+    ip_bounds: np.ndarray  # (NB,) i32, sign-flipped for unsigned order
+    ip_bitmap: np.ndarray  # (NB+1, GW) u32
+    svc_bounds: np.ndarray  # (SB,) i32 (keys < 2^24, no flip needed)
+    svc_bitmap: np.ndarray  # (SB+1, SW) u32
+    ingress: DirectionTensors
+    egress: DirectionTensors
+    iso_in_gid: int
+    iso_out_gid: int
+    n_ip_groups: int
+    n_svc_groups: int
+
+
+def _flip(a: np.ndarray) -> np.ndarray:
+    """u32 -> sign-flipped i32 preserving unsigned order under signed compare."""
+    return (a.astype(np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+
+
+def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
+    ip_space = _GroupSpace()
+    svc_space = _GroupSpace()
+
+    ag_ranges: dict[str, tuple[tuple[int, int], ...]] = {
+        name: tuple(g.ranges()) for name, g in ps.address_groups.items()
+    }
+    atg_ranges: dict[str, tuple[tuple[int, int], ...]] = {}
+    for name, g in ps.applied_to_groups.items():
+        atg_ranges[name] = _merge(
+            [iputil.cidr_to_range(m.ip) for m in g.members]
+        )
+
+    def applied_gid(policy: NetworkPolicy, rule: NetworkPolicyRule) -> int:
+        names = rule.applied_to_groups or policy.applied_to_groups
+        ranges: list[tuple[int, int]] = []
+        for n in names:
+            ranges.extend(atg_ranges.get(n, ()))
+        return ip_space.intern(_merge(ranges))
+
+    def peer_repr(peer: NetworkPolicyPeer):
+        """-> (gid, [(lo,hi)*<=SLOTS]) with overflow folded into the group."""
+        if peer.is_any:
+            return ip_space.any, []
+        block_ranges: list[tuple[int, int]] = []
+        for b in peer.ip_blocks:
+            block_ranges.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
+        group_ranges: list[tuple[int, int]] = []
+        for n in peer.address_groups:
+            group_ranges.extend(ag_ranges.get(n, ()))
+        if len(block_ranges) <= PEER_RANGE_SLOTS:
+            inline = block_ranges
+        else:
+            group_ranges.extend(block_ranges)
+            inline = []
+        gid = ip_space.intern(_merge(group_ranges)) if group_ranges else ip_space.empty
+        return gid, inline
+
+    # -- collect rules per direction, phase-tagged ---------------------------
+
+    rows: dict[Direction, dict[int, list]] = {
+        Direction.IN: {0: [], 1: [], 2: []},
+        Direction.OUT: {0: [], 1: [], 2: []},
+    }
+    for p in ps.policies:
+        for i, r in enumerate(p.rules):
+            if p.is_k8s:
+                phase, sort_key = 1, ()
+            elif p.is_baseline:
+                phase, sort_key = 2, (p.tier_priority, p.priority, r.priority, p.uid)
+            else:
+                phase, sort_key = 0, (p.tier_priority, p.priority, r.priority, p.uid)
+            gid, inline = peer_repr(r.peer)
+            row = (
+                sort_key,
+                applied_gid(p, r),
+                gid,
+                inline,
+                svc_space.intern(_svc_key_ranges(r.services)),
+                _ACTION_CODE[r.action],
+                rule_id(p, i),
+            )
+            rows[r.direction][phase].append(row)
+
+    # -- isolation groups (K8s default-deny membership) ----------------------
+
+    def iso_gid(direction: Direction) -> int:
+        ranges: list[tuple[int, int]] = []
+        for p in ps.policies:
+            if p.is_k8s and direction in p.policy_types:
+                for n in p.applied_to_groups:
+                    ranges.extend(atg_ranges.get(n, ()))
+        return ip_space.intern(_merge(ranges)) if ranges else ip_space.empty
+
+    iso_in = iso_gid(Direction.IN)
+    iso_out = iso_gid(Direction.OUT)
+
+    # -- emit per-direction arrays -------------------------------------------
+
+    def emit(direction: Direction) -> DirectionTensors:
+        ordered = []
+        for phase in (0, 1, 2):
+            seg = rows[direction][phase]
+            if phase != 1:
+                seg = sorted(seg, key=lambda t: t[0])
+            ordered.extend(seg)
+        n0 = len(rows[direction][0])
+        nk = len(rows[direction][1])
+        nb = len(rows[direction][2])
+        R = max(1, len(ordered))
+        at = np.full(R, ip_space.empty, dtype=np.int32)
+        pg = np.full(R, ip_space.empty, dtype=np.int32)
+        # Empty slots: lo=MAX, hi=0 -> lo > hi, never matches.
+        plo = np.full((R, PEER_RANGE_SLOTS), (1 << 32) - 1, dtype=np.uint32)
+        phi = np.zeros((R, PEER_RANGE_SLOTS), dtype=np.uint32)
+        sg = np.full(R, svc_space.empty, dtype=np.int32)
+        act = np.full(R, ACT_DROP, dtype=np.int32)
+        ids: list[str] = [""] * R
+        for j, (_, a, g, inline, s, ac, rid) in enumerate(ordered):
+            at[j], pg[j], sg[j], act[j], ids[j] = a, g, s, ac, rid
+            for k, (lo, hi) in enumerate(inline[:PEER_RANGE_SLOTS]):
+                plo[j, k] = lo
+                phi[j, k] = hi - 1  # inclusive upper bound
+        return DirectionTensors(
+            at_gid=at,
+            peer_gid=pg,
+            peer_lo=_flip(plo),
+            peer_hi=_flip(phi),
+            svc_gid=sg,
+            action=act,
+            n_phase0=n0,
+            n_k8s=nk,
+            n_baseline=nb,
+            rule_ids=ids,
+        )
+
+    # NOTE: emit() interns nothing new (all gids interned above), so tables
+    # built after emit are complete.
+    t_in = emit(Direction.IN)
+    t_out = emit(Direction.OUT)
+
+    ip_bounds64, ip_bitmap = ip_space.build_tables()
+    svc_bounds64, svc_bitmap = svc_space.build_tables()
+
+    return CompiledPolicySet(
+        ip_bounds=_flip(ip_bounds64.astype(np.uint32)),
+        ip_bitmap=ip_bitmap,
+        svc_bounds=svc_bounds64.astype(np.int32),
+        svc_bitmap=svc_bitmap,
+        ingress=t_in,
+        egress=t_out,
+        iso_in_gid=iso_in,
+        iso_out_gid=iso_out,
+        n_ip_groups=len(ip_space.groups),
+        n_svc_groups=len(svc_space.groups),
+    )

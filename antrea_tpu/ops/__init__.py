@@ -1,0 +1,1 @@
+from .match import DeviceRuleSet, classify_batch, make_classifier  # noqa: F401

@@ -1,0 +1,102 @@
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.compile import (
+    _svc_key_ranges,
+    compile_policy_set,
+)
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.simulator import gen_cluster
+from antrea_tpu.utils import ip as iputil
+
+
+def test_svc_key_ranges_any():
+    assert _svc_key_ranges([]) == ((0, 1 << 32),)
+
+
+def test_svc_key_ranges_tcp_port():
+    r = _svc_key_ranges([cp.Service(protocol=cp.PROTO_TCP, port=80)])
+    assert r == ((cp.PROTO_TCP << 16 | 80, cp.PROTO_TCP << 16 | 81),)
+
+
+def test_svc_key_ranges_port_range():
+    r = _svc_key_ranges([cp.Service(protocol=cp.PROTO_TCP, port=80, end_port=90)])
+    assert r == ((cp.PROTO_TCP << 16 | 80, cp.PROTO_TCP << 16 | 91),)
+
+
+def test_svc_key_ranges_port_65535():
+    # Regression: range ending at 65535 crosses into bit 16; OR-packing would
+    # corrupt the end key for odd protocol numbers (e.g. UDP=17).
+    r = _svc_key_ranges([cp.Service(protocol=cp.PROTO_UDP, port=60000, end_port=65535)])
+    key = cp.PROTO_UDP << 16 | 65535
+    assert any(lo <= key < hi for lo, hi in r)
+    r32 = _svc_key_ranges([cp.Service(protocol=cp.PROTO_UDP, port=65535)])
+    assert any(lo <= key < hi for lo, hi in r32)
+    assert not any(lo <= (cp.PROTO_UDP << 16 | 65534) < hi for lo, hi in r32)
+
+
+def test_svc_key_ranges_proto_only():
+    r = _svc_key_ranges([cp.Service(protocol=cp.PROTO_UDP)])
+    assert r == ((cp.PROTO_UDP << 16, (cp.PROTO_UDP + 1) << 16),)
+
+
+def test_svc_key_ranges_icmp_ignores_port():
+    r = _svc_key_ranges([cp.Service(protocol=cp.PROTO_ICMP, port=80)])
+    assert r == ((cp.PROTO_ICMP << 16, (cp.PROTO_ICMP + 1) << 16),)
+
+
+def test_svc_key_ranges_wildcard_proto_with_port():
+    # protocol=None + port: TCP/UDP/SCTP constrained, other protos full rows.
+    r = _svc_key_ranges([cp.Service(port=443)])
+    # ICMP (proto 1) full row must be covered:
+    key_icmp = cp.PROTO_ICMP << 16 | 7
+    assert any(lo <= key_icmp < hi for lo, hi in r)
+    # TCP port 443 in, 444 out:
+    assert any(lo <= (cp.PROTO_TCP << 16 | 443) < hi for lo, hi in r)
+    assert not any(lo <= (cp.PROTO_TCP << 16 | 444) < hi for lo, hi in r)
+
+
+def test_compile_dedupes_groups():
+    cluster = gen_cluster(500, seed=3)
+    cps = compile_policy_set(cluster.ps)
+    n_rules = cps.ingress.n_rules + cps.egress.n_rules
+    # Content-addressing must keep group count well below rule count.
+    assert cps.n_ip_groups < n_rules
+    assert cps.n_svc_groups < n_rules // 2
+    # Phase segment bookkeeping is consistent.
+    for d in (cps.ingress, cps.egress):
+        assert d.n_phase0 + d.n_k8s + d.n_baseline == len([r for r in d.rule_ids if r])
+
+
+def test_bitmap_membership_matches_scalar():
+    """Interval+bitmap membership == scalar range membership, for every group."""
+    cluster = gen_cluster(200, seed=11)
+    ps = cluster.ps
+    cps = compile_policy_set(ps)
+
+    bounds_u = (cps.ip_bounds.view(np.uint32) ^ np.uint32(0x80000000)).astype(np.uint64)
+    rng = np.random.default_rng(0)
+    samples = np.concatenate(
+        [
+            rng.integers(0, 1 << 32, size=256, dtype=np.uint64),
+            np.asarray(cluster.pod_ips[:128], dtype=np.uint64),
+        ]
+    )
+
+    # Rebuild the interned group ranges the same way the compiler does, then
+    # cross-check bitmap bits on random and pod IPs.
+    from antrea_tpu.compiler.compile import _GroupSpace  # noqa: PLC0415
+
+    space = _GroupSpace()
+    for g in ps.address_groups.values():
+        space.intern(tuple(g.ranges()))
+    bounds2, bitmap2 = space.build_tables()
+
+    for gid, ranges in enumerate(space.groups):
+        for ip in samples[:64]:
+            iv = int(np.searchsorted(bounds2, ip, side="right"))
+            got = bool((bitmap2[iv, gid >> 5] >> (gid & 31)) & 1)
+            want = any(lo <= ip < hi for lo, hi in ranges)
+            assert got == want, (gid, int(ip))
+
+    assert bounds_u.dtype == np.uint64  # sanity on flip round-trip
