@@ -100,14 +100,7 @@ class AddressGroup:
         ranges = [iputil.cidr_to_range(m.ip) for m in self.members]
         for b in self.ip_blocks:
             ranges.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
-        ranges.sort()
-        merged: list[tuple[int, int]] = []
-        for lo, hi in ranges:
-            if merged and lo <= merged[-1][1]:
-                merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
-            else:
-                merged.append((lo, hi))
-        return merged
+        return iputil.merge_ranges(ranges)
 
 
 @dataclass

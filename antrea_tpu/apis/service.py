@@ -1,0 +1,31 @@
+"""Service / endpoint types consumed by the proxy compiler.
+
+Semantic analog of what AntreaProxy consumes from k8s Services +
+EndpointSlices (ref: /root/reference/pkg/agent/proxy/proxier.go:73 and
+third_party/proxy types): a ClusterIP:port/proto frontend, a set of endpoint
+(ip, port) backends, and optional ClientIP session affinity with a timeout
+(ref: serviceLearnFlow, pkg/agent/openflow/pipeline.go:2316).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    ip: str
+    port: int
+
+
+@dataclass
+class ServiceEntry:
+    cluster_ip: str
+    port: int
+    protocol: int  # PROTO_TCP etc.
+    endpoints: list[Endpoint] = field(default_factory=list)
+    # 0 = no session affinity; else ClientIP affinity hard-timeout seconds
+    # (OVS learn-flow hard_timeout analog).
+    affinity_timeout_s: int = 0
+    name: str = ""
+    namespace: str = ""

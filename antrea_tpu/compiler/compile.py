@@ -94,17 +94,8 @@ def _svc_key_ranges(services: list[Service]) -> tuple[tuple[int, int], ...]:
     return _merge(ranges)
 
 
-def _merge(ranges: list[tuple[int, int]]) -> tuple[tuple[int, int], ...]:
-    ranges = sorted(ranges)
-    merged: list[tuple[int, int]] = []
-    for lo, hi in ranges:
-        if lo >= hi:
-            continue
-        if merged and lo <= merged[-1][1]:
-            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
-        else:
-            merged.append((lo, hi))
-    return tuple(merged)
+def _merge(ranges) -> tuple[tuple[int, int], ...]:
+    return tuple(iputil.merge_ranges(ranges))
 
 
 class _GroupSpace:
@@ -187,11 +178,11 @@ class CompiledPolicySet:
     iso_out_gid: int
     n_ip_groups: int
     n_svc_groups: int
+    # Introspection: named AddressGroup -> ip-group id (bitmap column).
+    ag_gids: dict[str, int] = field(default_factory=dict)
 
 
-def _flip(a: np.ndarray) -> np.ndarray:
-    """u32 -> sign-flipped i32 preserving unsigned order under signed compare."""
-    return (a.astype(np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+_flip = iputil.flip_u32
 
 
 def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
@@ -201,6 +192,9 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
     ag_ranges: dict[str, tuple[tuple[int, int], ...]] = {
         name: tuple(g.ranges()) for name, g in ps.address_groups.items()
     }
+    # Intern every named group up front (content-addressed: free if a peer
+    # also interns the same ranges) so each has a stable bitmap column.
+    ag_gids = {name: ip_space.intern(r) for name, r in ag_ranges.items()}
     atg_ranges: dict[str, tuple[tuple[int, int], ...]] = {}
     for name, g in ps.applied_to_groups.items():
         atg_ranges[name] = _merge(
@@ -329,4 +323,5 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
         iso_out_gid=iso_out,
         n_ip_groups=len(ip_space.groups),
         n_svc_groups=len(svc_space.groups),
+        ag_gids=ag_gids,
     )

@@ -1,0 +1,94 @@
+"""Service table compiler: ServiceEntry list -> lookup tensors.
+
+The tensor analog of AntreaProxy's OVS state: the ServiceLB table's
+ClusterIP:port match flows and the per-service endpoint group buckets
+(ref: /root/reference/pkg/agent/proxy/proxier.go:986 syncProxyRules ->
+installServiceGroup/installServiceFlows; group buckets in
+pkg/agent/openflow/pipeline.go serviceEndpointGroup).
+
+Lookup is two-stage exact match (no i64 keys on TPU):
+  1. binary search the sorted unique frontend IPs;
+  2. compare (proto<<16|port) against that IP's padded slot row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..apis.service import ServiceEntry
+from ..utils import ip as iputil
+
+MAX_PORTS_PER_IP = 16
+MAX_ENDPOINTS = 64
+
+
+_flip = iputil.flip_u32
+
+
+@dataclass
+class ServiceTables:
+    uip_f: np.ndarray  # (NU,) sorted sign-flipped i32 unique frontend IPs
+    ppk: np.ndarray  # (NU, MAX_PORTS_PER_IP) i32 (proto<<16|port), -1 empty
+    slot_svc: np.ndarray  # (NU, MAX_PORTS_PER_IP) i32 service index, -1 empty
+    n_ep: np.ndarray  # (S,) i32 (>=1 rows padded with 1 to avoid mod-0)
+    has_ep: np.ndarray  # (S,) i32 0/1 — services with no endpoints drop
+    aff_timeout: np.ndarray  # (S,) i32 seconds, 0 = off
+    ep_ip_f: np.ndarray  # (S, MAX_ENDPOINTS) sign-flipped i32
+    ep_port: np.ndarray  # (S, MAX_ENDPOINTS) i32
+    names: list[str]
+
+    @property
+    def n_services(self) -> int:
+        return int(self.n_ep.shape[0])
+
+
+def compile_services(services: list[ServiceEntry]) -> ServiceTables:
+    S = max(1, len(services))
+    n_ep = np.ones(S, dtype=np.int32)
+    has_ep = np.zeros(S, dtype=np.int32)
+    aff = np.zeros(S, dtype=np.int32)
+    ep_ip = np.zeros((S, MAX_ENDPOINTS), dtype=np.uint32)
+    ep_port = np.zeros((S, MAX_ENDPOINTS), dtype=np.int32)
+    names: list[str] = [""] * S
+
+    by_ip: dict[int, list[tuple[int, int]]] = {}
+    for si, svc in enumerate(services):
+        ip_u = iputil.ip_to_u32(svc.cluster_ip)
+        key = (svc.protocol << 16) + svc.port
+        by_ip.setdefault(ip_u, []).append((key, si))
+        eps = svc.endpoints[:MAX_ENDPOINTS]
+        n_ep[si] = max(1, len(eps))
+        has_ep[si] = 1 if eps else 0
+        aff[si] = svc.affinity_timeout_s
+        for k, ep in enumerate(eps):
+            ep_ip[si, k] = iputil.ip_to_u32(ep.ip)
+            ep_port[si, k] = ep.port
+        names[si] = f"{svc.namespace}/{svc.name}" if svc.name else f"svc-{si}"
+
+    NU = max(1, len(by_ip))
+    uips = np.zeros(NU, dtype=np.uint32)
+    ppk = np.full((NU, MAX_PORTS_PER_IP), -1, dtype=np.int32)
+    slot_svc = np.full((NU, MAX_PORTS_PER_IP), -1, dtype=np.int32)
+    for row, ip_u in enumerate(sorted(by_ip)):
+        uips[row] = ip_u
+        entries = by_ip[ip_u][:MAX_PORTS_PER_IP]
+        for col, (key, si) in enumerate(entries):
+            ppk[row, col] = key
+            slot_svc[row, col] = si
+
+    # Sort rows by flipped key so device-side searchsorted over i32 works.
+    uip_f = _flip(uips)
+    order = np.argsort(uip_f, kind="stable")
+    return ServiceTables(
+        uip_f=uip_f[order],
+        ppk=ppk[order],
+        slot_svc=slot_svc[order],
+        n_ep=n_ep,
+        has_ep=has_ep,
+        aff_timeout=aff,
+        ep_ip_f=_flip(ep_ip),
+        ep_port=ep_port,
+        names=names,
+    )

@@ -1,0 +1,1 @@
+from .pipeline import PipelineState, make_pipeline, init_state  # noqa: F401

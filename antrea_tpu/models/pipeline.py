@@ -1,0 +1,315 @@
+"""The tpuflow staged datapath pipeline (the flagship "model").
+
+One jitted step processes a packet batch through the stage sequence the
+reference realizes as OVS tables
+(/root/reference/pkg/agent/openflow/framework.go:96-118 stages,
+pipeline.go:114-195 tables), re-expressed as batched tensor transforms:
+
+  ConntrackState   device conn-table lookup; established (-new+est) bypasses
+                   all policy tables, reproducing the ct_state semantics in
+                   docs/design/ovs-pipeline.md:1685-1691.
+  ServiceLB        exact-match frontend lookup + endpoint selection: session
+                   affinity (learn-flow analog, pipeline.go:2316) or 5-tuple
+                   hash over the endpoint buckets (group select analog);
+                   no-endpoint services reject (SvcReject packet-in analog).
+  EndpointDNAT     rewrite dst to the chosen endpoint (ct(commit,nat) analog).
+  Egress/Ingress   the conjunctive-match classification kernel (ops/match)
+  security         on the POST-DNAT tuple (PreRouting precedes EgressSecurity
+                   in the reference's stage order).
+  ConntrackCommit  allowed new connections enter the conn table (batched
+                   scatter) => subsequent packets take the est fast path.
+
+State (conn table + affinity table) is carried functionally: step(state, ...)
+-> (state', verdicts).  Tables are direct-mapped hash tables in device memory;
+a slot collision evicts (cache semantics — correctness is preserved because a
+miss just re-classifies, and endpoint choice is a deterministic hash).
+
+Batch semantics are "simultaneous arrival": lookups see the state at batch
+start, commits apply at batch end.  Within-batch same-slot writes are
+last-writer-wins (enforced deterministically, see _scatter_last).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.compile import ACT_ALLOW, ACT_REJECT, CompiledPolicySet
+from ..compiler.services import ServiceTables
+from ..ops import hashing
+from ..ops.match import DeviceRuleSet, StaticMeta, classify_batch, to_device
+
+MISS = jnp.int32(-1)
+
+
+class ConnTable(NamedTuple):
+    """Direct-mapped connection table; row N (the last) is a write dump for
+    masked-out scatters."""
+
+    key_src: jax.Array  # (N+1,) i32 flipped bits
+    key_dst: jax.Array
+    key_pp: jax.Array  # sport<<16 | dport
+    key_proto: jax.Array
+    valid: jax.Array  # (N+1,) i32 0/1
+    dnat_ip_f: jax.Array  # resolved post-DNAT dst
+    dnat_port: jax.Array
+    ts: jax.Array  # last-seen seconds
+
+
+class AffinityTable(NamedTuple):
+    key_client: jax.Array  # (M+1,) i32 flipped bits
+    key_svc: jax.Array  # (M+1,) i32
+    valid: jax.Array
+    ep: jax.Array  # endpoint slot index within the service bucket row
+    ts: jax.Array  # creation seconds (hard timeout, no refresh — learn-flow)
+
+
+class PipelineState(NamedTuple):
+    conn: ConnTable
+    aff: AffinityTable
+
+
+class DeviceServiceTables(NamedTuple):
+    uip_f: jax.Array
+    ppk: jax.Array
+    slot_svc: jax.Array
+    n_ep: jax.Array
+    has_ep: jax.Array
+    aff_timeout: jax.Array
+    ep_ip_f: jax.Array
+    ep_port: jax.Array
+
+
+class PipelineMeta(NamedTuple):
+    match: StaticMeta
+    conn_slots: int
+    aff_slots: int
+    ct_timeout_s: int
+
+
+def svc_to_device(st: ServiceTables) -> DeviceServiceTables:
+    return DeviceServiceTables(
+        uip_f=jnp.asarray(st.uip_f),
+        ppk=jnp.asarray(st.ppk),
+        slot_svc=jnp.asarray(st.slot_svc),
+        n_ep=jnp.asarray(st.n_ep),
+        has_ep=jnp.asarray(st.has_ep),
+        aff_timeout=jnp.asarray(st.aff_timeout),
+        ep_ip_f=jnp.asarray(st.ep_ip_f),
+        ep_port=jnp.asarray(st.ep_port),
+    )
+
+
+def init_state(conn_slots: int = 1 << 20, aff_slots: int = 1 << 18) -> PipelineState:
+    def zeros(n):
+        return jnp.zeros(n + 1, dtype=jnp.int32)
+
+    conn = ConnTable(
+        key_src=zeros(conn_slots),
+        key_dst=zeros(conn_slots),
+        key_pp=zeros(conn_slots),
+        key_proto=zeros(conn_slots),
+        valid=zeros(conn_slots),
+        dnat_ip_f=zeros(conn_slots),
+        dnat_port=zeros(conn_slots),
+        ts=zeros(conn_slots),
+    )
+    aff = AffinityTable(
+        key_client=zeros(aff_slots),
+        key_svc=zeros(aff_slots),
+        valid=zeros(aff_slots),
+        ep=zeros(aff_slots),
+        ts=zeros(aff_slots),
+    )
+    return PipelineState(conn=conn, aff=aff)
+
+
+def _raw_bits(x_f: jax.Array) -> jax.Array:
+    """Sign-flipped i32 -> i32 whose u32 reinterpretation is the raw value."""
+    return x_f ^ jnp.int32(-(2**31))
+
+
+def _scatter_last(arr: jax.Array, slots: jax.Array, vals: jax.Array, mask: jax.Array, dump: int):
+    """Masked scatter with deterministic last-writer-wins on duplicate slots.
+
+    XLA leaves overlapping scatter order unspecified; we disambiguate by
+    scattering the winning batch index first (max wins), then gathering each
+    slot's winner's value.  Cost: one extra scatter+gather — negligible next
+    to the rule scan.
+    """
+    B = slots.shape[0]
+    slots_m = jnp.where(mask, slots, dump)
+    order = jnp.arange(B, dtype=jnp.int32)
+    winner = jnp.full(arr.shape[0], -1, dtype=jnp.int32).at[slots_m].max(order)
+    win_idx = winner[slots_m]  # (B,) winning batch index for my slot
+    is_winner = (win_idx == order) & mask
+    return arr.at[jnp.where(is_winner, slots, dump)].set(vals)
+
+
+def make_pipeline(
+    cps: CompiledPolicySet,
+    svc: ServiceTables,
+    *,
+    chunk: int = 512,
+    conn_slots: int = 1 << 20,
+    aff_slots: int = 1 << 18,
+    ct_timeout_s: int = 3600,
+):
+    """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
+
+    step(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now) ->
+    (state', out dict).  drs/dsvc are explicit args so a control-plane bundle
+    commit is just "call with the new tensors" — the double-buffered rule-swap
+    analog of OVS bundle transactions (ofctrl_bridge.go:468).
+    """
+    drs, match_meta = to_device(cps, chunk)
+    dsvc = svc_to_device(svc)
+    meta = PipelineMeta(
+        match=match_meta,
+        conn_slots=conn_slots,
+        aff_slots=aff_slots,
+        ct_timeout_s=ct_timeout_s,
+    )
+    state = init_state(conn_slots, aff_slots)
+
+    def step(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now):
+        return pipeline_step(
+            state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, meta=meta
+        )
+
+    return step, state, (drs, dsvc)
+
+
+def _pipeline_step(
+    state: PipelineState,
+    drs: DeviceRuleSet,
+    dsvc: DeviceServiceTables,
+    src_f: jax.Array,
+    dst_f: jax.Array,
+    proto: jax.Array,
+    sport: jax.Array,
+    dport: jax.Array,
+    now: jax.Array,  # scalar i32 seconds
+    *,
+    meta: PipelineMeta,
+):
+    conn, aff = state.conn, state.aff
+    B = src_f.shape[0]
+
+    src_raw = _raw_bits(src_f)
+    dst_raw = _raw_bits(dst_f)
+    pp = (sport << 16) | dport
+
+    # ---- ConntrackState: lookup -------------------------------------------
+    h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
+    slot = (h & jnp.uint32(meta.conn_slots - 1)).astype(jnp.int32)
+    ct_key_hit = (
+        (conn.valid[slot] == 1)
+        & (conn.key_src[slot] == src_f)
+        & (conn.key_dst[slot] == dst_f)
+        & (conn.key_pp[slot] == pp)
+        & (conn.key_proto[slot] == proto)
+    )
+    fresh = (now - conn.ts[slot]) <= meta.ct_timeout_s
+    est = ct_key_hit & fresh
+
+    # ---- ServiceLB + EndpointDNAT -----------------------------------------
+    row = jnp.searchsorted(dsvc.uip_f, dst_f, side="left")
+    row = jnp.clip(row, 0, dsvc.uip_f.shape[0] - 1)
+    ip_is_svc = dsvc.uip_f[row] == dst_f
+    key = (proto << 16) + dport
+    slot_eq = dsvc.ppk[row] == key[:, None]  # (B, MAXP)
+    slot_found = slot_eq.any(axis=1)
+    slot_col = jnp.argmax(slot_eq, axis=1)
+    svc_idx = jnp.where(
+        ip_is_svc & slot_found, dsvc.slot_svc[row, slot_col], MISS
+    )
+    is_svc = svc_idx >= 0
+    svc_safe = jnp.clip(svc_idx, 0, dsvc.n_ep.shape[0] - 1)
+    no_ep = is_svc & (dsvc.has_ep[svc_safe] == 0)
+
+    # Session affinity lookup (ClientIP affinity, hard timeout).
+    aff_on = is_svc & (dsvc.aff_timeout[svc_safe] > 0)
+    ah = hashing.fnv_mix([src_raw, svc_safe], xp=jnp)
+    aslot = (ah & jnp.uint32(meta.aff_slots - 1)).astype(jnp.int32)
+    aff_key_hit = (
+        (aff.valid[aslot] == 1)
+        & (aff.key_client[aslot] == src_f)
+        & (aff.key_svc[aslot] == svc_idx)
+    )
+    aff_fresh = (now - aff.ts[aslot]) <= dsvc.aff_timeout[svc_safe]
+    aff_hit = aff_on & aff_key_hit & aff_fresh
+
+    hash_ep = (h.astype(jnp.int32) & jnp.int32(0x7FFFFFFF)) % dsvc.n_ep[svc_safe]
+    ep_col = jnp.where(aff_hit, aff.ep[aslot], hash_ep)
+    ep_col = jnp.clip(ep_col, 0, dsvc.ep_ip_f.shape[1] - 1)
+
+    dnat_ip_new = jnp.where(is_svc & ~no_ep, dsvc.ep_ip_f[svc_safe, ep_col], dst_f)
+    dnat_port_new = jnp.where(is_svc & ~no_ep, dsvc.ep_port[svc_safe, ep_col], dport)
+
+    # Established connections reuse the committed NAT resolution.
+    dnat_ip = jnp.where(est, conn.dnat_ip_f[slot], dnat_ip_new)
+    dnat_port = jnp.where(est, conn.dnat_port[slot], dnat_port_new)
+
+    # ---- Egress/Ingress security (post-DNAT tuple) ------------------------
+    cls = classify_batch(drs, src_f, dnat_ip, proto, dnat_port, meta=meta.match)
+
+    # ---- verdict resolution ----------------------------------------------
+    # est bypass: -new+est traffic skips policy tables (ovs-pipeline.md:1685).
+    # no-endpoint services reject before policy (SvcReject).
+    code = jnp.where(
+        est,
+        ACT_ALLOW,
+        jnp.where(no_ep, ACT_REJECT, cls["code"]),
+    ).astype(jnp.int32)
+
+    # ---- ConntrackCommit ---------------------------------------------------
+    commit = (~est) & (code == ACT_ALLOW)
+    dump = meta.conn_slots
+    conn = ConnTable(
+        key_src=_scatter_last(conn.key_src, slot, src_f, commit, dump),
+        key_dst=_scatter_last(conn.key_dst, slot, dst_f, commit, dump),
+        key_pp=_scatter_last(conn.key_pp, slot, pp, commit, dump),
+        key_proto=_scatter_last(conn.key_proto, slot, proto, commit, dump),
+        valid=_scatter_last(conn.valid, slot, jnp.ones(B, jnp.int32), commit, dump),
+        dnat_ip_f=_scatter_last(conn.dnat_ip_f, slot, dnat_ip, commit, dump),
+        dnat_port=_scatter_last(conn.dnat_port, slot, dnat_port, commit, dump),
+        ts=_scatter_last(conn.ts, slot, jnp.full(B, now, jnp.int32), commit, dump),
+    )
+    # Refresh last-seen on established hits (idle-timeout semantics).
+    refresh_slot = jnp.where(est, slot, dump)
+    conn = conn._replace(ts=conn.ts.at[refresh_slot].set(now))
+
+    # Affinity learn: new service packets on affinity services without a live
+    # entry learn their endpoint — before policy verdict, like the OVS learn
+    # action in ServiceLB (pipeline.go:2316).
+    learn = (~est) & aff_on & ~aff_hit & ~no_ep
+    adump = meta.aff_slots
+    aff = AffinityTable(
+        key_client=_scatter_last(aff.key_client, aslot, src_f, learn, adump),
+        key_svc=_scatter_last(aff.key_svc, aslot, svc_idx, learn, adump),
+        valid=_scatter_last(aff.valid, aslot, jnp.ones(B, jnp.int32), learn, adump),
+        ep=_scatter_last(aff.ep, aslot, ep_col, learn, adump),
+        ts=_scatter_last(aff.ts, aslot, jnp.full(B, now, jnp.int32), learn, adump),
+    )
+
+    out = {
+        "code": code,
+        "est": est.astype(jnp.int32),
+        "svc_idx": svc_idx,
+        "dnat_ip_f": dnat_ip,
+        "dnat_port": dnat_port,
+        "egress_code": jnp.where(est, ACT_ALLOW, cls["egress_code"]),
+        "egress_rule": jnp.where(est, MISS, cls["egress_rule"]),
+        "ingress_code": jnp.where(est, ACT_ALLOW, cls["ingress_code"]),
+        "ingress_rule": jnp.where(est, MISS, cls["ingress_rule"]),
+        "committed": commit.astype(jnp.int32),
+    }
+    return PipelineState(conn=conn, aff=aff), out
+
+
+# jit wrapper: meta is static.
+pipeline_step = jax.jit(_pipeline_step, static_argnames=("meta",))

@@ -36,6 +36,7 @@ from ..compiler.compile import (
     CompiledPolicySet,
     DirectionTensors,
 )
+from ..utils import ip as iputil
 
 BIG = jnp.int32(1 << 30)  # "no match" sentinel for first-match indices
 
@@ -276,7 +277,7 @@ def classify_batch(
 
 def flip_ips(a: np.ndarray) -> np.ndarray:
     """Host helper: u32 IP array -> sign-flipped i32 (kernel input layout)."""
-    return (np.asarray(a, dtype=np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+    return iputil.flip_u32(a)
 
 
 # meta is static (plain ints/tuples, hashable); drs is a traced pytree arg so

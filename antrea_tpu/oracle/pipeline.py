@@ -1,0 +1,165 @@
+"""Scalar full-pipeline oracle: conntrack + service LB + policy.
+
+Extends the policy oracle with the stateful stages, using the SAME hash
+functions and the SAME direct-mapped slot discipline as the device pipeline
+(models/pipeline.py) so parity is exact, including eviction behavior.
+
+Batch semantics match the device: a batch is "simultaneous arrival" —
+lookups see start-of-batch state; commits/learns/refreshes apply afterwards
+in batch order (last writer wins on slot collisions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..apis.service import ServiceEntry
+from ..compiler.compile import ACT_ALLOW, ACT_REJECT
+from ..compiler.ir import PolicySet
+from ..ops import hashing
+from ..packet import Packet, PacketBatch
+from ..utils import ip as iputil
+from .interpreter import Oracle
+
+
+@dataclass
+class ScalarOutcome:
+    code: int
+    est: bool
+    svc_idx: int  # -1 none
+    dnat_ip: int  # raw u32
+    dnat_port: int
+    egress_rule: Optional[str]
+    ingress_rule: Optional[str]
+    committed: bool
+
+
+class PipelineOracle:
+    def __init__(
+        self,
+        ps: PolicySet,
+        services: list[ServiceEntry],
+        *,
+        conn_slots: int = 1 << 20,
+        aff_slots: int = 1 << 18,
+        ct_timeout_s: int = 3600,
+    ):
+        self.oracle = Oracle(ps)
+        self.services = services
+        self.conn_slots = conn_slots
+        self.aff_slots = aff_slots
+        self.ct_timeout_s = ct_timeout_s
+        self.svc_by_key: dict[tuple[int, int, int], int] = {}
+        for i, s in enumerate(services):
+            self.svc_by_key[(iputil.ip_to_u32(s.cluster_ip), s.protocol, s.port)] = i
+        self.conn: dict[int, dict] = {}
+        self.aff: dict[int, dict] = {}
+
+    def _flow_hash(self, p: Packet) -> int:
+        return int(
+            hashing.flow_hash(
+                np.uint32(p.src_ip), np.uint32(p.dst_ip), p.proto, p.src_port, p.dst_port
+            )
+        )
+
+    def step(self, batch: PacketBatch, now: int) -> list[ScalarOutcome]:
+        conn0 = {k: dict(v) for k, v in self.conn.items()}
+        aff0 = {k: dict(v) for k, v in self.aff.items()}
+        outs: list[ScalarOutcome] = []
+        commits: list[tuple[int, dict]] = []
+        refreshes: list[int] = []
+        learns: list[tuple[int, dict]] = []
+
+        for i in range(batch.size):
+            p = batch.packet(i)
+            h = self._flow_hash(p)
+            slot = h & (self.conn_slots - 1)
+            e = conn0.get(slot)
+            key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
+            est = (
+                e is not None
+                and e["key"] == key
+                and (now - e["ts"]) <= self.ct_timeout_s
+            )
+
+            svc_idx = self.svc_by_key.get((p.dst_ip, p.proto, p.dst_port), -1)
+            svc = self.services[svc_idx] if svc_idx >= 0 else None
+            no_ep = svc is not None and not svc.endpoints
+
+            dnat_ip, dnat_port = p.dst_ip, p.dst_port
+            aff_learn: Optional[tuple[int, dict]] = None
+            if est:
+                dnat_ip, dnat_port = e["dnat_ip"], e["dnat_port"]
+            elif svc is not None and not no_ep:
+                n_ep = len(svc.endpoints)
+                ep_col = (h & 0x7FFFFFFF) % max(1, n_ep)
+                if svc.affinity_timeout_s > 0:
+                    ah = int(hashing.fnv_mix([np.uint32(p.src_ip), np.uint32(svc_idx)]))
+                    aslot = ah & (self.aff_slots - 1)
+                    ae = aff0.get(aslot)
+                    if (
+                        ae is not None
+                        and ae["client"] == p.src_ip
+                        and ae["svc"] == svc_idx
+                        and (now - ae["ts"]) <= svc.affinity_timeout_s
+                    ):
+                        ep_col = ae["ep"]
+                    else:
+                        aff_learn = (aslot, {"client": p.src_ip, "svc": svc_idx,
+                                             "ep": ep_col, "ts": now})
+                ep = svc.endpoints[ep_col]
+                dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
+
+            if est:
+                outs.append(
+                    ScalarOutcome(ACT_ALLOW, True, svc_idx, dnat_ip, dnat_port,
+                                  None, None, False)
+                )
+                refreshes.append(slot)
+                continue
+
+            if no_ep:
+                outs.append(
+                    ScalarOutcome(ACT_REJECT, False, svc_idx, dnat_ip, dnat_port,
+                                  None, None, False)
+                )
+                if aff_learn:
+                    learns.append(aff_learn)
+                continue
+
+            v = self.oracle.classify(
+                Packet(
+                    src_ip=p.src_ip,
+                    dst_ip=dnat_ip,
+                    proto=p.proto,
+                    src_port=p.src_port,
+                    dst_port=dnat_port,
+                )
+            )
+            committed = v.code == 0
+            outs.append(
+                ScalarOutcome(
+                    int(v.code), False, svc_idx, dnat_ip, dnat_port,
+                    v.egress.rule, v.ingress.rule, committed
+                )
+            )
+            if committed:
+                commits.append(
+                    (slot, {"key": key, "dnat_ip": dnat_ip, "dnat_port": dnat_port,
+                            "ts": now})
+                )
+            if aff_learn:
+                learns.append(aff_learn)
+
+        # Apply state mutations in batch order (last writer wins).
+        for slot, entry in commits:
+            self.conn[slot] = entry
+        for slot in refreshes:
+            if slot in self.conn:
+                self.conn[slot]["ts"] = now
+        for aslot, entry in learns:
+            self.aff[aslot] = entry
+        return outs

@@ -1,2 +1,3 @@
 from .genpolicy import SyntheticCluster, gen_cluster  # noqa: F401
+from .genservice import gen_services  # noqa: F401
 from .traffic import gen_traffic  # noqa: F401

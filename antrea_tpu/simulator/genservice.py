@@ -1,0 +1,49 @@
+"""Synthetic Service generator (the AntreaProxy test-config side:
+BASELINE config 3 — ClusterIP services with endpoint selection + affinity)."""
+
+from __future__ import annotations
+
+import random
+
+from ..apis.controlplane import PROTO_TCP, PROTO_UDP
+from ..apis.service import Endpoint, ServiceEntry
+from ..utils import ip as iputil
+
+
+def gen_services(
+    n_services: int,
+    pod_ips: list[int],
+    *,
+    max_endpoints: int = 8,
+    affinity_fraction: float = 0.3,
+    no_ep_fraction: float = 0.02,
+    seed: int = 0,
+) -> list[ServiceEntry]:
+    rng = random.Random(seed)
+    out: list[ServiceEntry] = []
+    for i in range(n_services):
+        # Service CIDR analog: 10.96.0.0/12-style frontend space, disjoint
+        # from the pod CIDRs used by simulator.genpolicy.
+        ip = f"10.{96 + (i // 65536)}.{(i // 256) % 256}.{i % 256}"
+        proto = PROTO_TCP if rng.random() < 0.9 else PROTO_UDP
+        port = rng.choice([80, 443, 8080, 9090, 5432, rng.randrange(1024, 32768)])
+        if rng.random() < no_ep_fraction:
+            eps = []
+        else:
+            n_ep = rng.randrange(1, max_endpoints + 1)
+            eps = [
+                Endpoint(ip=iputil.u32_to_ip(rng.choice(pod_ips)), port=rng.choice([8080, 80, 9376]))
+                for _ in range(n_ep)
+            ]
+        out.append(
+            ServiceEntry(
+                cluster_ip=ip,
+                port=port,
+                protocol=proto,
+                endpoints=eps,
+                affinity_timeout_s=300 if rng.random() < affinity_fraction else 0,
+                name=f"svc-{i}",
+                namespace=f"ns-{i % 32}",
+            )
+        )
+    return out

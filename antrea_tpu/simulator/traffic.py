@@ -23,6 +23,8 @@ def gen_traffic(
     pod_to_pod_fraction: float = 0.8,
     zipf_a: float = 1.2,
     seed: int = 0,
+    services=None,  # optional list[ServiceEntry]; a share of flows target them
+    svc_fraction: float = 0.3,
 ) -> PacketBatch:
     rng = np.random.default_rng(seed)
     pods = np.asarray(pod_ips, dtype=np.uint32)
@@ -42,6 +44,20 @@ def gen_traffic(
         rng.choice(common, size=n_flows),
         rng.integers(1, 65536, size=n_flows),
     ).astype(np.int32)
+
+    if services:
+        from ..utils import ip as iputil
+
+        pick = rng.integers(0, len(services), size=n_flows)
+        svc_ip = np.array(
+            [iputil.ip_to_u32(s.cluster_ip) for s in services], dtype=np.uint32
+        )[pick]
+        svc_port = np.array([s.port for s in services], dtype=np.int32)[pick]
+        svc_proto = np.array([s.protocol for s in services], dtype=np.int32)[pick]
+        to_svc = rng.random(n_flows) < svc_fraction
+        f_dst = np.where(to_svc, svc_ip, f_dst)
+        f_dport = np.where(to_svc, svc_port, f_dport)
+        f_proto = np.where(to_svc, svc_proto, f_proto)
 
     # Zipf draw over flows -> batch indices.
     idx = (rng.zipf(zipf_a, size=batch) - 1) % n_flows

@@ -44,16 +44,26 @@ def cidr_to_range(cidr: str) -> tuple[int, int]:
     return lo, lo + size
 
 
-def cidrs_to_ranges(cidrs: Iterable[str]) -> list[tuple[int, int]]:
-    """CIDR list -> sorted, merged half-open ranges (set semantics: union)."""
-    ranges = sorted(cidr_to_range(c) for c in cidrs)
+def merge_ranges(ranges: Iterable[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Sort + merge half-open ranges; drops empty (lo >= hi) ranges.
+
+    The single merge implementation shared by the oracle, the compiler and
+    the group machinery — they must agree on range semantics exactly.
+    """
     merged: list[tuple[int, int]] = []
-    for lo, hi in ranges:
+    for lo, hi in sorted(ranges):
+        if lo >= hi:
+            continue
         if merged and lo <= merged[-1][1]:
             merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
         else:
             merged.append((lo, hi))
     return merged
+
+
+def cidrs_to_ranges(cidrs: Iterable[str]) -> list[tuple[int, int]]:
+    """CIDR list -> sorted, merged half-open ranges (set semantics: union)."""
+    return merge_ranges(cidr_to_range(c) for c in cidrs)
 
 
 def ipblock_to_ranges(cidr: str, excepts: Iterable[str] = ()) -> list[tuple[int, int]]:
@@ -79,3 +89,12 @@ def ipblock_to_ranges(cidr: str, excepts: Iterable[str] = ()) -> list[tuple[int,
 
 def ip_in_ranges(ip_u32: int, ranges: Iterable[tuple[int, int]]) -> bool:
     return any(lo <= ip_u32 < hi for lo, hi in ranges)
+
+
+def flip_u32(a):
+    """u32 array -> sign-flipped i32 preserving unsigned order under signed
+    compares.  THE encoding contract between compiler and kernels: every
+    device-side IP/bound is stored flipped; keep exactly one implementation."""
+    import numpy as np
+
+    return (np.asarray(a, dtype=np.uint32) ^ np.uint32(0x80000000)).view(np.int32)

@@ -69,34 +69,39 @@ def test_compile_dedupes_groups():
 
 
 def test_bitmap_membership_matches_scalar():
-    """Interval+bitmap membership == scalar range membership, for every group."""
+    """The ACTUAL compiled interval table + bitmap must agree with scalar
+    range membership for every named address group, on random IPs, pod IPs,
+    and exact interval boundaries (the edge-sensitive values)."""
     cluster = gen_cluster(200, seed=11)
     ps = cluster.ps
     cps = compile_policy_set(ps)
 
+    # Un-flip the device bounds back to unsigned space.
     bounds_u = (cps.ip_bounds.view(np.uint32) ^ np.uint32(0x80000000)).astype(np.uint64)
+    assert (np.diff(bounds_u.astype(np.int64)) > 0).all()  # sorted, unique
+
     rng = np.random.default_rng(0)
-    samples = np.concatenate(
-        [
-            rng.integers(0, 1 << 32, size=256, dtype=np.uint64),
-            np.asarray(cluster.pod_ips[:128], dtype=np.uint64),
-        ]
+    samples = np.unique(
+        np.concatenate(
+            [
+                rng.integers(0, 1 << 32, size=256, dtype=np.uint64),
+                np.asarray(cluster.pod_ips, dtype=np.uint64),
+                bounds_u,  # exact boundaries
+                np.clip(bounds_u.astype(np.int64) - 1, 0, None).astype(np.uint64),
+                np.array([0, (1 << 32) - 1], dtype=np.uint64),
+            ]
+        )
     )
+    ivs = np.searchsorted(bounds_u, samples, side="right")
 
-    # Rebuild the interned group ranges the same way the compiler does, then
-    # cross-check bitmap bits on random and pod IPs.
-    from antrea_tpu.compiler.compile import _GroupSpace  # noqa: PLC0415
-
-    space = _GroupSpace()
-    for g in ps.address_groups.values():
-        space.intern(tuple(g.ranges()))
-    bounds2, bitmap2 = space.build_tables()
-
-    for gid, ranges in enumerate(space.groups):
-        for ip in samples[:64]:
-            iv = int(np.searchsorted(bounds2, ip, side="right"))
-            got = bool((bitmap2[iv, gid >> 5] >> (gid & 31)) & 1)
-            want = any(lo <= ip < hi for lo, hi in ranges)
-            assert got == want, (gid, int(ip))
-
-    assert bounds_u.dtype == np.uint64  # sanity on flip round-trip
+    checked = 0
+    for name, g in ps.address_groups.items():
+        gid = cps.ag_gids[name]
+        ranges = g.ranges()
+        bits = (cps.ip_bitmap[ivs, gid >> 5] >> np.uint32(gid & 31)) & 1
+        want = np.array(
+            [any(lo <= ip < hi for lo, hi in ranges) for ip in samples], dtype=np.uint32
+        )
+        np.testing.assert_array_equal(bits, want, err_msg=name)
+        checked += 1
+    assert checked > 20

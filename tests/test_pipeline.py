@@ -1,0 +1,255 @@
+"""Full-pipeline parity + behavior tests: conntrack est-bypass, service LB,
+DNAT, session affinity — device pipeline vs scalar pipeline oracle."""
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.compile import compile_policy_set
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.models.pipeline import make_pipeline
+from antrea_tpu.ops.match import flip_ips
+from antrea_tpu.oracle.pipeline import PipelineOracle
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.simulator import gen_cluster, gen_services, gen_traffic
+from antrea_tpu.utils import ip as iputil
+
+CONN_SLOTS = 1 << 16
+AFF_SLOTS = 1 << 12
+
+
+def run_step(step, state, drs, dsvc, t: PacketBatch, now: int):
+    state, out = step(
+        state,
+        drs,
+        dsvc,
+        np.asarray(flip_ips(t.src_ip)),
+        np.asarray(flip_ips(t.dst_ip)),
+        t.proto.astype(np.int32),
+        t.src_port.astype(np.int32),
+        t.dst_port.astype(np.int32),
+        np.int32(now),
+    )
+    return state, {k: np.asarray(v) for k, v in out.items()}
+
+
+def unflip(a):
+    return (np.asarray(a, dtype=np.int32).view(np.uint32) ^ np.uint32(0x80000000))
+
+
+def compare(cps, out, scalar_outs, i):
+    so = scalar_outs[i]
+    assert int(out["code"][i]) == so.code, (i, "code")
+    assert bool(out["est"][i]) == so.est, (i, "est")
+    assert int(out["svc_idx"][i]) == so.svc_idx, (i, "svc")
+    assert int(unflip(out["dnat_ip_f"][i : i + 1])[0]) == so.dnat_ip, (i, "dnat_ip")
+    assert int(out["dnat_port"][i]) == so.dnat_port, (i, "dnat_port")
+    for key, ids, want in (
+        ("ingress_rule", cps.ingress.rule_ids, so.ingress_rule),
+        ("egress_rule", cps.egress.rule_ids, so.egress_rule),
+    ):
+        ridx = int(out[key][i])
+        got = ids[ridx] if ridx >= 0 else None
+        assert got == want, (i, key, got, want)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_pipeline_parity_multistep(seed):
+    cluster = gen_cluster(150, seed=seed)
+    services = gen_services(24, cluster.pod_ips, seed=seed + 1, no_ep_fraction=0.1)
+    traffic = gen_traffic(
+        cluster.pod_ips, batch=160, seed=seed + 2, services=services, svc_fraction=0.4
+    )
+    cps = compile_policy_set(cluster.ps)
+    svt = compile_services(services)
+    step, state, (drs, dsvc) = make_pipeline(
+        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+    )
+    po = PipelineOracle(
+        cluster.ps, services, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+    )
+
+    est_seen = 0
+    for step_i, now in enumerate([1000, 1010, 1020]):
+        state, out = run_step(step, state, drs, dsvc, traffic, now)
+        scalar = po.step(traffic, now)
+        for i in range(traffic.size):
+            compare(cps, out, scalar, i)
+        est_seen += int(out["est"].sum())
+        if step_i > 0:
+            # Repeat batches must hit the conn table for allowed flows.
+            assert out["est"].sum() > 0
+    assert est_seen > 0
+
+
+def _mini_env():
+    """One pod, one service with two endpoints, no policies."""
+    ps = PolicySet()
+    services = [
+        ServiceEntry(
+            cluster_ip="10.96.0.1",
+            port=80,
+            protocol=cp.PROTO_TCP,
+            endpoints=[Endpoint("10.0.0.10", 8080), Endpoint("10.0.0.11", 8080)],
+            affinity_timeout_s=100,
+        ),
+        ServiceEntry(
+            cluster_ip="10.96.0.2", port=80, protocol=cp.PROTO_TCP, endpoints=[]
+        ),
+    ]
+    cps = compile_policy_set(ps)
+    svt = compile_services(services)
+    step, state, (drs, dsvc) = make_pipeline(
+        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+    )
+    return ps, services, cps, step, state, drs, dsvc
+
+
+def _batch(rows):
+    return PacketBatch(
+        src_ip=np.array([r[0] for r in rows], dtype=np.uint32),
+        dst_ip=np.array([r[1] for r in rows], dtype=np.uint32),
+        proto=np.array([r[2] for r in rows], dtype=np.int32),
+        src_port=np.array([r[3] for r in rows], dtype=np.int32),
+        dst_port=np.array([r[4] for r in rows], dtype=np.int32),
+    )
+
+
+def test_service_dnat_and_no_ep_reject():
+    _, services, cps, step, state, drs, dsvc = _mini_env()
+    client = iputil.ip_to_u32("10.0.0.5")
+    svc1 = iputil.ip_to_u32("10.96.0.1")
+    svc2 = iputil.ip_to_u32("10.96.0.2")
+    t = _batch(
+        [
+            (client, svc1, cp.PROTO_TCP, 40000, 80),
+            (client, svc2, cp.PROTO_TCP, 40001, 80),
+            (client, svc1, cp.PROTO_UDP, 40002, 80),  # wrong proto: not a svc
+        ]
+    )
+    state, out = run_step(step, state, drs, dsvc, t, 100)
+    # svc1: DNAT to one of the endpoints, allowed, committed.
+    assert int(out["svc_idx"][0]) == 0
+    assert int(out["code"][0]) == 0
+    dnat0 = int(unflip(out["dnat_ip_f"][:1])[0])
+    assert dnat0 in (iputil.ip_to_u32("10.0.0.10"), iputil.ip_to_u32("10.0.0.11"))
+    assert int(out["dnat_port"][0]) == 8080
+    assert int(out["committed"][0]) == 1
+    # svc2: no endpoints -> REJECT, not committed.
+    assert int(out["svc_idx"][1]) == 1
+    assert int(out["code"][1]) == 2
+    assert int(out["committed"][1]) == 0
+    # wrong proto: not service traffic, dst unchanged.
+    assert int(out["svc_idx"][2]) == -1
+    assert int(unflip(out["dnat_ip_f"][2:3])[0]) == svc1
+
+
+def test_est_bypass_and_ct_timeout():
+    """A committed connection bypasses policy until idle timeout expires."""
+    # Policy that drops everything to the endpoint IP from anywhere.
+    ps = PolicySet()
+    ps.applied_to_groups["atg-ep"] = cp.AppliedToGroup(
+        "atg-ep", [cp.GroupMember(ip="10.0.0.10", node="n0")]
+    )
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="drop-ep",
+            name="drop-ep",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-ep"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN, action=cp.RuleAction.DROP, priority=0
+                )
+            ],
+        )
+    )
+    cps = compile_policy_set(ps)
+    svt = compile_services([])
+    step, state, (drs, dsvc) = make_pipeline(
+        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS,
+        ct_timeout_s=60,
+    )
+    client = iputil.ip_to_u32("10.0.0.5")
+    ep = iputil.ip_to_u32("10.0.0.10")
+    allowed = iputil.ip_to_u32("10.0.0.99")
+    t_allowed = _batch([(client, allowed, cp.PROTO_TCP, 40000, 80)])
+    t_denied = _batch([(client, ep, cp.PROTO_TCP, 40001, 80)])
+
+    # Denied flow never commits; allowed flow commits then shortcuts.
+    state, out = run_step(step, state, drs, dsvc, t_denied, 0)
+    assert int(out["code"][0]) == 1 and int(out["committed"][0]) == 0
+    state, out = run_step(step, state, drs, dsvc, t_allowed, 0)
+    assert int(out["code"][0]) == 0 and int(out["committed"][0]) == 1
+    state, out = run_step(step, state, drs, dsvc, t_allowed, 30)
+    assert int(out["est"][0]) == 1
+    # After idle timeout the flow re-classifies (fresh commit, not est).
+    state, out = run_step(step, state, drs, dsvc, t_allowed, 200)
+    assert int(out["est"][0]) == 0 and int(out["committed"][0]) == 1
+
+
+def test_policy_applies_post_dnat():
+    """Dropping the ENDPOINT IP must drop service traffic to the ClusterIP —
+    proves security stages see the DNAT-ed tuple (PreRouting precedes
+    EgressSecurity in the reference stage order)."""
+    ps = PolicySet()
+    ps.applied_to_groups["atg-ep"] = cp.AppliedToGroup(
+        "atg-ep", [cp.GroupMember(ip="10.0.0.10", node="n0")]
+    )
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="drop-ep",
+            name="drop-ep",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-ep"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN, action=cp.RuleAction.DROP, priority=0
+                )
+            ],
+        )
+    )
+    services = [
+        ServiceEntry(
+            cluster_ip="10.96.0.1",
+            port=80,
+            protocol=cp.PROTO_TCP,
+            endpoints=[Endpoint("10.0.0.10", 8080)],
+        )
+    ]
+    cps = compile_policy_set(ps)
+    svt = compile_services(services)
+    step, state, (drs, dsvc) = make_pipeline(
+        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+    )
+    client = iputil.ip_to_u32("10.0.0.5")
+    t = _batch([(client, iputil.ip_to_u32("10.96.0.1"), cp.PROTO_TCP, 40000, 80)])
+    state, out = run_step(step, state, drs, dsvc, t, 0)
+    assert int(out["code"][0]) == 1  # dropped via endpoint-IP rule post-DNAT
+    assert cps.ingress.rule_ids[int(out["ingress_rule"][0])] == "drop-ep/In/0"
+
+
+def test_session_affinity_sticky_and_expiry():
+    _, services, cps, step, state, drs, dsvc = _mini_env()
+    client = iputil.ip_to_u32("10.0.0.5")
+    svc1 = iputil.ip_to_u32("10.96.0.1")
+
+    # Different source ports would normally re-hash; affinity pins them.
+    eps = set()
+    for sport, now in [(40000, 0), (40010, 10), (40020, 20)]:
+        t = _batch([(client, svc1, cp.PROTO_TCP, sport, 80)])
+        state, out = run_step(step, state, drs, dsvc, t, now)
+        eps.add(int(unflip(out["dnat_ip_f"][:1])[0]))
+    assert len(eps) == 1  # sticky
+
+    # After the 100s affinity hard timeout, selection re-hashes (may or may
+    # not land elsewhere; verify the entry expired by checking re-learn).
+    t = _batch([(client, svc1, cp.PROTO_TCP, 50000, 80)])
+    state, out = run_step(step, state, drs, dsvc, t, 500)
+    assert int(out["code"][0]) == 0
