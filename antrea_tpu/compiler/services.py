@@ -45,6 +45,22 @@ class ServiceTables:
 
 
 def compile_services(services: list[ServiceEntry]) -> ServiceTables:
+    # Capacity guards: silent truncation would diverge from the scalar
+    # oracle (which uses the untruncated service definitions), breaking
+    # verdict/DNAT parity.  The flow cache additionally packs svc_idx into
+    # 14 bits (models/pipeline._pack_meta1).
+    if len(services) >= (1 << 14) - 1:
+        raise ValueError(
+            f"{len(services)} services exceeds the 14-bit svc_idx capacity "
+            f"({(1 << 14) - 2}); shard services across datapath instances"
+        )
+    for svc in services:
+        if len(svc.endpoints) > MAX_ENDPOINTS:
+            raise ValueError(
+                f"service {svc.cluster_ip}:{svc.port} has "
+                f"{len(svc.endpoints)} endpoints > MAX_ENDPOINTS="
+                f"{MAX_ENDPOINTS}; raise MAX_ENDPOINTS"
+            )
     S = max(1, len(services))
     n_ep = np.ones(S, dtype=np.int32)
     has_ep = np.zeros(S, dtype=np.int32)
@@ -58,7 +74,7 @@ def compile_services(services: list[ServiceEntry]) -> ServiceTables:
         ip_u = iputil.ip_to_u32(svc.cluster_ip)
         key = (svc.protocol << 16) + svc.port
         by_ip.setdefault(ip_u, []).append((key, si))
-        eps = svc.endpoints[:MAX_ENDPOINTS]
+        eps = svc.endpoints
         n_ep[si] = max(1, len(eps))
         has_ep[si] = 1 if eps else 0
         aff[si] = svc.affinity_timeout_s
@@ -73,7 +89,12 @@ def compile_services(services: list[ServiceEntry]) -> ServiceTables:
     slot_svc = np.full((NU, MAX_PORTS_PER_IP), -1, dtype=np.int32)
     for row, ip_u in enumerate(sorted(by_ip)):
         uips[row] = ip_u
-        entries = by_ip[ip_u][:MAX_PORTS_PER_IP]
+        entries = by_ip[ip_u]
+        if len(entries) > MAX_PORTS_PER_IP:
+            raise ValueError(
+                f"frontend IP {ip_u} has {len(entries)} (proto,port) "
+                f"entries > MAX_PORTS_PER_IP={MAX_PORTS_PER_IP}"
+            )
         for col, (key, si) in enumerate(entries):
             ppk[row, col] = key
             slot_svc[row, col] = si

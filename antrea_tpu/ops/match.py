@@ -38,7 +38,13 @@ from ..compiler.compile import (
 )
 from ..utils import ip as iputil
 
-BIG = jnp.int32(1 << 30)  # "no match" sentinel for first-match indices
+# "No match" sentinel for first-match indices.  Deliberately a PYTHON int,
+# not an eager jnp scalar: a concrete device array captured by a jitted
+# function becomes a buffer-backed executable constant, which on some TPU
+# runtimes (observed on the axon platform) both slows that executable ~1000x
+# and degrades every subsequent dispatch in the process.  Python scalars
+# trace to HLO literals and stay fast.
+BIG = 1 << 30
 
 
 class DeviceDirection(NamedTuple):
@@ -49,6 +55,10 @@ class DeviceDirection(NamedTuple):
     peer_hi: jax.Array
     svc_gid: jax.Array
     action: jax.Array  # (R_padded,) flat, for post-scan gather
+    # (n_chunks,) global chunk index — carried as data (not an arange built in
+    # the kernel) so a rule-axis shard_map slice still knows its global rule
+    # offsets and cross-shard first-match combines stay a plain lax.pmin.
+    chunk_idx: jax.Array
 
 
 class DeviceRuleSet(NamedTuple):
@@ -73,9 +83,10 @@ class StaticMeta(NamedTuple):
     iso_out_gid: int
 
 
-def _chunked(dt: DirectionTensors, chunk: int) -> DeviceDirection:
+def _chunked(dt: DirectionTensors, chunk: int, chunk_multiple: int = 1) -> DeviceDirection:
     R = dt.n_rules
     n_chunks = max(1, -(-R // chunk))
+    n_chunks = -(-n_chunks // chunk_multiple) * chunk_multiple
     pad = n_chunks * chunk - R
 
     def pad1(a: np.ndarray, fill) -> np.ndarray:
@@ -96,17 +107,22 @@ def _chunked(dt: DirectionTensors, chunk: int) -> DeviceDirection:
         ),
         svc_gid=jnp.asarray(pad1(dt.svc_gid, 0).reshape(n_chunks, chunk)),
         action=jnp.asarray(pad1(dt.action, ACT_DROP)),
+        chunk_idx=jnp.arange(n_chunks, dtype=jnp.int32),
     )
 
 
-def to_device(cps: CompiledPolicySet, chunk: int = 512) -> tuple[DeviceRuleSet, StaticMeta]:
+def to_device(
+    cps: CompiledPolicySet, chunk: int = 512, chunk_multiple: int = 1
+) -> tuple[DeviceRuleSet, StaticMeta]:
+    """chunk_multiple pads each direction's chunk count to a multiple (so the
+    leading chunk axis divides evenly across a rule-parallel mesh axis)."""
     drs = DeviceRuleSet(
         ip_bounds=jnp.asarray(cps.ip_bounds),
         ip_bitmap=jnp.asarray(cps.ip_bitmap),
         svc_bounds=jnp.asarray(cps.svc_bounds),
         svc_bitmap=jnp.asarray(cps.svc_bitmap),
-        ingress=_chunked(cps.ingress, chunk),
-        egress=_chunked(cps.egress, chunk),
+        ingress=_chunked(cps.ingress, chunk, chunk_multiple),
+        egress=_chunked(cps.egress, chunk, chunk_multiple),
     )
     meta = StaticMeta(
         chunk=chunk,
@@ -144,7 +160,6 @@ def _direction_scan(
     evaluation phase (BIG = none)."""
     n0, nk, _nb = phases
     B = pod_row.shape[0]
-    n_chunks = dd.at_gid.shape[0]
 
     def body(carry, xs):
         h0, hk, hb = carry
@@ -177,7 +192,7 @@ def _direction_scan(
         jnp.full(B, BIG, dtype=jnp.int32),
     )
     xs = (
-        jnp.arange(n_chunks, dtype=jnp.int32),
+        dd.chunk_idx,
         dd.at_gid,
         dd.peer_gid,
         dd.peer_lo,
@@ -236,10 +251,17 @@ def classify_batch(
     dst_port: jax.Array,  # (B,) i32
     *,
     meta: StaticMeta,
+    hit_combine=None,
 ):
     """-> dict with final/egress/ingress codes and deciding rule indices.
 
     Codes use the oracle encoding: 0 allow, 1 drop, 2 reject.
+
+    hit_combine, if given, is applied to each per-phase first-match hit tensor
+    between the rule scan and phase resolution — the rule-parallel seam: a
+    shard_map caller passes ``lambda h: lax.pmin(h, 'rule')`` so each rule
+    shard scans only its local chunks and the global first match is an
+    all-reduce over ICI (the TPU analog of OVS evaluating one shared table).
     """
     src_iv = jnp.searchsorted(drs.ip_bounds, src_ip_f, side="right")
     dst_iv = jnp.searchsorted(drs.ip_bounds, dst_ip_f, side="right")
@@ -257,6 +279,10 @@ def classify_batch(
     out_hits = _direction_scan(
         drs.egress, meta.out_phases, src_row, dst_row, svc_row, dst_ip_f, meta.chunk
     )
+
+    if hit_combine is not None:
+        in_hits = tuple(hit_combine(h) for h in in_hits)
+        out_hits = tuple(hit_combine(h) for h in out_hits)
 
     in_code, in_rule = _resolve(
         drs.ingress, in_hits, _scalar_bit(dst_row, meta.iso_in_gid)
@@ -282,7 +308,7 @@ def flip_ips(a: np.ndarray) -> np.ndarray:
 
 # meta is static (plain ints/tuples, hashable); drs is a traced pytree arg so
 # the big bitmap tensors stay runtime inputs instead of baked-in constants.
-_classify_jit = jax.jit(classify_batch, static_argnames=("meta",))
+_classify_jit = jax.jit(classify_batch, static_argnames=("meta", "hit_combine"))
 
 
 def make_classifier(cps: CompiledPolicySet, chunk: int = 512):

@@ -1,11 +1,24 @@
-"""Scalar full-pipeline oracle: conntrack + service LB + policy.
+"""Scalar full-pipeline oracle: flow cache + conntrack + service LB + policy.
 
-Extends the policy oracle with the stateful stages, using the SAME hash
-functions and the SAME direct-mapped slot discipline as the device pipeline
-(models/pipeline.py) so parity is exact, including eviction behavior.
+This is the SPEC for models/pipeline.py — same hash functions, same
+direct-mapped slot discipline, same generation semantics — so parity is
+exact, including eviction behavior:
+
+  * A unified flow cache keyed by the 5-tuple caches the verdict, DNAT
+    resolution and rule attribution of every classified flow (the OVS
+    EMC/megaflow-cache analog; the reference's datapath performance rests on
+    the same design, docs/design/ovs-pipeline.md conntrack sections).
+  * ALLOW entries are conntrack commits: they persist across rule-set
+    generations (the ct_state -new+est policy bypass,
+    ovs-pipeline.md:1685-1691) and pin their DNAT endpoint and service
+    attribution at establishment time.
+  * DROP/REJECT entries are tagged with the rule generation; a bundle
+    commit (gen bump) invalidates them (megaflow revalidation analog), so
+    denied flows are re-evaluated against the new rules.
+  * Any hit refreshes the idle timeout.
 
 Batch semantics match the device: a batch is "simultaneous arrival" —
-lookups see start-of-batch state; commits/learns/refreshes apply afterwards
+lookups see start-of-batch state; inserts/learns/refreshes apply afterwards
 in batch order (last writer wins on slot collisions).
 """
 
@@ -43,20 +56,34 @@ class PipelineOracle:
         ps: PolicySet,
         services: list[ServiceEntry],
         *,
-        conn_slots: int = 1 << 20,
+        flow_slots: int = 1 << 20,
         aff_slots: int = 1 << 18,
         ct_timeout_s: int = 3600,
     ):
         self.oracle = Oracle(ps)
         self.services = services
-        self.conn_slots = conn_slots
+        self.flow_slots = flow_slots
         self.aff_slots = aff_slots
         self.ct_timeout_s = ct_timeout_s
         self.svc_by_key: dict[tuple[int, int, int], int] = {}
         for i, s in enumerate(services):
             self.svc_by_key[(iputil.ip_to_u32(s.cluster_ip), s.protocol, s.port)] = i
-        self.conn: dict[int, dict] = {}
+        # slot -> {key, code, svc, dnat_ip, dnat_port, ts, gen}; gen None = ALLOW/eternal
+        self.flow: dict[int, dict] = {}
         self.aff: dict[int, dict] = {}
+
+    def update(self, ps: PolicySet = None, services: list[ServiceEntry] = None):
+        """Control-plane bundle commit: swap rules/services.  The caller
+        bumps the device-side gen; here denials are invalidated lazily via
+        the stored gen value mismatching."""
+        if ps is not None:
+            self.oracle = Oracle(ps)
+        if services is not None:
+            self.services = services
+            self.svc_by_key = {
+                (iputil.ip_to_u32(s.cluster_ip), s.protocol, s.port): i
+                for i, s in enumerate(services)
+            }
 
     def _flow_hash(self, p: Packet) -> int:
         return int(
@@ -65,35 +92,45 @@ class PipelineOracle:
             )
         )
 
-    def step(self, batch: PacketBatch, now: int) -> list[ScalarOutcome]:
-        conn0 = {k: dict(v) for k, v in self.conn.items()}
+    def step(self, batch: PacketBatch, now: int, gen: int = 0) -> list[ScalarOutcome]:
+        flow0 = {k: dict(v) for k, v in self.flow.items()}
         aff0 = {k: dict(v) for k, v in self.aff.items()}
         outs: list[ScalarOutcome] = []
-        commits: list[tuple[int, dict]] = []
+        inserts: list[tuple[int, dict]] = []
         refreshes: list[int] = []
         learns: list[tuple[int, dict]] = []
 
         for i in range(batch.size):
             p = batch.packet(i)
             h = self._flow_hash(p)
-            slot = h & (self.conn_slots - 1)
-            e = conn0.get(slot)
+            slot = h & (self.flow_slots - 1)
+            e = flow0.get(slot)
             key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
-            est = (
+            hit = (
                 e is not None
                 and e["key"] == key
                 and (now - e["ts"]) <= self.ct_timeout_s
+                and (e["gen"] is None or e["gen"] == gen)
             )
+            if hit:
+                est = e["gen"] is None
+                outs.append(
+                    ScalarOutcome(
+                        e["code"], est, e["svc"], e["dnat_ip"], e["dnat_port"],
+                        e["rule_out"], e["rule_in"], False,
+                    )
+                )
+                refreshes.append(slot)
+                continue
 
+            # ---- slow path: ServiceLB -> classify -> commit ---------------
             svc_idx = self.svc_by_key.get((p.dst_ip, p.proto, p.dst_port), -1)
             svc = self.services[svc_idx] if svc_idx >= 0 else None
             no_ep = svc is not None and not svc.endpoints
 
             dnat_ip, dnat_port = p.dst_ip, p.dst_port
             aff_learn: Optional[tuple[int, dict]] = None
-            if est:
-                dnat_ip, dnat_port = e["dnat_ip"], e["dnat_port"]
-            elif svc is not None and not no_ep:
+            if svc is not None and not no_ep:
                 n_ep = len(svc.endpoints)
                 ep_col = (h & 0x7FFFFFFF) % max(1, n_ep)
                 if svc.affinity_timeout_s > 0:
@@ -113,53 +150,42 @@ class PipelineOracle:
                 ep = svc.endpoints[ep_col]
                 dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
 
-            if est:
-                outs.append(
-                    ScalarOutcome(ACT_ALLOW, True, svc_idx, dnat_ip, dnat_port,
-                                  None, None, False)
-                )
-                refreshes.append(slot)
-                continue
-
             if no_ep:
-                outs.append(
-                    ScalarOutcome(ACT_REJECT, False, svc_idx, dnat_ip, dnat_port,
-                                  None, None, False)
+                code, rule_in, rule_out = ACT_REJECT, None, None
+            else:
+                v = self.oracle.classify(
+                    Packet(
+                        src_ip=p.src_ip,
+                        dst_ip=dnat_ip,
+                        proto=p.proto,
+                        src_port=p.src_port,
+                        dst_port=dnat_port,
+                    )
                 )
-                if aff_learn:
-                    learns.append(aff_learn)
-                continue
+                code, rule_in, rule_out = int(v.code), v.ingress.rule, v.egress.rule
 
-            v = self.oracle.classify(
-                Packet(
-                    src_ip=p.src_ip,
-                    dst_ip=dnat_ip,
-                    proto=p.proto,
-                    src_port=p.src_port,
-                    dst_port=dnat_port,
-                )
-            )
-            committed = v.code == 0
+            committed = code == ACT_ALLOW
             outs.append(
-                ScalarOutcome(
-                    int(v.code), False, svc_idx, dnat_ip, dnat_port,
-                    v.egress.rule, v.ingress.rule, committed
-                )
+                ScalarOutcome(code, False, svc_idx, dnat_ip, dnat_port,
+                              rule_out, rule_in, committed)
             )
-            if committed:
-                commits.append(
-                    (slot, {"key": key, "dnat_ip": dnat_ip, "dnat_port": dnat_port,
-                            "ts": now})
-                )
+            inserts.append(
+                (slot, {
+                    "key": key, "code": code, "svc": svc_idx,
+                    "dnat_ip": dnat_ip, "dnat_port": dnat_port, "ts": now,
+                    "gen": None if committed else gen,
+                    "rule_in": rule_in, "rule_out": rule_out,
+                })
+            )
             if aff_learn:
                 learns.append(aff_learn)
 
         # Apply state mutations in batch order (last writer wins).
-        for slot, entry in commits:
-            self.conn[slot] = entry
+        for slot, entry in inserts:
+            self.flow[slot] = entry
         for slot in refreshes:
-            if slot in self.conn:
-                self.conn[slot]["ts"] = now
+            if slot in self.flow:
+                self.flow[slot]["ts"] = now
         for aslot, entry in learns:
             self.aff[aslot] = entry
         return outs

@@ -1,0 +1,7 @@
+from .mesh import (  # noqa: F401
+    make_mesh,
+    make_sharded_classifier,
+    make_sharded_pipeline,
+    shard_rule_set,
+    shard_state,
+)

@@ -1,0 +1,64 @@
+"""Honest device timing on high-latency runtimes.
+
+Two pathologies observed on the tunneled TPU platform ("axon") make naive
+timing lie in BOTH directions:
+
+  * `jax.block_until_ready` does not actually wait for device completion —
+    async-dispatch timings can under-report by 1000x.  Only a device->host
+    fetch of (a piece of) the result guarantees completion.
+  * The dispatch+fetch round trip costs ~120 ms, so per-call synchronous
+    timing over-reports small kernels by the same factor.
+
+`device_loop_time` removes both: it runs the kernel K times *inside one
+dispatch* via lax.fori_loop (with a carry dependency so iterations cannot be
+collapsed or reordered), fetches a scalar once, and differences two K values
+to cancel the round-trip constant.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fetch_scalar(x) -> float:
+    return float(np.asarray(x).ravel()[0])
+
+
+def device_loop_time(
+    make_step: Callable,
+    init_carry,
+    *,
+    k_small: int = 2,
+    k_big: int = 12,
+    repeats: int = 3,
+) -> float:
+    """Seconds per iteration of make_step, measured on-device.
+
+    make_step(i, carry) -> carry' must be jit-traceable; carry must be a
+    pytree of arrays whose first leaf's first element participates in every
+    iteration (so the loop cannot be dead-code eliminated).
+    """
+
+    def run_k(k):
+        @jax.jit
+        def f(carry):
+            return jax.lax.fori_loop(0, k, make_step, carry)
+
+        # warm (compile) then time.
+        _fetch_scalar(jax.tree.leaves(f(init_carry))[0])
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = f(init_carry)
+            _fetch_scalar(jax.tree.leaves(out)[0])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_small = run_k(k_small)
+    t_big = run_k(k_big)
+    return max((t_big - t_small) / (k_big - k_small), 1e-9)

@@ -1,9 +1,23 @@
-"""Test env: force CPU with 8 virtual devices so sharding tests run without
-real multi-chip hardware (the driver's dryrun does the same)."""
+"""Test env: ensure a CPU platform with 8 virtual devices is available so
+sharding tests run without real multi-chip hardware (the driver's multi-chip
+dryrun uses the same trick).  If a real TPU platform is configured (e.g.
+JAX_PLATFORMS=axon), it is kept as the default platform and single-device
+tests run on it; the mesh tests explicitly ask for jax.devices("cpu")."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+_plat = os.environ.get("JAX_PLATFORMS", "")
+if _plat == "":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+elif "cpu" not in _plat.split(","):
+    os.environ["JAX_PLATFORMS"] = _plat + ",cpu"
+
+
+def cpu_devices():
+    import jax
+
+    return jax.devices("cpu")

@@ -20,7 +20,7 @@ CONN_SLOTS = 1 << 16
 AFF_SLOTS = 1 << 12
 
 
-def run_step(step, state, drs, dsvc, t: PacketBatch, now: int):
+def run_step(step, state, drs, dsvc, t: PacketBatch, now: int, gen: int = 0):
     state, out = step(
         state,
         drs,
@@ -31,6 +31,7 @@ def run_step(step, state, drs, dsvc, t: PacketBatch, now: int):
         t.src_port.astype(np.int32),
         t.dst_port.astype(np.int32),
         np.int32(now),
+        np.int32(gen),
     )
     return state, {k: np.asarray(v) for k, v in out.items()}
 
@@ -65,16 +66,16 @@ def test_pipeline_parity_multistep(seed):
     cps = compile_policy_set(cluster.ps)
     svt = compile_services(services)
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     po = PipelineOracle(
-        cluster.ps, services, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cluster.ps, services, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
 
     est_seen = 0
     for step_i, now in enumerate([1000, 1010, 1020]):
         state, out = run_step(step, state, drs, dsvc, traffic, now)
-        scalar = po.step(traffic, now)
+        scalar = po.step(traffic, now, 0)
         for i in range(traffic.size):
             compare(cps, out, scalar, i)
         est_seen += int(out["est"].sum())
@@ -102,7 +103,7 @@ def _mini_env():
     cps = compile_policy_set(ps)
     svt = compile_services(services)
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     return ps, services, cps, step, state, drs, dsvc
 
@@ -171,7 +172,7 @@ def test_est_bypass_and_ct_timeout():
     cps = compile_policy_set(ps)
     svt = compile_services([])
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS,
+        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS,
         ct_timeout_s=60,
     )
     client = iputil.ip_to_u32("10.0.0.5")
@@ -226,7 +227,7 @@ def test_policy_applies_post_dnat():
     cps = compile_policy_set(ps)
     svt = compile_services(services)
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, conn_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     client = iputil.ip_to_u32("10.0.0.5")
     t = _batch([(client, iputil.ip_to_u32("10.96.0.1"), cp.PROTO_TCP, 40000, 80)])
@@ -253,3 +254,70 @@ def test_session_affinity_sticky_and_expiry():
     t = _batch([(client, svc1, cp.PROTO_TCP, 50000, 80)])
     state, out = run_step(step, state, drs, dsvc, t, 500)
     assert int(out["code"][0]) == 0
+
+
+def _deny_all_ps(target_ip: str) -> PolicySet:
+    ps = PolicySet()
+    ps.applied_to_groups["atg"] = cp.AppliedToGroup(
+        "atg", [cp.GroupMember(ip=target_ip, node="n0")]
+    )
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="deny-all",
+            name="deny-all",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN, action=cp.RuleAction.DROP, priority=0
+                )
+            ],
+        )
+    )
+    return ps
+
+
+def test_generation_semantics():
+    """Bundle commits (gen bumps) invalidate cached denials but preserve
+    established connections — the ct est-bypass + megaflow-revalidation
+    semantics of the reference (docs/design/ovs-pipeline.md:1685-1691)."""
+    from antrea_tpu.models.pipeline import make_pipeline as mk
+    from antrea_tpu.ops.match import to_device
+
+    client = "10.0.0.5"
+    target = "10.0.0.10"
+    t = _batch([(iputil.ip_to_u32(client), iputil.ip_to_u32(target),
+                 cp.PROTO_TCP, 40000, 80)])
+
+    # gen 0: open policy set -> flow allowed + committed.
+    open_ps = PolicySet()
+    cps_open = compile_policy_set(open_ps)
+    svt = compile_services([])
+    step, state, (drs_open, dsvc) = mk(
+        cps_open, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+    )
+    state, out = run_step(step, state, drs_open, dsvc, t, 0, gen=0)
+    assert int(out["code"][0]) == 0 and int(out["committed"][0]) == 1
+
+    # gen 1: rules now deny — but the ESTABLISHED flow persists (est bypass).
+    cps_deny = compile_policy_set(_deny_all_ps(target))
+    drs_deny, _ = to_device(cps_deny, 64)
+    state, out = run_step(step, state, drs_deny, dsvc, t, 10, gen=1)
+    assert int(out["est"][0]) == 1 and int(out["code"][0]) == 0
+    assert int(out["n_miss"]) == 0  # pure fast path
+
+    # A DIFFERENT flow (new sport) to the same target is denied at gen 1...
+    t2 = _batch([(iputil.ip_to_u32(client), iputil.ip_to_u32(target),
+                  cp.PROTO_TCP, 40001, 80)])
+    state, out = run_step(step, state, drs_deny, dsvc, t2, 20, gen=1)
+    assert int(out["code"][0]) == 1
+    # ...and the denial is served from cache on repeat (no slow path).
+    state, out = run_step(step, state, drs_deny, dsvc, t2, 30, gen=1)
+    assert int(out["code"][0]) == 1 and int(out["n_miss"]) == 0
+
+    # gen 2: rules revert to allow — the cached denial is INVALIDATED.
+    state, out = run_step(step, state, drs_open, dsvc, t2, 40, gen=2)
+    assert int(out["code"][0]) == 0 and int(out["committed"][0]) == 1
+    assert int(out["n_miss"]) == 1  # denial re-classified, not cache-served
