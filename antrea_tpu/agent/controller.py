@@ -1,0 +1,113 @@
+"""Agent-side NetworkPolicy controller: watch -> ruleCache -> reconcile.
+
+The L3 analog of the reference's agent policy path
+(/root/reference/pkg/agent/controller/networkpolicy/networkpolicy_controller.go:910
+watcher loop; cache.go:58 ruleCache; pod_reconciler.go:297 Reconcile):
+subscribes to the dissemination store for ONE node, assembles the local
+span-filtered PolicySet from events alone, and reconciles changes into the
+node's Datapath:
+
+  * group membership deltas   -> datapath.apply_group_delta (incremental, no
+                                 recompile — the flow-mod analog)
+  * policy add/update/delete,
+    group add/delete          -> a pending 'rules dirty' flag; sync() folds
+                                 everything into ONE install_bundle (the
+                                 reference batches via BatchInstallPolicyRule
+                                 Flows at bootstrap, network_policy.go:1310)
+  * service updates           -> install_bundle(services=...)
+
+The local PolicySet is built ONLY from watch events — never from reaching
+into the central controller — which is what makes the dissemination path a
+tested boundary.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..apis import controlplane as cp
+from ..compiler.ir import PolicySet
+from ..controller.networkpolicy import WatchEvent
+from ..datapath.interface import Datapath
+from ..dissemination.store import RamStore
+
+
+class AgentPolicyController:
+    def __init__(self, node: str, datapath: Datapath, store: Optional[RamStore] = None):
+        self.node = node
+        self.datapath = datapath
+        self._ps = PolicySet()
+        self._rules_dirty = False
+        self._deltas: list[tuple[str, list, list]] = []
+        if store is not None:
+            store.watch(node, self.handle_event)
+
+    # -- watcher -------------------------------------------------------------
+
+    def handle_event(self, ev: WatchEvent) -> None:
+        if ev.obj_type == "NetworkPolicy":
+            if ev.kind == "DELETED":
+                self._ps.policies = [p for p in self._ps.policies if p.uid != ev.name]
+            else:
+                known = any(p.uid == ev.name for p in self._ps.policies)
+                if ev.kind == "UPDATED" and ev.span_only and known:
+                    return  # dissemination scope changed, spec did not
+                obj = copy.deepcopy(ev.obj)
+                self._ps.policies = [
+                    p for p in self._ps.policies if p.uid != obj.uid
+                ] + [obj]
+            self._rules_dirty = True
+            return
+
+        table = (
+            self._ps.applied_to_groups
+            if ev.obj_type == "AppliedToGroup"
+            else self._ps.address_groups
+        )
+        if ev.kind == "DELETED":
+            if table.pop(ev.name, None) is not None:
+                self._rules_dirty = True
+            return
+        if ev.kind == "ADDED" or ev.name not in table:
+            table[ev.name] = copy.deepcopy(ev.obj)
+            self._rules_dirty = True
+            return
+        # UPDATED on a known group: incremental membership delta.
+        if ev.added or ev.removed:
+            g = table[ev.name]
+            removed_ips = [m.ip for m in ev.removed]
+            for ip in removed_ips:
+                for i, m in enumerate(g.members):
+                    if m.ip == ip:
+                        del g.members[i]
+                        break
+            for m in ev.added:
+                g.members.append(copy.deepcopy(m))
+            self._deltas.append((ev.name, [m.ip for m in ev.added], removed_ips))
+
+    # -- reconciler ----------------------------------------------------------
+
+    def sync(self) -> None:
+        """Apply pending changes to the datapath: one bundle for structural
+        changes, or the queued incremental deltas otherwise."""
+        if self._rules_dirty:
+            # A bundle folds any pending deltas too (membership is already
+            # reflected in the local PolicySet).
+            self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
+            self._rules_dirty = False
+            self._deltas.clear()
+            return
+        for name, added, removed in self._deltas:
+            try:
+                self.datapath.apply_group_delta(name, added, removed)
+            except KeyError:
+                # Group unknown to the datapath snapshot (e.g. delta arrived
+                # before any bundle): fall back to a bundle.
+                self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
+                break
+        self._deltas.clear()
+
+    @property
+    def policy_set(self) -> PolicySet:
+        return self._ps

@@ -1,0 +1,214 @@
+"""Raw (user-facing) API objects: K8s core objects + policy CRDs.
+
+These are the INPUTS to the central control plane — the analog of the K8s
+objects and Antrea CRDs the reference's controller watches:
+
+  * Pod/Namespace — the entity side of the grouping index
+    (ref /root/reference/pkg/controller/grouping/group_entity_index.go:57).
+  * K8sNetworkPolicy — networking/v1 NetworkPolicy spec subset
+    (ref pkg/controller/networkpolicy/networkpolicy_controller.go:1498
+    processNetworkPolicy path).
+  * AntreaNetworkPolicy / AntreaClusterNetworkPolicy — the ANNP/ACNP CRDs
+    (ref pkg/apis/crd/v1beta1; conversion in
+    pkg/controller/networkpolicy/clusternetworkpolicy.go).
+
+Only the fields the datapath build consumes are modeled; everything here is
+hashable/canonicalizable so selectors can be content-addressed the way the
+reference normalizes group selectors (networkpolicy_controller.go
+normalizedNameForSelector).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .controlplane import Direction, IPBlock, RuleAction
+
+# -- label selectors ---------------------------------------------------------
+
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_NOT_EXISTS = "DoesNotExist"
+
+
+@dataclass(frozen=True)
+class SelectorRequirement:
+    """One matchExpressions entry (metav1.LabelSelectorRequirement)."""
+
+    key: str
+    operator: str  # In / NotIn / Exists / DoesNotExist
+    values: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """metav1.LabelSelector subset: matchLabels + matchExpressions.
+
+    An EMPTY selector matches every object (K8s semantics); None at a use
+    site means "no selector given", which callers must interpret per-field
+    (e.g. NP peer with nil podSelector).
+    """
+
+    match_labels: tuple[tuple[str, str], ...] = ()
+    match_expressions: tuple[SelectorRequirement, ...] = ()
+
+    @staticmethod
+    def make(
+        labels: Optional[dict] = None,
+        expressions: Optional[list[SelectorRequirement]] = None,
+    ) -> "LabelSelector":
+        return LabelSelector(
+            match_labels=tuple(sorted((labels or {}).items())),
+            match_expressions=tuple(expressions or ()),
+        )
+
+    def matches(self, labels: dict) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for req in self.match_expressions:
+            present = req.key in labels
+            if req.operator == OP_EXISTS:
+                if not present:
+                    return False
+            elif req.operator == OP_NOT_EXISTS:
+                if present:
+                    return False
+            elif req.operator == OP_IN:
+                if not present or labels[req.key] not in req.values:
+                    return False
+            elif req.operator == OP_NOT_IN:
+                if present and labels[req.key] in req.values:
+                    return False
+            else:
+                raise ValueError(f"unknown selector operator {req.operator}")
+        return True
+
+    def canonical(self) -> str:
+        exprs = ",".join(
+            f"{r.key} {r.operator} [{','.join(sorted(r.values))}]"
+            for r in sorted(self.match_expressions, key=lambda r: (r.key, r.operator))
+        )
+        lbls = ",".join(f"{k}={v}" for k, v in self.match_labels)
+        return f"ml({lbls});me({exprs})"
+
+
+# -- core objects ------------------------------------------------------------
+
+
+@dataclass
+class Pod:
+    namespace: str
+    name: str
+    ip: str = ""
+    node: str = ""
+    labels: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Namespace:
+    name: str
+    labels: dict = field(default_factory=dict)
+
+
+# -- K8s NetworkPolicy (networking/v1 subset) --------------------------------
+
+
+@dataclass(frozen=True)
+class K8sPeer:
+    """NetworkPolicyPeer: exactly one of (selectors, ip_block) in practice.
+
+    pod_selector/ns_selector semantics (upstream):
+      pod only  -> pods matching it in the policy's namespace
+      ns only   -> all pods in matching namespaces
+      both      -> pods matching pod_selector in matching namespaces
+    """
+
+    pod_selector: Optional[LabelSelector] = None
+    ns_selector: Optional[LabelSelector] = None
+    ip_block: Optional[IPBlock] = None
+
+
+@dataclass(frozen=True)
+class PortSpec:
+    """NetworkPolicyPort / Antrea rule port: protocol + port[-end_port]."""
+
+    protocol: Optional[int] = 6  # TCP default per K8s API
+    port: Optional[int] = None
+    end_port: Optional[int] = None
+
+
+@dataclass
+class K8sNPRule:
+    peers: list[K8sPeer] = field(default_factory=list)  # empty = any peer
+    ports: list[PortSpec] = field(default_factory=list)  # empty = any port
+
+
+@dataclass
+class K8sNetworkPolicy:
+    uid: str
+    namespace: str
+    name: str
+    pod_selector: LabelSelector = field(default_factory=LabelSelector)
+    policy_types: list[Direction] = field(default_factory=list)
+    ingress: list[K8sNPRule] = field(default_factory=list)
+    egress: list[K8sNPRule] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# -- Antrea-native policies (ANNP/ACNP subset) -------------------------------
+
+
+@dataclass(frozen=True)
+class AntreaPeer:
+    """ACNP/ANNP rule peer."""
+
+    pod_selector: Optional[LabelSelector] = None
+    ns_selector: Optional[LabelSelector] = None
+    ip_block: Optional[IPBlock] = None
+
+
+@dataclass(frozen=True)
+class AntreaAppliedTo:
+    pod_selector: Optional[LabelSelector] = None
+    ns_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class AntreaNPRule:
+    direction: Direction
+    action: RuleAction = RuleAction.ALLOW
+    peers: list[AntreaPeer] = field(default_factory=list)  # empty = any
+    ports: list[PortSpec] = field(default_factory=list)  # empty = any
+    applied_to: list[AntreaAppliedTo] = field(default_factory=list)  # override
+    name: str = ""
+
+
+@dataclass
+class AntreaNetworkPolicy:
+    """ANNP (namespaced) or ACNP (namespace == '')."""
+
+    uid: str
+    name: str
+    namespace: str = ""  # "" = cluster-scoped (ACNP)
+    tier_priority: int = 250  # TIER_APPLICATION
+    priority: float = 5.0
+    applied_to: list[AntreaAppliedTo] = field(default_factory=list)
+    rules: list[AntreaNPRule] = field(default_factory=list)
+
+    @property
+    def is_cluster_scoped(self) -> bool:
+        return self.namespace == ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name

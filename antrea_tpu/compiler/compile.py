@@ -104,20 +104,35 @@ class _GroupSpace:
     The dedup is the tensor analog of the reference's shared
     conjMatchFlowContext cache (network_policy.go:342-400): identical address
     sets used by many rules get one bitmap column, not one per rule.
+
+    Two addressing modes:
+      * value-addressed (ident=None): immutable range sets (inline ipBlocks,
+        the any/empty groups) dedup by value;
+      * identity-addressed (ident=tuple): sets built from NAMED groups dedup
+        by constituent names, NOT by current value — two different
+        AddressGroups with coincidentally identical members must keep
+        separate bitmap columns, or an incremental membership delta to one
+        would corrupt the other.  `ident_of` records the provenance each
+        updatable gid was built from (consumed by the incremental-update
+        path, datapath/tpuflow.py).
     """
 
     def __init__(self) -> None:
-        self._ids: dict[tuple[tuple[int, int], ...], int] = {}
+        self._ids: dict[tuple, int] = {}
         self.groups: list[tuple[tuple[int, int], ...]] = []
+        self.ident_of: dict[int, tuple] = {}
         self.empty = self.intern(())
         self.any = self.intern(FULL_SPACE)
 
-    def intern(self, ranges: tuple[tuple[int, int], ...]) -> int:
-        gid = self._ids.get(ranges)
+    def intern(self, ranges: tuple[tuple[int, int], ...], ident: tuple = None) -> int:
+        key = ("val", ranges) if ident is None else ident
+        gid = self._ids.get(key)
         if gid is None:
             gid = len(self.groups)
-            self._ids[ranges] = gid
+            self._ids[key] = gid
             self.groups.append(ranges)
+            if ident is not None:
+                self.ident_of[gid] = ident
         return gid
 
     def build_tables(self) -> tuple[np.ndarray, np.ndarray]:
@@ -180,6 +195,11 @@ class CompiledPolicySet:
     n_svc_groups: int
     # Introspection: named AddressGroup -> ip-group id (bitmap column).
     ag_gids: dict[str, int] = field(default_factory=dict)
+    # Provenance of identity-addressed gids (see _GroupSpace): gid ->
+    # ("agu"|"atgu", sorted constituent group names, static extra ranges).
+    # The incremental-update path uses this to find every bitmap column a
+    # named-group membership delta must patch.
+    gid_ident: dict[int, tuple] = field(default_factory=dict)
 
 
 _flip = iputil.flip_u32
@@ -192,9 +212,12 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
     ag_ranges: dict[str, tuple[tuple[int, int], ...]] = {
         name: tuple(g.ranges()) for name, g in ps.address_groups.items()
     }
-    # Intern every named group up front (content-addressed: free if a peer
-    # also interns the same ranges) so each has a stable bitmap column.
-    ag_gids = {name: ip_space.intern(r) for name, r in ag_ranges.items()}
+    # Intern every named group up front so each has a stable bitmap column;
+    # identity-addressed (the group is mutable via membership deltas).
+    ag_gids = {
+        name: ip_space.intern(r, ident=("agu", (name,), ()))
+        for name, r in ag_ranges.items()
+    }
     atg_ranges: dict[str, tuple[tuple[int, int], ...]] = {}
     for name, g in ps.applied_to_groups.items():
         atg_ranges[name] = _merge(
@@ -202,11 +225,13 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
         )
 
     def applied_gid(policy: NetworkPolicy, rule: NetworkPolicyRule) -> int:
-        names = rule.applied_to_groups or policy.applied_to_groups
+        names = tuple(sorted(rule.applied_to_groups or policy.applied_to_groups))
         ranges: list[tuple[int, int]] = []
         for n in names:
             ranges.extend(atg_ranges.get(n, ()))
-        return ip_space.intern(_merge(ranges))
+        if not names:
+            return ip_space.empty
+        return ip_space.intern(_merge(ranges), ident=("atgu", names, ()))
 
     def peer_repr(peer: NetworkPolicyPeer):
         """-> (gid, [(lo,hi)*<=SLOTS]) with overflow folded into the group."""
@@ -216,14 +241,23 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
         for b in peer.ip_blocks:
             block_ranges.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
         group_ranges: list[tuple[int, int]] = []
-        for n in peer.address_groups:
+        names = tuple(sorted(peer.address_groups))
+        for n in names:
             group_ranges.extend(ag_ranges.get(n, ()))
         if len(block_ranges) <= PEER_RANGE_SLOTS:
             inline = block_ranges
+            static: tuple = ()
         else:
             group_ranges.extend(block_ranges)
             inline = []
-        gid = ip_space.intern(_merge(group_ranges)) if group_ranges else ip_space.empty
+            static = _merge(block_ranges)
+        if not names and not static:
+            # Pure-inline peer (or dangling empty): nothing mutable.
+            gid = ip_space.empty if not group_ranges else ip_space.intern(
+                _merge(group_ranges)
+            )
+        else:
+            gid = ip_space.intern(_merge(group_ranges), ident=("agu", names, static))
         return gid, inline
 
     # -- collect rules per direction, phase-tagged ---------------------------
@@ -255,12 +289,18 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
     # -- isolation groups (K8s default-deny membership) ----------------------
 
     def iso_gid(direction: Direction) -> int:
-        ranges: list[tuple[int, int]] = []
+        names: set[str] = set()
         for p in ps.policies:
             if p.is_k8s and direction in p.policy_types:
-                for n in p.applied_to_groups:
-                    ranges.extend(atg_ranges.get(n, ()))
-        return ip_space.intern(_merge(ranges)) if ranges else ip_space.empty
+                names.update(p.applied_to_groups)
+        if not names:
+            return ip_space.empty
+        ranges: list[tuple[int, int]] = []
+        for n in sorted(names):
+            ranges.extend(atg_ranges.get(n, ()))
+        # Identity-addressed like any ATG union, so pod churn in a K8s
+        # policy's appliedTo also patches the isolation column incrementally.
+        return ip_space.intern(_merge(ranges), ident=("atgu", tuple(sorted(names)), ()))
 
     iso_in = iso_gid(Direction.IN)
     iso_out = iso_gid(Direction.OUT)
@@ -324,4 +364,5 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
         n_ip_groups=len(ip_space.groups),
         n_svc_groups=len(svc_space.groups),
         ag_gids=ag_gids,
+        gid_ident=dict(ip_space.ident_of),
     )

@@ -1,0 +1,214 @@
+"""GroupEntityIndex: bidirectional label-selector <-> pod index.
+
+The TPU build's analog of the reference's shared grouping index
+(/root/reference/pkg/controller/grouping/group_entity_index.go:57): policy
+controllers register *groups* (a selector scoped to a namespace or to
+namespace-selected namespaces); the index maintains each group's member pods
+incrementally as pods/namespaces churn, and notifies listeners of exactly the
+groups whose membership changed.
+
+Design (mirrors the reference's labelItem/entityItem factoring, re-derived):
+pods are bucketed by (namespace, frozen label set) — all pods sharing a
+label set belong to one *bucket*, and selector matching is evaluated
+per-bucket, not per-pod.  A group's membership is the union of its matched
+buckets.  Pod churn within an existing bucket (the common case at scale:
+replicas of a deployment share labels) touches no selector evaluation at
+all; only novel label sets pay a match against registered groups.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..apis.crd import LabelSelector, Namespace, Pod
+
+
+@dataclass(frozen=True)
+class GroupSelector:
+    """A registered group: selector scoped per the reference's GroupSelector
+    (pkg/apis/controlplane/types.go GroupSelector semantics):
+
+      namespace != ""           -> pods in that namespace matching pod_selector
+                                   (pod_selector None = all pods in namespace)
+      namespace == ""           -> cluster-scoped:
+        ns_selector None        -> pod_selector across ALL namespaces
+        ns_selector given       -> pods in matching namespaces; pod_selector
+                                   None = all pods in those namespaces
+    """
+
+    namespace: str = ""
+    pod_selector: Optional[LabelSelector] = None
+    ns_selector: Optional[LabelSelector] = None
+
+    def canonical(self) -> str:
+        ps = self.pod_selector.canonical() if self.pod_selector is not None else "nil"
+        ns = self.ns_selector.canonical() if self.ns_selector is not None else "nil"
+        return f"ns={self.namespace};pod={ps};nsSel={ns}"
+
+    def key(self) -> str:
+        # Content-addressed group name (the reference hashes the normalized
+        # selector string, networkpolicy_controller.go); hex digest keeps
+        # keys stable across processes.
+        import hashlib
+
+        return hashlib.sha1(self.canonical().encode()).hexdigest()[:20]
+
+
+@dataclass
+class _Bucket:
+    namespace: str
+    labels: dict
+    pods: dict = field(default_factory=dict)  # pod_key -> Pod
+    groups: set = field(default_factory=set)  # group keys matching this bucket
+
+
+def _bucket_key(namespace: str, labels: dict) -> tuple:
+    return (namespace, tuple(sorted(labels.items())))
+
+
+class GroupEntityIndex:
+    """Incremental selector index. Not thread-safe; callers serialize (the
+    reference funnels mutations through workqueues the same way)."""
+
+    def __init__(self):
+        self._groups: dict[str, GroupSelector] = {}
+        self._buckets: dict[tuple, _Bucket] = {}
+        self._pod_bucket: dict[str, tuple] = {}  # pod_key -> bucket key
+        self._namespaces: dict[str, Namespace] = {}
+        self._handlers: list[Callable[[set[str]], None]] = []
+
+    # -- subscriptions -------------------------------------------------------
+
+    def add_event_handler(self, fn: Callable[[set[str]], None]) -> None:
+        """fn(changed_group_keys) fires after any mutation that changes
+        membership of one or more groups."""
+        self._handlers.append(fn)
+
+    def _notify(self, changed: set[str]) -> None:
+        if changed:
+            for fn in self._handlers:
+                fn(set(changed))
+
+    # -- group registration --------------------------------------------------
+
+    def add_group(self, sel: GroupSelector) -> str:
+        """Register (idempotent); returns the group key."""
+        key = sel.key()
+        if key in self._groups:
+            return key
+        self._groups[key] = sel
+        for bk, bucket in self._buckets.items():
+            if self._selector_matches_bucket(sel, bucket):
+                bucket.groups.add(key)
+        return key
+
+    def delete_group(self, key: str) -> None:
+        if self._groups.pop(key, None) is None:
+            return
+        for bucket in self._buckets.values():
+            bucket.groups.discard(key)
+
+    def get_members(self, key: str) -> list[Pod]:
+        out: list[Pod] = []
+        for bucket in self._buckets.values():
+            if key in bucket.groups:
+                out.extend(bucket.pods.values())
+        out.sort(key=lambda p: p.key)
+        return out
+
+    def groups_of_pod(self, pod_key: str) -> set[str]:
+        bk = self._pod_bucket.get(pod_key)
+        if bk is None:
+            return set()
+        return set(self._buckets[bk].groups)
+
+    # -- matching ------------------------------------------------------------
+
+    def _selector_matches_bucket(self, sel: GroupSelector, bucket: _Bucket) -> bool:
+        if sel.namespace:
+            if bucket.namespace != sel.namespace:
+                return False
+        elif sel.ns_selector is not None:
+            ns = self._namespaces.get(bucket.namespace)
+            ns_labels = ns.labels if ns is not None else {}
+            if not sel.ns_selector.matches(ns_labels):
+                return False
+        if sel.pod_selector is not None and not sel.pod_selector.matches(bucket.labels):
+            return False
+        return True
+
+    # -- pod lifecycle -------------------------------------------------------
+
+    def upsert_pod(self, pod: Pod) -> None:
+        changed: set[str] = set()
+        new_bk = _bucket_key(pod.namespace, pod.labels)
+        old_bk = self._pod_bucket.get(pod.key)
+        if old_bk == new_bk:
+            # Same bucket: membership sets unchanged, but the member's
+            # ip/node may have changed -> groups still need re-emission.
+            old = self._buckets[old_bk].pods[pod.key]
+            if (old.ip, old.node) != (pod.ip, pod.node):
+                changed |= self._buckets[old_bk].groups
+            self._buckets[old_bk].pods[pod.key] = pod
+            self._notify(changed)
+            return
+        if old_bk is not None:
+            changed |= self._remove_from_bucket(pod.key, old_bk)
+        bucket = self._buckets.get(new_bk)
+        if bucket is None:
+            bucket = _Bucket(namespace=pod.namespace, labels=dict(pod.labels))
+            bucket.groups = {
+                k for k, sel in self._groups.items()
+                if self._selector_matches_bucket(sel, bucket)
+            }
+            self._buckets[new_bk] = bucket
+        bucket.pods[pod.key] = pod
+        self._pod_bucket[pod.key] = new_bk
+        changed |= bucket.groups
+        self._notify(changed)
+
+    def delete_pod(self, pod_key: str) -> None:
+        bk = self._pod_bucket.get(pod_key)
+        if bk is None:
+            return
+        changed = self._remove_from_bucket(pod_key, bk)
+        self._notify(changed)
+
+    def _remove_from_bucket(self, pod_key: str, bk: tuple) -> set[str]:
+        bucket = self._buckets[bk]
+        bucket.pods.pop(pod_key, None)
+        self._pod_bucket.pop(pod_key, None)
+        changed = set(bucket.groups)
+        if not bucket.pods:
+            del self._buckets[bk]
+        return changed
+
+    # -- namespace lifecycle -------------------------------------------------
+
+    def upsert_namespace(self, ns: Namespace) -> None:
+        old = self._namespaces.get(ns.name)
+        self._namespaces[ns.name] = ns
+        if old is not None and old.labels == ns.labels:
+            return
+        # Namespace labels changed: every cluster-scoped group with an
+        # ns_selector must re-match every bucket in this namespace.
+        changed: set[str] = set()
+        for bucket in self._buckets.values():
+            if bucket.namespace != ns.name:
+                continue
+            for key, sel in self._groups.items():
+                if sel.namespace or sel.ns_selector is None:
+                    continue
+                now = self._selector_matches_bucket(sel, bucket)
+                was = key in bucket.groups
+                if now != was:
+                    (bucket.groups.add if now else bucket.groups.discard)(key)
+                    if bucket.pods:
+                        changed.add(key)
+        self._notify(changed)
+
+    def delete_namespace(self, name: str) -> None:
+        self._namespaces.pop(name, None)
+        # Pods of the namespace are deleted via their own delete events (the
+        # reference relies on the same ordering from the apiserver).

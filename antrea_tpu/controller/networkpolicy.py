@@ -1,0 +1,465 @@
+"""Central NetworkPolicy controller: raw objects -> internal policy state.
+
+The L4 layer of the reference rebuilt for the TPU datapath: watches raw
+Pod/Namespace/K8sNetworkPolicy/ACNP/ANNP objects, computes the internal
+representation the datapath compiler consumes — NetworkPolicy +
+AddressGroup + AppliedToGroup, content-addressed by normalized selector —
+plus each object's per-Node *span*, and emits incremental watch events.
+
+Reference analogs (semantic, not structural):
+  syncInternalNetworkPolicy  pkg/controller/networkpolicy/networkpolicy_controller.go:1498
+  syncAddressGroup           networkpolicy_controller.go:1096
+  syncAppliedToGroup         networkpolicy_controller.go:1297
+  grouping index             pkg/controller/grouping/group_entity_index.go:57
+  span-filtered dissemination docs/design/architecture.md:57-60
+
+Differences by design: the reference funnels mutations through workqueues
+with retry; here mutations are synchronous calls (the dissemination layer
+adds the async boundary), which keeps the computation deterministic for
+testing while preserving the same incremental delta structure.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..apis import controlplane as cp
+from ..apis.crd import (
+    AntreaAppliedTo,
+    AntreaNetworkPolicy,
+    AntreaPeer,
+    K8sNetworkPolicy,
+    K8sPeer,
+    LabelSelector,
+    Namespace,
+    Pod,
+    PortSpec,
+)
+from ..compiler.ir import PolicySet
+from .grouping import GroupEntityIndex, GroupSelector
+
+
+@dataclass
+class WatchEvent:
+    """One dissemination-plane event. For group updates, added/removed carry
+    the member delta (the incremental-update path the agent compiler can
+    apply without a full recompile; ref architecture.md:61-62 'only sends
+    deltas')."""
+
+    kind: str  # ADDED / UPDATED / DELETED
+    obj_type: str  # NetworkPolicy / AddressGroup / AppliedToGroup
+    name: str
+    obj: object = None
+    span: set = field(default_factory=set)
+    added: list = field(default_factory=list)
+    removed: list = field(default_factory=list)
+    # True when only dissemination scope changed, not the object's spec —
+    # consumers that already hold the object need not reconcile (keeps the
+    # incremental delta path from degrading into full bundle installs).
+    span_only: bool = False
+
+
+def _members_of(pods: list[Pod]) -> list[cp.GroupMember]:
+    return [
+        cp.GroupMember(ip=p.ip, node=p.node, pod_namespace=p.namespace, pod_name=p.name)
+        for p in pods
+        if p.ip  # pods without assigned IPs are not yet datapath-relevant
+    ]
+
+
+def _member_key(m: cp.GroupMember) -> tuple:
+    return (m.pod_namespace, m.pod_name, m.ip, m.node)
+
+
+@dataclass
+class _GroupState:
+    selector: GroupSelector
+    members: list = field(default_factory=list)
+    # uids of internal NPs referencing this group (refcount for GC)
+    refs: set = field(default_factory=set)
+
+
+class NetworkPolicyController:
+    def __init__(self, index: Optional[GroupEntityIndex] = None):
+        self.index = index or GroupEntityIndex()
+        self.index.add_event_handler(self._on_groups_changed)
+        self._nps: dict[str, cp.NetworkPolicy] = {}
+        self._np_span: dict[str, set] = {}
+        self._atgs: dict[str, _GroupState] = {}
+        self._ags: dict[str, _GroupState] = {}
+        self._subs: list[Callable[[WatchEvent], None]] = []
+        # Raw-policy bookkeeping so upserts can diff/cleanup.
+        self._raw_uid_kind: dict[str, str] = {}
+
+    # -- subscriptions -------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
+        self._subs.append(fn)
+
+    def _emit(self, ev: WatchEvent) -> None:
+        for fn in self._subs:
+            fn(ev)
+
+    # -- entity passthrough --------------------------------------------------
+
+    def upsert_pod(self, pod: Pod) -> None:
+        self.index.upsert_pod(pod)
+
+    def delete_pod(self, pod_key: str) -> None:
+        self.index.delete_pod(pod_key)
+
+    def upsert_namespace(self, ns: Namespace) -> None:
+        self.index.upsert_namespace(ns)
+
+    def delete_namespace(self, name: str) -> None:
+        self.index.delete_namespace(name)
+
+    # -- group plumbing ------------------------------------------------------
+
+    def _ensure_group(
+        self, table: dict, sel: GroupSelector, ref_uid: str, obj_type: str
+    ) -> str:
+        key = self.index.add_group(sel)
+        st = table.get(key)
+        if st is None:
+            st = _GroupState(selector=sel)
+            st.members = _members_of(self.index.get_members(key))
+            table[key] = st
+            st.refs.add(ref_uid)
+            self._emit(WatchEvent(
+                kind="ADDED", obj_type=obj_type, name=key,
+                obj=self._group_obj(obj_type, key, st),
+                span=self._group_span(obj_type, key, st),
+                added=list(st.members),
+            ))
+        else:
+            st.refs.add(ref_uid)
+        return key
+
+    def _unref_group(self, table: dict, key: str, ref_uid: str, obj_type: str) -> None:
+        st = table.get(key)
+        if st is None:
+            return
+        st.refs.discard(ref_uid)
+        if not st.refs:
+            del table[key]
+            self._emit(WatchEvent(kind="DELETED", obj_type=obj_type, name=key))
+            # Drop from the index only when neither table references the key.
+            other = self._ags if table is self._atgs else self._atgs
+            if key not in other:
+                self.index.delete_group(key)
+
+    def _group_obj(self, obj_type: str, key: str, st: _GroupState):
+        if obj_type == "AppliedToGroup":
+            return cp.AppliedToGroup(name=key, members=list(st.members))
+        return cp.AddressGroup(name=key, members=list(st.members))
+
+    def _group_span(self, obj_type: str, key: str, st: _GroupState) -> set:
+        """A group is needed wherever a policy referencing it applies.
+
+        NOTE: this covers AppliedToGroups too — unlike the reference (which
+        sends each agent only its local ATG members, since OVS matches pods
+        by ofport), the tpuflow kernel matches appliedTo by IP over the FULL
+        member set, so every node in a referencing policy's span needs the
+        whole group."""
+        keys_of = (
+            self._np_atg_keys if obj_type == "AppliedToGroup" else self._np_ag_keys
+        )
+        span: set = set()
+        for uid, np in self._nps.items():
+            if key in keys_of(np):
+                span |= self._np_span.get(uid, set())
+        return span
+
+    def _reemit_group_spans(self, np: cp.NetworkPolicy, skip: set = frozenset()) -> None:
+        """After a policy's span changes (or it is first installed), refresh
+        the span on every group it references so the dissemination store can
+        fan the groups out to newly-covered nodes (the reference achieves
+        this by enqueueing group syncs from syncInternalNetworkPolicy,
+        networkpolicy_controller.go:1498).  Groups in `skip` already got a
+        delta-bearing event this round."""
+        for obj_type, table, keys in (
+            ("AppliedToGroup", self._atgs, self._np_atg_keys(np)),
+            ("AddressGroup", self._ags, self._np_ag_keys(np)),
+        ):
+            for key in keys:
+                st = table.get(key)
+                if st is None or (obj_type, key) in skip:
+                    continue
+                self._emit(WatchEvent(
+                    kind="UPDATED", obj_type=obj_type, name=key,
+                    obj=self._group_obj(obj_type, key, st),
+                    span=self._group_span(obj_type, key, st),
+                    span_only=True,
+                ))
+
+    @staticmethod
+    def _np_atg_keys(np: cp.NetworkPolicy) -> set:
+        keys = set(np.applied_to_groups)
+        for r in np.rules:
+            keys |= set(r.applied_to_groups)
+        return keys
+
+    @staticmethod
+    def _np_ag_keys(np: cp.NetworkPolicy) -> set:
+        keys: set = set()
+        for r in np.rules:
+            keys |= set(r.from_peer.address_groups)
+            keys |= set(r.to_peer.address_groups)
+        return keys
+
+    # -- membership-change fanout (the incremental path) ---------------------
+
+    def _on_groups_changed(self, group_keys: set) -> None:
+        # Phase 1: update memberships and collect deltas (no events yet).
+        pending: list[tuple[str, str, _GroupState, list, list]] = []
+        span_dirty = False
+        for key in group_keys:
+            for table, obj_type in ((self._atgs, "AppliedToGroup"), (self._ags, "AddressGroup")):
+                st = table.get(key)
+                if st is None:
+                    continue
+                new_members = _members_of(self.index.get_members(key))
+                old = {_member_key(m): m for m in st.members}
+                new = {_member_key(m): m for m in new_members}
+                added = [m for k, m in new.items() if k not in old]
+                removed = [m for k, m in old.items() if k not in new]
+                if not added and not removed:
+                    continue
+                st.members = new_members
+                if obj_type == "AppliedToGroup":
+                    span_dirty = True
+                pending.append((obj_type, key, st, added, removed))
+
+        # Phase 2: refresh NP spans FIRST so every group event below carries
+        # the post-churn span (a delta landing on a new node must reach that
+        # node in the same event).
+        span_changed_nps: list[cp.NetworkPolicy] = []
+        if span_dirty:
+            span_changed_nps = self._recompute_np_spans()
+
+        # Phase 3: one delta-bearing event per changed group.
+        emitted: set = set()
+        for obj_type, key, st, added, removed in pending:
+            emitted.add((obj_type, key))
+            self._emit(WatchEvent(
+                kind="UPDATED", obj_type=obj_type, name=key,
+                obj=self._group_obj(obj_type, key, st),
+                span=self._group_span(obj_type, key, st),
+                added=added, removed=removed,
+            ))
+        # Phase 4: span-refresh the OTHER groups of span-changed policies so
+        # newly-covered nodes receive them too.
+        for np in span_changed_nps:
+            self._reemit_group_spans(np, skip=emitted)
+
+    def _recompute_np_spans(self) -> list:
+        """Refresh every NP's span; emits span-only NP UPDATED events and
+        returns the policies whose span changed."""
+        changed = []
+        for uid, np in self._nps.items():
+            span: set = set()
+            for key in self._np_atg_keys(np):
+                st = self._atgs.get(key)
+                if st is not None:
+                    span |= {m.node for m in st.members if m.node}
+            if span != self._np_span.get(uid):
+                self._np_span[uid] = span
+                self._emit(WatchEvent(
+                    kind="UPDATED", obj_type="NetworkPolicy", name=uid,
+                    obj=np, span=set(span), span_only=True,
+                ))
+                changed.append(np)
+        return changed
+
+    # -- K8s NetworkPolicy ---------------------------------------------------
+
+    def upsert_k8s_policy(self, np: K8sNetworkPolicy) -> None:
+        internal = self._convert_k8s(np)
+        self._install(np.uid, internal, kind="k8s")
+
+    def _convert_k8s(self, np: K8sNetworkPolicy) -> cp.NetworkPolicy:
+        atg_key = self._ensure_group(
+            self._atgs,
+            GroupSelector(namespace=np.namespace, pod_selector=np.pod_selector),
+            np.uid, "AppliedToGroup",
+        )
+        rules: list[cp.NetworkPolicyRule] = []
+        for direction, raw_rules in ((cp.Direction.IN, np.ingress), (cp.Direction.OUT, np.egress)):
+            for rr in raw_rules:
+                peer = self._convert_k8s_peers(np, rr.peers)
+                rules.append(cp.NetworkPolicyRule(
+                    direction=direction,
+                    from_peer=peer if direction == cp.Direction.IN else cp.NetworkPolicyPeer(),
+                    to_peer=peer if direction == cp.Direction.OUT else cp.NetworkPolicyPeer(),
+                    services=[_port_to_service(p) for p in rr.ports],
+                    action=cp.RuleAction.ALLOW,
+                    priority=-1,
+                ))
+        policy_types = list(np.policy_types) or (
+            [cp.Direction.IN] + ([cp.Direction.OUT] if np.egress else [])
+        )
+        return cp.NetworkPolicy(
+            uid=np.uid, name=np.name, namespace=np.namespace,
+            type=cp.NetworkPolicyType.K8S, rules=rules,
+            applied_to_groups=[atg_key], policy_types=policy_types,
+        )
+
+    def _convert_k8s_peers(
+        self, np: K8sNetworkPolicy, peers: list[K8sPeer]
+    ) -> cp.NetworkPolicyPeer:
+        if not peers:
+            return cp.NetworkPolicyPeer()  # any
+        groups: list[str] = []
+        blocks: list[cp.IPBlock] = []
+        for p in peers:
+            if p.ip_block is not None:
+                blocks.append(p.ip_block)
+                continue
+            if p.ns_selector is None:
+                sel = GroupSelector(namespace=np.namespace, pod_selector=p.pod_selector or LabelSelector())
+            else:
+                sel = GroupSelector(namespace="", pod_selector=p.pod_selector, ns_selector=p.ns_selector)
+            groups.append(self._ensure_group(self._ags, sel, np.uid, "AddressGroup"))
+        return cp.NetworkPolicyPeer(address_groups=groups, ip_blocks=blocks)
+
+    # -- Antrea-native policies ----------------------------------------------
+
+    def upsert_antrea_policy(self, anp: AntreaNetworkPolicy) -> None:
+        internal = self._convert_antrea(anp)
+        self._install(anp.uid, internal, kind="antrea")
+
+    def _convert_antrea(self, anp: AntreaNetworkPolicy) -> cp.NetworkPolicy:
+        def atg_of(at: AntreaAppliedTo) -> str:
+            if anp.is_cluster_scoped:
+                sel = GroupSelector(namespace="", pod_selector=at.pod_selector,
+                                    ns_selector=at.ns_selector)
+            else:
+                sel = GroupSelector(namespace=anp.namespace,
+                                    pod_selector=at.pod_selector or LabelSelector())
+            return self._ensure_group(self._atgs, sel, anp.uid, "AppliedToGroup")
+
+        policy_atgs = [atg_of(at) for at in anp.applied_to]
+        rules: list[cp.NetworkPolicyRule] = []
+        for i, rr in enumerate(anp.rules):
+            peer = self._convert_antrea_peers(anp, rr.peers)
+            rules.append(cp.NetworkPolicyRule(
+                direction=rr.direction,
+                from_peer=peer if rr.direction == cp.Direction.IN else cp.NetworkPolicyPeer(),
+                to_peer=peer if rr.direction == cp.Direction.OUT else cp.NetworkPolicyPeer(),
+                services=[_port_to_service(p) for p in rr.ports],
+                action=rr.action,
+                priority=i,
+                name=rr.name,
+                applied_to_groups=[atg_of(at) for at in rr.applied_to],
+            ))
+        ptype = (cp.NetworkPolicyType.ACNP if anp.is_cluster_scoped
+                 else cp.NetworkPolicyType.ANNP)
+        return cp.NetworkPolicy(
+            uid=anp.uid, name=anp.name, namespace=anp.namespace, type=ptype,
+            rules=rules, applied_to_groups=policy_atgs,
+            tier_priority=anp.tier_priority, priority=anp.priority,
+        )
+
+    def _convert_antrea_peers(
+        self, anp: AntreaNetworkPolicy, peers: list[AntreaPeer]
+    ) -> cp.NetworkPolicyPeer:
+        if not peers:
+            return cp.NetworkPolicyPeer()
+        groups: list[str] = []
+        blocks: list[cp.IPBlock] = []
+        for p in peers:
+            if p.ip_block is not None:
+                blocks.append(p.ip_block)
+                continue
+            if anp.is_cluster_scoped or p.ns_selector is not None:
+                sel = GroupSelector(namespace="", pod_selector=p.pod_selector,
+                                    ns_selector=p.ns_selector)
+            else:
+                sel = GroupSelector(namespace=anp.namespace,
+                                    pod_selector=p.pod_selector or LabelSelector())
+            groups.append(self._ensure_group(self._ags, sel, anp.uid, "AddressGroup"))
+        return cp.NetworkPolicyPeer(address_groups=groups, ip_blocks=blocks)
+
+    # -- install / delete ----------------------------------------------------
+
+    def _install(self, uid: str, internal: cp.NetworkPolicy, kind: str) -> None:
+        old = self._nps.get(uid)
+        self._nps[uid] = internal
+        self._raw_uid_kind[uid] = kind
+        span: set = set()
+        for key in self._np_atg_keys(internal):
+            st = self._atgs.get(key)
+            if st is not None:
+                span |= {m.node for m in st.members if m.node}
+        self._np_span[uid] = span
+        if old is not None:
+            # Unref groups the new version no longer uses.
+            for key in self._np_atg_keys(old) - self._np_atg_keys(internal):
+                self._unref_group(self._atgs, key, uid, "AppliedToGroup")
+            for key in self._np_ag_keys(old) - self._np_ag_keys(internal):
+                self._unref_group(self._ags, key, uid, "AddressGroup")
+        self._emit(WatchEvent(
+            kind="UPDATED" if old is not None else "ADDED",
+            obj_type="NetworkPolicy", name=uid, obj=internal, span=set(span),
+        ))
+        # Group spans depend on referencing-policy spans; refresh them now
+        # that this policy's span is known (groups were ensured before the
+        # policy existed in _nps, so their initial span missed it).
+        self._reemit_group_spans(internal)
+
+    def delete_policy(self, uid: str) -> None:
+        np = self._nps.pop(uid, None)
+        if np is None:
+            return
+        self._np_span.pop(uid, None)
+        self._raw_uid_kind.pop(uid, None)
+        for key in self._np_atg_keys(np):
+            self._unref_group(self._atgs, key, uid, "AppliedToGroup")
+        for key in self._np_ag_keys(np):
+            self._unref_group(self._ags, key, uid, "AddressGroup")
+        self._emit(WatchEvent(kind="DELETED", obj_type="NetworkPolicy", name=uid))
+
+    # -- snapshots (compiler input) ------------------------------------------
+
+    def policy_set(self) -> PolicySet:
+        return PolicySet(
+            policies=list(self._nps.values()),
+            address_groups={
+                k: cp.AddressGroup(name=k, members=list(st.members))
+                for k, st in self._ags.items()
+            },
+            applied_to_groups={
+                k: cp.AppliedToGroup(name=k, members=list(st.members))
+                for k, st in self._atgs.items()
+            },
+        )
+
+    def policy_set_for_node(self, node: str) -> PolicySet:
+        """Span-filtered snapshot: exactly what the reference disseminates to
+        one agent (architecture.md:57-60)."""
+        policies = [
+            np for uid, np in self._nps.items()
+            if node in self._np_span.get(uid, set())
+        ]
+        atg_keys: set = set()
+        ag_keys: set = set()
+        for np in policies:
+            atg_keys |= self._np_atg_keys(np)
+            ag_keys |= self._np_ag_keys(np)
+        return PolicySet(
+            policies=policies,
+            address_groups={
+                k: cp.AddressGroup(name=k, members=list(self._ags[k].members))
+                for k in ag_keys if k in self._ags
+            },
+            applied_to_groups={
+                k: cp.AppliedToGroup(name=k, members=list(self._atgs[k].members))
+                for k in atg_keys if k in self._atgs
+            },
+        )
+
+
+def _port_to_service(p: PortSpec) -> cp.Service:
+    return cp.Service(protocol=p.protocol, port=p.port, end_port=p.end_port)

@@ -1,0 +1,26 @@
+"""Datapath plugin boundary (ref: pkg/ovs/ovsconfig OVSDatapathType seam)."""
+
+from .interface import Datapath, DatapathType, StepResult
+from .oracle_dp import OracleDatapath
+from .tpuflow import TpuflowDatapath
+
+
+def make_datapath(kind: DatapathType | str, *args, **kwargs) -> Datapath:
+    """Factory keyed on DatapathType — the GetOVSDatapathType dispatch analog
+    (ref ovsconfig/interfaces.go:82)."""
+    kind = DatapathType(kind)
+    if kind == DatapathType.TPUFLOW:
+        return TpuflowDatapath(*args, **kwargs)
+    if kind == DatapathType.ORACLE:
+        return OracleDatapath(*args, **kwargs)
+    raise ValueError(f"unknown datapath type {kind}")
+
+
+__all__ = [
+    "Datapath",
+    "DatapathType",
+    "StepResult",
+    "TpuflowDatapath",
+    "OracleDatapath",
+    "make_datapath",
+]

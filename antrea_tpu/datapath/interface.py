@@ -1,0 +1,123 @@
+"""The datapath plugin boundary.
+
+Analog of the reference's OVS datapath-type seam: `OVSDatapathType` at
+/root/reference/pkg/ovs/ovsconfig/interfaces.go:24 (sole upstream value
+"system" at :33, surfaced via GetOVSDatapathType :82) plus the semantic
+surface of the agent's openflow client (install/uninstall + atomic bundle
+transactions, pkg/ovs/openflow/ofctrl_bridge.go:468 AddFlowsInBundle).
+
+Everything above this boundary (controllers, dissemination, tests) drives a
+`Datapath` and never imports kernel internals; `tpuflow` (the TPU kernel)
+and `oracle` (the scalar reference implementation — this build's stand-in
+for OVSDatapathSystem in differential tests) are interchangeable behind it.
+
+Bundle semantics: `install_bundle` atomically replaces rule/service state
+and returns the new generation; in tpuflow this is the double-buffered
+(drs', dsvc', gen+1) tensor swap.  `apply_group_delta` is the incremental
+path (address-group watch deltas, docs/design/architecture.md:61-62):
+bounded host work + a small device upload, no recompile.
+"""
+
+from __future__ import annotations
+
+import enum
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..apis.service import ServiceEntry
+from ..compiler.ir import PolicySet
+from ..packet import PacketBatch
+
+
+class DatapathType(str, enum.Enum):
+    TPUFLOW = "tpuflow"
+    ORACLE = "oracle"
+
+
+@dataclass
+class StepResult:
+    """Batched verdict output; all arrays shape (B,).
+
+    rule ids are stable string identities (compiler.ir.rule_id); None where
+    no explicit rule decided (default allow / K8s default deny).
+    """
+
+    code: np.ndarray  # 0 allow / 1 drop / 2 reject
+    est: np.ndarray  # 0/1 — established-connection fast-path hit
+    svc_idx: np.ndarray  # -1 = not a service
+    dnat_ip: np.ndarray  # u32, post-DNAT destination
+    dnat_port: np.ndarray
+    ingress_rule: list  # Optional[str] per packet
+    egress_rule: list
+    committed: np.ndarray  # 0/1 — conntrack commit happened this step
+    n_miss: int
+
+
+class Datapath(ABC):
+    """One datapath instance == one node's dataplane (the OVS bridge analog)."""
+
+    @property
+    @abstractmethod
+    def datapath_type(self) -> DatapathType: ...
+
+    @property
+    @abstractmethod
+    def generation(self) -> int:
+        """Current bundle generation (cookie-round analog)."""
+
+    @abstractmethod
+    def install_bundle(
+        self,
+        ps: Optional[PolicySet] = None,
+        services: Optional[list[ServiceEntry]] = None,
+    ) -> int:
+        """Atomically replace the policy set and/or service set; returns the
+        new generation.  Established connections survive; cached denials are
+        invalidated (ovs-pipeline.md:1685-1691 semantics)."""
+
+    @abstractmethod
+    def apply_group_delta(
+        self,
+        group_name: str,
+        added_ips: list[str],
+        removed_ips: list[str],
+    ) -> int:
+        """Incremental membership update for a named AddressGroup or
+        AppliedToGroup; returns the new generation."""
+
+    @abstractmethod
+    def step(self, batch: PacketBatch, now: int) -> StepResult:
+        """Process one packet batch through the full stateful pipeline."""
+
+    @abstractmethod
+    def stats(self) -> "DatapathStats":
+        """Per-rule packet counters — the IngressMetric/EgressMetric table
+        analog (ref pkg/agent/openflow/pipeline.go metric tables; collection
+        path network_policy.go:2034 NetworkPolicyMetrics)."""
+
+    @abstractmethod
+    def trace(self, batch: PacketBatch, now: int) -> list[dict]:
+        """Read-only per-packet pipeline trace (the Traceflow analog, ref
+        pkg/agent/openflow/framework.go:328-338 flowsToTrace): for each
+        packet, the stage-by-stage observations WITHOUT mutating any state.
+        Keys: cache_hit, est, svc_idx, dnat_ip, dnat_port, egress_code,
+        egress_rule, ingress_code, ingress_rule, code."""
+
+
+@dataclass
+class DatapathStats:
+    """Cumulative per-rule packet counts since datapath creation.
+
+    Keyed by stable rule id; counts include both fresh classifications and
+    cached-entry hits (ct_label attribution persists across the cache, as in
+    the reference).  default_allow / default_deny count packets decided by
+    no explicit rule (table-miss allow / K8s isolation deny).
+    """
+
+    ingress: dict
+    egress: dict
+    default_allow: int = 0
+    default_deny: int = 0

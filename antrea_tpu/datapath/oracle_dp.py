@@ -1,0 +1,147 @@
+"""OracleDatapath: the scalar reference implementation behind the boundary.
+
+This is the build's stand-in for `OVSDatapathSystem` (the real-OVS datapath
+the reference tests differentially against,
+/root/reference/pkg/ovs/ovsconfig/interfaces.go:33 and the integration model
+in test/integration/agent/openflow_test.go): a second, independent
+implementation of the same Datapath surface, driven by the same bundles and
+deltas, used to diff verdicts against tpuflow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from collections import Counter
+
+from ..apis.controlplane import GroupMember
+from ..compiler.ir import PolicySet
+from ..oracle.pipeline import PipelineOracle
+from ..packet import PacketBatch
+from .interface import Datapath, DatapathStats, DatapathType, StepResult
+
+
+class OracleDatapath(Datapath):
+    def __init__(
+        self,
+        ps: Optional[PolicySet] = None,
+        services=None,
+        *,
+        flow_slots: int = 1 << 20,
+        aff_slots: int = 1 << 18,
+        ct_timeout_s: int = 3600,
+    ):
+        self._ps = ps if ps is not None else PolicySet()
+        self._services = list(services or [])
+        self._gen = 0
+        self._oracle = PipelineOracle(
+            self._ps, self._services,
+            flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
+        )
+        self._stats_in: Counter = Counter()
+        self._stats_out: Counter = Counter()
+        self._default_allow = 0
+        self._default_deny = 0
+
+    @property
+    def datapath_type(self) -> DatapathType:
+        return DatapathType.ORACLE
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    def install_bundle(self, ps=None, services=None) -> int:
+        if ps is not None:
+            self._ps = ps
+        if services is not None:
+            self._services = list(services)
+        self._oracle.update(
+            ps=ps, services=list(services) if services is not None else None
+        )
+        self._gen += 1
+        return self._gen
+
+    def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
+        touched = False
+        for table in (self._ps.address_groups, self._ps.applied_to_groups):
+            g = table.get(group_name)
+            if g is None:
+                continue
+            touched = True
+            for ip in added_ips:
+                g.members.append(GroupMember(ip=ip))
+            for ip in removed_ips:
+                for i, m in enumerate(g.members):
+                    if m.ip == ip:
+                        del g.members[i]
+                        break
+        if not touched:
+            raise KeyError(f"unknown group {group_name!r}")
+        self._oracle.update(ps=self._ps)
+        self._gen += 1
+        return self._gen
+
+    def stats(self) -> DatapathStats:
+        return DatapathStats(
+            ingress=dict(self._stats_in),
+            egress=dict(self._stats_out),
+            default_allow=self._default_allow,
+            default_deny=self._default_deny,
+        )
+
+    def trace(self, batch: PacketBatch, now: int) -> list[dict]:
+        """Read-only per-packet trace, same semantics as TpuflowDatapath:
+        the FRESH pipeline walk for every packet plus the cache overlay
+        (effective `code` from the cache on hits)."""
+        from ..models.pipeline import GEN_ETERNAL
+
+        o = self._oracle
+        gen_w = self._gen % GEN_ETERNAL
+        out = []
+        for i in range(batch.size):
+            p = batch.packet(i)
+            h = o._flow_hash(p)
+            _slot, e = o.lookup(o.flow, p, h, now, gen_w)
+            w = o.fresh_walk(o.aff, p, h, now)
+            out.append({
+                "cache_hit": e is not None,
+                "est": e is not None and e["gen"] is None,
+                "svc_idx": w["svc_idx"],
+                "no_ep": w["no_ep"],
+                "dnat_ip": w["dnat_ip"],
+                "dnat_port": w["dnat_port"],
+                "egress_code": w["egress_code"],
+                "egress_rule": w["egress_rule"],
+                "ingress_code": w["ingress_code"],
+                "ingress_rule": w["ingress_rule"],
+                "fresh_code": w["code"],
+                "code": e["code"] if e is not None else w["code"],
+            })
+        return out
+
+    def step(self, batch: PacketBatch, now: int) -> StepResult:
+        outs = self._oracle.step(batch, now, gen=self._gen)
+        for o in outs:
+            if o.ingress_rule is not None:
+                self._stats_in[o.ingress_rule] += 1
+            if o.egress_rule is not None:
+                self._stats_out[o.egress_rule] += 1
+            if o.ingress_rule is None and o.egress_rule is None:
+                if o.code == 0:
+                    self._default_allow += 1
+                else:
+                    self._default_deny += 1
+        return StepResult(
+            code=np.array([o.code for o in outs], np.int32),
+            est=np.array([int(o.est) for o in outs], np.int32),
+            svc_idx=np.array([o.svc_idx for o in outs], np.int32),
+            dnat_ip=np.array([o.dnat_ip for o in outs], np.uint32),
+            dnat_port=np.array([o.dnat_port for o in outs], np.int32),
+            ingress_rule=[o.ingress_rule for o in outs],
+            egress_rule=[o.egress_rule for o in outs],
+            committed=np.array([int(o.committed) for o in outs], np.int32),
+            n_miss=sum(1 for o in outs if not o.hit),
+        )

@@ -1,0 +1,378 @@
+"""TpuflowDatapath: the TPU kernel behind the Datapath boundary.
+
+Owns the device tensors (rules, services, flow-cache/conntrack state) for
+one datapath instance and realizes the bundle/commit semantics of the
+reference's OVS binding layer:
+
+  install_bundle   == AddFlowsInBundle + bundle commit
+                      (/root/reference/pkg/ovs/openflow/ofctrl_bridge.go:468):
+                      compile -> (drs', dsvc', gen+1) swap.  The swap is
+                      atomic by construction — the next step() call sees
+                      either the old or the new tensors, never a mix.
+  apply_group_delta== the incremental address-group watch delta
+                      (docs/design/architecture.md:61-62): O(affected
+                      columns) host work + a five-small-array device upload
+                      (ops/match.DeltaTable), no recompile; overflow folds
+                      into a full recompile (megaflow-revalidation analog).
+  generation       == the cookie round (pkg/agent/openflow/cookie/
+                      allocator.go:76-135): bumping it invalidates cached
+                      denials while established connections persist.
+
+Attribution caveat shared with the reference: rule attribution of
+established-connection hits is whatever was committed at insert time; after
+a bundle that renumbers rules, stale attributions resolve against the new
+table, exactly as OVS ct_label carries a conj_id that may outlive its rule
+(ref network_policy.go ct_label persistence).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..apis.controlplane import GroupMember
+from ..apis.service import ServiceEntry
+from ..compiler.compile import compile_policy_set
+from ..compiler.ir import PolicySet
+from ..compiler.services import compile_services
+from ..models import pipeline as pl
+from ..ops.match import DeltaTable, to_device
+from ..packet import PacketBatch
+from ..utils import ip as iputil
+from .interface import Datapath, DatapathStats, DatapathType, StepResult
+
+
+class TpuflowDatapath(Datapath):
+    def __init__(
+        self,
+        ps: Optional[PolicySet] = None,
+        services: Optional[list[ServiceEntry]] = None,
+        *,
+        chunk: int = 512,
+        flow_slots: int = 1 << 20,
+        aff_slots: int = 1 << 18,
+        ct_timeout_s: int = 3600,
+        miss_chunk: int = 4096,
+        delta_slots: int = 128,
+    ):
+        self._chunk = chunk
+        self._delta_slots = delta_slots
+        self._pipe_kw = dict(
+            flow_slots=flow_slots, aff_slots=aff_slots,
+            ct_timeout_s=ct_timeout_s, miss_chunk=miss_chunk,
+        )
+        self._ps = ps if ps is not None else PolicySet()
+        self._services = list(services or [])
+        self._gen = 0
+        self._state = pl.init_state(flow_slots, aff_slots)
+        # Per-rule packet counters (IngressMetric/EgressMetric analog),
+        # keyed by stable rule id so they survive bundle renumbering.
+        self._stats_in: Counter = Counter()
+        self._stats_out: Counter = Counter()
+        self._default_allow = 0
+        self._default_deny = 0
+        self._compile_rules()
+        self._compile_services()
+
+    # -- Datapath ------------------------------------------------------------
+
+    @property
+    def datapath_type(self) -> DatapathType:
+        return DatapathType.TPUFLOW
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    def install_bundle(self, ps=None, services=None) -> int:
+        if ps is not None:
+            self._ps = ps
+            self._compile_rules()
+        if services is not None:
+            self._services = list(services)
+            self._compile_services()
+        self._gen += 1
+        return self._gen
+
+    def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
+        gids = self._name_gids.get(group_name, [])
+        if not gids and group_name not in self._group_members:
+            raise KeyError(f"unknown group {group_name!r}")
+        rows: list[tuple[tuple[int, int], int, int]] = []  # (range, gid, sign)
+        own = self._group_members.setdefault(group_name, Counter())
+        need_recompile = False
+
+        for ip in added_ips:
+            r = iputil.cidr_to_range(ip)
+            if not _contains(self._ranges_of(group_name), r):
+                for gid in gids:
+                    if not self._covered_by_others(gid, group_name, r):
+                        rows.append((r, gid, +1))
+            own[ip] += 1
+        for ip in removed_ips:
+            if own[ip] <= 0:
+                continue
+            own[ip] -= 1
+            if own[ip] == 0:
+                del own[ip]
+            r = iputil.cidr_to_range(ip)
+            residual = self._ranges_of(group_name)
+            if _contains(residual, r):
+                continue  # another member/block still provides this range
+            if _overlaps(residual, r):
+                # Partial residual coverage (overlapping CIDR members): a
+                # whole-range clear would be wrong — fold via full compile.
+                need_recompile = True
+                continue
+            for gid in gids:
+                if self._covered_by_others(gid, group_name, r):
+                    continue
+                if self._partially_covered_by_others(gid, group_name, r):
+                    need_recompile = True
+                else:
+                    rows.append((r, gid, -1))
+
+        self._sync_ps_members(group_name)
+        if need_recompile or self._n_deltas + len(rows) > self._delta_slots:
+            # Fold everything into a fresh compile (the revalidation event)
+            # — membership mirrors are already current.
+            self._compile_rules()
+        elif rows:
+            self._append_deltas(rows)
+        self._gen += 1
+        return self._gen
+
+    def step(self, batch: PacketBatch, now: int) -> StepResult:
+        state, out = pl.pipeline_step(
+            self._state,
+            self._drs,
+            self._dsvc,
+            jnp.asarray(iputil.flip_u32(batch.src_ip)),
+            jnp.asarray(iputil.flip_u32(batch.dst_ip)),
+            jnp.asarray(batch.proto.astype(np.int32)),
+            jnp.asarray(batch.src_port.astype(np.int32)),
+            jnp.asarray(batch.dst_port.astype(np.int32)),
+            jnp.int32(now),
+            jnp.int32(self._gen),
+            meta=self._meta,
+        )
+        self._state = state
+        o = {k: np.asarray(v) for k, v in out.items()}
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+        self._count_metrics(o, in_ids, out_ids)
+        return StepResult(
+            code=o["code"],
+            est=o["est"],
+            svc_idx=o["svc_idx"],
+            dnat_ip=(o["dnat_ip_f"].astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32),
+            dnat_port=o["dnat_port"],
+            ingress_rule=[
+                in_ids[i] if 0 <= i < len(in_ids) and in_ids[i] else None
+                for i in o["ingress_rule"]
+            ],
+            egress_rule=[
+                out_ids[i] if 0 <= i < len(out_ids) and out_ids[i] else None
+                for i in o["egress_rule"]
+            ],
+            committed=o["committed"],
+            n_miss=int(o["n_miss"]),
+        )
+
+    def stats(self) -> DatapathStats:
+        return DatapathStats(
+            ingress=dict(self._stats_in),
+            egress=dict(self._stats_out),
+            default_allow=self._default_allow,
+            default_deny=self._default_deny,
+        )
+
+    def trace(self, batch: PacketBatch, now: int) -> list[dict]:
+        """Traceflow analog: per-packet stage observations, state untouched.
+
+        Reports the FRESH pipeline walk (ServiceLB + classifier) for every
+        packet plus the cache-lookup overlay; for cache-hit packets the
+        effective `code` is the cached one while dnat/rule fields show what
+        a fresh walk would decide (a probe, not a replay of commit state).
+        """
+        o = pl.pipeline_trace(
+            self._state,
+            self._drs,
+            self._dsvc,
+            jnp.asarray(iputil.flip_u32(batch.src_ip)),
+            jnp.asarray(iputil.flip_u32(batch.dst_ip)),
+            jnp.asarray(batch.proto.astype(np.int32)),
+            jnp.asarray(batch.src_port.astype(np.int32)),
+            jnp.asarray(batch.dst_port.astype(np.int32)),
+            jnp.int32(now),
+            jnp.int32(self._gen),
+            meta=self._meta,
+        )
+        o = {k: np.asarray(v) for k, v in o.items()}
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+
+        def rid(ids, i):
+            return ids[i] if 0 <= i < len(ids) and ids[i] else None
+
+        out = []
+        for i in range(batch.size):
+            out.append({
+                "cache_hit": bool(o["cache_hit"][i]),
+                "est": bool(o["est"][i]),
+                "svc_idx": int(o["svc_idx"][i]),
+                "no_ep": bool(o["no_ep"][i]),
+                "dnat_ip": int(np.uint32(o["dnat_ip_f"][i] ^ np.int32(-(2**31)))),
+                "dnat_port": int(o["dnat_port"][i]),
+                "egress_code": int(o["egress_code"][i]),
+                "egress_rule": rid(out_ids, int(o["egress_rule"][i])),
+                "ingress_code": int(o["ingress_code"][i]),
+                "ingress_rule": rid(in_ids, int(o["ingress_rule"][i])),
+                "fresh_code": int(o["fresh_code"][i]),
+                "code": int(o["code"][i]),
+            })
+        return out
+
+    # -- internals -----------------------------------------------------------
+
+    def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
+        for key, ids, ctr in (
+            ("ingress_rule", in_ids, self._stats_in),
+            ("egress_rule", out_ids, self._stats_out),
+        ):
+            idx = o[key]
+            # Cached entries can carry attribution indices from an older
+            # generation (ct_label semantics); clamp to the current table.
+            vals = idx[(idx >= 0) & (idx < len(ids))]
+            if vals.size:
+                bc = np.bincount(vals, minlength=len(ids))
+                for r in np.nonzero(bc)[0]:
+                    if ids[r]:
+                        ctr[ids[r]] += int(bc[r])
+        none_mask = (o["ingress_rule"] < 0) & (o["egress_rule"] < 0)
+        self._default_allow += int(((o["code"] == 0) & none_mask).sum())
+        self._default_deny += int(((o["code"] != 0) & none_mask).sum())
+
+    def _compile_rules(self) -> None:
+        cps = compile_policy_set(self._ps)
+        pl.check_rule_capacity(cps)
+        drs, match_meta = to_device(cps, self._chunk, delta_slots=self._delta_slots)
+        self._cps = cps
+        self._drs = drs
+        self._meta = pl.PipelineMeta(
+            match=match_meta,
+            flow_slots=self._pipe_kw["flow_slots"],
+            aff_slots=self._pipe_kw["aff_slots"],
+            ct_timeout_s=self._pipe_kw["ct_timeout_s"],
+            miss_chunk=self._pipe_kw["miss_chunk"],
+        )
+        # Reset incremental bookkeeping: the compile folded all prior deltas.
+        self._n_deltas = 0
+        self._delta_host = {
+            "lo_f": np.full(self._delta_slots, 2**31 - 1, np.int32),
+            "hi_f": np.full(self._delta_slots, -(2**31), np.int32),
+            "word": np.zeros(self._delta_slots, np.int32),
+            "bit": np.zeros(self._delta_slots, np.uint32),
+            "sign": np.zeros(self._delta_slots, np.int32),
+        }
+        self._name_gids: dict[str, list[int]] = {}
+        self._gid_ident = dict(cps.gid_ident)
+        for gid, (_kind, names, _static) in self._gid_ident.items():
+            for n in names:
+                self._name_gids.setdefault(n, []).append(gid)
+        # Membership mirrors for coverage checks and overflow recompiles.
+        # Counter of member ip/cidr STRINGS (refcounted: two pods may share
+        # an IP transiently); per-group static ipBlocks tracked separately
+        # (they change only via install_bundle).
+        self._group_members: dict[str, Counter] = {}
+        self._static_blocks: dict[str, list[tuple[int, int]]] = {}
+        for name, g in self._ps.address_groups.items():
+            c = Counter()
+            for m in g.members:
+                c[m.ip] += 1
+            self._group_members[name] = c
+            blocks: list[tuple[int, int]] = []
+            for b in g.ip_blocks:
+                blocks.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
+            self._static_blocks[name] = blocks
+        for name, g in self._ps.applied_to_groups.items():
+            if name in self._group_members:
+                continue  # same-named AddressGroup => same selector/members
+            c = Counter()
+            for m in g.members:
+                c[m.ip] += 1
+            self._group_members[name] = c
+
+    def _compile_services(self) -> None:
+        self._dsvc = pl.svc_to_device(compile_services(self._services))
+
+    def _ranges_of(self, name: str) -> list[tuple[int, int]]:
+        """Current merged ranges of a named group (members + static blocks)."""
+        mem = self._group_members.get(name)
+        rs: list[tuple[int, int]] = []
+        if mem is not None:
+            rs.extend(iputil.cidr_to_range(s) for s, c in mem.items() if c > 0)
+        rs.extend(self._static_blocks.get(name, ()))
+        return iputil.merge_ranges(rs)
+
+    def _covered_by_others(self, gid: int, exclude: str, r: tuple[int, int]) -> bool:
+        _kind, names, static = self._gid_ident[gid]
+        if _contains(iputil.merge_ranges(list(static)), r):
+            return True
+        return any(
+            _contains(self._ranges_of(n), r) for n in names if n != exclude
+        )
+
+    def _partially_covered_by_others(self, gid: int, exclude: str, r) -> bool:
+        _kind, names, static = self._gid_ident[gid]
+        if _overlaps(iputil.merge_ranges(list(static)), r):
+            return True
+        return any(
+            _overlaps(self._ranges_of(n), r) for n in names if n != exclude
+        )
+
+    def _append_deltas(self, rows) -> None:
+        h = self._delta_host
+        for (lo, hi), gid, sign in rows:
+            i = self._n_deltas
+            h["lo_f"][i] = iputil.flip_u32(np.uint32(lo))
+            h["hi_f"][i] = iputil.flip_u32(np.uint32(hi - 1))  # inclusive
+            h["word"][i] = gid >> 5
+            h["bit"][i] = np.uint32(1 << (gid & 31))
+            h["sign"][i] = sign
+            self._n_deltas += 1
+        self._drs = self._drs._replace(ip_delta=DeltaTable(
+            lo_f=jnp.asarray(h["lo_f"]),
+            hi_f=jnp.asarray(h["hi_f"]),
+            word=jnp.asarray(h["word"]),
+            bit=jnp.asarray(h["bit"]),
+            sign=jnp.asarray(h["sign"]),
+        ))
+
+    def _sync_ps_members(self, name: str) -> None:
+        """Keep the held PolicySet's group membership in line with the
+        membership mirror so an overflow-triggered recompile sees current
+        membership."""
+        own = self._group_members.get(name, Counter())
+        members = [
+            GroupMember(ip=s) for s, cnt in sorted(own.items()) for _ in range(cnt)
+        ]
+        ag = self._ps.address_groups.get(name)
+        if ag is not None:
+            ag.members = list(members)
+        atg = self._ps.applied_to_groups.get(name)
+        if atg is not None:
+            atg.members = list(members)
+
+
+def _contains(ranges: list[tuple[int, int]], r: tuple[int, int]) -> bool:
+    lo, hi = r
+    return any(lo >= lo2 and hi <= hi2 for lo2, hi2 in ranges)
+
+
+def _overlaps(ranges: list[tuple[int, int]], r: tuple[int, int]) -> bool:
+    lo, hi = r
+    return any(lo < hi2 and hi > lo2 for lo2, hi2 in ranges)

@@ -1,0 +1,5 @@
+"""Dissemination plane (ref: pkg/apiserver RAM store + watch fan-out)."""
+
+from .store import RamStore
+
+__all__ = ["RamStore"]

@@ -1,0 +1,80 @@
+"""Watchable object store with span-based per-node filtering.
+
+The analog of the reference's in-memory aggregated-API storage:
+/root/reference/pkg/apiserver/storage/ram/store.go:46-80 (indexer + watchers
++ event fan-out, no etcd) with per-watcher filtering via SelectFunc
+(storage/interfaces.go:60) — the mechanism behind "a Node receives an object
+iff it needs it" (docs/design/architecture.md:57-60).
+
+Differences by design: events are delivered synchronously to subscriber
+callbacks (the network/serialization boundary arrives with the gRPC service
+in the C++ runtime layer); the reference's resourceVersion bookkeeping
+reduces to Python object identity because there is one producer.
+
+Key behavior shared with the reference: a watcher is told about an object
+when the object's span GROWS to include its node (synthesized ADDED), and
+gets a DELETED when the span shrinks away from it — the span diff IS the
+subscription filter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Callable, Optional
+
+from ..controller.networkpolicy import WatchEvent
+
+
+@dataclass
+class _Stored:
+    obj: object
+    span: set
+
+
+class RamStore:
+    """One store instance per object type family; here one instance carries
+    all three types keyed by (obj_type, name) since WatchEvent is uniform."""
+
+    def __init__(self):
+        self._objs: dict[tuple[str, str], _Stored] = {}
+        self._watchers: list[tuple[str, Callable[[WatchEvent], None], set]] = []
+
+    # -- producer side -------------------------------------------------------
+
+    def apply(self, ev: WatchEvent) -> None:
+        key = (ev.obj_type, ev.name)
+        if ev.kind == "DELETED":
+            self._objs.pop(key, None)
+            for node, cb, known in self._watchers:
+                if key in known:
+                    known.discard(key)
+                    cb(WatchEvent(kind="DELETED", obj_type=ev.obj_type, name=ev.name))
+            return
+
+        self._objs[key] = _Stored(obj=ev.obj, span=set(ev.span))
+        for node, cb, known in self._watchers:
+            relevant = node in ev.span
+            if relevant and key not in known:
+                known.add(key)
+                cb(replace(ev, kind="ADDED"))
+            elif relevant:
+                cb(ev)
+            elif key in known:
+                # Span shrank away from this node: retract the object.
+                known.discard(key)
+                cb(WatchEvent(kind="DELETED", obj_type=ev.obj_type, name=ev.name))
+
+    # -- consumer side -------------------------------------------------------
+
+    def watch(self, node: str, cb: Callable[[WatchEvent], None]) -> None:
+        """Subscribe a node: replays current relevant objects as ADDED, then
+        streams filtered events (the reference's watch bookmark semantics)."""
+        known: set = set()
+        for (obj_type, name), st in sorted(self._objs.items()):
+            if node in st.span:
+                known.add((obj_type, name))
+                cb(WatchEvent(
+                    kind="ADDED", obj_type=obj_type, name=name,
+                    obj=st.obj, span=set(st.span),
+                ))
+        self._watchers.append((node, cb, known))

@@ -73,21 +73,29 @@ GEN_ETERNAL = (1 << GEN_BITS) - 1
 
 
 class FlowCache(NamedTuple):
-    """Direct-mapped unified flow cache; separate i32 columns, (N+1,) each.
+    """Direct-mapped unified flow cache, row-packed for the fast path.
 
-    key_pg packs proto (9 bits, value 0..255 plus a valid bit 8) with the
-    entry generation (GEN_BITS): proto | 0x100 | gen << 9.  Zero rows
-    (proto bits 0, valid bit unset) can never match a real packet.
+    Layout chosen from measurement on v5e (see docstring history): the fast
+    path is gather-bound, and one (N, 4) ROW gather is ~10-30x faster than
+    four 1-D column gathers (contiguous 16B reads vs four scattered 4B
+    reads), while row SCATTERS are slow and 1-D column scatters fast — so
+    the hit-path write (ts refresh) keeps its own column and full-entry
+    writes (inserts) happen only on the miss path where the batch is small.
+
+      keys (N+1, 4) i32: [src_f, dst_f, sport<<16|dport, proto|0x100|gen<<9]
+        key_pg packs proto (8 bits + valid bit 8) with the entry generation
+        (GEN_BITS): zero rows (valid bit unset) can never match a packet.
+      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules, 0]
+        meta1 = code(2) | (svc_idx+1)(14) | dnat_port(16)
+        rules = (rule_in+1)(16) | (rule_out+1)(16); 0 = default/none
+      ts   (N+1,)  i32: last-seen seconds (refreshed on every hit)
+
+    dst in keys is the ORIGINAL (pre-DNAT) dst; dnat_ip_f the resolved one.
     """
 
-    key_src: jax.Array  # sign-flipped src ip
-    key_dst: jax.Array  # sign-flipped ORIGINAL dst ip (pre-DNAT)
-    key_pp: jax.Array  # sport<<16 | dport (original dport)
-    key_pg: jax.Array  # proto | 0x100 | gen<<9
-    ts: jax.Array  # last-seen seconds
-    dnat_ip_f: jax.Array  # resolved post-DNAT dst (== dst if not a service)
-    meta1: jax.Array  # code(2) | (svc_idx+1)(14) | dnat_port(16)
-    rules: jax.Array  # (rule_in+1)(16) | (rule_out+1)(16); 0 = default/none
+    keys: jax.Array
+    meta: jax.Array
+    ts: jax.Array
 
 
 class AffinityTable(NamedTuple):
@@ -140,7 +148,11 @@ def init_state(flow_slots: int = 1 << 20, aff_slots: int = 1 << 18) -> PipelineS
     def zeros(n):
         return jnp.zeros(n + 1, dtype=jnp.int32)
 
-    flow = FlowCache(*[zeros(flow_slots) for _ in FlowCache._fields])
+    flow = FlowCache(
+        keys=jnp.zeros((flow_slots + 1, 4), dtype=jnp.int32),
+        meta=jnp.zeros((flow_slots + 1, 4), dtype=jnp.int32),
+        ts=zeros(flow_slots),
+    )
     aff = AffinityTable(*[zeros(aff_slots) for _ in AffinityTable._fields])
     return PipelineState(flow=flow, aff=aff)
 
@@ -150,15 +162,27 @@ def _raw_bits(x_f: jax.Array) -> jax.Array:
     return x_f ^ jnp.int32(-(2**31))
 
 
-def _scatter_last(arr, slots, vals, mask, dump):
-    """Masked scatter with deterministic last-writer-wins on duplicate slots."""
+def _winner_mask(n_slots, slots, mask, dump):
+    """Deterministic last-writer-wins for duplicate slots in one batch."""
     B = slots.shape[0]
     slots_m = jnp.where(mask, slots, dump)
     order = jnp.arange(B, dtype=jnp.int32)
-    winner = jnp.full_like(arr, -1).at[slots_m].max(order)
-    win_idx = winner[slots_m]
-    is_winner = (win_idx == order) & mask
+    winner = jnp.full((n_slots + 1,), -1, jnp.int32).at[slots_m].max(order)
+    return (winner[slots_m] == order) & mask
+
+
+def _scatter_last(arr, slots, vals, mask, dump):
+    """Masked 1-D scatter with last-writer-wins on duplicate slots."""
+    is_winner = _winner_mask(arr.shape[0] - 1, slots, mask, dump)
     return arr.at[jnp.where(is_winner, slots, dump)].set(vals)
+
+
+def _scatter_last_rows(arr, slots, rows, mask, dump):
+    """Masked row scatter ((M, K) payload into (N+1, K)) with
+    last-writer-wins; used only on the miss path where M is small (row
+    scatters are slow on TPU — see FlowCache layout rationale)."""
+    is_winner = _winner_mask(arr.shape[0] - 1, slots, mask, dump)
+    return arr.at[jnp.where(is_winner, slots, dump)].set(rows)
 
 
 def _pack_meta1(code, svc_idx, dnat_port):
@@ -173,8 +197,10 @@ def _unpack_meta1(m1):
 
 
 def _pack_rules(rule_in, rule_out):
-    # Rule indices fit 16 bits each (to_device asserts n_rules < 0xFFFF);
-    # stored +1 so the zero row means "no rule" (MISS).
+    # Rule indices fit 16 bits each (check_rule_capacity, invoked by every
+    # pipeline constructor, guards n_rules < 0xFFFE per direction; callers
+    # composing to_device + _pack_rules directly must call it themselves).
+    # Stored +1 so the zero row means "no rule" (MISS).
     return (rule_in + 1) | ((rule_out + 1) << 16)
 
 
@@ -269,9 +295,14 @@ def _service_lb(
     ah = hashing.fnv_mix([src_raw, svc_safe], xp=jnp)
     aslot = (ah & jnp.uint32(aff_slots - 1)).astype(jnp.int32)
     # Entry liveness = stored ep+1 > 0 (works even for learns at now == 0).
+    # A stored ep slot >= the service's current endpoint count is stale
+    # (endpoints shrank since the learn) — treat as a miss and re-select, the
+    # analog of AntreaProxy's stale learn-flow/conntrack cleanup on endpoint
+    # deletion (ref proxier.go syncProxyRules endpoint-change handling).
     aff_hit = (
         aff_on
         & (aff.ep[aslot] > 0)
+        & (aff.ep[aslot] - 1 < dsvc.n_ep[svc_safe])
         & (aff.key_client[aslot] == src_f)
         & (aff.key_svc[aslot] == svc_idx)
         & ((now - aff.ts[aslot]) <= dsvc.aff_timeout[svc_safe])
@@ -319,23 +350,25 @@ def _pipeline_step(
     pp = (sport << 16) | dport
     gen_w = jnp.asarray(gen, jnp.int32) % GEN_ETERNAL  # never == GEN_ETERNAL
 
-    # ---- fast path: flow-cache lookup (9 column gathers) -------------------
+    # ---- fast path: flow-cache lookup (2 row gathers + 1 column gather) ----
     h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    kpg = flow.key_pg[slot]
+    kr = flow.keys[slot]  # (B, 4)
+    kpg = kr[:, 3]
     key_hit = (
-        (flow.key_src[slot] == src_f)
-        & (flow.key_dst[slot] == dst_f)
-        & (flow.key_pp[slot] == pp)
+        (kr[:, 0] == src_f)
+        & (kr[:, 1] == dst_f)
+        & (kr[:, 2] == pp)
         & ((kpg == pg_cur) | (kpg == pg_est))
     )
     fresh = (now - flow.ts[slot]) <= meta.ct_timeout_s
     hit = key_hit & fresh
-    c_code, c_svc, c_dport = _unpack_meta1(flow.meta1[slot])
-    c_dnat_ip = flow.dnat_ip_f[slot]
-    c_rule_in, c_rule_out = _unpack_rules(flow.rules[slot])
+    mr = flow.meta[slot]  # (B, 4)
+    c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
+    c_dnat_ip = mr[:, 0]
+    c_rule_in, c_rule_out = _unpack_rules(mr[:, 2])
     est = hit & (kpg == pg_est)
 
     # Idle-timeout refresh for hits.
@@ -392,6 +425,10 @@ def _pipeline_step(
                 meta=meta.match, hit_combine=hit_combine,
             )
             code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
+            # SvcReject happens in EndpointDNAT, BEFORE the policy tables
+            # (ref pipeline.go table order): no rule attribution for it.
+            rule_in = jnp.where(no_ep, MISS, cls["ingress_rule"])
+            rule_out = jnp.where(no_ep, MISS, cls["egress_rule"])
 
             # Scatter results into the output images.
             tgt = jnp.where(valid, idx, B)
@@ -399,8 +436,8 @@ def _pipeline_step(
             out_svc = out_svc.at[tgt].set(svc_idx)
             out_dnat_ip = out_dnat_ip.at[tgt].set(dnat_ip)
             out_dnat_port = out_dnat_port.at[tgt].set(dnat_port)
-            out_rule_in = out_rule_in.at[tgt].set(cls["ingress_rule"])
-            out_rule_out = out_rule_out.at[tgt].set(cls["egress_rule"])
+            out_rule_in = out_rule_in.at[tgt].set(rule_in)
+            out_rule_out = out_rule_out.at[tgt].set(rule_out)
             out_committed = out_committed.at[tgt].set((code == ACT_ALLOW).astype(jnp.int32))
 
             # Insert into the flow cache: ALLOW entries as ETERNAL
@@ -409,18 +446,16 @@ def _pipeline_step(
             pg_ins = p_m | 0x100 | (egen << 9)
             m1 = _pack_meta1(code, svc_idx, dnat_port)
             ins = valid
+            key_rows = jnp.stack([s_f, d_f, pp_m, pg_ins], axis=1)
+            meta_rows = jnp.stack(
+                [dnat_ip, m1, _pack_rules(rule_in, rule_out),
+                 jnp.zeros((M,), jnp.int32)],
+                axis=1,
+            )
             flow = FlowCache(
-                key_src=_scatter_last(flow.key_src, slot_m, s_f, ins, dump),
-                key_dst=_scatter_last(flow.key_dst, slot_m, d_f, ins, dump),
-                key_pp=_scatter_last(flow.key_pp, slot_m, pp_m, ins, dump),
-                key_pg=_scatter_last(flow.key_pg, slot_m, pg_ins, ins, dump),
+                keys=_scatter_last_rows(flow.keys, slot_m, key_rows, ins, dump),
+                meta=_scatter_last_rows(flow.meta, slot_m, meta_rows, ins, dump),
                 ts=_scatter_last(flow.ts, slot_m, jnp.full((M,), now, jnp.int32), ins, dump),
-                dnat_ip_f=_scatter_last(flow.dnat_ip_f, slot_m, dnat_ip, ins, dump),
-                meta1=_scatter_last(flow.meta1, slot_m, m1, ins, dump),
-                rules=_scatter_last(
-                    flow.rules, slot_m,
-                    _pack_rules(cls["ingress_rule"], cls["egress_rule"]), ins, dump,
-                ),
             )
             lm = learn["mask"] & valid
             adump = meta.aff_slots
@@ -474,3 +509,74 @@ def _pipeline_step(
 
 # jit wrapper: meta is static.
 pipeline_step = jax.jit(_pipeline_step, static_argnames=("meta", "hit_combine"))
+
+
+def _pipeline_trace(
+    state: PipelineState,
+    drs: DeviceRuleSet,
+    dsvc: DeviceServiceTables,
+    src_f: jax.Array,
+    dst_f: jax.Array,
+    proto: jax.Array,
+    sport: jax.Array,
+    dport: jax.Array,
+    now: jax.Array,
+    gen: jax.Array,
+    *,
+    meta: PipelineMeta,
+    hit_combine=None,
+):
+    """Read-only per-packet stage trace (the Traceflow analog,
+    ref framework.go:328-338): every packet is walked through ServiceLB and
+    the full classifier regardless of cache state, and the cache lookup is
+    reported alongside — no state is mutated, like a Traceflow probe marked
+    to bypass conntrack commit.
+    """
+    flow, aff = state.flow, state.aff
+    N = meta.flow_slots
+    src_raw = _raw_bits(src_f)
+    dst_raw = _raw_bits(dst_f)
+    pp = (sport << 16) | dport
+    gen_w = jnp.asarray(gen, jnp.int32) % GEN_ETERNAL
+
+    h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
+    slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
+    pg_cur = proto | 0x100 | (gen_w << 9)
+    pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
+    kpg = flow.key_pg[slot]
+    key_hit = (
+        (flow.key_src[slot] == src_f)
+        & (flow.key_dst[slot] == dst_f)
+        & (flow.key_pp[slot] == pp)
+        & ((kpg == pg_cur) | (kpg == pg_est))
+    )
+    hit = key_hit & ((now - flow.ts[slot]) <= meta.ct_timeout_s)
+    est = hit & (kpg == pg_est)
+    c_code, c_svc, c_dport = _unpack_meta1(flow.meta1[slot])
+
+    svc_idx, no_ep, dnat_ip, dnat_port, _learn = _service_lb(
+        aff, dsvc, h, src_f, dst_f, proto, dport, now, meta.aff_slots
+    )
+    cls = classify_batch(
+        drs, src_f, dnat_ip, proto, dnat_port,
+        meta=meta.match, hit_combine=hit_combine,
+    )
+    fresh_code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
+    return {
+        "cache_hit": hit.astype(jnp.int32),
+        "est": est.astype(jnp.int32),
+        "cached_code": jnp.where(hit, c_code, -1),
+        "svc_idx": svc_idx,
+        "no_ep": no_ep.astype(jnp.int32),
+        "dnat_ip_f": dnat_ip,
+        "dnat_port": dnat_port,
+        "egress_code": cls["egress_code"],
+        "egress_rule": cls["egress_rule"],
+        "ingress_code": cls["ingress_code"],
+        "ingress_rule": cls["ingress_rule"],
+        "fresh_code": fresh_code,
+        "code": jnp.where(hit, c_code, fresh_code),
+    }
+
+
+pipeline_trace = jax.jit(_pipeline_trace, static_argnames=("meta", "hit_combine"))

@@ -47,6 +47,58 @@ from ..utils import ip as iputil
 BIG = 1 << 30
 
 
+class DeltaTable(NamedTuple):
+    """Fixed-capacity incremental membership-delta table (device-resident).
+
+    The TPU answer to the reference's incremental address-group watch deltas
+    (docs/design/architecture.md:61-62): a pod joining/leaving a group does
+    NOT recompile the interval bitmap — the host appends one row per affected
+    bitmap column and re-uploads only these five small arrays.  The kernel
+    patches the gathered per-packet membership rows before the rule scan, so
+    every consumer (peer bits, appliedTo bits, isolation bits) sees the
+    updated membership.  A full recompile (bundle commit) folds the deltas
+    back into the bitmap and clears the table — the megaflow-revalidation
+    analog, triggered on capacity overflow.
+
+    Empty slots: sign == 0 (and lo > hi so the range never matches).
+    """
+
+    lo_f: jax.Array  # (D,) sign-flipped i32, inclusive
+    hi_f: jax.Array  # (D,) sign-flipped i32, inclusive
+    word: jax.Array  # (D,) i32 — bitmap word column
+    bit: jax.Array  # (D,) u32 — single-bit mask
+    sign: jax.Array  # (D,) i32 — +1 set, -1 clear, 0 empty
+
+
+def empty_delta(slots: int) -> DeltaTable:
+    return DeltaTable(
+        lo_f=jnp.full((slots,), 2**31 - 1, dtype=jnp.int32),
+        hi_f=jnp.full((slots,), -(2**31), dtype=jnp.int32),
+        word=jnp.zeros((slots,), dtype=jnp.int32),
+        bit=jnp.zeros((slots,), dtype=jnp.uint32),
+        sign=jnp.zeros((slots,), dtype=jnp.int32),
+    )
+
+
+def _apply_delta(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable) -> jax.Array:
+    """rows (B, W) u32 gathered membership rows -> patched rows.
+
+    Slots apply in order, so a later delta for the same bit wins
+    (chronological append order on the host side).
+    """
+
+    def body(rows, x):
+        lo, hi, w, bitmask, sign = x
+        m = (ip_f >= lo) & (ip_f <= hi)
+        col = jax.lax.dynamic_index_in_dim(rows, w, axis=1, keepdims=False)
+        col = jnp.where(m & (sign > 0), col | bitmask, col)
+        col = jnp.where(m & (sign < 0), col & ~bitmask, col)
+        return jax.lax.dynamic_update_index_in_dim(rows, col, w, axis=1), None
+
+    rows, _ = jax.lax.scan(body, rows, (dt.lo_f, dt.hi_f, dt.word, dt.bit, dt.sign))
+    return rows
+
+
 class DeviceDirection(NamedTuple):
     # (n_chunks, C) chunked rule arrays.
     at_gid: jax.Array
@@ -71,6 +123,7 @@ class DeviceRuleSet(NamedTuple):
     svc_bitmap: jax.Array
     ingress: DeviceDirection
     egress: DeviceDirection
+    ip_delta: DeltaTable
 
 
 class StaticMeta(NamedTuple):
@@ -81,6 +134,7 @@ class StaticMeta(NamedTuple):
     out_phases: tuple[int, int, int]
     iso_in_gid: int
     iso_out_gid: int
+    delta_slots: int = 0
 
 
 def _chunked(dt: DirectionTensors, chunk: int, chunk_multiple: int = 1) -> DeviceDirection:
@@ -112,10 +166,15 @@ def _chunked(dt: DirectionTensors, chunk: int, chunk_multiple: int = 1) -> Devic
 
 
 def to_device(
-    cps: CompiledPolicySet, chunk: int = 512, chunk_multiple: int = 1
+    cps: CompiledPolicySet,
+    chunk: int = 512,
+    chunk_multiple: int = 1,
+    delta_slots: int = 0,
 ) -> tuple[DeviceRuleSet, StaticMeta]:
     """chunk_multiple pads each direction's chunk count to a multiple (so the
-    leading chunk axis divides evenly across a rule-parallel mesh axis)."""
+    leading chunk axis divides evenly across a rule-parallel mesh axis).
+    delta_slots reserves capacity for incremental membership deltas
+    (see DeltaTable); 0 compiles the delta machinery out entirely."""
     drs = DeviceRuleSet(
         ip_bounds=jnp.asarray(cps.ip_bounds),
         ip_bitmap=jnp.asarray(cps.ip_bitmap),
@@ -123,6 +182,7 @@ def to_device(
         svc_bitmap=jnp.asarray(cps.svc_bitmap),
         ingress=_chunked(cps.ingress, chunk, chunk_multiple),
         egress=_chunked(cps.egress, chunk, chunk_multiple),
+        ip_delta=empty_delta(max(delta_slots, 1)),
     )
     meta = StaticMeta(
         chunk=chunk,
@@ -130,6 +190,7 @@ def to_device(
         out_phases=(cps.egress.n_phase0, cps.egress.n_k8s, cps.egress.n_baseline),
         iso_in_gid=cps.iso_in_gid,
         iso_out_gid=cps.iso_out_gid,
+        delta_slots=delta_slots,
     )
     return drs, meta
 
@@ -271,6 +332,12 @@ def classify_batch(
     src_row = drs.ip_bitmap[src_iv]  # (B, GW)
     dst_row = drs.ip_bitmap[dst_iv]
     svc_row = drs.svc_bitmap[svc_iv]
+
+    if meta.delta_slots > 0:
+        # Incremental membership deltas patch the gathered rows, so peer/
+        # appliedTo/isolation consumers all see post-delta membership.
+        src_row = _apply_delta(src_row, src_ip_f, drs.ip_delta)
+        dst_row = _apply_delta(dst_row, dst_ip_f, drs.ip_delta)
 
     # Ingress: pod = dst, peer = src. Egress: pod = src, peer = dst.
     in_hits = _direction_scan(

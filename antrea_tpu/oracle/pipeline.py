@@ -31,6 +31,7 @@ import numpy as np
 
 from ..apis.service import ServiceEntry
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT
+from ..models.pipeline import GEN_ETERNAL
 from ..compiler.ir import PolicySet
 from ..ops import hashing
 from ..packet import Packet, PacketBatch
@@ -48,6 +49,7 @@ class ScalarOutcome:
     egress_rule: Optional[str]
     ingress_rule: Optional[str]
     committed: bool
+    hit: bool = False  # flow-cache hit (False => slow-path classification)
 
 
 class PipelineOracle:
@@ -92,7 +94,83 @@ class PipelineOracle:
             )
         )
 
+    def lookup(self, flow_view: dict, p: Packet, h: int, now: int, gen_w: int):
+        """Read-only flow-cache probe -> (slot, entry-or-None)."""
+        slot = h & (self.flow_slots - 1)
+        e = flow_view.get(slot)
+        key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
+        hit = (
+            e is not None
+            and e["key"] == key
+            and (now - e["ts"]) <= self.ct_timeout_s
+            and (e["gen"] is None or e["gen"] == gen_w)
+        )
+        return slot, (e if hit else None)
+
+    def fresh_walk(self, aff_view: dict, p: Packet, h: int, now: int):
+        """The slow-path walk (ServiceLB -> DNAT -> classify), read-only.
+
+        -> dict with svc_idx, no_ep, dnat_ip, dnat_port, aff_learn, code,
+        plus the classifier's per-direction observations (computed on the
+        post-DNAT tuple even for no-endpoint rejects — the what-if a trace
+        probe reports; step() discards attribution for those, matching the
+        EndpointDNAT-before-policy-tables order).
+        """
+        svc_idx = self.svc_by_key.get((p.dst_ip, p.proto, p.dst_port), -1)
+        svc = self.services[svc_idx] if svc_idx >= 0 else None
+        no_ep = svc is not None and not svc.endpoints
+
+        dnat_ip, dnat_port = p.dst_ip, p.dst_port
+        aff_learn: Optional[tuple[int, dict]] = None
+        if svc is not None and not no_ep:
+            n_ep = len(svc.endpoints)
+            ep_col = (h & 0x7FFFFFFF) % max(1, n_ep)
+            if svc.affinity_timeout_s > 0:
+                ah = int(hashing.fnv_mix([np.uint32(p.src_ip), np.uint32(svc_idx)]))
+                aslot = ah & (self.aff_slots - 1)
+                ae = aff_view.get(aslot)
+                # ae["ep"] >= n_ep means the endpoint list shrank since the
+                # learn: stale — fall through to hash re-select (matches the
+                # device's aff_hit staleness guard).
+                if (
+                    ae is not None
+                    and ae["client"] == p.src_ip
+                    and ae["svc"] == svc_idx
+                    and ae["ep"] < n_ep
+                    and (now - ae["ts"]) <= svc.affinity_timeout_s
+                ):
+                    ep_col = ae["ep"]
+                else:
+                    aff_learn = (aslot, {"client": p.src_ip, "svc": svc_idx,
+                                         "ep": ep_col, "ts": now})
+            ep = svc.endpoints[ep_col]
+            dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
+
+        v = self.oracle.classify(
+            Packet(src_ip=p.src_ip, dst_ip=dnat_ip, proto=p.proto,
+                   src_port=p.src_port, dst_port=dnat_port)
+        )
+        code = ACT_REJECT if no_ep else int(v.code)
+        return {
+            "svc_idx": svc_idx,
+            "no_ep": no_ep,
+            "dnat_ip": dnat_ip,
+            "dnat_port": dnat_port,
+            "aff_learn": aff_learn,
+            "code": code,
+            "ingress_code": int(v.ingress.code),
+            "ingress_rule": v.ingress.rule,
+            "egress_code": int(v.egress.code),
+            "egress_rule": v.egress.rule,
+        }
+
     def step(self, batch: PacketBatch, now: int, gen: int = 0) -> list[ScalarOutcome]:
+        # The device packs entry generations into GEN_BITS (22) bits, with
+        # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
+        # against the same wrapped value so spec and device agree across the
+        # 2^22-1 commit horizon (the aliasing window — a denial cached
+        # exactly 2^22-1 commits ago revalidates — is shared by design).
+        gen = gen % GEN_ETERNAL
         flow0 = {k: dict(v) for k, v in self.flow.items()}
         aff0 = {k: dict(v) for k, v in self.aff.items()}
         outs: list[ScalarOutcome] = []
@@ -103,82 +181,42 @@ class PipelineOracle:
         for i in range(batch.size):
             p = batch.packet(i)
             h = self._flow_hash(p)
-            slot = h & (self.flow_slots - 1)
-            e = flow0.get(slot)
-            key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
-            hit = (
-                e is not None
-                and e["key"] == key
-                and (now - e["ts"]) <= self.ct_timeout_s
-                and (e["gen"] is None or e["gen"] == gen)
-            )
-            if hit:
+            slot, e = self.lookup(flow0, p, h, now, gen)
+            if e is not None:
                 est = e["gen"] is None
                 outs.append(
                     ScalarOutcome(
                         e["code"], est, e["svc"], e["dnat_ip"], e["dnat_port"],
-                        e["rule_out"], e["rule_in"], False,
+                        e["rule_out"], e["rule_in"], False, hit=True,
                     )
                 )
                 refreshes.append(slot)
                 continue
 
             # ---- slow path: ServiceLB -> classify -> commit ---------------
-            svc_idx = self.svc_by_key.get((p.dst_ip, p.proto, p.dst_port), -1)
-            svc = self.services[svc_idx] if svc_idx >= 0 else None
-            no_ep = svc is not None and not svc.endpoints
-
-            dnat_ip, dnat_port = p.dst_ip, p.dst_port
-            aff_learn: Optional[tuple[int, dict]] = None
-            if svc is not None and not no_ep:
-                n_ep = len(svc.endpoints)
-                ep_col = (h & 0x7FFFFFFF) % max(1, n_ep)
-                if svc.affinity_timeout_s > 0:
-                    ah = int(hashing.fnv_mix([np.uint32(p.src_ip), np.uint32(svc_idx)]))
-                    aslot = ah & (self.aff_slots - 1)
-                    ae = aff0.get(aslot)
-                    if (
-                        ae is not None
-                        and ae["client"] == p.src_ip
-                        and ae["svc"] == svc_idx
-                        and (now - ae["ts"]) <= svc.affinity_timeout_s
-                    ):
-                        ep_col = ae["ep"]
-                    else:
-                        aff_learn = (aslot, {"client": p.src_ip, "svc": svc_idx,
-                                             "ep": ep_col, "ts": now})
-                ep = svc.endpoints[ep_col]
-                dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
-
-            if no_ep:
-                code, rule_in, rule_out = ACT_REJECT, None, None
-            else:
-                v = self.oracle.classify(
-                    Packet(
-                        src_ip=p.src_ip,
-                        dst_ip=dnat_ip,
-                        proto=p.proto,
-                        src_port=p.src_port,
-                        dst_port=dnat_port,
-                    )
-                )
-                code, rule_in, rule_out = int(v.code), v.ingress.rule, v.egress.rule
-
+            w = self.fresh_walk(aff0, p, h, now)
+            code = w["code"]
+            # No-endpoint reject happens before the policy tables: drop the
+            # classifier's what-if attribution.
+            rule_in = None if w["no_ep"] else w["ingress_rule"]
+            rule_out = None if w["no_ep"] else w["egress_rule"]
             committed = code == ACT_ALLOW
             outs.append(
-                ScalarOutcome(code, False, svc_idx, dnat_ip, dnat_port,
-                              rule_out, rule_in, committed)
+                ScalarOutcome(code, False, w["svc_idx"], w["dnat_ip"],
+                              w["dnat_port"], rule_out, rule_in, committed)
             )
+            key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
             inserts.append(
                 (slot, {
-                    "key": key, "code": code, "svc": svc_idx,
-                    "dnat_ip": dnat_ip, "dnat_port": dnat_port, "ts": now,
+                    "key": key, "code": code, "svc": w["svc_idx"],
+                    "dnat_ip": w["dnat_ip"], "dnat_port": w["dnat_port"],
+                    "ts": now,
                     "gen": None if committed else gen,
                     "rule_in": rule_in, "rule_out": rule_out,
                 })
             )
-            if aff_learn:
-                learns.append(aff_learn)
+            if w["aff_learn"]:
+                learns.append(w["aff_learn"])
 
         # Apply state mutations in batch order (last writer wins).
         for slot, entry in inserts:

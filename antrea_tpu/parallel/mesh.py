@@ -89,6 +89,8 @@ def _drs_specs() -> m.DeviceRuleSet:
         svc_bitmap=P(),
         ingress=dd,
         egress=dd,
+        # Delta table: small, read by every shard -> replicated.
+        ip_delta=m.DeltaTable(*([P()] * len(m.DeltaTable._fields))),
     )
 
 

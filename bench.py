@@ -32,16 +32,44 @@ from antrea_tpu.compiler.services import compile_services
 from antrea_tpu.models import pipeline as pl
 from antrea_tpu.simulator.genpolicy import gen_cluster
 from antrea_tpu.simulator.genservice import gen_services
+from antrea_tpu.ops.match import classify_batch
 from antrea_tpu.simulator.traffic import gen_traffic
 from antrea_tpu.utils import ip as iputil
+from antrea_tpu.utils.timing import device_loop_time
 
 N_RULES = 100_000
 N_SERVICES = 5_000
 B = 1 << 17
+B_COLD = 1 << 13
 K = 128
 FLOW_SLOTS = 1 << 22
 MISS_CHUNK = 256
 BASELINE_PPS = 10e6
+
+
+def measure_cold(drs, match_meta, src, dst, proto, dport):
+    """All-miss classification pps: the conjunctive-match kernel alone, no
+    flow-cache credit (VERDICT round 1 weak #4 — the steady-state number
+    measures the cache; this measures classification at full rule count)."""
+    s = src[:B_COLD]
+    d = dst[:B_COLD]
+    p = proto[:B_COLD]
+    dp = dport[:B_COLD]
+
+    def body(i, carry):
+        # acc leads the carry: device_loop_time fetches the FIRST leaf to
+        # detect completion, so it must be one that changes every iteration.
+        acc, s_, d_, p_, dp_ = carry
+        # Carry-dependent perturbation so XLA cannot hoist the classify out
+        # of the loop as loop-invariant.
+        dp2 = dp_ ^ (acc[0] & 1)
+        cls = classify_batch(drs, s_, d_, p_, dp2, meta=match_meta)
+        acc = acc.at[:1].add(cls["code"].sum(dtype=jnp.int32))
+        return (acc, s_, d_, p_, dp_)
+
+    carry = (jnp.zeros(8, jnp.int32), s, d, p, dp)
+    sec = device_loop_time(body, carry, k_small=4, k_big=16, repeats=3)
+    return B_COLD / sec
 
 
 def main():
@@ -69,25 +97,36 @@ def main():
                       jnp.int32(101), jnp.int32(0))
 
     def body(i, carry):
-        st, drs_, dsvc_, s_, d_, p_, sp_, dp_, acc = carry
+        # acc leads the carry (see measure_cold): in steady state the flow
+        # cache keys never change, so they must not be the completion probe.
+        acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_ = carry
         st, o = pl._pipeline_step(
             st, drs_, dsvc_, s_, d_, p_, sp_, dp_, 102 + i, 0,
             meta=step.meta,
         )
         acc = acc.at[:1].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
-        return (st, drs_, dsvc_, s_, d_, p_, sp_, dp_, acc)
+        return (acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_)
 
-    carry = (state, drs, dsvc, src, dst, proto, sport, dport,
-             jnp.zeros(8, jnp.int32))
+    carry = (jnp.zeros(8, jnp.int32), state, drs, dsvc, src, dst, proto,
+             sport, dport)
     # Two-K differencing cancels the dispatch+fetch round trip (~120ms on
     # the tunneled platform) out of the per-step time.
     sec_per_step = device_loop_time(body, carry, k_small=8, k_big=K, repeats=3)
     pps = B / sec_per_step
+    cold_pps = measure_cold(drs, step.meta.match, src, dst, proto, dport)
     print(json.dumps({
         "metric": f"classified_pkts_per_sec_chip_{N_RULES // 1000}k_rules",
         "value": round(pps, 1),
         "unit": "packets/s",
         "vs_baseline": round(pps / BASELINE_PPS, 4),
+        "extra": {
+            "cold_classify_pps": round(cold_pps, 1),
+            "cold_vs_baseline": round(cold_pps / BASELINE_PPS, 4),
+            "steady_batch": B,
+            "cold_batch": B_COLD,
+            "n_rules": N_RULES,
+            "n_services": N_SERVICES,
+        },
     }))
 
 

@@ -1,0 +1,205 @@
+"""Run BOTH the scalar oracle and the batched kernel against the
+hand-authored truth tables in fixtures_reachability.py.
+
+The expectations were written from the reference's documented semantics, not
+from either implementation — this is the non-circular leg of the parity
+triangle (reference docs -> fixtures <- oracle <- kernel).
+"""
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.compile import compile_policy_set
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.models import pipeline as pl
+from antrea_tpu.ops.match import flip_ips, make_classifier
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.oracle.pipeline import PipelineOracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+from fixtures_reachability import ALLOW, DROP, REJECT, SCENARIOS, _ip, ag, atg
+
+import jax.numpy as jnp
+
+
+def _probe_packet(p) -> Packet:
+    return Packet(
+        src_ip=iputil.ip_to_u32(_ip(p.src)),
+        dst_ip=iputil.ip_to_u32(_ip(p.dst)),
+        proto=p.proto,
+        src_port=p.sport,
+        dst_port=p.dport,
+    )
+
+
+@pytest.mark.parametrize("scenario", SCENARIOS, ids=lambda s: s.name)
+def test_oracle_matches_fixture(scenario):
+    oracle = Oracle(scenario.ps)
+    bad = []
+    for p in scenario.probes:
+        got = int(oracle.classify(_probe_packet(p)).code)
+        if got != p.expect:
+            bad.append((p, "expected", p.expect, "got", got))
+    assert not bad, (scenario.name, scenario.cite, bad)
+
+
+@pytest.mark.parametrize("scenario", SCENARIOS, ids=lambda s: s.name)
+def test_kernel_matches_fixture(scenario):
+    cps = compile_policy_set(scenario.ps)
+    fn, _ = make_classifier(cps, chunk=16)
+    pkts = [_probe_packet(p) for p in scenario.probes]
+    batch = PacketBatch.from_packets(pkts)
+    out = fn(
+        flip_ips(batch.src_ip),
+        flip_ips(batch.dst_ip),
+        batch.proto.astype(np.int32),
+        batch.dst_port.astype(np.int32),
+    )
+    codes = np.asarray(out["code"])
+    bad = [
+        (p, "expected", p.expect, "got", int(codes[i]))
+        for i, p in enumerate(scenario.probes)
+        if int(codes[i]) != p.expect
+    ]
+    assert not bad, (scenario.name, scenario.cite, bad)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-level fixtures: ServiceLB/DNAT + conntrack semantics, expectations
+# authored from ovs-pipeline.md ServiceLB/EndpointDNAT (:1028-1158) and the
+# established-bypass rules (:1685-1691).
+# ---------------------------------------------------------------------------
+
+CLIENT = "10.10.0.26"
+EP = "10.10.0.7"  # the web pod is the service endpoint
+VIP = "10.96.0.10"
+
+
+def _svc(endpoints, affinity=0):
+    return ServiceEntry(
+        name="svc", namespace="default", cluster_ip=VIP, port=80, protocol=6,
+        endpoints=endpoints, affinity_timeout_s=affinity,
+    )
+
+
+def _mk_pipeline(ps, services):
+    cps = compile_policy_set(ps)
+    svc = compile_services(services)
+    step, state, (drs, dsvc) = pl.make_pipeline(
+        cps, svc, chunk=16, flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=32
+    )
+    return step, state, drs, dsvc
+
+
+def _one(step, state, drs, dsvc, src, dst, dport, now, gen, proto=6, sport=40000):
+    state, out = step(
+        state, drs, dsvc,
+        jnp.asarray(flip_ips(np.array([iputil.ip_to_u32(src)], np.uint32))),
+        jnp.asarray(flip_ips(np.array([iputil.ip_to_u32(dst)], np.uint32))),
+        jnp.asarray(np.array([proto], np.int32)),
+        jnp.asarray(np.array([sport], np.int32)),
+        jnp.asarray(np.array([dport], np.int32)),
+        jnp.int32(now), jnp.int32(gen),
+    )
+    return state, {k: np.asarray(v) for k, v in out.items()}
+
+
+def test_fixture_service_dnat_policy_on_endpoint():
+    """A drop policy on the ENDPOINT pod must apply to traffic addressed to
+    the ClusterIP: classification happens post-DNAT (PreRouting precedes
+    EgressSecurity, framework.go:96-118)."""
+    from antrea_tpu.apis.controlplane import Direction, RuleAction
+    from fixtures_reachability import acnp, rule, peer, _ps
+
+    ps = _ps(
+        [acnp("deny-client-to-ep", ["at-ep"],
+              [rule(Direction.IN, peer("g-client"), action=RuleAction.DROP)])],
+        [ag("g-client", "client")],
+        [atg("at-ep", "web")],
+    )
+    step, state, drs, dsvc = _mk_pipeline(ps, [_svc([Endpoint(EP, 8080)])])
+    state, out = _one(step, state, drs, dsvc, CLIENT, VIP, 80, now=10, gen=0)
+    assert int(out["code"][0]) == DROP
+    # DNAT resolution is still reported (the verdict is post-DNAT):
+    dnat_ip = int(np.uint32(np.asarray(out["dnat_ip_f"][0]) ^ np.int32(-(2**31))))
+    assert dnat_ip == iputil.ip_to_u32(EP)
+    assert int(out["dnat_port"][0]) == 8080
+    # An unrelated source is allowed and DNATed.
+    state, out = _one(step, state, drs, dsvc, "10.10.0.33", VIP, 80, now=11, gen=0)
+    assert int(out["code"][0]) == ALLOW
+
+
+def test_fixture_service_no_endpoints_rejects():
+    """ovs-pipeline.md EndpointDNAT: a service with no endpoints gets the
+    SvcReject treatment (REJECT, not silent drop)."""
+    from fixtures_reachability import _ps
+
+    step, state, drs, dsvc = _mk_pipeline(_ps([]), [_svc([])])
+    state, out = _one(step, state, drs, dsvc, CLIENT, VIP, 80, now=5, gen=0)
+    assert int(out["code"][0]) == REJECT
+    # Non-service traffic unaffected.
+    state, out = _one(step, state, drs, dsvc, CLIENT, EP, 80, now=6, gen=0)
+    assert int(out["code"][0]) == ALLOW
+
+
+def test_fixture_established_bypass_survives_policy_change():
+    """ovs-pipeline.md:1685-1691 — established connections go straight to
+    the metric table; a policy update does not affect ongoing connections,
+    but NEW connections see the new rules."""
+    from antrea_tpu.apis.controlplane import Direction, RuleAction
+    from fixtures_reachability import acnp, rule, peer, _ps
+
+    step, state, drs0, dsvc = _mk_pipeline(_ps([]), [])
+    # Establish client->web under no policy.
+    state, out = _one(step, state, drs0, dsvc, CLIENT, EP, 80, now=1, gen=0)
+    assert int(out["code"][0]) == ALLOW and int(out["committed"][0]) == 1
+
+    # Bundle commit: a new rule set that drops client->web; gen bumps.
+    ps2 = _ps(
+        [acnp("deny", ["at-ep"],
+              [rule(Direction.IN, peer("g-client"), action=RuleAction.DROP)])],
+        [ag("g-client", "client")],
+        [atg("at-ep", "web")],
+    )
+    cps2 = compile_policy_set(ps2)
+    from antrea_tpu.ops.match import to_device
+    drs2, _meta2 = to_device(cps2, 16)
+
+    # Same flow: established bypass -> still allowed under the new rules.
+    state, out = _one(step, state, drs2, dsvc, CLIENT, EP, 80, now=2, gen=1)
+    assert int(out["code"][0]) == ALLOW
+    assert int(out["est"][0]) == 1
+    # A NEW flow (different sport) is classified by the new rules -> drop.
+    state, out = _one(step, state, drs2, dsvc, CLIENT, EP, 80, now=3, gen=1,
+                      sport=40001)
+    assert int(out["code"][0]) == DROP
+    assert int(out["est"][0]) == 0
+
+
+def test_fixture_denied_flow_revalidated_after_relax():
+    """The inverse: cached denials are generation-tagged and re-evaluated
+    after a bundle commit (megaflow revalidation analog)."""
+    from antrea_tpu.apis.controlplane import Direction, RuleAction
+    from antrea_tpu.ops.match import to_device
+    from fixtures_reachability import acnp, rule, peer, _ps
+
+    ps1 = _ps(
+        [acnp("deny", ["at-ep"],
+              [rule(Direction.IN, peer("g-client"), action=RuleAction.DROP)])],
+        [ag("g-client", "client")],
+        [atg("at-ep", "web")],
+    )
+    step, state, drs1, dsvc = _mk_pipeline(ps1, [])
+    state, out = _one(step, state, drs1, dsvc, CLIENT, EP, 80, now=1, gen=0)
+    assert int(out["code"][0]) == DROP
+    # Cached denial: same flow, same gen -> still drop, from the cache.
+    state, out = _one(step, state, drs1, dsvc, CLIENT, EP, 80, now=2, gen=0)
+    assert int(out["code"][0]) == DROP and int(out["n_miss"]) == 0
+
+    # Relax: empty policy set, gen bump -> the denial is re-classified.
+    cps2 = compile_policy_set(_ps([]))
+    drs2, _ = to_device(cps2, 16)
+    state, out = _one(step, state, drs2, dsvc, CLIENT, EP, 80, now=3, gen=1)
+    assert int(out["code"][0]) == ALLOW and int(out["n_miss"]) == 1
