@@ -62,7 +62,7 @@ import numpy as np
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT, CompiledPolicySet
 from ..compiler.services import ServiceTables
 from ..ops import hashing
-from ..ops.match import DeviceRuleSet, StaticMeta, classify_batch, to_device
+from ..ops.match import DeviceRuleSet, StaticMeta, classify_batch, to_device, to_host
 
 # Python ints, never eager jnp scalars: see the BIG comment in ops/match.py.
 MISS = -1
@@ -131,26 +131,33 @@ class PipelineMeta(NamedTuple):
     miss_chunk: int  # slow-path round size
 
 
-def svc_to_device(st: ServiceTables) -> DeviceServiceTables:
+def svc_to_host(st: ServiceTables) -> DeviceServiceTables:
+    """Numpy-resident variant (zero device placement; see ops/match.to_host)."""
     return DeviceServiceTables(
-        uip_f=jnp.asarray(st.uip_f),
-        ppk=jnp.asarray(st.ppk),
-        slot_svc=jnp.asarray(st.slot_svc),
-        n_ep=jnp.asarray(st.n_ep),
-        has_ep=jnp.asarray(st.has_ep),
-        aff_timeout=jnp.asarray(st.aff_timeout),
-        ep_ip_f=jnp.asarray(st.ep_ip_f),
-        ep_port=jnp.asarray(st.ep_port),
+        uip_f=np.asarray(st.uip_f),
+        ppk=np.asarray(st.ppk),
+        slot_svc=np.asarray(st.slot_svc),
+        n_ep=np.asarray(st.n_ep),
+        has_ep=np.asarray(st.has_ep),
+        aff_timeout=np.asarray(st.aff_timeout),
+        ep_ip_f=np.asarray(st.ep_ip_f),
+        ep_port=np.asarray(st.ep_port),
     )
 
 
-def init_state(flow_slots: int = 1 << 20, aff_slots: int = 1 << 18) -> PipelineState:
+def svc_to_device(st: ServiceTables) -> DeviceServiceTables:
+    return jax.tree_util.tree_map(jnp.asarray, svc_to_host(st))
+
+
+def init_state(
+    flow_slots: int = 1 << 20, aff_slots: int = 1 << 18, xp=jnp
+) -> PipelineState:
     def zeros(n):
-        return jnp.zeros(n + 1, dtype=jnp.int32)
+        return xp.zeros(n + 1, dtype=xp.int32)
 
     flow = FlowCache(
-        keys=jnp.zeros((flow_slots + 1, 4), dtype=jnp.int32),
-        meta=jnp.zeros((flow_slots + 1, 4), dtype=jnp.int32),
+        keys=xp.zeros((flow_slots + 1, 4), dtype=xp.int32),
+        meta=xp.zeros((flow_slots + 1, 4), dtype=xp.int32),
         ts=zeros(flow_slots),
     )
     aff = AffinityTable(*[zeros(aff_slots) for _ in AffinityTable._fields])
@@ -231,6 +238,7 @@ def make_pipeline(
     aff_slots: int = 1 << 18,
     ct_timeout_s: int = 3600,
     miss_chunk: int = 4096,
+    host: bool = False,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -240,10 +248,18 @@ def make_pipeline(
     double-buffered rule-swap analog of OVS bundle transactions
     (ofctrl_bridge.go:468); bumping gen invalidates cached denials while
     established (ALLOW) entries persist, per conntrack semantics.
+
+    host=True keeps every tensor numpy-resident (no device placement) — for
+    compile checks on hosts whose accelerator runtime may be broken; jit
+    places numpy leaves itself at call time.
     """
     check_rule_capacity(cps)
-    drs, match_meta = to_device(cps, chunk)
-    dsvc = svc_to_device(svc)
+    if host:
+        drs, match_meta = to_host(cps, chunk)
+        dsvc = svc_to_host(svc)
+    else:
+        drs, match_meta = to_device(cps, chunk)
+        dsvc = svc_to_device(svc)
     meta = PipelineMeta(
         match=match_meta,
         flow_slots=flow_slots,
@@ -251,7 +267,7 @@ def make_pipeline(
         ct_timeout_s=ct_timeout_s,
         miss_chunk=miss_chunk,
     )
-    state = init_state(flow_slots, aff_slots)
+    state = init_state(flow_slots, aff_slots, xp=np if host else jnp)
 
     def step(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen):
         return pipeline_step(
@@ -324,6 +340,26 @@ def _service_lb(
     return svc_idx, no_ep, dnat_ip, dnat_port, learn
 
 
+def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, ct_timeout_s):
+    """Shared fast-path flow-cache probe for step and trace (single source of
+    truth for the FlowCache row layout).
+
+    -> (hit, est, meta_row (B,4)) where meta_row is the gathered meta rows.
+    """
+    kr = flow.keys[slot]  # (B, 4) row gather
+    kpg = kr[:, 3]
+    key_hit = (
+        (kr[:, 0] == src_f)
+        & (kr[:, 1] == dst_f)
+        & (kr[:, 2] == pp)
+        & ((kpg == pg_cur) | (kpg == pg_est))
+    )
+    fresh = (now - flow.ts[slot]) <= ct_timeout_s
+    hit = key_hit & fresh
+    est = hit & (kpg == pg_est)
+    return hit, est, flow.meta[slot]
+
+
 def _pipeline_step(
     state: PipelineState,
     drs: DeviceRuleSet,
@@ -355,21 +391,12 @@ def _pipeline_step(
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    kr = flow.keys[slot]  # (B, 4)
-    kpg = kr[:, 3]
-    key_hit = (
-        (kr[:, 0] == src_f)
-        & (kr[:, 1] == dst_f)
-        & (kr[:, 2] == pp)
-        & ((kpg == pg_cur) | (kpg == pg_est))
+    hit, est, mr = _cache_lookup(
+        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
     )
-    fresh = (now - flow.ts[slot]) <= meta.ct_timeout_s
-    hit = key_hit & fresh
-    mr = flow.meta[slot]  # (B, 4)
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
     c_dnat_ip = mr[:, 0]
     c_rule_in, c_rule_out = _unpack_rules(mr[:, 2])
-    est = hit & (kpg == pg_est)
 
     # Idle-timeout refresh for hits.
     flow = flow._replace(ts=flow.ts.at[jnp.where(hit, slot, dump)].set(now))
@@ -543,16 +570,10 @@ def _pipeline_trace(
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    kpg = flow.key_pg[slot]
-    key_hit = (
-        (flow.key_src[slot] == src_f)
-        & (flow.key_dst[slot] == dst_f)
-        & (flow.key_pp[slot] == pp)
-        & ((kpg == pg_cur) | (kpg == pg_est))
+    hit, est, mr = _cache_lookup(
+        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
     )
-    hit = key_hit & ((now - flow.ts[slot]) <= meta.ct_timeout_s)
-    est = hit & (kpg == pg_est)
-    c_code, c_svc, c_dport = _unpack_meta1(flow.meta1[slot])
+    c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
 
     svc_idx, no_ep, dnat_ip, dnat_port, _learn = _service_lb(
         aff, dsvc, h, src_f, dst_f, proto, dport, now, meta.aff_slots

@@ -70,13 +70,13 @@ class DeltaTable(NamedTuple):
     sign: jax.Array  # (D,) i32 — +1 set, -1 clear, 0 empty
 
 
-def empty_delta(slots: int) -> DeltaTable:
+def empty_delta(slots: int, xp=jnp) -> DeltaTable:
     return DeltaTable(
-        lo_f=jnp.full((slots,), 2**31 - 1, dtype=jnp.int32),
-        hi_f=jnp.full((slots,), -(2**31), dtype=jnp.int32),
-        word=jnp.zeros((slots,), dtype=jnp.int32),
-        bit=jnp.zeros((slots,), dtype=jnp.uint32),
-        sign=jnp.zeros((slots,), dtype=jnp.int32),
+        lo_f=xp.full((slots,), 2**31 - 1, dtype=xp.int32),
+        hi_f=xp.full((slots,), -(2**31), dtype=xp.int32),
+        word=xp.zeros((slots,), dtype=xp.int32),
+        bit=xp.zeros((slots,), dtype=xp.uint32),
+        sign=xp.zeros((slots,), dtype=xp.int32),
     )
 
 
@@ -151,18 +151,48 @@ def _chunked(dt: DirectionTensors, chunk: int, chunk_multiple: int = 1) -> Devic
 
     # at_gid fill = 0 == the EMPTY group: padded rules never match.
     return DeviceDirection(
-        at_gid=jnp.asarray(pad1(dt.at_gid, 0).reshape(n_chunks, chunk)),
-        peer_gid=jnp.asarray(pad1(dt.peer_gid, 0).reshape(n_chunks, chunk)),
-        peer_lo=jnp.asarray(
+        at_gid=np.ascontiguousarray(pad1(dt.at_gid, 0).reshape(n_chunks, chunk)),
+        peer_gid=np.ascontiguousarray(pad1(dt.peer_gid, 0).reshape(n_chunks, chunk)),
+        peer_lo=np.ascontiguousarray(
             pad1(dt.peer_lo, np.int32(2**31 - 1)).reshape(n_chunks, chunk, -1)
         ),
-        peer_hi=jnp.asarray(
+        peer_hi=np.ascontiguousarray(
             pad1(dt.peer_hi, np.int32(-(2**31))).reshape(n_chunks, chunk, -1)
         ),
-        svc_gid=jnp.asarray(pad1(dt.svc_gid, 0).reshape(n_chunks, chunk)),
-        action=jnp.asarray(pad1(dt.action, ACT_DROP)),
-        chunk_idx=jnp.arange(n_chunks, dtype=jnp.int32),
+        svc_gid=np.ascontiguousarray(pad1(dt.svc_gid, 0).reshape(n_chunks, chunk)),
+        action=np.ascontiguousarray(pad1(dt.action, ACT_DROP)),
+        chunk_idx=np.arange(n_chunks, dtype=np.int32),
     )
+
+
+def to_host(
+    cps: CompiledPolicySet,
+    chunk: int = 512,
+    chunk_multiple: int = 1,
+    delta_slots: int = 0,
+) -> tuple[DeviceRuleSet, StaticMeta]:
+    """Numpy-resident variant of to_device: the same pytree, zero device
+    placement.  Used by the driver's compile-check entry() so constructing
+    example args performs NO eager transfer (a broken-libtpu host must be able
+    to build the args; jit accepts numpy leaves and places them itself)."""
+    drs = DeviceRuleSet(
+        ip_bounds=np.asarray(cps.ip_bounds),
+        ip_bitmap=np.asarray(cps.ip_bitmap),
+        svc_bounds=np.asarray(cps.svc_bounds),
+        svc_bitmap=np.asarray(cps.svc_bitmap),
+        ingress=_chunked(cps.ingress, chunk, chunk_multiple),
+        egress=_chunked(cps.egress, chunk, chunk_multiple),
+        ip_delta=empty_delta(max(delta_slots, 1), xp=np),
+    )
+    meta = StaticMeta(
+        chunk=chunk,
+        in_phases=(cps.ingress.n_phase0, cps.ingress.n_k8s, cps.ingress.n_baseline),
+        out_phases=(cps.egress.n_phase0, cps.egress.n_k8s, cps.egress.n_baseline),
+        iso_in_gid=cps.iso_in_gid,
+        iso_out_gid=cps.iso_out_gid,
+        delta_slots=delta_slots,
+    )
+    return drs, meta
 
 
 def to_device(
@@ -175,24 +205,8 @@ def to_device(
     leading chunk axis divides evenly across a rule-parallel mesh axis).
     delta_slots reserves capacity for incremental membership deltas
     (see DeltaTable); 0 compiles the delta machinery out entirely."""
-    drs = DeviceRuleSet(
-        ip_bounds=jnp.asarray(cps.ip_bounds),
-        ip_bitmap=jnp.asarray(cps.ip_bitmap),
-        svc_bounds=jnp.asarray(cps.svc_bounds),
-        svc_bitmap=jnp.asarray(cps.svc_bitmap),
-        ingress=_chunked(cps.ingress, chunk, chunk_multiple),
-        egress=_chunked(cps.egress, chunk, chunk_multiple),
-        ip_delta=empty_delta(max(delta_slots, 1)),
-    )
-    meta = StaticMeta(
-        chunk=chunk,
-        in_phases=(cps.ingress.n_phase0, cps.ingress.n_k8s, cps.ingress.n_baseline),
-        out_phases=(cps.egress.n_phase0, cps.egress.n_k8s, cps.egress.n_baseline),
-        iso_in_gid=cps.iso_in_gid,
-        iso_out_gid=cps.iso_out_gid,
-        delta_slots=delta_slots,
-    )
-    return drs, meta
+    host, meta = to_host(cps, chunk, chunk_multiple, delta_slots)
+    return jax.tree_util.tree_map(jnp.asarray, host), meta
 
 
 def _bit(rows: jax.Array, gids: jax.Array) -> jax.Array:

@@ -12,7 +12,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# ANTREA_TPU_TEST_PLATFORM overrides the hermetic default so kernels can
+# occasionally be validated on real hardware (e.g. =tpu).
+os.environ["JAX_PLATFORMS"] = os.environ.get("ANTREA_TPU_TEST_PLATFORM", "cpu")
 
 
 def cpu_devices():
