@@ -51,14 +51,12 @@ class TpuflowDatapath(Datapath):
         ps: Optional[PolicySet] = None,
         services: Optional[list[ServiceEntry]] = None,
         *,
-        chunk: int = 512,
         flow_slots: int = 1 << 20,
         aff_slots: int = 1 << 18,
         ct_timeout_s: int = 3600,
         miss_chunk: int = 4096,
         delta_slots: int = 128,
     ):
-        self._chunk = chunk
         self._delta_slots = delta_slots
         self._pipe_kw = dict(
             flow_slots=flow_slots, aff_slots=aff_slots,
@@ -259,7 +257,7 @@ class TpuflowDatapath(Datapath):
     def _compile_rules(self) -> None:
         cps = compile_policy_set(self._ps)
         pl.check_rule_capacity(cps)
-        drs, match_meta = to_device(cps, self._chunk, delta_slots=self._delta_slots)
+        drs, match_meta = to_device(cps, delta_slots=self._delta_slots)
         self._cps = cps
         self._drs = drs
         self._meta = pl.PipelineMeta(
@@ -270,13 +268,17 @@ class TpuflowDatapath(Datapath):
             miss_chunk=self._pipe_kw["miss_chunk"],
         )
         # Reset incremental bookkeeping: the compile folded all prior deltas.
+        D = self._delta_slots
         self._n_deltas = 0
         self._delta_host = {
-            "lo_f": np.full(self._delta_slots, 2**31 - 1, np.int32),
-            "hi_f": np.full(self._delta_slots, -(2**31), np.int32),
-            "word": np.zeros(self._delta_slots, np.int32),
-            "bit": np.zeros(self._delta_slots, np.uint32),
-            "sign": np.zeros(self._delta_slots, np.int32),
+            "lo_f": np.full(D, 2**31 - 1, np.int32),
+            "hi_f": np.full(D, -(2**31), np.int32),
+            "sign": np.zeros(D, np.int32),
+            "iso": np.zeros(D, np.int32),
+            "at_in": np.zeros((D, match_meta.w_in), np.uint32),
+            "peer_in": np.zeros((D, match_meta.w_in), np.uint32),
+            "at_out": np.zeros((D, match_meta.w_out), np.uint32),
+            "peer_out": np.zeros((D, match_meta.w_out), np.uint32),
         }
         self._name_gids: dict[str, list[int]] = {}
         self._gid_ident = dict(cps.gid_ident)
@@ -334,22 +336,41 @@ class TpuflowDatapath(Datapath):
             _overlaps(self._ranges_of(n), r) for n in names if n != exclude
         )
 
+    def _rule_mask(self, gids: np.ndarray, gid: int, w: int) -> np.ndarray:
+        """(w,) u32 bitmap of rules whose dim gid == gid (the pre-resolved
+        per-dimension delta mask the kernel ORs/clears on gathered rows)."""
+        idx = np.nonzero(gids == gid)[0]
+        mask = np.zeros(w, np.uint32)
+        np.bitwise_or.at(mask, idx >> 5, (1 << (idx & 31)).astype(np.uint32))
+        return mask
+
     def _append_deltas(self, rows) -> None:
         h = self._delta_host
+        cps = self._cps
+        mm = self._meta.match
         for (lo, hi), gid, sign in rows:
             i = self._n_deltas
             h["lo_f"][i] = iputil.flip_u32(np.uint32(lo))
             h["hi_f"][i] = iputil.flip_u32(np.uint32(hi - 1))  # inclusive
-            h["word"][i] = gid >> 5
-            h["bit"][i] = np.uint32(1 << (gid & 31))
             h["sign"][i] = sign
+            h["at_in"][i] = self._rule_mask(cps.ingress.at_gid, gid, mm.w_in)
+            h["peer_in"][i] = self._rule_mask(cps.ingress.peer_gid, gid, mm.w_in)
+            h["at_out"][i] = self._rule_mask(cps.egress.at_gid, gid, mm.w_out)
+            h["peer_out"][i] = self._rule_mask(cps.egress.peer_gid, gid, mm.w_out)
+            h["iso"][i] = (1 if gid == cps.iso_in_gid else 0) | (
+                2 if gid == cps.iso_out_gid else 0
+            )
             self._n_deltas += 1
         self._drs = self._drs._replace(ip_delta=DeltaTable(
             lo_f=jnp.asarray(h["lo_f"]),
             hi_f=jnp.asarray(h["hi_f"]),
-            word=jnp.asarray(h["word"]),
-            bit=jnp.asarray(h["bit"]),
             sign=jnp.asarray(h["sign"]),
+            iso=jnp.asarray(h["iso"]),
+            at_in=jnp.asarray(h["at_in"]),
+            peer_in=jnp.asarray(h["peer_in"]),
+            at_out=jnp.asarray(h["at_out"]),
+            peer_out=jnp.asarray(h["peer_out"]),
+            n=jnp.int32(self._n_deltas),
         ))
 
     def _sync_ps_members(self, name: str) -> None:

@@ -233,7 +233,6 @@ def make_pipeline(
     cps: CompiledPolicySet,
     svc: ServiceTables,
     *,
-    chunk: int = 512,
     flow_slots: int = 1 << 20,
     aff_slots: int = 1 << 18,
     ct_timeout_s: int = 3600,
@@ -255,10 +254,10 @@ def make_pipeline(
     """
     check_rule_capacity(cps)
     if host:
-        drs, match_meta = to_host(cps, chunk)
+        drs, match_meta = to_host(cps)
         dsvc = svc_to_host(svc)
     else:
-        drs, match_meta = to_device(cps, chunk)
+        drs, match_meta = to_device(cps)
         dsvc = svc_to_device(svc)
     meta = PipelineMeta(
         match=match_meta,

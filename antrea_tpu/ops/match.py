@@ -1,22 +1,36 @@
 """Batched conjunctive-match classification kernel (the tpuflow hot path).
 
 This is the TPU execution of what OVS does per-packet in C: walk the policy
-tables and produce a verdict.  Instead of a flow-table walk, we do:
+tables and produce a verdict.  The kernel is gather-structured (round-3
+redesign; the round-2 kernel was a lax.scan over rule chunks testing per-rule
+group bits plus a (B, C, K) inline-range broadcast, and topped out at 176k
+pps @ 100k rules — 0.018x the 10M target):
 
-  1. interval lookup: searchsorted over the compiled elementary-interval
-     boundaries for src IP, dst IP and the (proto<<16|port) service key;
-  2. one row-gather per dimension from the bit-packed group-membership
-     matrix -> per-packet group bitmaps (the factored address sets);
-  3. a lax.scan over rule chunks: each chunk tests appliedTo/peer/service
-     bits per (packet, rule) pair — the conjunction(id, k/n) analog
-     (ref: /root/reference/pkg/agent/openflow/network_policy.go:325) —
-     and folds per-evaluation-phase first-match indices;
-  4. phase resolution replicating the OVS table order:
+  1. per-dimension interval lookup: searchsorted over the dimension's OWN
+     elementary-interval boundaries (appliedTo / peer over the u32 IP space,
+     service over the (proto << 16 | dst_port) key space);
+  2. one row gather per dimension from that dimension's bit-packed
+     RULE-INCIDENCE table: inc[iv] is a bitmap over rules — bit r set iff
+     rule r's interned group for this dimension contains interval iv.  This
+     is the factored address-set sharing of the reference's conjunction
+     engine (/root/reference/pkg/agent/openflow/network_policy.go:325,:442),
+     transposed from (interval -> groups) to (interval -> rule bits) at
+     compile time so the kernel never walks groups at all;
+  3. AND the three rows -> per-packet rule-match bitmap (B, ceil(R/32));
+  4. per-evaluation-phase first-set-bit (isolate-lowest-bit + popcount +
+     min-reduce) replicating the OVS table order:
      AntreaPolicy{In,E}gressRule -> K8s {In,E}gressRule + isolation
      default-deny -> Baseline -> default allow
      (ref: /root/reference/pkg/agent/openflow/pipeline.go:114-195).
 
-All arrays are int32 lanes; IPs are sign-flipped so signed compares give
+Per packet the work is three ~R/32-word row gathers per direction plus a
+handful of vector word ops — HBM-streaming-bound with no per-rule scan, no
+data-dependent control flow, and no gather along the lane axis (row gathers
+along the major axis are the fast pattern on TPU; see the FlowCache layout
+rationale in models/pipeline.py).  Inline peer CIDR blocks are folded into
+interned groups by the compiler, so they are ordinary incidence bits here.
+
+All arrays are i32/u32 lanes; IPs are sign-flipped so signed compares give
 unsigned order (see compiler/compile.py).  Everything is static-shaped and
 jit-compatible; batch size is the only trace-time variable.
 """
@@ -46,150 +60,233 @@ from ..utils import ip as iputil
 # trace to HLO literals and stay fast.
 BIG = 1 << 30
 
+_ALL1 = 0xFFFFFFFF
+
+
+class DimTable(NamedTuple):
+    """One match dimension: interval bounds + rule-incidence rows."""
+
+    bounds: jax.Array  # (NB,) i32 ascending (sign-flipped for IP dims)
+    inc: jax.Array  # (NB+1, W) u32 — rule bitmap per elementary interval
+
+
+class DeviceDirection(NamedTuple):
+    at: DimTable  # appliedTo, probed with the pod-side IP
+    peer: DimTable  # peer, probed with the other side's IP
+    svc: DimTable  # service, probed with (proto << 16 | dst_port)
+    action: jax.Array  # (W*32,) i32 flat, for post-resolve gather
+    # (W,) global word index — carried as data (not an arange built in the
+    # kernel) so a rule-axis shard_map slice still knows its global rule
+    # offsets and cross-shard first-match combines stay a plain lax.pmin.
+    word_idx: jax.Array
+
+
+class IsoTable(NamedTuple):
+    """K8s default-deny isolation membership (one bit per packet)."""
+
+    bounds: jax.Array  # (K,) i32 sign-flipped
+    val: jax.Array  # (K+1,) i32 0/1
+
 
 class DeltaTable(NamedTuple):
     """Fixed-capacity incremental membership-delta table (device-resident).
 
     The TPU answer to the reference's incremental address-group watch deltas
     (docs/design/architecture.md:61-62): a pod joining/leaving a group does
-    NOT recompile the interval bitmap — the host appends one row per affected
-    bitmap column and re-uploads only these five small arrays.  The kernel
-    patches the gathered per-packet membership rows before the rule scan, so
-    every consumer (peer bits, appliedTo bits, isolation bits) sees the
-    updated membership.  A full recompile (bundle commit) folds the deltas
-    back into the bitmap and clears the table — the megaflow-revalidation
+    NOT recompile any interval table — the host appends one slot carrying
+    the affected ip range plus PRE-RESOLVED per-dimension rule masks (the
+    bitmaps of rules whose at/peer gid is the patched group), and the kernel
+    patches the gathered incidence rows before the AND, so every consumer
+    sees the updated membership.  A full recompile (bundle commit) folds the
+    deltas back into the tables and clears this — the megaflow-revalidation
     analog, triggered on capacity overflow.
 
-    Empty slots: sign == 0 (and lo > hi so the range never matches).
+    Slots apply in append order inside a dynamic-trip-count loop (`n`), so
+    zero pending deltas cost zero iterations and a later delta for the same
+    rule bit wins.  Empty slots: sign == 0.
     """
 
     lo_f: jax.Array  # (D,) sign-flipped i32, inclusive
     hi_f: jax.Array  # (D,) sign-flipped i32, inclusive
-    word: jax.Array  # (D,) i32 — bitmap word column
-    bit: jax.Array  # (D,) u32 — single-bit mask
     sign: jax.Array  # (D,) i32 — +1 set, -1 clear, 0 empty
-
-
-def empty_delta(slots: int, xp=jnp) -> DeltaTable:
-    return DeltaTable(
-        lo_f=xp.full((slots,), 2**31 - 1, dtype=xp.int32),
-        hi_f=xp.full((slots,), -(2**31), dtype=xp.int32),
-        word=xp.zeros((slots,), dtype=xp.int32),
-        bit=xp.zeros((slots,), dtype=xp.uint32),
-        sign=xp.zeros((slots,), dtype=xp.int32),
-    )
-
-
-def _apply_delta(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable) -> jax.Array:
-    """rows (B, W) u32 gathered membership rows -> patched rows.
-
-    Slots apply in order, so a later delta for the same bit wins
-    (chronological append order on the host side).
-    """
-
-    def body(rows, x):
-        lo, hi, w, bitmask, sign = x
-        m = (ip_f >= lo) & (ip_f <= hi)
-        col = jax.lax.dynamic_index_in_dim(rows, w, axis=1, keepdims=False)
-        col = jnp.where(m & (sign > 0), col | bitmask, col)
-        col = jnp.where(m & (sign < 0), col & ~bitmask, col)
-        return jax.lax.dynamic_update_index_in_dim(rows, col, w, axis=1), None
-
-    rows, _ = jax.lax.scan(body, rows, (dt.lo_f, dt.hi_f, dt.word, dt.bit, dt.sign))
-    return rows
-
-
-class DeviceDirection(NamedTuple):
-    # (n_chunks, C) chunked rule arrays.
-    at_gid: jax.Array
-    peer_gid: jax.Array
-    peer_lo: jax.Array  # (n_chunks, C, K)
-    peer_hi: jax.Array
-    svc_gid: jax.Array
-    action: jax.Array  # (R_padded,) flat, for post-scan gather
-    # (n_chunks,) global chunk index — carried as data (not an arange built in
-    # the kernel) so a rule-axis shard_map slice still knows its global rule
-    # offsets and cross-shard first-match combines stay a plain lax.pmin.
-    chunk_idx: jax.Array
+    iso: jax.Array  # (D,) i32 — bit0: patches iso_in, bit1: patches iso_out
+    at_in: jax.Array  # (D, W_in) u32 rule mask for the ingress appliedTo dim
+    peer_in: jax.Array  # (D, W_in)
+    at_out: jax.Array  # (D, W_out)
+    peer_out: jax.Array  # (D, W_out)
+    n: jax.Array  # () i32 — active slots
 
 
 class DeviceRuleSet(NamedTuple):
     """Device-resident compiled rule tensors (the double-buffered side of a
     bundle commit; ref bundle semantics: pkg/ovs/openflow/ofctrl_bridge.go:468)."""
 
-    ip_bounds: jax.Array
-    ip_bitmap: jax.Array
-    svc_bounds: jax.Array
-    svc_bitmap: jax.Array
     ingress: DeviceDirection
     egress: DeviceDirection
+    iso_in: IsoTable
+    iso_out: IsoTable
     ip_delta: DeltaTable
 
 
 class StaticMeta(NamedTuple):
     """Trace-time constants (not pytree leaves)."""
 
-    chunk: int
     in_phases: tuple[int, int, int]  # (n_phase0, n_k8s, n_baseline)
     out_phases: tuple[int, int, int]
-    iso_in_gid: int
-    iso_out_gid: int
+    w_in: int  # ingress rule words (incl. shard padding)
+    w_out: int
     delta_slots: int = 0
 
 
-def _chunked(dt: DirectionTensors, chunk: int, chunk_multiple: int = 1) -> DeviceDirection:
-    R = dt.n_rules
-    n_chunks = max(1, -(-R // chunk))
-    n_chunks = -(-n_chunks // chunk_multiple) * chunk_multiple
-    pad = n_chunks * chunk - R
-
-    def pad1(a: np.ndarray, fill) -> np.ndarray:
-        if pad == 0:
-            return a
-        shape = (pad,) + a.shape[1:]
-        return np.concatenate([a, np.full(shape, fill, dtype=a.dtype)])
-
-    # at_gid fill = 0 == the EMPTY group: padded rules never match.
-    return DeviceDirection(
-        at_gid=np.ascontiguousarray(pad1(dt.at_gid, 0).reshape(n_chunks, chunk)),
-        peer_gid=np.ascontiguousarray(pad1(dt.peer_gid, 0).reshape(n_chunks, chunk)),
-        peer_lo=np.ascontiguousarray(
-            pad1(dt.peer_lo, np.int32(2**31 - 1)).reshape(n_chunks, chunk, -1)
-        ),
-        peer_hi=np.ascontiguousarray(
-            pad1(dt.peer_hi, np.int32(-(2**31))).reshape(n_chunks, chunk, -1)
-        ),
-        svc_gid=np.ascontiguousarray(pad1(dt.svc_gid, 0).reshape(n_chunks, chunk)),
-        action=np.ascontiguousarray(pad1(dt.action, ACT_DROP)),
-        chunk_idx=np.arange(n_chunks, dtype=np.int32),
+def empty_delta(slots: int, w_in: int, w_out: int, xp=jnp) -> DeltaTable:
+    return DeltaTable(
+        lo_f=xp.full((slots,), 2**31 - 1, dtype=xp.int32),
+        hi_f=xp.full((slots,), -(2**31), dtype=xp.int32),
+        sign=xp.zeros((slots,), dtype=xp.int32),
+        iso=xp.zeros((slots,), dtype=xp.int32),
+        at_in=xp.zeros((slots, w_in), dtype=xp.uint32),
+        peer_in=xp.zeros((slots, w_in), dtype=xp.uint32),
+        at_out=xp.zeros((slots, w_out), dtype=xp.uint32),
+        peer_out=xp.zeros((slots, w_out), dtype=xp.uint32),
+        n=xp.zeros((), dtype=xp.int32),
     )
+
+
+# ---------------------------------------------------------------------------
+# Host-side table construction
+# ---------------------------------------------------------------------------
+
+
+def _rules_by_gid(gids: np.ndarray) -> dict[int, np.ndarray]:
+    order = np.argsort(gids, kind="stable").astype(np.int64)
+    sg = gids[order]
+    uniq, starts = np.unique(sg, return_index=True)
+    out: dict[int, np.ndarray] = {}
+    for i, g in enumerate(uniq):
+        end = starts[i + 1] if i + 1 < len(uniq) else len(sg)
+        out[int(g)] = order[starts[i] : end]
+    return out
+
+
+def _inc_mask(rule_idx: np.ndarray, w: int) -> np.ndarray:
+    """Rule indices -> (w,) u32 bitmap."""
+    inc = np.zeros(w, dtype=np.uint32)
+    np.bitwise_or.at(inc, rule_idx >> 5, (1 << (rule_idx & 31)).astype(np.uint32))
+    return inc
+
+
+def _span(bounds_u: np.ndarray, lo: int, hi: int) -> tuple[int, int]:
+    """[lo, hi) range -> inclusive interval-row span [a, b].
+
+    Mirrors the interval convention of compiler/compile._GroupSpace
+    .build_tables: row i covers (bounds[i-1], bounds[i]] in searchsorted-
+     'right' index space.
+    """
+    a = int(np.searchsorted(bounds_u, lo, side="right"))
+    b = int(np.searchsorted(bounds_u, hi - 1, side="right"))
+    return a, b
+
+
+def _dim_bounds(by: dict[int, np.ndarray], groups: list) -> np.ndarray:
+    pts: set[int] = set()
+    for g in by:
+        for lo, hi in groups[g]:
+            pts.add(int(lo))
+            if hi < (1 << 32):
+                pts.add(int(hi))
+    return np.array(sorted(pts), dtype=np.uint64)
+
+
+def _dim_table_host(gids: np.ndarray, groups: list, w: int, ip_dim: bool) -> DimTable:
+    """Build one dimension's (bounds, incidence) pair.
+
+    Only the groups this dimension actually uses contribute boundary points,
+    so each dimension's interval table stays as small as its own address
+    structure (the appliedTo dimension is typically far coarser than peer).
+    """
+    by = _rules_by_gid(gids)
+    bounds_u = _dim_bounds(by, groups)
+    inc = np.zeros((len(bounds_u) + 1, w), dtype=np.uint32)
+    for g, rr in by.items():
+        ranges = groups[g]
+        if not ranges or rr.size == 0:
+            continue
+        gmask = _inc_mask(rr, w)
+        nzw = np.nonzero(gmask)[0]
+        vals = gmask[nzw]
+        for lo, hi in ranges:
+            a, b = _span(bounds_u, lo, hi)
+            inc[a : b + 1, nzw] |= vals
+    if ip_dim:
+        bounds = iputil.flip_u32(bounds_u.astype(np.uint32))
+    else:
+        bounds = bounds_u.astype(np.int32)
+    return DimTable(bounds=bounds, inc=inc)
+
+
+def _iso_host(gid: int, groups: list) -> IsoTable:
+    ranges = groups[gid]
+    pts: set[int] = set()
+    for lo, hi in ranges:
+        pts.add(int(lo))
+        if hi < (1 << 32):
+            pts.add(int(hi))
+    bounds_u = np.array(sorted(pts), dtype=np.uint64)
+    val = np.zeros(len(bounds_u) + 1, dtype=np.int32)
+    for lo, hi in ranges:
+        a, b = _span(bounds_u, lo, hi)
+        val[a : b + 1] = 1
+    return IsoTable(bounds=iputil.flip_u32(bounds_u.astype(np.uint32)), val=val)
+
+
+def _direction_host(
+    dt: DirectionTensors, cps: CompiledPolicySet, w: int
+) -> DeviceDirection:
+    action = np.full(w * 32, ACT_DROP, dtype=np.int32)
+    action[: dt.n_rules] = dt.action
+    return DeviceDirection(
+        at=_dim_table_host(dt.at_gid, cps.ip_groups, w, ip_dim=True),
+        peer=_dim_table_host(dt.peer_gid, cps.ip_groups, w, ip_dim=True),
+        svc=_dim_table_host(dt.svc_gid, cps.svc_groups, w, ip_dim=False),
+        action=action,
+        word_idx=np.arange(w, dtype=np.int32),
+    )
+
+
+def _width(n_rules: int, word_multiple: int) -> int:
+    w = max(1, -(-n_rules // 32))
+    return -(-w // word_multiple) * word_multiple
 
 
 def to_host(
     cps: CompiledPolicySet,
-    chunk: int = 512,
-    chunk_multiple: int = 1,
+    word_multiple: int = 1,
     delta_slots: int = 0,
 ) -> tuple[DeviceRuleSet, StaticMeta]:
     """Numpy-resident variant of to_device: the same pytree, zero device
-    placement.  Used by the driver's compile-check entry() so constructing
-    example args performs NO eager transfer (a broken-libtpu host must be able
-    to build the args; jit accepts numpy leaves and places them itself)."""
+    placement (jit accepts numpy leaves and places them itself — used by the
+    driver's compile-check entry() so a broken accelerator runtime can still
+    build example args).
+
+    word_multiple pads each direction's rule-word count to a multiple (so
+    the incidence word axis divides evenly across a rule-parallel mesh
+    axis).  delta_slots reserves capacity for incremental membership deltas
+    (see DeltaTable); 0 compiles the delta machinery out entirely.
+    """
+    w_in = _width(cps.ingress.n_rules, word_multiple)
+    w_out = _width(cps.egress.n_rules, word_multiple)
     drs = DeviceRuleSet(
-        ip_bounds=np.asarray(cps.ip_bounds),
-        ip_bitmap=np.asarray(cps.ip_bitmap),
-        svc_bounds=np.asarray(cps.svc_bounds),
-        svc_bitmap=np.asarray(cps.svc_bitmap),
-        ingress=_chunked(cps.ingress, chunk, chunk_multiple),
-        egress=_chunked(cps.egress, chunk, chunk_multiple),
-        ip_delta=empty_delta(max(delta_slots, 1), xp=np),
+        ingress=_direction_host(cps.ingress, cps, w_in),
+        egress=_direction_host(cps.egress, cps, w_out),
+        iso_in=_iso_host(cps.iso_in_gid, cps.ip_groups),
+        iso_out=_iso_host(cps.iso_out_gid, cps.ip_groups),
+        ip_delta=empty_delta(max(delta_slots, 1), w_in, w_out, xp=np),
     )
     meta = StaticMeta(
-        chunk=chunk,
         in_phases=(cps.ingress.n_phase0, cps.ingress.n_k8s, cps.ingress.n_baseline),
         out_phases=(cps.egress.n_phase0, cps.egress.n_k8s, cps.egress.n_baseline),
-        iso_in_gid=cps.iso_in_gid,
-        iso_out_gid=cps.iso_out_gid,
+        w_in=w_in,
+        w_out=w_out,
         delta_slots=delta_slots,
     )
     return drs, meta
@@ -197,96 +294,82 @@ def to_host(
 
 def to_device(
     cps: CompiledPolicySet,
-    chunk: int = 512,
-    chunk_multiple: int = 1,
+    word_multiple: int = 1,
     delta_slots: int = 0,
 ) -> tuple[DeviceRuleSet, StaticMeta]:
-    """chunk_multiple pads each direction's chunk count to a multiple (so the
-    leading chunk axis divides evenly across a rule-parallel mesh axis).
-    delta_slots reserves capacity for incremental membership deltas
-    (see DeltaTable); 0 compiles the delta machinery out entirely."""
-    host, meta = to_host(cps, chunk, chunk_multiple, delta_slots)
+    host, meta = to_host(cps, word_multiple, delta_slots)
     return jax.tree_util.tree_map(jnp.asarray, host), meta
 
 
-def _bit(rows: jax.Array, gids: jax.Array) -> jax.Array:
-    """rows (B, W) u32, gids (C,) -> (B, C) 0/1 int32."""
-    w = gids >> 5
-    b = (gids & 31).astype(jnp.uint32)
-    words = jnp.take(rows, w, axis=1)  # (B, C)
-    return ((words >> b[None, :]) & 1).astype(jnp.int32)
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
 
 
-def _scalar_bit(rows: jax.Array, gid: int) -> jax.Array:
-    """rows (B, W), static gid -> (B,) 0/1."""
-    return ((rows[:, gid >> 5] >> np.uint32(gid & 31)) & 1).astype(jnp.int32)
+def _patch_rows(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks) -> jax.Array:
+    """Apply the active delta slots to gathered incidence rows (B, W)."""
+
+    def body(i, rows):
+        m = (ip_f >= dt.lo_f[i]) & (ip_f <= dt.hi_f[i])
+        mask = masks[i][None, :]
+        s = dt.sign[i]
+        rows = jnp.where((m & (s > 0))[:, None], rows | mask, rows)
+        rows = jnp.where((m & (s < 0))[:, None], rows & ~mask, rows)
+        return rows
+
+    return jax.lax.fori_loop(0, dt.n, body, rows)
 
 
-def _direction_scan(
-    dd: DeviceDirection,
-    phases: tuple[int, int, int],
-    pod_row: jax.Array,
-    peer_row: jax.Array,
-    svc_row: jax.Array,
-    peer_ip_f: jax.Array,
-    chunk: int,
-):
-    """-> (hit0, hitK, hitB): per-packet first-match global rule index per
-    evaluation phase (BIG = none)."""
-    n0, nk, _nb = phases
-    B = pod_row.shape[0]
-
-    def body(carry, xs):
-        h0, hk, hb = carry
-        ci, at_g, pg_g, plo, phi, sg_g = xs
-        base = ci * chunk
-        gidx = base + jnp.arange(chunk, dtype=jnp.int32)  # (C,)
-
-        pod_ok = _bit(pod_row, at_g)
-        peer_ok = _bit(peer_row, pg_g)
-        # inline literal ranges (sign-flipped inclusive bounds)
-        in_rng = (
-            (peer_ip_f[:, None, None] >= plo[None, :, :])
-            & (peer_ip_f[:, None, None] <= phi[None, :, :])
-        ).any(axis=2)
-        svc_ok = _bit(svc_row, sg_g)
-        match = pod_ok & (peer_ok | in_rng.astype(jnp.int32)) & svc_ok  # (B, C)
-
-        cand = jnp.where(match == 1, gidx[None, :], BIG)  # (B, C)
-        h0 = jnp.minimum(h0, jnp.where(gidx[None, :] < n0, cand, BIG).min(axis=1))
-        hk = jnp.minimum(
-            hk,
-            jnp.where((gidx[None, :] >= n0) & (gidx[None, :] < n0 + nk), cand, BIG).min(axis=1),
+def _patch_iso(bit: jax.Array, ip_f: jax.Array, dt: DeltaTable, which: int) -> jax.Array:
+    def body(i, bit):
+        m = (
+            (ip_f >= dt.lo_f[i])
+            & (ip_f <= dt.hi_f[i])
+            & (((dt.iso[i] >> which) & 1) == 1)
         )
-        hb = jnp.minimum(hb, jnp.where(gidx[None, :] >= n0 + nk, cand, BIG).min(axis=1))
-        return (h0, hk, hb), None
+        s = dt.sign[i]
+        bit = jnp.where(m & (s > 0), 1, bit)
+        bit = jnp.where(m & (s < 0), 0, bit)
+        return bit
 
-    init = (
-        jnp.full(B, BIG, dtype=jnp.int32),
-        jnp.full(B, BIG, dtype=jnp.int32),
-        jnp.full(B, BIG, dtype=jnp.int32),
-    )
-    xs = (
-        dd.chunk_idx,
-        dd.at_gid,
-        dd.peer_gid,
-        dd.peer_lo,
-        dd.peer_hi,
-        dd.svc_gid,
-    )
-    (h0, hk, hb), _ = jax.lax.scan(body, init, xs)
-    return h0, hk, hb
+    return jax.lax.fori_loop(0, dt.n, body, bit)
 
 
-def _resolve(
-    dd: DeviceDirection,
-    hits,
-    pod_iso: jax.Array,
-):
+def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, int]):
+    """match (B, W) u32 -> per-phase first-set global rule index (BIG = none).
+
+    First-match-in-priority-order == lowest set bit: rule order encodes
+    priority (compiler/compile.py), bit r of word w is global rule 32w+r.
+    """
+    n0, nk, _nb = phases
+    base = word_idx * 32  # (W,) i32
+
+    def mask_lt(n: int) -> jax.Array:
+        """(W,) u32 — bits whose global rule index < n."""
+        k = jnp.clip(n - base, 0, 32)
+        m = (jnp.uint32(1) << jnp.minimum(k, 31).astype(jnp.uint32)) - jnp.uint32(1)
+        return jnp.where(k >= 32, jnp.uint32(_ALL1), m)
+
+    m0 = mask_lt(n0)
+    mhi = mask_lt(n0 + nk)
+    phase_masks = (m0, mhi & ~m0, ~mhi)
+
+    def first(pm: jax.Array) -> jax.Array:
+        mw = match & pm[None, :]
+        lsb = mw & (jnp.uint32(0) - mw)
+        tz = jax.lax.population_count(lsb - jnp.uint32(1))  # 32 when mw == 0
+        idx = base[None, :] + tz.astype(jnp.int32)
+        idx = jnp.where(mw == jnp.uint32(0), BIG, idx)
+        return idx.min(axis=1)
+
+    return tuple(first(pm) for pm in phase_masks)
+
+
+def _resolve(action: jax.Array, hits, pod_iso: jax.Array):
     """Phase resolution -> (code (B,), rule_idx (B,) [-1 = default])."""
     h0, hk, hb = hits
-    a0 = dd.action[jnp.clip(h0, 0, dd.action.shape[0] - 1)]
-    ab = dd.action[jnp.clip(hb, 0, dd.action.shape[0] - 1)]
+    a0 = action[jnp.clip(h0, 0, action.shape[0] - 1)]
+    ab = action[jnp.clip(hb, 0, action.shape[0] - 1)]
     has0 = h0 < BIG
     hask = hk < BIG
     hasb = hb < BIG
@@ -294,6 +377,7 @@ def _resolve(
     decided0 = has0 & (a0 != ACT_PASS)
     decidedb = hasb & (ab != ACT_PASS)
 
+    # K8s NP rules are any-match ALLOW within the isolation model.
     k8s_code = jnp.where(hask, ACT_ALLOW, ACT_DROP)
     k8s_rule = jnp.where(hask, hk, -1)
 
@@ -318,6 +402,19 @@ def _resolve(
     return code.astype(jnp.int32), rule.astype(jnp.int32)
 
 
+def _searchsorted_right(bounds: jax.Array, x: jax.Array) -> jax.Array:
+    """TPU-tuned searchsorted(side='right').
+
+    jnp's default 'scan' (binary-search) method lowers to a sequential
+    gather loop that is ~40x slower on TPU than an all-pairs compare-reduce
+    for our table sizes (measured on v5e: 10.9 ms vs 0.28 ms at B=32k,
+    NB=33k).  compare_all is O(B*NB) but fuses into a streaming VPU
+    reduction; fall back to 'sort' (O((B+NB) log)) for very large tables.
+    """
+    method = "compare_all" if bounds.shape[0] <= (1 << 17) else "sort"
+    return jnp.searchsorted(bounds, x, side="right", method=method)
+
+
 def classify_batch(
     drs: DeviceRuleSet,
     src_ip_f: jax.Array,  # (B,) sign-flipped i32
@@ -332,45 +429,52 @@ def classify_batch(
 
     Codes use the oracle encoding: 0 allow, 1 drop, 2 reject.
 
-    hit_combine, if given, is applied to each per-phase first-match hit tensor
-    between the rule scan and phase resolution — the rule-parallel seam: a
-    shard_map caller passes ``lambda h: lax.pmin(h, 'rule')`` so each rule
-    shard scans only its local chunks and the global first match is an
-    all-reduce over ICI (the TPU analog of OVS evaluating one shared table).
+    hit_combine, if given, is applied to each per-phase first-match hit
+    tensor between the word scan and phase resolution — the rule-parallel
+    seam: a shard_map caller passes ``lambda h: lax.pmin(h, 'rule')`` so
+    each rule shard ANDs only its local incidence words and the global first
+    match is an all-reduce over ICI (the TPU analog of OVS evaluating one
+    shared table).
     """
-    src_iv = jnp.searchsorted(drs.ip_bounds, src_ip_f, side="right")
-    dst_iv = jnp.searchsorted(drs.ip_bounds, dst_ip_f, side="right")
+    ing, eg = drs.ingress, drs.egress
     svc_key = (proto << 16) | dst_port
-    svc_iv = jnp.searchsorted(drs.svc_bounds, svc_key, side="right")
 
-    src_row = drs.ip_bitmap[src_iv]  # (B, GW)
-    dst_row = drs.ip_bitmap[dst_iv]
-    svc_row = drs.svc_bitmap[svc_iv]
+    def dim_row(tab: DimTable, x: jax.Array) -> jax.Array:
+        return tab.inc[_searchsorted_right(tab.bounds, x)]
+
+    def iso_bit(tab: IsoTable, x: jax.Array) -> jax.Array:
+        return tab.val[_searchsorted_right(tab.bounds, x)]
+
+    # Ingress: pod = dst, peer = src.  Egress: pod = src, peer = dst.
+    in_at = dim_row(ing.at, dst_ip_f)
+    in_peer = dim_row(ing.peer, src_ip_f)
+    in_svc = dim_row(ing.svc, svc_key)
+    out_at = dim_row(eg.at, src_ip_f)
+    out_peer = dim_row(eg.peer, dst_ip_f)
+    out_svc = dim_row(eg.svc, svc_key)
+    iso_in = iso_bit(drs.iso_in, dst_ip_f)
+    iso_out = iso_bit(drs.iso_out, src_ip_f)
 
     if meta.delta_slots > 0:
         # Incremental membership deltas patch the gathered rows, so peer/
         # appliedTo/isolation consumers all see post-delta membership.
-        src_row = _apply_delta(src_row, src_ip_f, drs.ip_delta)
-        dst_row = _apply_delta(dst_row, dst_ip_f, drs.ip_delta)
+        d = drs.ip_delta
+        in_at = _patch_rows(in_at, dst_ip_f, d, d.at_in)
+        in_peer = _patch_rows(in_peer, src_ip_f, d, d.peer_in)
+        out_at = _patch_rows(out_at, src_ip_f, d, d.at_out)
+        out_peer = _patch_rows(out_peer, dst_ip_f, d, d.peer_out)
+        iso_in = _patch_iso(iso_in, dst_ip_f, d, 0)
+        iso_out = _patch_iso(iso_out, src_ip_f, d, 1)
 
-    # Ingress: pod = dst, peer = src. Egress: pod = src, peer = dst.
-    in_hits = _direction_scan(
-        drs.ingress, meta.in_phases, dst_row, src_row, svc_row, src_ip_f, meta.chunk
-    )
-    out_hits = _direction_scan(
-        drs.egress, meta.out_phases, src_row, dst_row, svc_row, dst_ip_f, meta.chunk
-    )
+    in_hits = _phase_hits(in_at & in_peer & in_svc, ing.word_idx, meta.in_phases)
+    out_hits = _phase_hits(out_at & out_peer & out_svc, eg.word_idx, meta.out_phases)
 
     if hit_combine is not None:
         in_hits = tuple(hit_combine(h) for h in in_hits)
         out_hits = tuple(hit_combine(h) for h in out_hits)
 
-    in_code, in_rule = _resolve(
-        drs.ingress, in_hits, _scalar_bit(dst_row, meta.iso_in_gid)
-    )
-    out_code, out_rule = _resolve(
-        drs.egress, out_hits, _scalar_bit(src_row, meta.iso_out_gid)
-    )
+    in_code, in_rule = _resolve(ing.action, in_hits, iso_in)
+    out_code, out_rule = _resolve(eg.action, out_hits, iso_out)
 
     final = jnp.where(out_code != ACT_ALLOW, out_code, in_code)
     return {
@@ -388,13 +492,13 @@ def flip_ips(a: np.ndarray) -> np.ndarray:
 
 
 # meta is static (plain ints/tuples, hashable); drs is a traced pytree arg so
-# the big bitmap tensors stay runtime inputs instead of baked-in constants.
+# the big incidence tensors stay runtime inputs instead of baked-in constants.
 _classify_jit = jax.jit(classify_batch, static_argnames=("meta", "hit_combine"))
 
 
-def make_classifier(cps: CompiledPolicySet, chunk: int = 512):
+def make_classifier(cps: CompiledPolicySet):
     """-> (fn(src_f, dst_f, proto, dport) -> verdict dict, DeviceRuleSet)."""
-    drs, meta = to_device(cps, chunk)
+    drs, meta = to_device(cps)
 
     def fn(src_f, dst_f, proto, dport):
         return _classify_jit(drs, src_f, dst_f, proto, dport, meta=meta)

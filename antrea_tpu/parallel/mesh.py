@@ -12,17 +12,18 @@ equivalent scale axes map onto a 2-D `jax.sharding.Mesh`:
       only if the same flow lands on the same shard, and when it doesn't the
       miss merely re-classifies (same verdict, deterministic endpoint hash).
 
-  ``rule`` axis — the rule-chunk axis (TP analog of conjunctive factoring):
-      the chunked rule arrays are sharded on their leading (chunk) axis; each
-      shard scans only local chunks and the global first-match indices are a
-      single `lax.pmin` all-reduce over ICI per evaluation phase — six i32
-      (B,) vectors per batch, negligible next to the scan FLOPs.
+  ``rule`` axis — the rule-word axis (TP analog of conjunctive factoring):
+      the rule-incidence tables are sharded on their WORD (trailing) axis;
+      each shard gathers + ANDs only its local slice of every incidence row
+      and the global first-match indices are a single `lax.pmin` all-reduce
+      over ICI per evaluation phase — six i32 (B,) vectors per batch,
+      negligible next to the gather bytes.
 
-The interval tables / bitmaps / service tables are replicated (they are the
-small, read-mostly side), the rule chunks are sharded (they are the memory
-that grows with rule count) — at 100k+ rules per direction this is what lets
-the rule state exceed a single chip's HBM, the way the reference relies on
-OVS's shared tables + megaflow cache.
+The interval bounds / iso / service tables are replicated (they are the
+small, read-mostly side), the incidence words are sharded (they are the
+memory that grows with rule count) — at 100k+ rules per direction this is
+what lets the rule state exceed a single chip's HBM, the way the reference
+relies on OVS's shared tables + megaflow cache.
 
 State layout under shard_map: conn/aff arrays gain a leading (D,) axis
 sharded over ``data``; shard d sees its (slots+1,) slice.  Verdicts after the
@@ -73,24 +74,35 @@ def make_mesh(n_data: int, n_rule: int, devices=None) -> Mesh:
 # PartitionSpecs for each pytree.
 
 def _drs_specs() -> m.DeviceRuleSet:
+    def dim():
+        return m.DimTable(bounds=P(), inc=P(None, RULE))
+
     dd = m.DeviceDirection(
-        at_gid=P(RULE, None),
-        peer_gid=P(RULE, None),
-        peer_lo=P(RULE, None, None),
-        peer_hi=P(RULE, None, None),
-        svc_gid=P(RULE, None),
-        action=P(),  # small flat gather table, replicated
-        chunk_idx=P(RULE),
+        at=dim(),
+        peer=dim(),
+        svc=dim(),
+        action=P(),  # small flat gather table, replicated (indexed post-pmin)
+        word_idx=P(RULE),
     )
+    iso = m.IsoTable(bounds=P(), val=P())
     return m.DeviceRuleSet(
-        ip_bounds=P(),
-        ip_bitmap=P(),
-        svc_bounds=P(),
-        svc_bitmap=P(),
         ingress=dd,
         egress=dd,
-        # Delta table: small, read by every shard -> replicated.
-        ip_delta=m.DeltaTable(*([P()] * len(m.DeltaTable._fields))),
+        iso_in=iso,
+        iso_out=iso,
+        # Delta ranges/signs replicated; the per-slot rule masks shard on
+        # the same word axis as the incidence tables they patch.
+        ip_delta=m.DeltaTable(
+            lo_f=P(),
+            hi_f=P(),
+            sign=P(),
+            iso=P(),
+            at_in=P(None, RULE),
+            peer_in=P(None, RULE),
+            at_out=P(None, RULE),
+            peer_out=P(None, RULE),
+            n=P(),
+        ),
     )
 
 
@@ -104,10 +116,10 @@ def _state_specs() -> pl.PipelineState:
     return pl.PipelineState(flow=flow, aff=aff)
 
 
-def shard_rule_set(cps: CompiledPolicySet, mesh: Mesh, chunk: int = 512):
+def shard_rule_set(cps: CompiledPolicySet, mesh: Mesh):
     """Compile + place rule tensors on the mesh -> (drs, StaticMeta)."""
     n_rule = mesh.shape[RULE]
-    drs, meta = m.to_device(cps, chunk, chunk_multiple=n_rule)
+    drs, meta = m.to_device(cps, word_multiple=n_rule)
     specs = _drs_specs()
     drs = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), drs, specs
@@ -130,13 +142,13 @@ def _pmin_rule(h: jax.Array) -> jax.Array:
     return lax.pmin(h, RULE)
 
 
-def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh, chunk: int = 512):
+def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh):
     """Stateless sharded classification: -> (fn(src_f, dst_f, proto, dport), drs).
 
     fn is jitted over the mesh; inputs are (B,) arrays with B divisible by the
     data axis size; outputs land sharded over ``data``.
     """
-    drs, meta = shard_rule_set(cps, mesh, chunk)
+    drs, meta = shard_rule_set(cps, mesh)
 
     def body(drs, src_f, dst_f, proto, dport):
         return m.classify_batch(
@@ -163,7 +175,6 @@ def make_sharded_pipeline(
     svc: ServiceTables,
     mesh: Mesh,
     *,
-    chunk: int = 512,
     flow_slots: int = 1 << 20,
     aff_slots: int = 1 << 18,
     ct_timeout_s: int = 3600,
@@ -178,7 +189,7 @@ def make_sharded_pipeline(
     only when ITS slice of the batch has cache misses.
     """
     pl.check_rule_capacity(cps)
-    drs, match_meta = shard_rule_set(cps, mesh, chunk)
+    drs, match_meta = shard_rule_set(cps, mesh)
     dsvc = jax.tree.map(
         lambda x: jax.device_put(x, NamedSharding(mesh, P())),
         pl.svc_to_device(svc),

@@ -59,15 +59,18 @@ def measure_cold(drs, match_meta, src, dst, proto, dport):
     def body(i, carry):
         # acc leads the carry: device_loop_time fetches the FIRST leaf to
         # detect completion, so it must be one that changes every iteration.
-        acc, s_, d_, p_, dp_ = carry
+        # drs rides in the carry, NOT the closure: closure-captured device
+        # arrays lower to HLO constants, and ~1GB of incidence tables
+        # overflows the remote-compile request on the tunneled platform.
+        acc, drs_, s_, d_, p_, dp_ = carry
         # Carry-dependent perturbation so XLA cannot hoist the classify out
         # of the loop as loop-invariant.
         dp2 = dp_ ^ (acc[0] & 1)
-        cls = classify_batch(drs, s_, d_, p_, dp2, meta=match_meta)
+        cls = classify_batch(drs_, s_, d_, p_, dp2, meta=match_meta)
         acc = acc.at[:1].add(cls["code"].sum(dtype=jnp.int32))
-        return (acc, s_, d_, p_, dp_)
+        return (acc, drs_, s_, d_, p_, dp_)
 
-    carry = (jnp.zeros(8, jnp.int32), s, d, p, dp)
+    carry = (jnp.zeros(8, jnp.int32), drs, s, d, p, dp)
     sec = device_loop_time(body, carry, k_small=4, k_big=16, repeats=3)
     return B_COLD / sec
 
@@ -88,7 +91,7 @@ def main():
     dport = jnp.asarray(tr.dst_port)
 
     step, state, (drs, dsvc) = pl.make_pipeline(
-        cps, svc, chunk=512, flow_slots=FLOW_SLOTS, miss_chunk=MISS_CHUNK
+        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=MISS_CHUNK
     )
     # Warm: cold classify of the whole flow universe, then a cache-warm pass.
     state, out = step(state, drs, dsvc, src, dst, proto, sport, dport,

@@ -286,7 +286,7 @@ def test_controller_to_kernel_end_to_end():
     ))
     ps = ctl.policy_set()
     cps = compile_policy_set(ps)
-    fn, _ = make_classifier(cps, chunk=16)
+    fn, _ = make_classifier(cps)
     oracle = Oracle(ps)
 
     ips = ["10.0.0.10", "10.0.0.11", "10.0.0.20", "10.0.0.30", "10.0.9.9"]

@@ -33,7 +33,7 @@ def _mk_pair(n_rules=120, n_services=12, seed=3, delta_slots=64):
 
     tpu = TpuflowDatapath(
         copy.deepcopy(cluster.ps), services,
-        chunk=32, flow_slots=1 << 12, aff_slots=1 << 10, miss_chunk=64,
+        flow_slots=1 << 12, aff_slots=1 << 10, miss_chunk=64,
         delta_slots=delta_slots,
     )
     orc = OracleDatapath(
@@ -128,11 +128,11 @@ def test_delta_matches_fresh_compile():
     cluster, services, tpu, _ = _mk_pair()
     ag = sorted(cluster.ps.address_groups)[1]
     atg = sorted(cluster.ps.applied_to_groups)[2]
-    bitmap_before = tpu._drs.ip_bitmap
+    bitmap_before = tpu._drs.ingress.at.inc
     tpu.apply_group_delta(ag, added_ips=["10.8.8.8"], removed_ips=[])
     victim = cluster.ps.applied_to_groups[atg].members[-1].ip
     tpu.apply_group_delta(atg, added_ips=[], removed_ips=[victim])
-    assert tpu._drs.ip_bitmap is bitmap_before  # no recompile happened
+    assert tpu._drs.ingress.at.inc is bitmap_before  # no recompile happened
     assert tpu._n_deltas > 0
 
     # From-scratch datapath over the mutated policy set (tpu._ps is kept in
@@ -141,7 +141,7 @@ def test_delta_matches_fresh_compile():
 
     fresh = TpuflowDatapath(
         copy.deepcopy(tpu._ps), services,
-        chunk=32, flow_slots=1 << 12, aff_slots=1 << 10, miss_chunk=64,
+        flow_slots=1 << 12, aff_slots=1 << 10, miss_chunk=64,
     )
     b = _batch(cluster, services, 256, seed=31)
     b.src_ip[:16] = iputil.ip_to_u32("10.8.8.8")

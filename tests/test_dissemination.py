@@ -47,7 +47,7 @@ def _wire():
     agents = {}
     for node in NODES:
         dp = TpuflowDatapath(
-            chunk=16, flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=32,
+            flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=32,
             delta_slots=32,
         )
         agents[node] = AgentPolicyController(node, dp, store)
@@ -120,7 +120,7 @@ def test_late_subscriber_replay():
             peers=[AntreaPeer(pod_selector=LabelSelector.make({"app": "db"}))],
         )],
     ))
-    dp = TpuflowDatapath(chunk=16, flow_slots=1 << 10, aff_slots=1 << 8,
+    dp = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8,
                          miss_chunk=32)
     agent = AgentPolicyController("nodeA", dp, store)
     agent.sync()
@@ -146,20 +146,20 @@ def test_pod_churn_flows_as_incremental_deltas():
     for agent in agents.values():
         agent.sync()
     dp_a = agents["nodeA"].datapath
-    bitmap_before = dp_a._drs.ip_bitmap
+    bitmap_before = dp_a._drs.ingress.at.inc
 
     # New client pod on nodeC: for nodeA this is a pure AddressGroup member
     # delta -> incremental path, no recompile.
     ctl.upsert_pod(mk_pod("cli2", "10.0.0.21", "nodeC", app="client"))
     agents["nodeA"].sync()
-    assert dp_a._drs.ip_bitmap is bitmap_before
+    assert dp_a._drs.ingress.at.inc is bitmap_before
     assert dp_a._n_deltas > 0
     _assert_agent_matches_snapshot(ctl, agents, now=20)
 
     # Remove it again: membership reverts, still incremental.
     ctl.delete_pod("default/cli2")
     agents["nodeA"].sync()
-    assert dp_a._drs.ip_bitmap is bitmap_before
+    assert dp_a._drs.ingress.at.inc is bitmap_before
     _assert_agent_matches_snapshot(ctl, agents, now=30)
 
 

@@ -15,7 +15,7 @@ def run_parity(n_rules: int, seed: int, batch: int = 192, chunk: int = 64):
     cluster = gen_cluster(n_rules, seed=seed)
     traffic = gen_traffic(cluster.pod_ips, batch=batch, seed=seed + 1)
     cps = compile_policy_set(cluster.ps)
-    fn, _ = make_classifier(cps, chunk=chunk)
+    fn, _ = make_classifier(cps)
 
     out = fn(
         flip_ips(traffic.src_ip),
@@ -54,7 +54,7 @@ def test_parity_small(seed):
 
 
 def test_parity_medium():
-    run_parity(400, seed=7, batch=256, chunk=128)
+    run_parity(400, seed=7, batch=256)
 
 
 def test_parity_k8s_only():
@@ -70,7 +70,7 @@ def test_parity_acnp_only():
 def _parity_cluster(cluster, batch=160):
     traffic = gen_traffic(cluster.pod_ips, batch=batch, seed=9)
     cps = compile_policy_set(cluster.ps)
-    fn, _ = make_classifier(cps, chunk=64)
+    fn, _ = make_classifier(cps)
     out = fn(
         flip_ips(traffic.src_ip),
         flip_ips(traffic.dst_ip),

@@ -54,11 +54,11 @@ def test_sharded_classifier_matches_single(cluster, batch):
     cps = compile_policy_set(cluster.ps)
     src_f, dst_f, proto, _, dport = _cols(batch)
 
-    ref_fn, _ = make_classifier(cps, chunk=64)
+    ref_fn, _ = make_classifier(cps)
     ref = ref_fn(src_f, dst_f, proto, dport)
 
     mesh = _mesh(2, 4)
-    fn, _drs = make_sharded_classifier(cps, mesh, chunk=64)
+    fn, _drs = make_sharded_classifier(cps, mesh)
     got = fn(src_f, dst_f, proto, dport)
 
     for k in ref:
@@ -69,11 +69,11 @@ def test_sharded_classifier_rule_only_mesh(cluster, batch):
     """data=1: pure rule-parallelism must also agree."""
     cps = compile_policy_set(cluster.ps)
     src_f, dst_f, proto, _, dport = _cols(batch)
-    ref_fn, _ = make_classifier(cps, chunk=64)
+    ref_fn, _ = make_classifier(cps)
     ref = ref_fn(src_f, dst_f, proto, dport)
 
     mesh = _mesh(1, 8)
-    fn, _ = make_sharded_classifier(cps, mesh, chunk=64)
+    fn, _ = make_sharded_classifier(cps, mesh)
     got = fn(src_f, dst_f, proto, dport)
     np.testing.assert_array_equal(np.asarray(got["code"]), np.asarray(ref["code"]))
 
@@ -85,11 +85,11 @@ def test_sharded_pipeline_matches_single(cluster, batch):
     now = jnp.int32(1000)
 
     step1, st1, (drs1, dsvc1) = make_pipeline(
-        cps, svc, chunk=64, flow_slots=1 << 14, aff_slots=1 << 12
+        cps, svc, flow_slots=1 << 14, aff_slots=1 << 12
     )
     mesh = _mesh(2, 4)
     stepN, stN, (drsN, dsvcN) = make_sharded_pipeline(
-        cps, svc, mesh, chunk=64, flow_slots=1 << 14, aff_slots=1 << 12
+        cps, svc, mesh, flow_slots=1 << 14, aff_slots=1 << 12
     )
 
     # Two steps: second sees the conntrack/affinity state of the first.

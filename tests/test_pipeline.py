@@ -66,7 +66,7 @@ def test_pipeline_parity_multistep(seed):
     cps = compile_policy_set(cluster.ps)
     svt = compile_services(services)
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     po = PipelineOracle(
         cluster.ps, services, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
@@ -103,7 +103,7 @@ def _mini_env():
     cps = compile_policy_set(ps)
     svt = compile_services(services)
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     return ps, services, cps, step, state, drs, dsvc
 
@@ -172,7 +172,7 @@ def test_est_bypass_and_ct_timeout():
     cps = compile_policy_set(ps)
     svt = compile_services([])
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS,
+        cps, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS,
         ct_timeout_s=60,
     )
     client = iputil.ip_to_u32("10.0.0.5")
@@ -227,7 +227,7 @@ def test_policy_applies_post_dnat():
     cps = compile_policy_set(ps)
     svt = compile_services(services)
     step, state, (drs, dsvc) = make_pipeline(
-        cps, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     client = iputil.ip_to_u32("10.0.0.5")
     t = _batch([(client, iputil.ip_to_u32("10.96.0.1"), cp.PROTO_TCP, 40000, 80)])
@@ -296,7 +296,7 @@ def test_generation_semantics():
     cps_open = compile_policy_set(open_ps)
     svt = compile_services([])
     step, state, (drs_open, dsvc) = mk(
-        cps_open, svt, chunk=64, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+        cps_open, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
     )
     state, out = run_step(step, state, drs_open, dsvc, t, 0, gen=0)
     assert int(out["code"][0]) == 0 and int(out["committed"][0]) == 1

@@ -48,7 +48,7 @@ def test_oracle_matches_fixture(scenario):
 @pytest.mark.parametrize("scenario", SCENARIOS, ids=lambda s: s.name)
 def test_kernel_matches_fixture(scenario):
     cps = compile_policy_set(scenario.ps)
-    fn, _ = make_classifier(cps, chunk=16)
+    fn, _ = make_classifier(cps)
     pkts = [_probe_packet(p) for p in scenario.probes]
     batch = PacketBatch.from_packets(pkts)
     out = fn(
@@ -88,7 +88,7 @@ def _mk_pipeline(ps, services):
     cps = compile_policy_set(ps)
     svc = compile_services(services)
     step, state, (drs, dsvc) = pl.make_pipeline(
-        cps, svc, chunk=16, flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=32
+        cps, svc, flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=32
     )
     return step, state, drs, dsvc
 
