@@ -131,25 +131,31 @@ class _GroupSpace:
                 self.ident_of[gid] = ident
         return gid
 
-    def build_tables(self) -> tuple[np.ndarray, np.ndarray]:
-        """-> (bounds (NB,) u64, bitmap (NB+1, ceil(G/32)) u32)."""
-        pts: set[int] = set()
-        for ranges in self.groups:
-            for lo, hi in ranges:
-                pts.add(lo)
-                if hi < (1 << 32):
-                    pts.add(hi)
-        bounds = np.array(sorted(pts), dtype=np.uint64)
-        n_iv = len(bounds) + 1
-        gw = max(1, (len(self.groups) + 31) // 32)
-        bitmap = np.zeros((n_iv, gw), dtype=np.uint32)
-        for gid, ranges in enumerate(self.groups):
-            w, b = gid >> 5, np.uint32(1 << (gid & 31))
-            for lo, hi in ranges:
-                start = int(np.searchsorted(bounds, lo, side="right"))
-                end = int(np.searchsorted(bounds, hi - 1, side="right"))
-                bitmap[start : end + 1, w] |= b
-        return bounds, bitmap
+def build_group_tables(groups: list) -> tuple[np.ndarray, np.ndarray]:
+    """(interval x group) membership tables for a gid-indexed range-set list
+    -> (bounds (NB,) u64, bitmap (NB+1, ceil(G/32)) u32).
+
+    Introspection/debug surface only: the classification kernel consumes the
+    per-dimension RULE-incidence tables built in ops/match instead, so this
+    O(intervals x groups) construction must stay off the compile path (it is
+    reached lazily via CompiledPolicySet.ip_bitmap etc.)."""
+    pts: set[int] = set()
+    for ranges in groups:
+        for lo, hi in ranges:
+            pts.add(lo)
+            if hi < (1 << 32):
+                pts.add(hi)
+    bounds = np.array(sorted(pts), dtype=np.uint64)
+    n_iv = len(bounds) + 1
+    gw = max(1, (len(groups) + 31) // 32)
+    bitmap = np.zeros((n_iv, gw), dtype=np.uint32)
+    for gid, ranges in enumerate(groups):
+        w, b = gid >> 5, np.uint32(1 << (gid & 31))
+        for lo, hi in ranges:
+            start = int(np.searchsorted(bounds, lo, side="right"))
+            end = int(np.searchsorted(bounds, hi - 1, side="right"))
+            bitmap[start : end + 1, w] |= b
+    return bounds, bitmap
 
 
 @dataclass
@@ -174,10 +180,6 @@ class DirectionTensors:
 class CompiledPolicySet:
     """Everything the classification kernel needs, as host numpy arrays."""
 
-    ip_bounds: np.ndarray  # (NB,) i32, sign-flipped for unsigned order
-    ip_bitmap: np.ndarray  # (NB+1, GW) u32
-    svc_bounds: np.ndarray  # (SB,) i32 (keys < 2^24, no flip needed)
-    svc_bitmap: np.ndarray  # (SB+1, SW) u32
     ingress: DirectionTensors
     egress: DirectionTensors
     iso_in_gid: int
@@ -196,6 +198,41 @@ class CompiledPolicySet:
     # The incremental-update path uses this to find every bitmap column a
     # named-group membership delta must patch.
     gid_ident: dict[int, tuple] = field(default_factory=dict)
+
+    # -- lazy (interval x group) introspection tables (test/debug surface) --
+    # The kernel reads the rule-incidence tables from ops/match, never these;
+    # building them eagerly would put O(intervals x groups) host work on
+    # every compile, including delta-overflow recompiles.
+    _ip_tables: tuple = field(default=None, repr=False, compare=False)
+    _svc_tables: tuple = field(default=None, repr=False, compare=False)
+
+    def _ip(self) -> tuple:
+        if self._ip_tables is None:
+            b64, bm = build_group_tables(self.ip_groups)
+            self._ip_tables = (_flip(b64.astype(np.uint32)), bm)
+        return self._ip_tables
+
+    def _svc(self) -> tuple:
+        if self._svc_tables is None:
+            b64, bm = build_group_tables(self.svc_groups)
+            self._svc_tables = (b64.astype(np.int32), bm)
+        return self._svc_tables
+
+    @property
+    def ip_bounds(self) -> np.ndarray:  # (NB,) i32, sign-flipped
+        return self._ip()[0]
+
+    @property
+    def ip_bitmap(self) -> np.ndarray:  # (NB+1, GW) u32
+        return self._ip()[1]
+
+    @property
+    def svc_bounds(self) -> np.ndarray:  # (SB,) i32 (keys < 2^24, no flip)
+        return self._svc()[0]
+
+    @property
+    def svc_bitmap(self) -> np.ndarray:  # (SB+1, SW) u32
+        return self._svc()[1]
 
 
 _flip = iputil.flip_u32
@@ -329,19 +366,13 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
             rule_ids=ids,
         )
 
-    # NOTE: emit() interns nothing new (all gids interned above), so tables
-    # built after emit are complete.
+    # NOTE: emit() interns nothing new (all gids interned above), so the
+    # lazy introspection tables (ip_bounds/ip_bitmap/...) are complete
+    # whenever first touched.
     t_in = emit(Direction.IN)
     t_out = emit(Direction.OUT)
 
-    ip_bounds64, ip_bitmap = ip_space.build_tables()
-    svc_bounds64, svc_bitmap = svc_space.build_tables()
-
     return CompiledPolicySet(
-        ip_bounds=_flip(ip_bounds64.astype(np.uint32)),
-        ip_bitmap=ip_bitmap,
-        svc_bounds=svc_bounds64.astype(np.int32),
-        svc_bitmap=svc_bitmap,
         ingress=t_in,
         egress=t_out,
         iso_in_gid=iso_in,

@@ -48,12 +48,22 @@ class StepResult:
     code: np.ndarray  # 0 allow / 1 drop / 2 reject
     est: np.ndarray  # 0/1 — established-connection fast-path hit
     svc_idx: np.ndarray  # -1 = not a service
-    dnat_ip: np.ndarray  # u32, post-DNAT destination
+    dnat_ip: np.ndarray  # u32, post-DNAT destination; on reply=1 packets:
+    #   the UN-DNAT rewrite (frontend ip the reply's SOURCE is restored to)
     dnat_port: np.ndarray
     ingress_rule: list  # Optional[str] per packet
     egress_rule: list
     committed: np.ndarray  # 0/1 — conntrack commit happened this step
     n_miss: int
+    # 0/1 — reverse-tuple (reply-direction) conntrack hit: the packet is the
+    # reply leg of a committed connection (endpoint -> client); dnat_ip/
+    # dnat_port then carry the un-DNAT source rewrite (ref UnSNAT/
+    # ConntrackState tables, pipeline.go:114-195; ovs-pipeline.md ct).
+    reply: np.ndarray = None
+    # 0 none / 1 tcp-rst / 2 icmp-port-unreachable — the packet-out synth
+    # the agent would emit for a REJECT verdict (ref pkg/agent/controller/
+    # networkpolicy/reject.go).
+    reject_kind: np.ndarray = None
 
 
 class Datapath(ABC):

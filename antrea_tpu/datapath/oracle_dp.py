@@ -18,9 +18,20 @@ from collections import Counter
 
 from ..apis.controlplane import GroupMember
 from ..compiler.ir import PolicySet
-from ..oracle.pipeline import PipelineOracle
+from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..packet import PacketBatch
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
+
+
+def _group_ranges(g) -> set:
+    """Merged u32 range set of a group's members + static blocks — the
+    compiled-visible membership (duplicate members are invisible)."""
+    from ..utils import ip as iputil
+
+    rs = [iputil.cidr_to_range(m.ip) for m in g.members]
+    for b in getattr(g, "ip_blocks", []) or []:
+        rs.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
+    return set(iputil.merge_ranges(rs))
 
 
 class OracleDatapath(Datapath):
@@ -66,11 +77,13 @@ class OracleDatapath(Datapath):
 
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
         touched = False
+        changed = False
         for table in (self._ps.address_groups, self._ps.applied_to_groups):
             g = table.get(group_name)
             if g is None:
                 continue
             touched = True
+            before = _group_ranges(g)
             for ip in added_ips:
                 g.members.append(GroupMember(ip=ip))
             for ip in removed_ips:
@@ -78,8 +91,16 @@ class OracleDatapath(Datapath):
                     if m.ip == ip:
                         del g.members[i]
                         break
+            if _group_ranges(g) != before:
+                changed = True
         if not touched:
             raise KeyError(f"unknown group {group_name!r}")
+        if not changed:
+            # Refcount-only delta (e.g. re-add of an already-present member):
+            # no verdict can differ — keep the generation, matching
+            # TpuflowDatapath's no-op fast path so the differential harness
+            # sees identical gen/cache behavior.
+            return self._gen
         self._oracle.update(ps=self._ps)
         self._gen += 1
         return self._gen
@@ -106,9 +127,12 @@ class OracleDatapath(Datapath):
             h = o._flow_hash(p)
             _slot, e = o.lookup(o.flow, p, h, now, gen_w)
             w = o.fresh_walk(o.aff, p, h, now)
+            code = e["code"] if e is not None else w["code"]
             out.append({
                 "cache_hit": e is not None,
                 "est": e is not None and e["gen"] is None,
+                "reply": e is not None and e.get("rpl", False),
+                "reject_kind": _reject_kind(code, p.proto),
                 "svc_idx": w["svc_idx"],
                 "no_ep": w["no_ep"],
                 "dnat_ip": w["dnat_ip"],
@@ -118,7 +142,7 @@ class OracleDatapath(Datapath):
                 "ingress_code": w["ingress_code"],
                 "ingress_rule": w["ingress_rule"],
                 "fresh_code": w["code"],
-                "code": e["code"] if e is not None else w["code"],
+                "code": code,
             })
         return out
 
@@ -144,4 +168,6 @@ class OracleDatapath(Datapath):
             egress_rule=[o.egress_rule for o in outs],
             committed=np.array([int(o.committed) for o in outs], np.int32),
             n_miss=sum(1 for o in outs if not o.hit),
+            reply=np.array([int(o.reply) for o in outs], np.int32),
+            reject_kind=np.array([o.reject_kind for o in outs], np.int32),
         )

@@ -101,6 +101,7 @@ class TpuflowDatapath(Datapath):
             raise KeyError(f"unknown group {group_name!r}")
         rows: list[tuple[tuple[int, int], int, int]] = []  # (range, gid, sign)
         own = self._group_members.setdefault(group_name, Counter())
+        ranges_before = self._ranges_of(group_name)
         need_recompile = False
 
         for ip in added_ips:
@@ -134,6 +135,17 @@ class TpuflowDatapath(Datapath):
                     rows.append((r, gid, -1))
 
         self._sync_ps_members(group_name)
+        if not need_recompile and self._ranges_of(group_name) == ranges_before:
+            # Net no-op delta (refcount-only re-add, or an add+remove of the
+            # same range cancelling within one call): no verdict can differ,
+            # so keep the generation — bumping would needlessly invalidate
+            # every cached DENY entry — and DISCARD any cancelling rows
+            # rather than burn delta slots on them.  The skip condition is
+            # "the group's merged range set is unchanged" — the same
+            # observable rule OracleDatapath applies, so the differential
+            # harness sees identical generations (a changed group whose
+            # ranges are covered by sibling groups still bumps, on both).
+            return self._gen
         if need_recompile or self._n_deltas + len(rows) > self._delta_slots:
             # Fold everything into a fresh compile (the revalidation event)
             # — membership mirrors are already current.
@@ -165,6 +177,8 @@ class TpuflowDatapath(Datapath):
         return StepResult(
             code=o["code"],
             est=o["est"],
+            reply=o["reply"],
+            reject_kind=o["reject_kind"],
             svc_idx=o["svc_idx"],
             dnat_ip=(o["dnat_ip_f"].astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32),
             dnat_port=o["dnat_port"],
@@ -221,6 +235,8 @@ class TpuflowDatapath(Datapath):
             out.append({
                 "cache_hit": bool(o["cache_hit"][i]),
                 "est": bool(o["est"][i]),
+                "reply": bool(o["reply"][i]),
+                "reject_kind": int(o["reject_kind"][i]),
                 "svc_idx": int(o["svc_idx"][i]),
                 "no_ep": bool(o["no_ep"][i]),
                 "dnat_ip": int(np.uint32(o["dnat_ip_f"][i] ^ np.int32(-(2**31)))),
@@ -291,16 +307,24 @@ class TpuflowDatapath(Datapath):
         # (they change only via install_bundle).
         self._group_members: dict[str, Counter] = {}
         self._static_blocks: dict[str, list[tuple[int, int]]] = {}
+        # Exemplar GroupMember per (group, ip) so _sync_ps_members rebuilds
+        # full members (node/namespace/name intact), not ip-only husks.
+        self._member_meta: dict[str, dict[str, GroupMember]] = {}
         for name, g in self._ps.address_groups.items():
             c = Counter()
+            meta = self._member_meta.setdefault(name, {})
             for m in g.members:
                 c[m.ip] += 1
+                meta.setdefault(m.ip, m)
             self._group_members[name] = c
             blocks: list[tuple[int, int]] = []
             for b in g.ip_blocks:
                 blocks.extend(iputil.ipblock_to_ranges(b.cidr, b.excepts))
             self._static_blocks[name] = blocks
         for name, g in self._ps.applied_to_groups.items():
+            meta = self._member_meta.setdefault(name, {})
+            for m in g.members:
+                meta.setdefault(m.ip, m)
             if name in self._group_members:
                 continue  # same-named AddressGroup => same selector/members
             c = Counter()
@@ -338,11 +362,11 @@ class TpuflowDatapath(Datapath):
 
     def _rule_mask(self, gids: np.ndarray, gid: int, w: int) -> np.ndarray:
         """(w,) u32 bitmap of rules whose dim gid == gid (the pre-resolved
-        per-dimension delta mask the kernel ORs/clears on gathered rows)."""
-        idx = np.nonzero(gids == gid)[0]
-        mask = np.zeros(w, np.uint32)
-        np.bitwise_or.at(mask, idx >> 5, (1 << (idx & 31)).astype(np.uint32))
-        return mask
+        per-dimension delta mask the kernel ORs/clears on gathered rows);
+        packed by the kernel's own bit layout (ops/match._inc_mask)."""
+        from ..ops.match import _inc_mask
+
+        return _inc_mask(np.nonzero(gids == gid)[0], w)
 
     def _append_deltas(self, rows) -> None:
         h = self._delta_host
@@ -378,8 +402,11 @@ class TpuflowDatapath(Datapath):
         membership mirror so an overflow-triggered recompile sees current
         membership."""
         own = self._group_members.get(name, Counter())
+        meta = self._member_meta.get(name, {})
         members = [
-            GroupMember(ip=s) for s, cnt in sorted(own.items()) for _ in range(cnt)
+            meta.get(s) or GroupMember(ip=s)
+            for s, cnt in sorted(own.items())
+            for _ in range(cnt)
         ]
         ag = self._ps.address_groups.get(name)
         if ag is not None:

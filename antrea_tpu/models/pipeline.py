@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..apis.controlplane import PROTO_TCP
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT, CompiledPolicySet
 from ..compiler.services import ServiceTables
 from ..ops import hashing
@@ -70,6 +71,28 @@ MISS = -1
 # generations are taken mod GEN_ETERNAL so they never collide with it.
 GEN_BITS = 22
 GEN_ETERNAL = (1 << GEN_BITS) - 1
+# Bit 31 of the packed proto/gen key word marks a REPLY-direction entry
+# (the reverse-tuple conntrack row committed alongside every ALLOW — the
+# ct reply-direction state of the reference's ConntrackZone/UnSNAT tables,
+# /root/reference/pkg/agent/openflow/pipeline.go UnSNAT/ConntrackState;
+# docs/design/ovs-pipeline.md ct sections).
+REPLY_BIT = -(2**31)
+
+# REJECT synthesis kinds (ref pkg/agent/controller/networkpolicy/reject.go:
+# TCP gets an RST, everything else an ICMP port-unreachable).
+REJECT_NONE = 0
+REJECT_TCP_RST = 1
+REJECT_ICMP_UNREACH = 2
+
+
+def reject_kind_of(code, proto, xp=jnp):
+    """REJECT synthesis kind for a verdict (scalar or array): TCP -> RST,
+    anything else -> ICMP port-unreachable; 0 when not a REJECT."""
+    return xp.where(
+        code == ACT_REJECT,
+        xp.where(proto == PROTO_TCP, REJECT_TCP_RST, REJECT_ICMP_UNREACH),
+        REJECT_NONE,
+    )
 
 
 class FlowCache(NamedTuple):
@@ -85,12 +108,24 @@ class FlowCache(NamedTuple):
       keys (N+1, 4) i32: [src_f, dst_f, sport<<16|dport, proto|0x100|gen<<9]
         key_pg packs proto (8 bits + valid bit 8) with the entry generation
         (GEN_BITS): zero rows (valid bit unset) can never match a packet.
-      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules, 0]
+        Bit 31 (REPLY_BIT) marks a reply-direction entry (below).
+      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules, pref]
         meta1 = code(2) | (svc_idx+1)(14) | dnat_port(16)
         rules = (rule_in+1)(16) | (rule_out+1)(16); 0 = default/none
+        pref = last partner-refresh attempt seconds (see below)
       ts   (N+1,)  i32: last-seen seconds (refreshed on every hit)
 
     dst in keys is the ORIGINAL (pre-DNAT) dst; dnat_ip_f the resolved one.
+
+    Every ALLOW commit also inserts a REPLY-direction entry (conntrack
+    commits both directions): keyed on the post-DNAT tuple with ports
+    swapped (endpoint ip, client ip, ep_port<<16|client_port), REPLY_BIT
+    set, eternal generation; its meta carries the UN-DNAT rewrite — the
+    original frontend (pre-DNAT dst ip / dst port) that the reply packet's
+    source must be restored to.  A reply hit is an established-connection
+    hit (est bypass of the policy tables) with `reply`=1 in the output.
+    Occupancy cost: a committed connection takes two slots, as kernel
+    conntrack keys both tuple directions.
     """
 
     keys: jax.Array
@@ -343,20 +378,25 @@ def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, ct_timeout_
     """Shared fast-path flow-cache probe for step and trace (single source of
     truth for the FlowCache row layout).
 
-    -> (hit, est, meta_row (B,4)) where meta_row is the gathered meta rows.
+    -> (hit, est, rpl, meta_row (B,4)) where meta_row is the gathered meta
+    rows.  rpl flags reply-direction (reverse-tuple) hits: their meta row
+    carries the un-DNAT rewrite (original service frontend ip/port) instead
+    of a DNAT resolution.
     """
     kr = flow.keys[slot]  # (B, 4) row gather
     kpg = kr[:, 3]
+    pg_rpl = pg_est | REPLY_BIT
     key_hit = (
         (kr[:, 0] == src_f)
         & (kr[:, 1] == dst_f)
         & (kr[:, 2] == pp)
-        & ((kpg == pg_cur) | (kpg == pg_est))
+        & ((kpg == pg_cur) | (kpg == pg_est) | (kpg == pg_rpl))
     )
     fresh = (now - flow.ts[slot]) <= ct_timeout_s
     hit = key_hit & fresh
-    est = hit & (kpg == pg_est)
-    return hit, est, flow.meta[slot]
+    est = hit & ((kpg == pg_est) | (kpg == pg_rpl))
+    rpl = hit & (kpg == pg_rpl)
+    return hit, est, rpl, flow.meta[slot]
 
 
 def _pipeline_step(
@@ -390,7 +430,7 @@ def _pipeline_step(
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    hit, est, mr = _cache_lookup(
+    hit, est, rpl, mr = _cache_lookup(
         flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
     )
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
@@ -399,6 +439,54 @@ def _pipeline_step(
 
     # Idle-timeout refresh for hits.
     flow = flow._replace(ts=flow.ts.at[jnp.where(hit, slot, dump)].set(now))
+
+    # Conntrack refreshes BOTH tuple directions on traffic in either
+    # direction (one kernel-ct connection == our two cache entries): an
+    # active connection's reply leg must not idle out while forward traffic
+    # keeps flowing (ovs-pipeline.md:1200 — reply traffic of an established
+    # connection is never policy-dropped).  Refreshing the partner on EVERY
+    # hit would add a key gather + ts scatter to the throughput path
+    # (~20% measured on v5e), so it is DEFERRED: meta[:,3] (pref) records
+    # the last partner-refresh attempt, and the partner walk runs only for
+    # lanes older than ct_timeout/2 — under lax.cond, so batches with no
+    # due lane pay nothing.  Sound because a verified refresh also
+    # resurrects a stale-but-unevicted partner: the connection provably
+    # stayed active (this entry's own freshness), matching kernel ct which
+    # would have refreshed the shared entry at every packet.  The partner
+    # slot is recomputed from the cached DNAT meta and its key VERIFIED
+    # before the refresh, so an unrelated entry that evicted the partner is
+    # never life-extended.
+    #   fwd est hit:  partner = reply entry (dnat_ip, src, dnat_port, sport)
+    #   reply hit:    partner = fwd entry (dst=client, frontend ip/port)
+    p_half = max(1, meta.ct_timeout_s // 2)
+    p_need = est & ((now - mr[:, 3]) >= p_half)
+
+    def partner_refresh(flow):
+        p_src = jnp.where(rpl, dst_f, c_dnat_ip)
+        p_dst = jnp.where(rpl, c_dnat_ip, src_f)
+        p_sport = jnp.where(rpl, dport, c_dport)
+        p_dport = jnp.where(rpl, c_dport, sport)
+        p_pg = jnp.where(rpl, pg_est, pg_est | REPLY_BIT)
+        p_h = hashing.flow_hash(
+            _raw_bits(p_src), _raw_bits(p_dst), proto, p_sport, p_dport, xp=jnp
+        )
+        p_slot = (p_h & jnp.uint32(N - 1)).astype(jnp.int32)
+        pkr = flow.keys[p_slot]
+        p_live = (
+            p_need
+            & (pkr[:, 0] == p_src)
+            & (pkr[:, 1] == p_dst)
+            & (pkr[:, 2] == ((p_sport << 16) | p_dport))
+            & (pkr[:, 3] == p_pg)
+        )
+        return flow._replace(
+            ts=flow.ts.at[jnp.where(p_live, p_slot, dump)].set(now),
+            # Attempt-time update even when the partner is gone, so an
+            # evicted partner doesn't drag the walk into every batch.
+            meta=flow.meta.at[jnp.where(p_need, slot, dump), 3].set(now),
+        )
+
+    flow = jax.lax.cond(p_need.any(), partner_refresh, lambda f: f, flow)
 
     miss = ~hit
     n_miss = miss.sum(dtype=jnp.int32)
@@ -468,20 +556,48 @@ def _pipeline_step(
 
             # Insert into the flow cache: ALLOW entries as ETERNAL
             # (conntrack commit), denials tagged with the current gen.
-            egen = jnp.where(code == ACT_ALLOW, GEN_ETERNAL, gen_w)
+            committed_m = code == ACT_ALLOW
+            egen = jnp.where(committed_m, GEN_ETERNAL, gen_w)
             pg_ins = p_m | 0x100 | (egen << 9)
             m1 = _pack_meta1(code, svc_idx, dnat_port)
+            rules_p = _pack_rules(rule_in, rule_out)
+            # Column 3 = pref: the commit itself freshens both directions.
+            zcol = jnp.full((M,), now, jnp.int32)
             ins = valid
             key_rows = jnp.stack([s_f, d_f, pp_m, pg_ins], axis=1)
-            meta_rows = jnp.stack(
-                [dnat_ip, m1, _pack_rules(rule_in, rule_out),
-                 jnp.zeros((M,), jnp.int32)],
-                axis=1,
+            meta_rows = jnp.stack([dnat_ip, m1, rules_p, zcol], axis=1)
+
+            # Conntrack commits BOTH directions (ref ConntrackCommit +
+            # reply-direction ct state, docs/design/ovs-pipeline.md ct
+            # sections): alongside every ALLOW, insert the reverse-tuple
+            # entry keyed on the POST-DNAT tuple with ports swapped
+            # (endpoint -> client), whose meta carries the un-DNAT rewrite —
+            # the original frontend (pre-DNAT dst ip/port) the reply's
+            # source must be restored to (UnSNAT/EndpointDNAT reverse).
+            rev_ins = valid & committed_m
+            rev_h = hashing.flow_hash(
+                _raw_bits(dnat_ip), _raw_bits(s_f), p_m, dnat_port, sp_m, xp=jnp
             )
+            rev_slot = (rev_h & jnp.uint32(N - 1)).astype(jnp.int32)
+            rev_pg = p_m | 0x100 | (GEN_ETERNAL << 9) | REPLY_BIT
+            rev_keys = jnp.stack(
+                [dnat_ip, s_f, (dnat_port << 16) | sp_m, rev_pg], axis=1
+            )
+            rev_meta = jnp.stack(
+                [d_f, _pack_meta1(code, svc_idx, dp_m), rules_p, zcol], axis=1
+            )
+
+            # Interleave per-packet [fwd_i, rev_i] so last-writer-wins slot
+            # collisions resolve in the same order as the oracle's
+            # per-packet insert sequence (parity on eviction races).
+            slot2 = jnp.stack([slot_m, rev_slot], axis=1).reshape(2 * M)
+            keys2 = jnp.stack([key_rows, rev_keys], axis=1).reshape(2 * M, 4)
+            meta2 = jnp.stack([meta_rows, rev_meta], axis=1).reshape(2 * M, 4)
+            ins2 = jnp.stack([ins, rev_ins], axis=1).reshape(2 * M)
             flow = FlowCache(
-                keys=_scatter_last_rows(flow.keys, slot_m, key_rows, ins, dump),
-                meta=_scatter_last_rows(flow.meta, slot_m, meta_rows, ins, dump),
-                ts=_scatter_last(flow.ts, slot_m, jnp.full((M,), now, jnp.int32), ins, dump),
+                keys=_scatter_last_rows(flow.keys, slot2, keys2, ins2, dump),
+                meta=_scatter_last_rows(flow.meta, slot2, meta2, ins2, dump),
+                ts=_scatter_last(flow.ts, slot2, jnp.full((2 * M,), now, jnp.int32), ins2, dump),
             )
             lm = learn["mask"] & valid
             adump = meta.aff_slots
@@ -519,9 +635,17 @@ def _pipeline_step(
     (out_code, out_svc, out_dnat_ip, out_dnat_port,
      out_rule_in, out_rule_out, out_committed) = outs
 
+    final_code = out_code[:B]
     out = {
-        "code": out_code[:B],
+        "code": final_code,
         "est": est.astype(jnp.int32),
+        # Reply-direction hit: dnat_ip_f/dnat_port carry the UN-DNAT rewrite
+        # (the frontend tuple the reply's SOURCE is restored to), not a
+        # destination rewrite.
+        "reply": rpl.astype(jnp.int32),
+        # REJECT synthesis kind (reject.go analog), derived from the
+        # packet's own proto so cached REJECT hits get the right kind too.
+        "reject_kind": reject_kind_of(final_code, proto),
         "svc_idx": out_svc[:B],
         "dnat_ip_f": out_dnat_ip[:B],
         "dnat_port": out_dnat_port[:B],
@@ -569,7 +693,7 @@ def _pipeline_trace(
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    hit, est, mr = _cache_lookup(
+    hit, est, rpl, mr = _cache_lookup(
         flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
     )
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
@@ -582,9 +706,11 @@ def _pipeline_trace(
         meta=meta.match, hit_combine=hit_combine,
     )
     fresh_code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
+    code = jnp.where(hit, c_code, fresh_code)
     return {
         "cache_hit": hit.astype(jnp.int32),
         "est": est.astype(jnp.int32),
+        "reply": rpl.astype(jnp.int32),
         "cached_code": jnp.where(hit, c_code, -1),
         "svc_idx": svc_idx,
         "no_ep": no_ep.astype(jnp.int32),
@@ -595,7 +721,8 @@ def _pipeline_trace(
         "ingress_code": cls["ingress_code"],
         "ingress_rule": cls["ingress_rule"],
         "fresh_code": fresh_code,
-        "code": jnp.where(hit, c_code, fresh_code),
+        "code": code,
+        "reject_kind": reject_kind_of(code, proto),
     }
 
 

@@ -30,8 +30,14 @@ from typing import Optional
 import numpy as np
 
 from ..apis.service import ServiceEntry
+from ..apis.controlplane import PROTO_TCP
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT
-from ..models.pipeline import GEN_ETERNAL
+from ..models.pipeline import (
+    GEN_ETERNAL,
+    REJECT_ICMP_UNREACH,
+    REJECT_NONE,
+    REJECT_TCP_RST,
+)
 from ..compiler.ir import PolicySet
 from ..ops import hashing
 from ..packet import Packet, PacketBatch
@@ -44,12 +50,22 @@ class ScalarOutcome:
     code: int
     est: bool
     svc_idx: int  # -1 none
-    dnat_ip: int  # raw u32
+    dnat_ip: int  # raw u32; on reply hits: the UN-DNAT source rewrite
     dnat_port: int
     egress_rule: Optional[str]
     ingress_rule: Optional[str]
     committed: bool
     hit: bool = False  # flow-cache hit (False => slow-path classification)
+    reply: bool = False  # reverse-tuple (reply-direction) conntrack hit
+    reject_kind: int = 0  # 0 none / 1 tcp-rst / 2 icmp-port-unreachable
+
+
+def _reject_kind(code: int, proto: int) -> int:
+    """Scalar twin of models.pipeline.reject_kind_of (ref reject.go) —
+    plain conditionals, this runs per packet in the oracle's hot loop."""
+    if code != ACT_REJECT:
+        return REJECT_NONE
+    return REJECT_TCP_RST if proto == PROTO_TCP else REJECT_ICMP_UNREACH
 
 
 class PipelineOracle:
@@ -176,6 +192,7 @@ class PipelineOracle:
         outs: list[ScalarOutcome] = []
         inserts: list[tuple[int, dict]] = []
         refreshes: list[int] = []
+        pref_updates: list[int] = []
         learns: list[tuple[int, dict]] = []
 
         for i in range(batch.size):
@@ -188,9 +205,41 @@ class PipelineOracle:
                     ScalarOutcome(
                         e["code"], est, e["svc"], e["dnat_ip"], e["dnat_port"],
                         e["rule_out"], e["rule_in"], False, hit=True,
+                        reply=e.get("rpl", False),
+                        reject_kind=_reject_kind(e["code"], p.proto),
                     )
                 )
                 refreshes.append(slot)
+                half = max(1, self.ct_timeout_s // 2)
+                if est and (now - e.get("pref", e["ts"])) >= half:
+                    # Conntrack refreshes BOTH directions; like the device,
+                    # the partner walk is deferred via the entry's pref
+                    # stamp (ct_timeout/2 cadence) and the partner entry is
+                    # key-verified before the refresh — which also
+                    # resurrects an idle-expired partner of a provably live
+                    # connection.
+                    pref_updates.append(slot)
+                    rpl = e.get("rpl", False)
+                    p_src = p.dst_ip if rpl else e["dnat_ip"]
+                    p_dst = e["dnat_ip"] if rpl else p.src_ip
+                    p_sport = p.dst_port if rpl else e["dnat_port"]
+                    p_dport = e["dnat_port"] if rpl else p.src_port
+                    p_h = int(
+                        hashing.flow_hash(
+                            np.uint32(p_src), np.uint32(p_dst),
+                            p.proto, p_sport, p_dport,
+                        )
+                    )
+                    p_slot = p_h & (self.flow_slots - 1)
+                    e2 = flow0.get(p_slot)
+                    if (
+                        e2 is not None
+                        and e2["key"] == (p_src, p_dst,
+                                          (p_sport << 16) | p_dport, p.proto)
+                        and e2["gen"] is None
+                        and e2.get("rpl", False) == (not rpl)
+                    ):
+                        refreshes.append(p_slot)
                 continue
 
             # ---- slow path: ServiceLB -> classify -> commit ---------------
@@ -203,22 +252,54 @@ class PipelineOracle:
             committed = code == ACT_ALLOW
             outs.append(
                 ScalarOutcome(code, False, w["svc_idx"], w["dnat_ip"],
-                              w["dnat_port"], rule_out, rule_in, committed)
+                              w["dnat_port"], rule_out, rule_in, committed,
+                              reject_kind=_reject_kind(code, p.proto))
             )
             key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
             inserts.append(
                 (slot, {
                     "key": key, "code": code, "svc": w["svc_idx"],
                     "dnat_ip": w["dnat_ip"], "dnat_port": w["dnat_port"],
-                    "ts": now,
+                    "ts": now, "pref": now,
                     "gen": None if committed else gen,
                     "rule_in": rule_in, "rule_out": rule_out,
+                    "rpl": False,
                 })
             )
+            if committed:
+                # Conntrack commits both directions: the reverse-tuple entry
+                # is keyed on the post-DNAT tuple with ports swapped
+                # (endpoint -> client) and carries the UN-DNAT rewrite (the
+                # original frontend) in its dnat fields.  Insert order (fwd
+                # then rev, per packet) matches the device's interleaved
+                # scatter so eviction races resolve identically.
+                rev_h = int(
+                    hashing.flow_hash(
+                        np.uint32(w["dnat_ip"]), np.uint32(p.src_ip),
+                        p.proto, w["dnat_port"], p.src_port,
+                    )
+                )
+                rev_slot = rev_h & (self.flow_slots - 1)
+                rev_key = (
+                    w["dnat_ip"], p.src_ip,
+                    (w["dnat_port"] << 16) | p.src_port, p.proto,
+                )
+                inserts.append(
+                    (rev_slot, {
+                        "key": rev_key, "code": code, "svc": w["svc_idx"],
+                        "dnat_ip": p.dst_ip, "dnat_port": p.dst_port,
+                        "ts": now, "pref": now, "gen": None,
+                        "rule_in": rule_in, "rule_out": rule_out,
+                        "rpl": True,
+                    })
+                )
             if w["aff_learn"]:
                 learns.append(w["aff_learn"])
 
         # Apply state mutations in batch order (last writer wins).
+        for slot in pref_updates:
+            if slot in self.flow:
+                self.flow[slot]["pref"] = now
         for slot, entry in inserts:
             self.flow[slot] = entry
         for slot in refreshes:

@@ -40,7 +40,11 @@ from antrea_tpu.utils.timing import device_loop_time
 N_RULES = 100_000
 N_SERVICES = 5_000
 B = 1 << 17
-B_COLD = 1 << 13
+# Big enough that K_big - K_small cold iterations take O(100ms) on-device —
+# the round-3 bitmap classifier runs ~7M pps cold, and a too-small cold
+# workload lets dispatch jitter swamp the two-K differencing (observed as a
+# nonsense clamped-at-zero elapsed time).
+B_COLD = 1 << 15
 K = 128
 FLOW_SLOTS = 1 << 22
 MISS_CHUNK = 256
@@ -71,7 +75,7 @@ def measure_cold(drs, match_meta, src, dst, proto, dport):
         return (acc, drs_, s_, d_, p_, dp_)
 
     carry = (jnp.zeros(8, jnp.int32), drs, s, d, p, dp)
-    sec = device_loop_time(body, carry, k_small=4, k_big=16, repeats=3)
+    sec = device_loop_time(body, carry, k_small=8, k_big=64, repeats=4)
     return B_COLD / sec
 
 

@@ -46,6 +46,8 @@ def _mk_pair(n_rules=120, n_services=12, seed=3, delta_slots=64):
 def _diff(tr, a, b, *, check_rules=True):
     assert a.code.tolist() == b.code.tolist()
     assert a.est.tolist() == b.est.tolist()
+    assert a.reply.tolist() == b.reply.tolist()
+    assert a.reject_kind.tolist() == b.reject_kind.tolist()
     assert a.svc_idx.tolist() == b.svc_idx.tolist()
     assert a.dnat_ip.tolist() == b.dnat_ip.tolist()
     assert a.dnat_port.tolist() == b.dnat_port.tolist()
@@ -122,6 +124,39 @@ def test_differential_group_delta():
 
     # Also re-touch existing flows: denials must have been revalidated.
     _diff(b, tpu.step(b, now=61), orc.step(b, now=61), check_rules=False)
+
+
+def test_noop_delta_keeps_generation_both_datapaths():
+    """A refcount-only delta (re-adding an already-present member) changes
+    no verdict, so NEITHER datapath bumps its generation — cached denials
+    stay cached (no needless slow-path revalidation) and the differential
+    harness still sees identical generations."""
+    cluster, services, tpu, orc = _mk_pair()
+    b = _batch(cluster, services, 160, seed=31)
+    _diff(b, tpu.step(b, now=50), orc.step(b, now=50))
+
+    ag = sorted(cluster.ps.address_groups)[0]
+    from collections import Counter as _C
+    counts = _C(m.ip for m in cluster.ps.address_groups[ag].members)
+    present = next(ip for ip, c in counts.items() if c == 1)  # unique member
+    g0t, g0o = tpu.generation, orc.generation
+    g1 = tpu.apply_group_delta(ag, added_ips=[present], removed_ips=[])
+    g2 = orc.apply_group_delta(ag, added_ips=[present], removed_ips=[])
+    assert g1 == g0t and g2 == g0o and g1 == g2
+
+    # Cached verdicts (incl. denials) are served from cache on both sides —
+    # the handful of misses are forward entries evicted by reverse-tuple
+    # inserts (slot collisions, identical on both implementations).
+    ra, rb = tpu.step(b, now=60), orc.step(b, now=60)
+    _diff(b, ra, rb, check_rules=False)
+    assert ra.n_miss == rb.n_miss and ra.n_miss < 8
+
+    # Dropping one of the two refcounts is still a no-op; dropping the last
+    # one is a real change and bumps both.
+    assert tpu.apply_group_delta(ag, [], [present]) == g1
+    assert orc.apply_group_delta(ag, [], [present]) == g2
+    assert tpu.apply_group_delta(ag, [], [present]) == g1 + 1
+    assert orc.apply_group_delta(ag, [], [present]) == g2 + 1
 
 
 def test_delta_matches_fresh_compile():

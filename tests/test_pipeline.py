@@ -44,6 +44,8 @@ def compare(cps, out, scalar_outs, i):
     so = scalar_outs[i]
     assert int(out["code"][i]) == so.code, (i, "code")
     assert bool(out["est"][i]) == so.est, (i, "est")
+    assert bool(out["reply"][i]) == so.reply, (i, "reply")
+    assert int(out["reject_kind"][i]) == so.reject_kind, (i, "reject_kind")
     assert int(out["svc_idx"][i]) == so.svc_idx, (i, "svc")
     assert int(unflip(out["dnat_ip_f"][i : i + 1])[0]) == so.dnat_ip, (i, "dnat_ip")
     assert int(out["dnat_port"][i]) == so.dnat_port, (i, "dnat_port")
@@ -236,6 +238,136 @@ def test_policy_applies_post_dnat():
     assert cps.ingress.rule_ids[int(out["ingress_rule"][0])] == "drop-ep/In/0"
 
 
+def test_reply_direction_undnat():
+    """A service connection's REPLY (endpoint -> client, post-DNAT tuple with
+    ports swapped) must hit the reverse conntrack entry: est bypass + the
+    un-DNAT rewrite restoring the original frontend tuple (ref UnSNAT/
+    ConntrackState tables, pipeline.go; ovs-pipeline.md ct sections)."""
+    _, services, cps, step, state, drs, dsvc = _mini_env()
+    client = iputil.ip_to_u32("10.0.0.5")
+    svc1 = iputil.ip_to_u32("10.96.0.1")
+
+    # Forward packet: client -> ClusterIP:80, DNAT to an endpoint.
+    t_fwd = _batch([(client, svc1, cp.PROTO_TCP, 40000, 80)])
+    state, out = run_step(step, state, drs, dsvc, t_fwd, 100)
+    assert int(out["committed"][0]) == 1
+    ep_ip = int(unflip(out["dnat_ip_f"][:1])[0])
+    ep_port = int(out["dnat_port"][0])
+
+    # Reply packet: endpoint -> client with swapped ports.
+    t_rpl = _batch([(ep_ip, client, cp.PROTO_TCP, ep_port, 40000)])
+    state, out = run_step(step, state, drs, dsvc, t_rpl, 110)
+    assert int(out["est"][0]) == 1, "reply must ride the est bypass"
+    assert int(out["reply"][0]) == 1
+    assert int(out["code"][0]) == 0
+    assert int(out["n_miss"]) == 0  # pure fast path, no re-classification
+    # un-DNAT: the reply's source is restored to the service frontend.
+    assert int(unflip(out["dnat_ip_f"][:1])[0]) == svc1
+    assert int(out["dnat_port"][0]) == 80
+
+    # A reply-shaped packet for a NEVER-committed connection is a fresh flow.
+    t_cold = _batch([(ep_ip, client, cp.PROTO_TCP, ep_port, 50505)])
+    state, out = run_step(step, state, drs, dsvc, t_cold, 120)
+    assert int(out["reply"][0]) == 0 and int(out["est"][0]) == 0
+
+
+def test_reply_bypasses_policy_and_reject_kinds():
+    """Reply-leg packets of established connections bypass policy even when
+    the rules would deny them; REJECT verdicts carry the synthesis kind
+    (TCP -> RST, UDP -> ICMP port-unreachable; ref reject.go)."""
+    ps = PolicySet()
+    ps.applied_to_groups["atg-client"] = cp.AppliedToGroup(
+        "atg-client", [cp.GroupMember(ip="10.0.0.5", node="n0")]
+    )
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="reject-client",
+            name="reject-client",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-client"],
+            tier_priority=cp.TIER_APPLICATION,
+            priority=1.0,
+            rules=[
+                cp.NetworkPolicyRule(
+                    direction=cp.Direction.IN, action=cp.RuleAction.REJECT,
+                    priority=0,
+                )
+            ],
+        )
+    )
+    cps = compile_policy_set(ps)
+    svt = compile_services([])
+    step, state, (drs, dsvc) = make_pipeline(
+        cps, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS
+    )
+    client = iputil.ip_to_u32("10.0.0.5")
+    server = iputil.ip_to_u32("10.0.0.80")
+
+    # Outbound client -> server is allowed (policy only guards ingress TO
+    # the client) and commits both directions.
+    t_fwd = _batch([(client, server, cp.PROTO_TCP, 41000, 80)])
+    state, out = run_step(step, state, drs, dsvc, t_fwd, 0)
+    assert int(out["code"][0]) == 0 and int(out["committed"][0]) == 1
+
+    # The server's reply targets the client — the ingress REJECT rule would
+    # hit a fresh flow, but the reply leg rides the reverse ct entry.
+    t_rpl = _batch([(server, client, cp.PROTO_TCP, 80, 41000)])
+    state, out = run_step(step, state, drs, dsvc, t_rpl, 10)
+    assert int(out["code"][0]) == 0 and int(out["reply"][0]) == 1
+
+    # A FRESH connection attempt to the client is rejected with a TCP RST...
+    t_tcp = _batch([(server, client, cp.PROTO_TCP, 2000, 9000)])
+    state, out = run_step(step, state, drs, dsvc, t_tcp, 20)
+    assert int(out["code"][0]) == 2 and int(out["reject_kind"][0]) == 1
+    # ...and a UDP one with an ICMP port-unreachable.
+    t_udp = _batch([(server, client, cp.PROTO_UDP, 2000, 9000)])
+    state, out = run_step(step, state, drs, dsvc, t_udp, 20)
+    assert int(out["code"][0]) == 2 and int(out["reject_kind"][0]) == 2
+
+
+def test_forward_traffic_keeps_reply_entry_alive():
+    """Conntrack refreshes both directions: steady forward traffic must keep
+    the reverse (reply) entry from idling out, so a late first reply of a
+    still-active connection rides the est bypass (ovs-pipeline.md:1200)."""
+    ps = PolicySet()
+    ps.applied_to_groups["atg-client"] = cp.AppliedToGroup(
+        "atg-client", [cp.GroupMember(ip="10.0.0.5", node="n0")]
+    )
+    ps.policies.append(
+        cp.NetworkPolicy(
+            uid="deny-to-client", name="deny-to-client",
+            type=cp.NetworkPolicyType.ACNP,
+            applied_to_groups=["atg-client"],
+            tier_priority=cp.TIER_APPLICATION, priority=1.0,
+            rules=[cp.NetworkPolicyRule(
+                direction=cp.Direction.IN, action=cp.RuleAction.DROP,
+                priority=0,
+            )],
+        )
+    )
+    cps = compile_policy_set(ps)
+    svt = compile_services([])
+    step, state, (drs, dsvc) = make_pipeline(
+        cps, svt, flow_slots=CONN_SLOTS, aff_slots=AFF_SLOTS, ct_timeout_s=100
+    )
+    client = iputil.ip_to_u32("10.0.0.5")
+    server = iputil.ip_to_u32("10.0.0.80")
+    t_fwd = _batch([(client, server, cp.PROTO_TCP, 41000, 80)])
+    t_rpl = _batch([(server, client, cp.PROTO_TCP, 80, 41000)])
+
+    state, out = run_step(step, state, drs, dsvc, t_fwd, 0)
+    assert int(out["committed"][0]) == 1
+    # Forward keepalives every 50s; at t=250 the reply entry's ORIGINAL
+    # ts=0 is long past the 100s idle timeout...
+    for now in (50, 100, 150, 200, 250):
+        state, out = run_step(step, state, drs, dsvc, t_fwd, now)
+        assert int(out["est"][0]) == 1, now
+    # ...but the first reply at t=260 still rides the est bypass, because
+    # each forward hit refreshed the partner entry too.
+    state, out = run_step(step, state, drs, dsvc, t_rpl, 260)
+    assert int(out["reply"][0]) == 1 and int(out["code"][0]) == 0
+
+
 def test_session_affinity_sticky_and_expiry():
     _, services, cps, step, state, drs, dsvc = _mini_env()
     client = iputil.ip_to_u32("10.0.0.5")
@@ -303,7 +435,7 @@ def test_generation_semantics():
 
     # gen 1: rules now deny — but the ESTABLISHED flow persists (est bypass).
     cps_deny = compile_policy_set(_deny_all_ps(target))
-    drs_deny, _ = to_device(cps_deny, 64)
+    drs_deny, _ = to_device(cps_deny)
     state, out = run_step(step, state, drs_deny, dsvc, t, 10, gen=1)
     assert int(out["est"][0]) == 1 and int(out["code"][0]) == 0
     assert int(out["n_miss"]) == 0  # pure fast path

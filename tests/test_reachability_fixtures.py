@@ -165,7 +165,7 @@ def test_fixture_established_bypass_survives_policy_change():
     )
     cps2 = compile_policy_set(ps2)
     from antrea_tpu.ops.match import to_device
-    drs2, _meta2 = to_device(cps2, 16)
+    drs2, _meta2 = to_device(cps2)
 
     # Same flow: established bypass -> still allowed under the new rules.
     state, out = _one(step, state, drs2, dsvc, CLIENT, EP, 80, now=2, gen=1)
@@ -200,6 +200,105 @@ def test_fixture_denied_flow_revalidated_after_relax():
 
     # Relax: empty policy set, gen bump -> the denial is re-classified.
     cps2 = compile_policy_set(_ps([]))
-    drs2, _ = to_device(cps2, 16)
+    drs2, _ = to_device(cps2)
     state, out = _one(step, state, drs2, dsvc, CLIENT, EP, 80, now=3, gen=1)
     assert int(out["code"][0]) == ALLOW and int(out["n_miss"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Reply-direction fixtures: ct reply state + un-DNAT + reject kinds,
+# expectations authored from ovs-pipeline.md (UnSNAT :863-889 undoes NAT on
+# reply packets via ct; ConntrackState/:1200 "reply traffic is never dropped
+# because of an Antrea-native NetworkPolicy or K8s NetworkPolicy rule") and
+# pkg/agent/controller/networkpolicy/reject.go (TCP -> RST, else ICMP
+# port-unreachable).  Run at the Datapath boundary on BOTH implementations.
+# ---------------------------------------------------------------------------
+
+
+def _both_datapaths(ps, services):
+    from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8)
+    return [
+        TpuflowDatapath(ps, services, miss_chunk=32, **kw),
+        OracleDatapath(ps, services, **kw),
+    ]
+
+
+def _probe(dp, src, dst, dport, now, proto=6, sport=40000):
+    batch = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+        proto=np.array([proto], np.int32),
+        src_port=np.array([sport], np.int32),
+        dst_port=np.array([dport], np.int32),
+    )
+    return dp.step(batch, now)
+
+
+def test_fixture_service_reply_undnat_both_datapaths():
+    """ovs-pipeline.md UnSNAT/ct: the reply leg of a DNAT'd Service
+    connection (endpoint -> client) is ct-established and its source is
+    restored to the Service frontend — on both datapaths identically."""
+    from fixtures_reachability import _ps
+
+    for dp in _both_datapaths(_ps([]), [_svc([Endpoint(EP, 8080)])]):
+        r = _probe(dp, CLIENT, VIP, 80, now=1)
+        assert int(r.code[0]) == ALLOW and int(r.committed[0]) == 1, dp.datapath_type
+        # Reply: endpoint -> client, ports swapped (ep_port 8080 -> sport).
+        r = _probe(dp, EP, CLIENT, dport=40000, sport=8080, now=2)
+        assert int(r.est[0]) == 1, dp.datapath_type
+        assert int(r.reply[0]) == 1, dp.datapath_type
+        assert int(r.code[0]) == ALLOW, dp.datapath_type
+        # un-DNAT: reported rewrite is the original frontend tuple.
+        assert int(r.dnat_ip[0]) == iputil.ip_to_u32(VIP), dp.datapath_type
+        assert int(r.dnat_port[0]) == 80, dp.datapath_type
+
+
+def test_fixture_reply_never_dropped_by_policy_both_datapaths():
+    """ovs-pipeline.md:1200 — reply traffic of an established connection is
+    never dropped by an NP rule, even one that would deny it as a fresh
+    flow."""
+    from antrea_tpu.apis.controlplane import Direction, RuleAction
+    from fixtures_reachability import _ps, acnp, rule, peer
+
+    # Deny ALL ingress to the client pod (would kill the reply as a fresh
+    # flow), but the client's own egress connection must still work both ways.
+    ps = _ps(
+        [acnp("deny-to-client", ["at-client"],
+              [rule(Direction.IN, peer("g-web"), action=RuleAction.DROP)])],
+        [ag("g-web", "web")],
+        [atg("at-client", "client")],
+    )
+    for dp in _both_datapaths(ps, []):
+        r = _probe(dp, CLIENT, EP, 80, now=1)
+        assert int(r.code[0]) == ALLOW and int(r.committed[0]) == 1, dp.datapath_type
+        r = _probe(dp, EP, CLIENT, dport=40000, sport=80, now=2)
+        assert int(r.code[0]) == ALLOW and int(r.reply[0]) == 1, dp.datapath_type
+        # The same packet WITHOUT the prior commit is a fresh flow -> DROP
+        # (different sport so it misses the reverse entry).
+        r = _probe(dp, EP, CLIENT, dport=40000, sport=81, now=3)
+        assert int(r.code[0]) == DROP and int(r.reply[0]) == 0, dp.datapath_type
+
+
+def test_fixture_reject_kinds_both_datapaths():
+    """reject.go: REJECT synthesizes a TCP RST for TCP flows and an ICMP
+    port-unreachable for UDP; SvcReject (no endpoints) gets the same
+    treatment."""
+    from antrea_tpu.apis.controlplane import Direction, RuleAction
+    from fixtures_reachability import _ps, acnp, rule, peer
+
+    ps = _ps(
+        [acnp("reject-to-web", ["at-web"],
+              [rule(Direction.IN, peer("g-client"), action=RuleAction.REJECT)])],
+        [ag("g-client", "client")],
+        [atg("at-web", "web")],
+    )
+    for dp in _both_datapaths(ps, [_svc([])]):
+        r = _probe(dp, CLIENT, EP, 80, now=1)  # TCP -> RST
+        assert int(r.code[0]) == REJECT and int(r.reject_kind[0]) == 1, dp.datapath_type
+        r = _probe(dp, CLIENT, EP, 53, now=2, proto=17)  # UDP -> ICMP
+        assert int(r.code[0]) == REJECT and int(r.reject_kind[0]) == 2, dp.datapath_type
+        # SvcReject: VIP with no endpoints, TCP -> RST kind.
+        r = _probe(dp, "10.10.0.33", VIP, 80, now=3)
+        assert int(r.code[0]) == REJECT and int(r.reject_kind[0]) == 1, dp.datapath_type
