@@ -2,20 +2,32 @@
 
 Semantic analog of what AntreaProxy consumes from k8s Services +
 EndpointSlices (ref: /root/reference/pkg/agent/proxy/proxier.go:73 and
-third_party/proxy types): a ClusterIP:port/proto frontend, a set of endpoint
-(ip, port) backends, and optional ClientIP session affinity with a timeout
-(ref: serviceLearnFlow, pkg/agent/openflow/pipeline.go:2316).
+third_party/proxy types): frontends (ClusterIP, LoadBalancer/external IPs,
+NodePort — ref proxier.go installServices :690 / syncProxyRules :986), a set
+of endpoint (ip, port) backends with node placement, optional ClientIP
+session affinity with a timeout (ref: serviceLearnFlow,
+pkg/agent/openflow/pipeline.go:2316), and externalTrafficPolicy
+(ref: third_party/proxy ServicePort.ExternalPolicyLocal; Local restricts
+external-frontend traffic to endpoints on the receiving node).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+# externalTrafficPolicy values (k8s spelling).
+ETP_CLUSTER = "Cluster"
+ETP_LOCAL = "Local"
+
 
 @dataclass(frozen=True)
 class Endpoint:
     ip: str
     port: int
+    # Node the backing pod runs on ("" = unknown/none).  Used by
+    # externalTrafficPolicy=Local filtering: an external-frontend packet may
+    # only select endpoints whose node == the datapath's node.
+    node: str = ""
 
 
 @dataclass
@@ -29,3 +41,14 @@ class ServiceEntry:
     affinity_timeout_s: int = 0
     name: str = ""
     namespace: str = ""
+    # External frontends (ref proxier.go:853 installServiceFlows over
+    # loadBalancerIPStrings + externalIPs): each ip gets the same
+    # proto/port frontend as the ClusterIP.
+    external_ips: list[str] = field(default_factory=list)
+    # 0 = no NodePort; else every node IP known to the datapath exposes
+    # (node_ip, protocol, node_port) as a frontend (ref proxier.go:690 +
+    # pipeline.go NodePortMark table).
+    node_port: int = 0
+    # ETP_CLUSTER (default) or ETP_LOCAL; applies to external frontends
+    # (LoadBalancer/external IPs + NodePort), never to the ClusterIP.
+    external_traffic_policy: str = ETP_CLUSTER

@@ -64,6 +64,11 @@ class StepResult:
     # the agent would emit for a REJECT verdict (ref pkg/agent/controller/
     # networkpolicy/reject.go).
     reject_kind: np.ndarray = None
+    # 0/1 — SNAT mark: external-frontend service traffic (NodePort /
+    # LoadBalancer IP) under externalTrafficPolicy=Cluster must be
+    # masqueraded so return traffic re-traverses this node (ref
+    # pipeline.go SNATMark/NodePortMark tables, proxier.go).
+    snat: np.ndarray = None
 
 
 class Datapath(ABC):

@@ -43,6 +43,8 @@ class OracleDatapath(Datapath):
         flow_slots: int = 1 << 20,
         aff_slots: int = 1 << 18,
         ct_timeout_s: int = 3600,
+        node_ips: Optional[list] = None,
+        node_name: str = "",
     ):
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -50,6 +52,7 @@ class OracleDatapath(Datapath):
         self._oracle = PipelineOracle(
             self._ps, self._services,
             flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
+            node_ips=list(node_ips or []), node_name=node_name,
         )
         self._stats_in: Counter = Counter()
         self._stats_out: Counter = Counter()
@@ -133,6 +136,7 @@ class OracleDatapath(Datapath):
                 "est": e is not None and e["gen"] is None,
                 "reply": e is not None and e.get("rpl", False),
                 "reject_kind": _reject_kind(code, p.proto),
+                "snat": w["snat"],
                 "svc_idx": w["svc_idx"],
                 "no_ep": w["no_ep"],
                 "dnat_ip": w["dnat_ip"],
@@ -170,4 +174,5 @@ class OracleDatapath(Datapath):
             n_miss=sum(1 for o in outs if not o.hit),
             reply=np.array([int(o.reply) for o in outs], np.int32),
             reject_kind=np.array([o.reject_kind for o in outs], np.int32),
+            snat=np.array([o.snat for o in outs], np.int32),
         )

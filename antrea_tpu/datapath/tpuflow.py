@@ -56,7 +56,14 @@ class TpuflowDatapath(Datapath):
         ct_timeout_s: int = 3600,
         miss_chunk: int = 4096,
         delta_slots: int = 128,
+        node_ips: Optional[list[str]] = None,
+        node_name: str = "",
     ):
+        # Node identity: NodePort frontends bind to these addresses and
+        # externalTrafficPolicy=Local filters endpoints to this node
+        # (ref proxier.go nodePortAddresses / externalPolicyLocal).
+        self._node_ips = list(node_ips or [])
+        self._node_name = node_name
         self._delta_slots = delta_slots
         self._pipe_kw = dict(
             flow_slots=flow_slots, aff_slots=aff_slots,
@@ -179,6 +186,7 @@ class TpuflowDatapath(Datapath):
             est=o["est"],
             reply=o["reply"],
             reject_kind=o["reject_kind"],
+            snat=o["snat"],
             svc_idx=o["svc_idx"],
             dnat_ip=(o["dnat_ip_f"].astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32),
             dnat_port=o["dnat_port"],
@@ -237,6 +245,7 @@ class TpuflowDatapath(Datapath):
                 "est": bool(o["est"][i]),
                 "reply": bool(o["reply"][i]),
                 "reject_kind": int(o["reject_kind"][i]),
+                "snat": int(o["snat"][i]),
                 "svc_idx": int(o["svc_idx"][i]),
                 "no_ep": bool(o["no_ep"][i]),
                 "dnat_ip": int(np.uint32(o["dnat_ip_f"][i] ^ np.int32(-(2**31)))),
@@ -333,7 +342,9 @@ class TpuflowDatapath(Datapath):
             self._group_members[name] = c
 
     def _compile_services(self) -> None:
-        self._dsvc = pl.svc_to_device(compile_services(self._services))
+        self._dsvc = pl.svc_to_device(compile_services(
+            self._services, node_ips=self._node_ips, node_name=self._node_name
+        ))
 
     def _ranges_of(self, name: str) -> list[tuple[int, int]]:
         """Current merged ranges of a named group (members + static blocks)."""

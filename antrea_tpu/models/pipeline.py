@@ -154,8 +154,10 @@ class DeviceServiceTables(NamedTuple):
     n_ep: jax.Array
     has_ep: jax.Array
     aff_timeout: jax.Array
-    ep_ip_f: jax.Array
-    ep_port: jax.Array
+    ep_base: jax.Array  # (P,) offsets into the flat endpoint arrays
+    ep_ip_f: jax.Array  # (E,) flat — unbounded endpoints per program
+    ep_port: jax.Array  # (E,) flat
+    snat: jax.Array  # (P,) 0/1 SNAT-mark flag (external frontend, ETP=Cluster)
 
 
 class PipelineMeta(NamedTuple):
@@ -175,8 +177,10 @@ def svc_to_host(st: ServiceTables) -> DeviceServiceTables:
         n_ep=np.asarray(st.n_ep),
         has_ep=np.asarray(st.has_ep),
         aff_timeout=np.asarray(st.aff_timeout),
+        ep_base=np.asarray(st.ep_base),
         ep_ip_f=np.asarray(st.ep_ip_f),
         ep_port=np.asarray(st.ep_port),
+        snat=np.asarray(st.snat),
     )
 
 
@@ -325,7 +329,11 @@ def _service_lb(
 ):
     """ServiceLB + affinity + endpoint choice for a (miss) sub-batch.
 
-    -> (svc_idx, no_ep, dnat_ip_f, dnat_port, learn dict)
+    svc_idx is an LB-program index (compiler/services.py): ClusterIP
+    frontends resolve to the cluster view (== service index), external
+    frontends (LoadBalancer IP / NodePort) to their per-policy shadow view.
+
+    -> (svc_idx, no_ep, dnat_ip_f, dnat_port, snat, learn dict)
     """
     row = jnp.searchsorted(dsvc.uip_f, dst_f, side="left")
     row = jnp.clip(row, 0, dsvc.uip_f.shape[0] - 1)
@@ -359,11 +367,14 @@ def _service_lb(
     )
     hash_ep = (h.astype(jnp.int32) & jnp.int32(0x7FFFFFFF)) % dsvc.n_ep[svc_safe]
     ep_col = jnp.where(aff_hit, aff.ep[aslot] - 1, hash_ep)
-    ep_col = jnp.clip(ep_col, 0, dsvc.ep_ip_f.shape[1] - 1)
+    # Flat indirect endpoint gather — no per-program endpoint cap (the
+    # reference's group buckets are unbounded, serviceEndpointGroup).
+    eidx = jnp.clip(dsvc.ep_base[svc_safe] + ep_col, 0, dsvc.ep_ip_f.shape[0] - 1)
 
     use_ep = is_svc & ~no_ep
-    dnat_ip = jnp.where(use_ep, dsvc.ep_ip_f[svc_safe, ep_col], dst_f)
-    dnat_port = jnp.where(use_ep, dsvc.ep_port[svc_safe, ep_col], dport)
+    dnat_ip = jnp.where(use_ep, dsvc.ep_ip_f[eidx], dst_f)
+    dnat_port = jnp.where(use_ep, dsvc.ep_port[eidx], dport)
+    snat = jnp.where(use_ep, dsvc.snat[svc_safe], 0)
     learn = {
         "mask": aff_on & ~aff_hit & ~no_ep,
         "aslot": aslot,
@@ -371,7 +382,7 @@ def _service_lb(
         "svc": svc_idx,
         "ep": ep_col + 1,  # stored +1: 0 means empty slot
     }
-    return svc_idx, no_ep, dnat_ip, dnat_port, learn
+    return svc_idx, no_ep, dnat_ip, dnat_port, snat, learn
 
 
 def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, ct_timeout_s):
@@ -502,18 +513,27 @@ def _pipeline_step(
     out_rule_in = outbuf(jnp.where(hit, c_rule_in, MISS))
     out_rule_out = outbuf(jnp.where(hit, c_rule_out, MISS))
     out_committed = outbuf(jnp.zeros(B, jnp.int32))
+    # SNAT mark is derivable from the cached program index (small (P,)
+    # gather), so it needs no flow-cache column; reply-direction hits carry
+    # the un-SNAT implicitly via the restored frontend tuple.
+    c_svc_safe = jnp.clip(c_svc, 0, dsvc.snat.shape[0] - 1)
+    out_snat = outbuf(
+        jnp.where(hit & ~rpl & (c_svc >= 0), dsvc.snat[c_svc_safe], 0)
+    )
 
     # ---- slow path: ServiceLB + classify + commit, misses only -------------
     def slow(args):
         flow, aff, outs = args
-        out_code, out_svc, out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out, out_committed = outs
+        (out_code, out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
+         out_rule_out, out_committed, out_snat) = outs
         # Batch semantics: affinity LOOKUPS see start-of-batch state even
         # across slow-path rounds; learns land in the carried table.
         aff_snap = aff
         midx = jnp.nonzero(miss, size=B, fill_value=B)[0].astype(jnp.int32)
 
         def round_body(carry):
-            r, flow, aff, out_code, out_svc, out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out, out_committed = carry
+            (r, flow, aff, out_code, out_svc, out_dnat_ip, out_dnat_port,
+             out_rule_in, out_rule_out, out_committed, out_snat) = carry
             idx = jax.lax.dynamic_slice(
                 jnp.concatenate([midx, jnp.full((M,), B, jnp.int32)]),
                 (r * M,),
@@ -530,7 +550,7 @@ def _pipeline_step(
             slot_m = slot[safe]
             pp_m = pp[safe]
 
-            svc_idx, no_ep, dnat_ip, dnat_port, learn = _service_lb(
+            svc_idx, no_ep, dnat_ip, dnat_port, snat_m, learn = _service_lb(
                 aff_snap, dsvc, h_m, s_f, d_f, p_m, dp_m, now, meta.aff_slots
             )
 
@@ -553,6 +573,7 @@ def _pipeline_step(
             out_rule_in = out_rule_in.at[tgt].set(rule_in)
             out_rule_out = out_rule_out.at[tgt].set(rule_out)
             out_committed = out_committed.at[tgt].set((code == ACT_ALLOW).astype(jnp.int32))
+            out_snat = out_snat.at[tgt].set(snat_m)
 
             # Insert into the flow cache: ALLOW entries as ETERNAL
             # (conntrack commit), denials tagged with the current gen.
@@ -608,19 +629,21 @@ def _pipeline_step(
                 ts=_scatter_last(aff.ts, learn["aslot"], jnp.full((M,), now, jnp.int32), lm, adump),
             )
             return (r + 1, flow, aff, out_code, out_svc, out_dnat_ip,
-                    out_dnat_port, out_rule_in, out_rule_out, out_committed)
+                    out_dnat_port, out_rule_in, out_rule_out, out_committed,
+                    out_snat)
 
         def round_cond(carry):
             r = carry[0]
             return r * M < n_miss
 
         carry = (jnp.int32(0), flow, aff, out_code, out_svc, out_dnat_ip,
-                 out_dnat_port, out_rule_in, out_rule_out, out_committed)
+                 out_dnat_port, out_rule_in, out_rule_out, out_committed,
+                 out_snat)
         carry = jax.lax.while_loop(round_cond, round_body, carry)
         (_, flow, aff, out_code, out_svc, out_dnat_ip, out_dnat_port,
-         out_rule_in, out_rule_out, out_committed) = carry
+         out_rule_in, out_rule_out, out_committed, out_snat) = carry
         return flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
-                           out_rule_in, out_rule_out, out_committed)
+                           out_rule_in, out_rule_out, out_committed, out_snat)
 
     def noop(args):
         return args
@@ -630,10 +653,10 @@ def _pipeline_step(
         slow,
         noop,
         (flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
-                     out_rule_in, out_rule_out, out_committed)),
+                     out_rule_in, out_rule_out, out_committed, out_snat)),
     )
     (out_code, out_svc, out_dnat_ip, out_dnat_port,
-     out_rule_in, out_rule_out, out_committed) = outs
+     out_rule_in, out_rule_out, out_committed, out_snat) = outs
 
     final_code = out_code[:B]
     out = {
@@ -652,6 +675,9 @@ def _pipeline_step(
         "ingress_rule": out_rule_in[:B],
         "egress_rule": out_rule_out[:B],
         "committed": out_committed[:B],
+        # SNAT-mark classification (pipeline.go SNATMark analog): external
+        # frontend traffic under ETP=Cluster needs masquerade on egress.
+        "snat": out_snat[:B],
         "n_miss": n_miss,
     }
     return PipelineState(flow=flow, aff=aff), out
@@ -698,7 +724,7 @@ def _pipeline_trace(
     )
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
 
-    svc_idx, no_ep, dnat_ip, dnat_port, _learn = _service_lb(
+    svc_idx, no_ep, dnat_ip, dnat_port, snat, _learn = _service_lb(
         aff, dsvc, h, src_f, dst_f, proto, dport, now, meta.aff_slots
     )
     cls = classify_batch(
@@ -716,6 +742,7 @@ def _pipeline_trace(
         "no_ep": no_ep.astype(jnp.int32),
         "dnat_ip_f": dnat_ip,
         "dnat_port": dnat_port,
+        "snat": snat,
         "egress_code": cls["egress_code"],
         "egress_rule": cls["egress_rule"],
         "ingress_code": cls["ingress_code"],

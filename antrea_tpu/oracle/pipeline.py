@@ -49,7 +49,7 @@ from .interpreter import Oracle
 class ScalarOutcome:
     code: int
     est: bool
-    svc_idx: int  # -1 none
+    svc_idx: int  # -1 none (LB-program index, see compiler/services.py)
     dnat_ip: int  # raw u32; on reply hits: the UN-DNAT source rewrite
     dnat_port: int
     egress_rule: Optional[str]
@@ -58,6 +58,7 @@ class ScalarOutcome:
     hit: bool = False  # flow-cache hit (False => slow-path classification)
     reply: bool = False  # reverse-tuple (reply-direction) conntrack hit
     reject_kind: int = 0  # 0 none / 1 tcp-rst / 2 icmp-port-unreachable
+    snat: int = 0  # SNAT mark: external frontend under ETP=Cluster
 
 
 def _reject_kind(code: int, proto: int) -> int:
@@ -66,6 +67,61 @@ def _reject_kind(code: int, proto: int) -> int:
     if code != ACT_REJECT:
         return REJECT_NONE
     return REJECT_TCP_RST if proto == PROTO_TCP else REJECT_ICMP_UNREACH
+
+
+@dataclass
+class _LBProgram:
+    """One LB program: an endpoint view + per-frontend-kind flags.  The
+    scalar twin of the compiler's program rows (compiler/services.py):
+    cluster views occupy indices 0..len(services)-1, external shadow views
+    (ETP=Local filtered, or ETP=Cluster SNAT-marked) follow."""
+
+    endpoints: list
+    affinity_timeout_s: int
+    snat: int
+
+
+def _build_programs(services, node_ips, node_name):
+    """-> (programs, frontends {(ip_u, proto, port) -> program idx})."""
+    from ..apis.service import ETP_LOCAL
+
+    progs = [
+        _LBProgram(list(s.endpoints), s.affinity_timeout_s, 0) for s in services
+    ]
+    fronts: dict[tuple[int, int, int], int] = {}
+
+    def add_front(ip_u: int, proto: int, port: int, prog: int) -> None:
+        key = (ip_u, proto, port)
+        if key in fronts:
+            # Same observable rule as compile_services: duplicate frontends
+            # are a config error, never silent last-writer-wins.
+            raise ValueError(
+                f"duplicate frontend {iputil.u32_to_ip(ip_u)} "
+                f"proto {proto} port {port}"
+            )
+        fronts[key] = prog
+
+    for si, svc in enumerate(services):
+        add_front(iputil.ip_to_u32(svc.cluster_ip), svc.protocol, svc.port, si)
+        has_external = bool(svc.external_ips) or (svc.node_port > 0 and node_ips)
+        if not has_external:
+            continue
+        ext = len(progs)
+        if svc.external_traffic_policy == ETP_LOCAL:
+            progs.append(_LBProgram(
+                [e for e in svc.endpoints if e.node == node_name],
+                svc.affinity_timeout_s, 0,
+            ))
+        else:
+            progs.append(_LBProgram(
+                list(svc.endpoints), svc.affinity_timeout_s, 1,
+            ))
+        for ip in svc.external_ips:
+            add_front(iputil.ip_to_u32(ip), svc.protocol, svc.port, ext)
+        if svc.node_port > 0:
+            for nip in node_ips:
+                add_front(iputil.ip_to_u32(nip), svc.protocol, svc.node_port, ext)
+    return progs, fronts
 
 
 class PipelineOracle:
@@ -77,18 +133,25 @@ class PipelineOracle:
         flow_slots: int = 1 << 20,
         aff_slots: int = 1 << 18,
         ct_timeout_s: int = 3600,
+        node_ips: list[str] | None = None,
+        node_name: str = "",
     ):
         self.oracle = Oracle(ps)
-        self.services = services
         self.flow_slots = flow_slots
         self.aff_slots = aff_slots
         self.ct_timeout_s = ct_timeout_s
-        self.svc_by_key: dict[tuple[int, int, int], int] = {}
-        for i, s in enumerate(services):
-            self.svc_by_key[(iputil.ip_to_u32(s.cluster_ip), s.protocol, s.port)] = i
+        self.node_ips = list(node_ips or [])
+        self.node_name = node_name
+        self._set_services(services)
         # slot -> {key, code, svc, dnat_ip, dnat_port, ts, gen}; gen None = ALLOW/eternal
         self.flow: dict[int, dict] = {}
         self.aff: dict[int, dict] = {}
+
+    def _set_services(self, services):
+        self.services = services
+        self.programs, self.svc_by_key = _build_programs(
+            services, self.node_ips, self.node_name
+        )
 
     def update(self, ps: PolicySet = None, services: list[ServiceEntry] = None):
         """Control-plane bundle commit: swap rules/services.  The caller
@@ -97,11 +160,7 @@ class PipelineOracle:
         if ps is not None:
             self.oracle = Oracle(ps)
         if services is not None:
-            self.services = services
-            self.svc_by_key = {
-                (iputil.ip_to_u32(s.cluster_ip), s.protocol, s.port): i
-                for i, s in enumerate(services)
-            }
+            self._set_services(services)
 
     def _flow_hash(self, p: Packet) -> int:
         return int(
@@ -133,15 +192,16 @@ class PipelineOracle:
         EndpointDNAT-before-policy-tables order).
         """
         svc_idx = self.svc_by_key.get((p.dst_ip, p.proto, p.dst_port), -1)
-        svc = self.services[svc_idx] if svc_idx >= 0 else None
-        no_ep = svc is not None and not svc.endpoints
+        prog = self.programs[svc_idx] if svc_idx >= 0 else None
+        no_ep = prog is not None and not prog.endpoints
 
         dnat_ip, dnat_port = p.dst_ip, p.dst_port
+        snat = 0
         aff_learn: Optional[tuple[int, dict]] = None
-        if svc is not None and not no_ep:
-            n_ep = len(svc.endpoints)
+        if prog is not None and not no_ep:
+            n_ep = len(prog.endpoints)
             ep_col = (h & 0x7FFFFFFF) % max(1, n_ep)
-            if svc.affinity_timeout_s > 0:
+            if prog.affinity_timeout_s > 0:
                 ah = int(hashing.fnv_mix([np.uint32(p.src_ip), np.uint32(svc_idx)]))
                 aslot = ah & (self.aff_slots - 1)
                 ae = aff_view.get(aslot)
@@ -153,14 +213,15 @@ class PipelineOracle:
                     and ae["client"] == p.src_ip
                     and ae["svc"] == svc_idx
                     and ae["ep"] < n_ep
-                    and (now - ae["ts"]) <= svc.affinity_timeout_s
+                    and (now - ae["ts"]) <= prog.affinity_timeout_s
                 ):
                     ep_col = ae["ep"]
                 else:
                     aff_learn = (aslot, {"client": p.src_ip, "svc": svc_idx,
                                          "ep": ep_col, "ts": now})
-            ep = svc.endpoints[ep_col]
+            ep = prog.endpoints[ep_col]
             dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
+            snat = prog.snat
 
         v = self.oracle.classify(
             Packet(src_ip=p.src_ip, dst_ip=dnat_ip, proto=p.proto,
@@ -172,6 +233,7 @@ class PipelineOracle:
             "no_ep": no_ep,
             "dnat_ip": dnat_ip,
             "dnat_port": dnat_port,
+            "snat": snat,
             "aff_learn": aff_learn,
             "code": code,
             "ingress_code": int(v.ingress.code),
@@ -201,12 +263,25 @@ class PipelineOracle:
             slot, e = self.lookup(flow0, p, h, now, gen)
             if e is not None:
                 est = e["gen"] is None
+                rpl_hit = e.get("rpl", False)
+                # SNAT mark recomputed from the cached program index against
+                # the CURRENT program table (mirrors the device's clipped
+                # dsvc.snat gather; reply hits un-SNAT via the restored
+                # frontend tuple instead).
+                snat = 0
+                if e["svc"] >= 0 and not rpl_hit and self.programs:
+                    # Empty program table == the device's P=max(1,...) pad
+                    # row (snat 0); otherwise mirror the clipped gather.
+                    snat = self.programs[
+                        min(e["svc"], len(self.programs) - 1)
+                    ].snat
                 outs.append(
                     ScalarOutcome(
                         e["code"], est, e["svc"], e["dnat_ip"], e["dnat_port"],
                         e["rule_out"], e["rule_in"], False, hit=True,
-                        reply=e.get("rpl", False),
+                        reply=rpl_hit,
                         reject_kind=_reject_kind(e["code"], p.proto),
+                        snat=snat,
                     )
                 )
                 refreshes.append(slot)
@@ -253,7 +328,8 @@ class PipelineOracle:
             outs.append(
                 ScalarOutcome(code, False, w["svc_idx"], w["dnat_ip"],
                               w["dnat_port"], rule_out, rule_in, committed,
-                              reject_kind=_reject_kind(code, p.proto))
+                              reject_kind=_reject_kind(code, p.proto),
+                              snat=w["snat"])
             )
             key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
             inserts.append(

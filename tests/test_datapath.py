@@ -48,6 +48,7 @@ def _diff(tr, a, b, *, check_rules=True):
     assert a.est.tolist() == b.est.tolist()
     assert a.reply.tolist() == b.reply.tolist()
     assert a.reject_kind.tolist() == b.reject_kind.tolist()
+    assert a.snat.tolist() == b.snat.tolist()
     assert a.svc_idx.tolist() == b.svc_idx.tolist()
     assert a.dnat_ip.tolist() == b.dnat_ip.tolist()
     assert a.dnat_port.tolist() == b.dnat_port.tolist()

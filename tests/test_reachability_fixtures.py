@@ -302,3 +302,135 @@ def test_fixture_reject_kinds_both_datapaths():
         # SvcReject: VIP with no endpoints, TCP -> RST kind.
         r = _probe(dp, "10.10.0.33", VIP, 80, now=3)
         assert int(r.code[0]) == REJECT and int(r.reject_kind[0]) == 1, dp.datapath_type
+
+
+# ---------------------------------------------------------------------------
+# Service-mode fixtures: NodePort / LoadBalancer / externalTrafficPolicy /
+# unbounded endpoints, authored from proxier.go (installServices :690,
+# installServiceFlows :853, syncProxyRules :986, externalPolicyLocal) and
+# pipeline.go (NodePortMark / SNATMark / serviceEndpointGroup).  Run at the
+# Datapath boundary on BOTH implementations.
+# ---------------------------------------------------------------------------
+
+NODE_IP = "172.18.0.3"
+NODE2_IP = "172.18.0.4"
+LB_VIP = "203.0.113.80"
+
+
+def _mode_dps(ps, services):
+    from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8,
+              node_ips=[NODE_IP, NODE2_IP], node_name="n0")
+    return [
+        TpuflowDatapath(ps, services, miss_chunk=32, **kw),
+        OracleDatapath(ps, services, **kw),
+    ]
+
+
+def test_fixture_nodeport_cluster_policy_both_datapaths():
+    """proxier.go:690 + pipeline.go NodePortMark: traffic to ANY node IP on
+    the node port is load-balanced like ClusterIP traffic, and under
+    externalTrafficPolicy=Cluster it carries the SNAT mark (SNATMark)."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    svc = ServiceEntry(
+        cluster_ip=VIP, port=80, protocol=6, node_port=30080,
+        endpoints=[Endpoint(EP, 8080, node="n1")],
+    )
+    for dp in _mode_dps(_ps([]), [svc]):
+        for nip in (NODE_IP, NODE2_IP):
+            r = _probe(dp, CLIENT, nip, 30080, now=1)
+            assert int(r.code[0]) == ALLOW, dp.datapath_type
+            assert int(r.dnat_ip[0]) == iputil.ip_to_u32(EP), dp.datapath_type
+            assert int(r.dnat_port[0]) == 8080, dp.datapath_type
+            assert int(r.snat[0]) == 1, dp.datapath_type  # ETP=Cluster
+        # ClusterIP traffic to the same service never carries the mark.
+        r = _probe(dp, CLIENT, VIP, 80, now=2)
+        assert int(r.code[0]) == ALLOW and int(r.snat[0]) == 0, dp.datapath_type
+        # A non-NodePort port on the node IP is not service traffic.
+        r = _probe(dp, CLIENT, NODE_IP, 31000, now=3)
+        assert int(r.svc_idx[0]) == -1, dp.datapath_type
+
+
+def test_fixture_loadbalancer_vip_both_datapaths():
+    """proxier.go:853: LoadBalancer ingress IPs (and externalIPs) get the
+    same frontend treatment as the ClusterIP."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    svc = ServiceEntry(
+        cluster_ip=VIP, port=80, protocol=6, external_ips=[LB_VIP],
+        endpoints=[Endpoint(EP, 8080, node="n1")],
+    )
+    for dp in _mode_dps(_ps([]), [svc]):
+        r = _probe(dp, "10.0.99.7", LB_VIP, 80, now=1)
+        assert int(r.code[0]) == ALLOW, dp.datapath_type
+        assert int(r.dnat_ip[0]) == iputil.ip_to_u32(EP), dp.datapath_type
+        assert int(r.snat[0]) == 1, dp.datapath_type
+
+
+def test_fixture_external_traffic_policy_local_both_datapaths():
+    """third_party/proxy ExternalPolicyLocal: external-frontend traffic may
+    only use endpoints on THIS node; client IP is preserved (no SNAT); a
+    Local service with no local endpoints gets the no-endpoint reject.
+    ClusterIP traffic is unaffected by the policy."""
+    from antrea_tpu.apis.service import ETP_LOCAL, Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    local_ep = Endpoint("10.10.0.7", 8080, node="n0")
+    remote_ep = Endpoint("10.10.0.33", 8080, node="n1")
+    svc_mixed = ServiceEntry(
+        cluster_ip=VIP, port=80, protocol=6, node_port=30080,
+        endpoints=[local_ep, remote_ep],
+        external_traffic_policy=ETP_LOCAL,
+    )
+    svc_remote_only = ServiceEntry(
+        cluster_ip="10.96.0.11", port=80, protocol=6, node_port=30081,
+        endpoints=[remote_ep],
+        external_traffic_policy=ETP_LOCAL,
+    )
+    for dp in _mode_dps(_ps([]), [svc_mixed, svc_remote_only]):
+        # NodePort on the mixed service must pick the LOCAL endpoint only.
+        for sport in (40000, 40001, 40002, 40003):
+            r = _probe(dp, "10.0.99.7", NODE_IP, 30080, now=1, sport=sport)
+            assert int(r.code[0]) == ALLOW, dp.datapath_type
+            assert int(r.dnat_ip[0]) == iputil.ip_to_u32("10.10.0.7"), dp.datapath_type
+            assert int(r.snat[0]) == 0, dp.datapath_type  # client IP preserved
+        # ClusterIP traffic still balances over ALL endpoints.
+        seen = set()
+        for sport in range(41000, 41032):
+            r = _probe(dp, "10.0.99.7", VIP, 80, now=2, sport=sport)
+            assert int(r.code[0]) == ALLOW, dp.datapath_type
+            seen.add(int(r.dnat_ip[0]))
+        assert len(seen) == 2, (dp.datapath_type, seen)
+        # Local service with no local endpoints: reject on the node port...
+        r = _probe(dp, "10.0.99.7", NODE_IP, 30081, now=3)
+        assert int(r.code[0]) == REJECT, dp.datapath_type
+        # ...but fine via the ClusterIP (cluster view has the remote ep).
+        r = _probe(dp, "10.0.99.7", "10.96.0.11", 80, now=4)
+        assert int(r.code[0]) == ALLOW, dp.datapath_type
+
+
+def test_fixture_unbounded_endpoints_both_datapaths():
+    """serviceEndpointGroup buckets are unbounded in the reference; the
+    round-2 64-endpoint cap is gone — 200 endpoints compile and the hash
+    select spreads across them deterministically and identically on both
+    datapaths."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    eps = [Endpoint(f"10.20.{i // 256}.{i % 256}", 9000) for i in range(200)]
+    svc = ServiceEntry(cluster_ip=VIP, port=80, protocol=6, endpoints=eps)
+    dps = _mode_dps(_ps([]), [svc])
+    picks = []
+    for dp in dps:
+        seen = set()
+        for sport in range(42000, 42128):
+            r = _probe(dp, CLIENT, VIP, 80, now=1, sport=sport)
+            assert int(r.code[0]) == ALLOW, dp.datapath_type
+            seen.add((sport, int(r.dnat_ip[0])))
+        picks.append(seen)
+    assert picks[0] == picks[1]  # identical endpoint choice per flow
+    assert len({ip for _, ip in picks[0]}) > 32  # real spread over 200 eps
