@@ -15,12 +15,20 @@ frontends; ref proxier.go externalPolicyLocal handling — a Local service
 with no local endpoints gets the no-endpoint treatment).  Programs
 0..len(services)-1 are the cluster views in input order, so svc_idx stays
 the service index for ClusterIP traffic; local shadow views are appended.
+ETP=Cluster external frontends SHARE the cluster program (identical
+endpoint view) — only their per-frontend SNAT flag differs (slot_snat),
+which the datapath caches in the flow entry at commit time so established
+connections keep their mark even if later service updates renumber
+programs (the ct-mark persistence analog).
 
 Endpoints live in a FLAT indirect layout (ep_base[p] + hash % n_ep[p]) —
 no per-service endpoint cap (the reference's group buckets are unbounded;
 round-2 verdict weak #6 called out the 64-endpoint padded row).  Per-IP
 (proto,port) slot rows are padded to the MEASURED maximum for this service
-set, not a fixed cap, so node IPs carrying many NodePorts compile fine.
+set, not a fixed cap.  Known trade: the row width scales with the single
+widest frontend IP (a node IP exposing thousands of NodePorts inflates
+every row); if that shape matters, the frontend table should move to a
+compile-time hash table — endpoints already use the CSR-style layout.
 
 Lookup is two-stage exact match (no i64 keys on TPU):
   1. binary search the sorted unique frontend IPs;
@@ -50,10 +58,13 @@ class ServiceTables:
     ep_base: np.ndarray  # (P,) i32 offset into the flat endpoint arrays
     ep_ip_f: np.ndarray  # (E,) sign-flipped i32 flat endpoint IPs
     ep_port: np.ndarray  # (E,) i32 flat endpoint ports
-    # (P,) i32 0/1 — external frontend with externalTrafficPolicy=Cluster:
-    # traffic needs the SNAT mark so return traffic re-traverses this node
-    # (ref pipeline.go SNATMark / serviceSNATFlows, NodePortMark table).
-    snat: np.ndarray
+    # (NU, MAXP) i32 0/1 per FRONTEND — external frontend with
+    # externalTrafficPolicy=Cluster: traffic needs the SNAT mark so return
+    # traffic re-traverses this node (ref pipeline.go SNATMark /
+    # serviceSNATFlows, NodePortMark table).  Per-frontend, not
+    # per-program: a ClusterIP and a NodePort of the same service share a
+    # program but only the external entry is marked.
+    slot_snat: np.ndarray
     names: list[str]
 
     @property
@@ -79,44 +90,38 @@ def compile_services(
         progs.append({
             "eps": list(svc.endpoints),
             "aff": svc.affinity_timeout_s,
-            "snat": 0,
             "name": f"{svc.namespace}/{svc.name}" if svc.name else f"svc-{si}",
         })
-    frontends: list[tuple[int, int, int]] = []  # (ip_u, key, prog)
+    frontends: list[tuple[int, int, int, int]] = []  # (ip_u, key, prog, snat)
     for si, svc in enumerate(services):
         key = (svc.protocol << 16) + svc.port
-        frontends.append((iputil.ip_to_u32(svc.cluster_ip), key, si))
+        frontends.append((iputil.ip_to_u32(svc.cluster_ip), key, si, 0))
         has_external = bool(svc.external_ips) or (
             svc.node_port > 0 and node_ips
         )
         if not has_external:
             continue
-        local = svc.external_traffic_policy == ETP_LOCAL
-        if local:
-            ext_prog = len(progs)
+        if svc.external_traffic_policy == ETP_LOCAL:
+            # Local preserves client IP (no SNAT) and restricts the view to
+            # this node's endpoints: a real shadow program (proxier.go).
+            ext_prog, ext_snat = len(progs), 0
             progs.append({
                 "eps": [e for e in svc.endpoints if e.node == node_name],
                 "aff": svc.affinity_timeout_s,
-                "snat": 0,  # Local preserves client IP: no SNAT (proxier.go)
                 "name": progs[si]["name"],
             })
         else:
-            # Cluster policy shares the cluster endpoint view but marks the
-            # external program for SNAT — a separate program so the flag is
-            # per-frontend-kind, like the reference's NodePortMark+SNATMark.
-            ext_prog = len(progs)
-            progs.append({
-                "eps": list(svc.endpoints),
-                "aff": svc.affinity_timeout_s,
-                "snat": 1,
-                "name": progs[si]["name"],
-            })
+            # Cluster policy: identical endpoint view — share the cluster
+            # program; the SNAT mark lives on the frontend entry.
+            ext_prog, ext_snat = si, 1
         for ip in svc.external_ips:
-            frontends.append((iputil.ip_to_u32(ip), key, ext_prog))
+            frontends.append((iputil.ip_to_u32(ip), key, ext_prog, ext_snat))
         if svc.node_port > 0:
             np_key = (svc.protocol << 16) + svc.node_port
             for nip in node_ips:
-                frontends.append((iputil.ip_to_u32(nip), np_key, ext_prog))
+                frontends.append(
+                    (iputil.ip_to_u32(nip), np_key, ext_prog, ext_snat)
+                )
 
     P = max(1, len(progs))
     # The flow cache packs program index into 14 bits (_pack_meta1); silent
@@ -129,7 +134,6 @@ def compile_services(
     n_ep = np.ones(P, dtype=np.int32)
     has_ep = np.zeros(P, dtype=np.int32)
     aff = np.zeros(P, dtype=np.int32)
-    snat = np.zeros(P, dtype=np.int32)
     ep_base = np.zeros(P, dtype=np.int32)
     names: list[str] = [""] * P
     flat_ip: list[int] = []
@@ -140,7 +144,6 @@ def compile_services(
         n_ep[pi] = max(1, len(eps))
         has_ep[pi] = 1 if eps else 0
         aff[pi] = pr["aff"]
-        snat[pi] = pr["snat"]
         names[pi] = pr["name"]
         for ep in eps:
             flat_ip.append(iputil.ip_to_u32(ep.ip))
@@ -148,26 +151,30 @@ def compile_services(
     if not flat_ip:  # keep gathers in-bounds for endpoint-less sets
         flat_ip, flat_port = [0], [0]
 
-    by_ip: dict[int, list[tuple[int, int]]] = {}
-    for ip_u, key, prog in frontends:
-        row = by_ip.setdefault(ip_u, [])
-        if any(k == key for k, _ in row):
+    by_ip: dict[int, list[tuple[int, int, int]]] = {}
+    seen_keys: dict[int, set] = {}
+    for ip_u, key, prog, fsnat in frontends:
+        keys = seen_keys.setdefault(ip_u, set())
+        if key in keys:
             raise ValueError(
                 f"duplicate frontend {iputil.u32_to_ip(ip_u)} "
                 f"proto/port key {key:#x}"
             )
-        row.append((key, prog))
+        keys.add(key)
+        by_ip.setdefault(ip_u, []).append((key, prog, fsnat))
 
     NU = max(1, len(by_ip))
     maxp = max(1, max((len(v) for v in by_ip.values()), default=1))
     uips = np.zeros(NU, dtype=np.uint32)
     ppk = np.full((NU, maxp), -1, dtype=np.int32)
     slot_svc = np.full((NU, maxp), -1, dtype=np.int32)
+    slot_snat = np.zeros((NU, maxp), dtype=np.int32)
     for row, ip_u in enumerate(sorted(by_ip)):
         uips[row] = ip_u
-        for col, (key, prog) in enumerate(by_ip[ip_u]):
+        for col, (key, prog, fsnat) in enumerate(by_ip[ip_u]):
             ppk[row, col] = key
             slot_svc[row, col] = prog
+            slot_snat[row, col] = fsnat
 
     # Sort rows by flipped key so device-side searchsorted over i32 works.
     uip_f = _flip(uips)
@@ -182,6 +189,6 @@ def compile_services(
         ep_base=ep_base,
         ep_ip_f=_flip(np.asarray(flat_ip, dtype=np.uint32)),
         ep_port=np.asarray(flat_port, dtype=np.int32),
-        snat=snat,
+        slot_snat=slot_snat[order],
         names=names,
     )

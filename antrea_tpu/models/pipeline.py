@@ -109,10 +109,13 @@ class FlowCache(NamedTuple):
         key_pg packs proto (8 bits + valid bit 8) with the entry generation
         (GEN_BITS): zero rows (valid bit unset) can never match a packet.
         Bit 31 (REPLY_BIT) marks a reply-direction entry (below).
-      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules, pref]
+      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules, snat<<31 | pref]
         meta1 = code(2) | (svc_idx+1)(14) | dnat_port(16)
         rules = (rule_in+1)(16) | (rule_out+1)(16); 0 = default/none
-        pref = last partner-refresh attempt seconds (see below)
+        pref = last partner-refresh attempt seconds (31 bits, see below);
+        bit 31 caches the frontend SNAT mark at commit time, so an
+        established external connection keeps its mark even if later
+        service updates renumber programs (ct-mark persistence analog)
       ts   (N+1,)  i32: last-seen seconds (refreshed on every hit)
 
     dst in keys is the ORIGINAL (pre-DNAT) dst; dnat_ip_f the resolved one.
@@ -157,7 +160,7 @@ class DeviceServiceTables(NamedTuple):
     ep_base: jax.Array  # (P,) offsets into the flat endpoint arrays
     ep_ip_f: jax.Array  # (E,) flat — unbounded endpoints per program
     ep_port: jax.Array  # (E,) flat
-    snat: jax.Array  # (P,) 0/1 SNAT-mark flag (external frontend, ETP=Cluster)
+    slot_snat: jax.Array  # (NU, MAXP) 0/1 per-frontend SNAT-mark flag
 
 
 class PipelineMeta(NamedTuple):
@@ -180,7 +183,7 @@ def svc_to_host(st: ServiceTables) -> DeviceServiceTables:
         ep_base=np.asarray(st.ep_base),
         ep_ip_f=np.asarray(st.ep_ip_f),
         ep_port=np.asarray(st.ep_port),
-        snat=np.asarray(st.snat),
+        slot_snat=np.asarray(st.slot_snat),
     )
 
 
@@ -374,7 +377,9 @@ def _service_lb(
     use_ep = is_svc & ~no_ep
     dnat_ip = jnp.where(use_ep, dsvc.ep_ip_f[eidx], dst_f)
     dnat_port = jnp.where(use_ep, dsvc.ep_port[eidx], dport)
-    snat = jnp.where(use_ep, dsvc.snat[svc_safe], 0)
+    # SNAT is a property of the matched FRONTEND entry (NodePort/LB under
+    # ETP=Cluster), not of the endpoint program.
+    snat = jnp.where(use_ep, dsvc.slot_snat[row, slot_col], 0)
     learn = {
         "mask": aff_on & ~aff_hit & ~no_ep,
         "aslot": aslot,
@@ -470,7 +475,8 @@ def _pipeline_step(
     #   fwd est hit:  partner = reply entry (dnat_ip, src, dnat_port, sport)
     #   reply hit:    partner = fwd entry (dst=client, frontend ip/port)
     p_half = max(1, meta.ct_timeout_s // 2)
-    p_need = est & ((now - mr[:, 3]) >= p_half)
+    c_pref = mr[:, 3] & 0x7FFFFFFF  # strip the cached snat bit
+    p_need = est & ((now - c_pref) >= p_half)
 
     def partner_refresh(flow):
         p_src = jnp.where(rpl, dst_f, c_dnat_ip)
@@ -494,7 +500,10 @@ def _pipeline_step(
             ts=flow.ts.at[jnp.where(p_live, p_slot, dump)].set(now),
             # Attempt-time update even when the partner is gone, so an
             # evicted partner doesn't drag the walk into every batch.
-            meta=flow.meta.at[jnp.where(p_need, slot, dump), 3].set(now),
+            # Preserve the cached snat bit alongside the new pref stamp.
+            meta=flow.meta.at[jnp.where(p_need, slot, dump), 3].set(
+                now | (mr[:, 3] & REPLY_BIT)
+            ),
         )
 
     flow = jax.lax.cond(p_need.any(), partner_refresh, lambda f: f, flow)
@@ -513,13 +522,10 @@ def _pipeline_step(
     out_rule_in = outbuf(jnp.where(hit, c_rule_in, MISS))
     out_rule_out = outbuf(jnp.where(hit, c_rule_out, MISS))
     out_committed = outbuf(jnp.zeros(B, jnp.int32))
-    # SNAT mark is derivable from the cached program index (small (P,)
-    # gather), so it needs no flow-cache column; reply-direction hits carry
-    # the un-SNAT implicitly via the restored frontend tuple.
-    c_svc_safe = jnp.clip(c_svc, 0, dsvc.snat.shape[0] - 1)
-    out_snat = outbuf(
-        jnp.where(hit & ~rpl & (c_svc >= 0), dsvc.snat[c_svc_safe], 0)
-    )
+    # SNAT mark cached in meta3's sign bit at commit time; reply-direction
+    # hits carry the un-SNAT implicitly via the restored frontend tuple.
+    c_snat = (mr[:, 3] >> 31) & 1
+    out_snat = outbuf(jnp.where(hit & ~rpl, c_snat, 0))
 
     # ---- slow path: ServiceLB + classify + commit, misses only -------------
     def slow(args):
@@ -582,8 +588,11 @@ def _pipeline_step(
             pg_ins = p_m | 0x100 | (egen << 9)
             m1 = _pack_meta1(code, svc_idx, dnat_port)
             rules_p = _pack_rules(rule_in, rule_out)
-            # Column 3 = pref: the commit itself freshens both directions.
-            zcol = jnp.full((M,), now, jnp.int32)
+            # Column 3 = snat bit | pref (the commit freshens both
+            # directions; the frontend SNAT mark is pinned here for the
+            # connection's lifetime).
+            pref_col = jnp.full((M,), now, jnp.int32)
+            zcol = pref_col | jnp.where(snat_m > 0, REPLY_BIT, 0)
             ins = valid
             key_rows = jnp.stack([s_f, d_f, pp_m, pg_ins], axis=1)
             meta_rows = jnp.stack([dnat_ip, m1, rules_p, zcol], axis=1)
@@ -605,7 +614,8 @@ def _pipeline_step(
                 [dnat_ip, s_f, (dnat_port << 16) | sp_m, rev_pg], axis=1
             )
             rev_meta = jnp.stack(
-                [d_f, _pack_meta1(code, svc_idx, dp_m), rules_p, zcol], axis=1
+                [d_f, _pack_meta1(code, svc_idx, dp_m), rules_p, pref_col],
+                axis=1,
             )
 
             # Interleave per-packet [fwd_i, rev_i] so last-writer-wins slot

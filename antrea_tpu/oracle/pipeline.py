@@ -71,26 +71,26 @@ def _reject_kind(code: int, proto: int) -> int:
 
 @dataclass
 class _LBProgram:
-    """One LB program: an endpoint view + per-frontend-kind flags.  The
-    scalar twin of the compiler's program rows (compiler/services.py):
-    cluster views occupy indices 0..len(services)-1, external shadow views
-    (ETP=Local filtered, or ETP=Cluster SNAT-marked) follow."""
+    """One LB program: an endpoint view + affinity.  The scalar twin of the
+    compiler's program rows (compiler/services.py): cluster views occupy
+    indices 0..len(services)-1, ETP=Local shadow views follow; ETP=Cluster
+    external frontends share the cluster program, with SNAT flagged on the
+    FRONTEND entry."""
 
     endpoints: list
     affinity_timeout_s: int
-    snat: int
 
 
 def _build_programs(services, node_ips, node_name):
-    """-> (programs, frontends {(ip_u, proto, port) -> program idx})."""
+    """-> (programs, frontends {(ip_u, proto, port) -> (prog idx, snat)})."""
     from ..apis.service import ETP_LOCAL
 
     progs = [
-        _LBProgram(list(s.endpoints), s.affinity_timeout_s, 0) for s in services
+        _LBProgram(list(s.endpoints), s.affinity_timeout_s) for s in services
     ]
-    fronts: dict[tuple[int, int, int], int] = {}
+    fronts: dict[tuple[int, int, int], tuple[int, int]] = {}
 
-    def add_front(ip_u: int, proto: int, port: int, prog: int) -> None:
+    def add_front(ip_u: int, proto: int, port: int, prog: int, snat: int) -> None:
         key = (ip_u, proto, port)
         if key in fronts:
             # Same observable rule as compile_services: duplicate frontends
@@ -99,28 +99,29 @@ def _build_programs(services, node_ips, node_name):
                 f"duplicate frontend {iputil.u32_to_ip(ip_u)} "
                 f"proto {proto} port {port}"
             )
-        fronts[key] = prog
+        fronts[key] = (prog, snat)
 
     for si, svc in enumerate(services):
-        add_front(iputil.ip_to_u32(svc.cluster_ip), svc.protocol, svc.port, si)
+        add_front(iputil.ip_to_u32(svc.cluster_ip), svc.protocol, svc.port, si, 0)
         has_external = bool(svc.external_ips) or (svc.node_port > 0 and node_ips)
         if not has_external:
             continue
-        ext = len(progs)
         if svc.external_traffic_policy == ETP_LOCAL:
+            ext, ext_snat = len(progs), 0
             progs.append(_LBProgram(
                 [e for e in svc.endpoints if e.node == node_name],
-                svc.affinity_timeout_s, 0,
+                svc.affinity_timeout_s,
             ))
         else:
-            progs.append(_LBProgram(
-                list(svc.endpoints), svc.affinity_timeout_s, 1,
-            ))
+            ext, ext_snat = si, 1
         for ip in svc.external_ips:
-            add_front(iputil.ip_to_u32(ip), svc.protocol, svc.port, ext)
+            add_front(iputil.ip_to_u32(ip), svc.protocol, svc.port, ext, ext_snat)
         if svc.node_port > 0:
             for nip in node_ips:
-                add_front(iputil.ip_to_u32(nip), svc.protocol, svc.node_port, ext)
+                add_front(
+                    iputil.ip_to_u32(nip), svc.protocol, svc.node_port,
+                    ext, ext_snat,
+                )
     return progs, fronts
 
 
@@ -191,7 +192,9 @@ class PipelineOracle:
         probe reports; step() discards attribution for those, matching the
         EndpointDNAT-before-policy-tables order).
         """
-        svc_idx = self.svc_by_key.get((p.dst_ip, p.proto, p.dst_port), -1)
+        svc_idx, front_snat = self.svc_by_key.get(
+            (p.dst_ip, p.proto, p.dst_port), (-1, 0)
+        )
         prog = self.programs[svc_idx] if svc_idx >= 0 else None
         no_ep = prog is not None and not prog.endpoints
 
@@ -221,7 +224,7 @@ class PipelineOracle:
                                          "ep": ep_col, "ts": now})
             ep = prog.endpoints[ep_col]
             dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
-            snat = prog.snat
+            snat = front_snat
 
         v = self.oracle.classify(
             Packet(src_ip=p.src_ip, dst_ip=dnat_ip, proto=p.proto,
@@ -264,17 +267,11 @@ class PipelineOracle:
             if e is not None:
                 est = e["gen"] is None
                 rpl_hit = e.get("rpl", False)
-                # SNAT mark recomputed from the cached program index against
-                # the CURRENT program table (mirrors the device's clipped
-                # dsvc.snat gather; reply hits un-SNAT via the restored
-                # frontend tuple instead).
-                snat = 0
-                if e["svc"] >= 0 and not rpl_hit and self.programs:
-                    # Empty program table == the device's P=max(1,...) pad
-                    # row (snat 0); otherwise mirror the clipped gather.
-                    snat = self.programs[
-                        min(e["svc"], len(self.programs) - 1)
-                    ].snat
+                # SNAT mark was pinned into the entry at commit time
+                # (ct-mark persistence: later service updates renumbering
+                # programs cannot flip an established connection's mark);
+                # reply hits un-SNAT via the restored frontend tuple.
+                snat = 0 if rpl_hit else e.get("snat", 0)
                 outs.append(
                     ScalarOutcome(
                         e["code"], est, e["svc"], e["dnat_ip"], e["dnat_port"],
@@ -336,7 +333,7 @@ class PipelineOracle:
                 (slot, {
                     "key": key, "code": code, "svc": w["svc_idx"],
                     "dnat_ip": w["dnat_ip"], "dnat_port": w["dnat_port"],
-                    "ts": now, "pref": now,
+                    "ts": now, "pref": now, "snat": w["snat"],
                     "gen": None if committed else gen,
                     "rule_in": rule_in, "rule_out": rule_out,
                     "rpl": False,

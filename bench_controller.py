@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Control-plane scale benchmark: full NP compute at the reference's
+xLargeScale shape (networkpolicy_controller_perf_test.go:46-52 —
+25k namespaces / 100k pods / 75k NetworkPolicies; reference: 5.84-6.42 s,
+1522-1708 MB, Go).
+
+Prints ONE json line like bench.py.  vs_baseline is wall / 6.13s (the
+midpoint of the reference's recorded range) — LOWER is better here, so the
+ratio is reported as reference_time / our_time (>1 means faster than the
+reference).
+
+Run: python bench_controller.py [--small]
+"""
+
+import json
+import sys
+import time
+import tracemalloc
+
+from antrea_tpu.apis.crd import (
+    K8sNetworkPolicy,
+    K8sNPRule,
+    K8sPeer,
+    LabelSelector,
+    Namespace,
+    Pod,
+    PortSpec,
+)
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+
+REF_SECONDS = 6.13  # midpoint of 5.84-6.42 (networkpolicy_controller_perf_test.go)
+
+
+def populate(ctrl, n_ns: int, pods_per_ns: int, nps_per_ns: int) -> int:
+    n_events = 0
+
+    def count(_ev):
+        nonlocal n_events
+        n_events += 1
+
+    ctrl.subscribe(count)
+    for i in range(n_ns):
+        ns = f"ns-{i}"
+        ctrl.upsert_namespace(Namespace(name=ns, labels={"team": f"t{i % 50}"}))
+        for j in range(pods_per_ns):
+            ctrl.upsert_pod(Pod(
+                name=f"pod-{j}", namespace=ns,
+                labels={"app": f"app-{j % 2}"},
+                ip=f"10.{(i >> 8) & 255}.{i & 255}.{j + 1}",
+                node=f"node-{(i * pods_per_ns + j) % 64}",
+            ))
+        for k in range(nps_per_ns):
+            ctrl.upsert_k8s_policy(K8sNetworkPolicy(
+                uid=f"np-{i}-{k}", name=f"np-{k}", namespace=ns,
+                pod_selector=LabelSelector.make({"app": f"app-{k % 2}"}),
+                ingress=[K8sNPRule(
+                    peers=[K8sPeer(pod_selector=LabelSelector.make(
+                        {"app": f"app-{(k + 1) % 2}"}))],
+                    ports=[PortSpec(protocol=6, port=80)],
+                )],
+            ))
+    return n_events
+
+
+def main():
+    small = "--small" in sys.argv
+    n_ns = 2500 if small else 25000
+    ctrl = NetworkPolicyController()
+    # The controller's live state is acyclic (dataclasses + string-keyed
+    # dicts) so refcounting reclaims everything; the cyclic collector only
+    # re-scans the linearly-growing heap on every threshold crossing,
+    # turning the build quadratic (measured 1.7x at 12.5k namespaces,
+    # worse at 25k).  Go's benchmark runs with a concurrent GC that does
+    # not stop the build this way.
+    import gc
+
+    gc.disable()
+    # tracemalloc instruments every allocation (~5x slowdown measured);
+    # only pay for it when the memory number is requested.
+    track_mem = "--mem" in sys.argv
+    if track_mem:
+        tracemalloc.start()
+    t0 = time.perf_counter()
+    n_events = populate(ctrl, n_ns=n_ns, pods_per_ns=4, nps_per_ns=3)
+    wall = time.perf_counter() - t0
+    peak = 0
+    if track_mem:
+        _cur, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+    ps = ctrl.policy_set()
+    print(json.dumps({
+        "metric": "controller_full_np_compute_seconds",
+        "value": round(wall, 2),
+        "unit": "s",
+        "vs_baseline": round(REF_SECONDS / wall, 4),
+        "extra": {
+            "n_namespaces": n_ns,
+            "n_pods": n_ns * 4,
+            "n_policies": len(ps.policies),
+            "n_applied_to_groups": len(ps.applied_to_groups),
+            "n_address_groups": len(ps.address_groups),
+            "n_events": n_events,
+            "peak_mb": round(peak / 1e6, 1) if track_mem else None,
+            "reference_seconds": REF_SECONDS,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -320,7 +320,7 @@ LB_VIP = "203.0.113.80"
 def _mode_dps(ps, services):
     from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
 
-    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8,
+    kw = dict(flow_slots=1 << 12, aff_slots=1 << 8,
               node_ips=[NODE_IP, NODE2_IP], node_name="n0")
     return [
         TpuflowDatapath(ps, services, miss_chunk=32, **kw),
@@ -434,3 +434,33 @@ def test_fixture_unbounded_endpoints_both_datapaths():
         picks.append(seen)
     assert picks[0] == picks[1]  # identical endpoint choice per flow
     assert len({ip for _, ip in picks[0]}) > 32  # real spread over 200 eps
+
+
+def test_fixture_snat_mark_pinned_across_service_updates():
+    """ct-mark persistence: an established NodePort connection keeps its
+    SNAT mark even when a later service update renumbers LB programs
+    (the mark was committed into the conntrack entry, like the reference
+    stores it in ct_mark, not re-derived per packet)."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    svc_a = ServiceEntry(cluster_ip=VIP, port=80, protocol=6, node_port=30080,
+                         endpoints=[Endpoint(EP, 8080, node="n1")])
+    svc_b = ServiceEntry(cluster_ip="10.96.0.50", port=80, protocol=6,
+                         endpoints=[Endpoint("10.10.0.33", 8080)])
+    # sport 40001: the default 40000 happens to put this connection's fwd
+    # and reply tuples in the SAME direct-mapped slot (a genuine low-bit
+    # hash collision, identical on both datapaths) — the reply insert then
+    # legitimately evicts the fwd entry, which is cache behavior, not the
+    # property under test.
+    for dp in _mode_dps(_ps([]), [svc_a]):
+        r = _probe(dp, CLIENT, NODE_IP, 30080, now=1, sport=40001)
+        assert int(r.snat[0]) == 1 and int(r.committed[0]) == 1, dp.datapath_type
+        # Insert an unrelated service ahead of A — programs renumber.
+        dp.install_bundle(services=[svc_b, svc_a])
+        r = _probe(dp, CLIENT, NODE_IP, 30080, now=2, sport=40001)
+        assert int(r.est[0]) == 1, dp.datapath_type
+        assert int(r.snat[0]) == 1, dp.datapath_type  # mark survives
+        # A fresh ClusterIP flow to B carries no mark.
+        r = _probe(dp, CLIENT, "10.96.0.50", 80, now=3)
+        assert int(r.snat[0]) == 0, dp.datapath_type
