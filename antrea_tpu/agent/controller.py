@@ -24,6 +24,7 @@ tested boundary.
 from __future__ import annotations
 
 import copy
+import os
 from typing import Optional
 
 from ..apis import controlplane as cp
@@ -34,12 +35,31 @@ from ..dissemination.store import RamStore
 
 
 class AgentPolicyController:
-    def __init__(self, node: str, datapath: Datapath, store: Optional[RamStore] = None):
+    def __init__(
+        self,
+        node: str,
+        datapath: Datapath,
+        store: Optional[RamStore] = None,
+        *,
+        filestore_dir: Optional[str] = None,
+    ):
         self.node = node
         self.datapath = datapath
         self._ps = PolicySet()
         self._rules_dirty = False
         self._deltas: list[tuple[str, list, list]] = []
+        # Filestore fallback (ref pkg/agent/controller/networkpolicy/
+        # filestore.go + watcher.FallbackFunc, networkpolicy_controller.go:
+        # 923,948): the last-received computed policy state is persisted so
+        # a restarted agent can enforce policy while the controller is
+        # unreachable.  A live store (re)connect replays everything and
+        # overwrites the fallback state.
+        self._filestore_dir = filestore_dir
+        if filestore_dir is not None and store is None:
+            loaded = self._load_filestore()
+            if loaded is not None:
+                self._ps = loaded
+                self._rules_dirty = True
         if store is not None:
             store.watch(node, self.handle_event)
 
@@ -90,13 +110,19 @@ class AgentPolicyController:
 
     def sync(self) -> None:
         """Apply pending changes to the datapath: one bundle for structural
-        changes, or the queued incremental deltas otherwise."""
+        changes, or the queued incremental deltas otherwise.  The filestore
+        fallback is refreshed only after a SUCCESSFUL apply — it records the
+        last state actually pushed to the datapath; idle syncs touch no
+        disk."""
+        if not self._rules_dirty and not self._deltas:
+            return
         if self._rules_dirty:
             # A bundle folds any pending deltas too (membership is already
             # reflected in the local PolicySet).
             self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
             self._rules_dirty = False
             self._deltas.clear()
+            self._save_filestore()
             return
         for name, added, removed in self._deltas:
             try:
@@ -107,7 +133,35 @@ class AgentPolicyController:
                 self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
                 break
         self._deltas.clear()
+        self._save_filestore()
 
     @property
     def policy_set(self) -> PolicySet:
         return self._ps
+
+    # -- filestore fallback ----------------------------------------------------
+
+    def _filestore_path(self) -> str:
+        return os.path.join(self._filestore_dir, f"agent_policies_{self.node}.json")
+
+    def _save_filestore(self) -> None:
+        if self._filestore_dir is None:
+            return
+        from ..datapath.persist import atomic_write_json
+        from ..dissemination import serde
+
+        atomic_write_json(
+            self._filestore_path(), serde.encode_policy_set(self._ps)
+        )
+
+    def _load_filestore(self) -> Optional[PolicySet]:
+        from ..datapath.persist import read_json
+        from ..dissemination import serde
+
+        body = read_json(self._filestore_path())
+        if body is None:
+            return None
+        try:
+            return serde.decode_policy_set(body)
+        except (ValueError, KeyError):
+            return None

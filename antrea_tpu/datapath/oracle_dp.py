@@ -45,10 +45,19 @@ class OracleDatapath(Datapath):
         ct_timeout_s: int = 3600,
         node_ips: Optional[list] = None,
         node_name: str = "",
+        persist_dir: Optional[str] = None,
     ):
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
         self._gen = 0
+        self._persist_dir = persist_dir
+        self._persist_dirty = False
+        if persist_dir is not None and ps is None and services is None:
+            from . import persist
+
+            snap = persist.load_snapshot(persist_dir)
+            if snap is not None:
+                self._ps, self._services, self._gen = snap
         self._oracle = PipelineOracle(
             self._ps, self._services,
             flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
@@ -76,7 +85,17 @@ class OracleDatapath(Datapath):
             ps=ps, services=list(services) if services is not None else None
         )
         self._gen += 1
+        self._persist()
         return self._gen
+
+    def _persist(self) -> None:
+        if self._persist_dir is not None:
+            from . import persist
+
+            persist.save_snapshot(
+                self._persist_dir, self._ps, self._services, self._gen
+            )
+        self._persist_dirty = False
 
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
         touched = False
@@ -106,7 +125,14 @@ class OracleDatapath(Datapath):
             return self._gen
         self._oracle.update(ps=self._ps)
         self._gen += 1
+        # Delta path marks dirty instead of rewriting the whole snapshot —
+        # see TpuflowDatapath.apply_group_delta for the recovery contract.
+        self._persist_dirty = True
         return self._gen
+
+    def checkpoint(self) -> None:
+        if getattr(self, "_persist_dirty", False):
+            self._persist()
 
     def stats(self) -> DatapathStats:
         return DatapathStats(

@@ -1,0 +1,91 @@
+"""Datapath restart persistence: snapshot + reload of compiled inputs.
+
+The cookie-round recovery model of the reference
+(/root/reference/pkg/agent/openflow/cookie/allocator.go:76-135 — round
+number persisted in OVSDB external-IDs; pkg/agent/agent.go:486-512 — a
+restarted agent installs the new round's flows, then deletes stale-round
+flows, make-before-break): here the persisted unit is the datapath's INPUT
+state (PolicySet + services + generation), because the compiled tensors are
+a pure function of it and recompiling on boot is cheaper than managing
+binary tensor compatibility.  SURVEY §5 maps this to "rule tensors are the
+checkpoint — persist compiled tensors + round id; reload and
+recompile-and-swap"; persisting the pre-compile state realizes the same
+recovery with a stable schema (dissemination/serde.py wire format).
+
+Flow-cache (conntrack) state is deliberately dropped on restart: in the
+reference it lives in the kernel and survives the agent, but here it is
+device memory owned by the process; established connections re-classify on
+first packet (a fresh commit), which changes cold-start cost, never
+verdicts.  The generation stays monotonic across restarts so any cached
+state that DID survive (e.g. a future device-resident store) could never
+alias a pre-restart denial.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..apis.service import ServiceEntry
+from ..compiler.ir import PolicySet
+from ..dissemination import serde
+
+SNAPSHOT_VERSION = 1
+_FILE = "datapath_snapshot.json"
+
+
+def snapshot_path(persist_dir: str) -> str:
+    return os.path.join(persist_dir, _FILE)
+
+
+def atomic_write_json(path: str, body: object) -> None:
+    """Durable atomic JSON write (tmp + fsync + rename): a crash mid-save
+    leaves the previous file intact — the OVSDB-transaction analog.  Shared
+    by datapath snapshots and the agent filestore."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(body, f, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json(path: str):
+    """-> parsed JSON or None on any read/parse failure (treated as a
+    fresh-boot condition by all consumers)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def save_snapshot(
+    persist_dir: str, ps: PolicySet, services: list[ServiceEntry], gen: int
+) -> None:
+    atomic_write_json(snapshot_path(persist_dir), {
+        "v": SNAPSHOT_VERSION,
+        "generation": gen,
+        "policySet": serde.encode_policy_set(ps),
+        "services": [serde.encode_service_entry(s) for s in services],
+    })
+
+
+def load_snapshot(persist_dir: str):
+    """-> (PolicySet, services, generation) or None if absent/unreadable.
+
+    Unreadable snapshots are treated as absent (fresh boot) — the reference
+    behaves the same when OVSDB external-IDs are missing: new round, full
+    reinstall."""
+    body = read_json(snapshot_path(persist_dir))
+    if body is None or body.get("v") != SNAPSHOT_VERSION:
+        return None
+    try:
+        return (
+            serde.decode_policy_set(body["policySet"]),
+            [serde.decode_service_entry(s) for s in body.get("services", ())],
+            int(body["generation"]),
+        )
+    except (ValueError, KeyError):
+        return None

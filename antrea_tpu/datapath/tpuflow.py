@@ -42,6 +42,7 @@ from ..models import pipeline as pl
 from ..ops.match import DeltaTable, to_device
 from ..packet import PacketBatch
 from ..utils import ip as iputil
+from . import persist
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 
 
@@ -58,6 +59,7 @@ class TpuflowDatapath(Datapath):
         delta_slots: int = 128,
         node_ips: Optional[list[str]] = None,
         node_name: str = "",
+        persist_dir: Optional[str] = None,
     ):
         # Node identity: NodePort frontends bind to these addresses and
         # externalTrafficPolicy=Local filters endpoints to this node
@@ -72,6 +74,16 @@ class TpuflowDatapath(Datapath):
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
         self._gen = 0
+        # Restart recovery (cookie-round analog, datapath/persist.py): when
+        # constructed WITHOUT explicit state, reload the last committed
+        # snapshot and resume with a MONOTONIC generation; flow-cache state
+        # is dropped (re-classifies, never re-verdicts differently).
+        self._persist_dir = persist_dir
+        self._persist_dirty = False
+        if persist_dir is not None and ps is None and services is None:
+            snap = persist.load_snapshot(persist_dir)
+            if snap is not None:
+                self._ps, self._services, self._gen = snap
         self._state = pl.init_state(flow_slots, aff_slots)
         # Per-rule packet counters (IngressMetric/EgressMetric analog),
         # keyed by stable rule id so they survive bundle renumbering.
@@ -100,6 +112,7 @@ class TpuflowDatapath(Datapath):
             self._services = list(services)
             self._compile_services()
         self._gen += 1
+        self._persist()
         return self._gen
 
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
@@ -160,6 +173,12 @@ class TpuflowDatapath(Datapath):
         elif rows:
             self._append_deltas(rows)
         self._gen += 1
+        # Incremental deltas do NOT rewrite the snapshot (that would turn
+        # the O(delta) path into O(total-state) disk I/O per event): the
+        # authoritative crash-recovery source for membership churn is the
+        # AGENT's filestore replay (filestore.go model); the datapath
+        # snapshot catches up on the next bundle commit or checkpoint().
+        self._persist_dirty = True
         return self._gen
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
@@ -260,6 +279,18 @@ class TpuflowDatapath(Datapath):
         return out
 
     # -- internals -----------------------------------------------------------
+
+    def _persist(self) -> None:
+        if self._persist_dir is not None:
+            persist.save_snapshot(
+                self._persist_dir, self._ps, self._services, self._gen
+            )
+        self._persist_dirty = False
+
+    def checkpoint(self) -> None:
+        """Flush a pending (delta-dirtied) snapshot to disk."""
+        if getattr(self, "_persist_dirty", False):
+            self._persist()
 
     def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
         for key, ids, ctr in (

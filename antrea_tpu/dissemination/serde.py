@@ -1,0 +1,264 @@
+"""Wire serialization for the control-plane API types.
+
+The analog of the reference's protobuf codecs for the controlplane API
+group (/root/reference/pkg/apis/controlplane — versioned v1beta2 objects,
+serialized protobuf over the watch connection, architecture.md:63-64).
+JSON is the wire format here — the schema discipline is the same: explicit
+field maps per type, a version tag, and round-trip tests.  Everything that
+crosses a process boundary (dissemination transport) or survives a restart
+(datapath snapshots, agent filestore) goes through these functions.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..apis import controlplane as cp
+from ..apis.service import Endpoint, ServiceEntry
+from ..compiler.ir import PolicySet
+from ..controller.networkpolicy import WatchEvent
+
+WIRE_VERSION = 1
+
+
+# -- controlplane objects ----------------------------------------------------
+
+
+def _member(m: cp.GroupMember) -> dict:
+    return {"ip": m.ip, "node": m.node, "ns": m.pod_namespace, "pod": m.pod_name}
+
+
+def _member_from(d: dict) -> cp.GroupMember:
+    return cp.GroupMember(
+        ip=d["ip"], node=d.get("node", ""),
+        pod_namespace=d.get("ns", ""), pod_name=d.get("pod", ""),
+    )
+
+
+def _block(b: cp.IPBlock) -> dict:
+    return {"cidr": b.cidr, "except": list(b.excepts)}
+
+
+def _block_from(d: dict) -> cp.IPBlock:
+    return cp.IPBlock(cidr=d["cidr"], excepts=tuple(d.get("except", ())))
+
+
+def _peer(p: cp.NetworkPolicyPeer) -> dict:
+    return {
+        "addressGroups": list(p.address_groups),
+        "ipBlocks": [_block(b) for b in p.ip_blocks],
+    }
+
+
+def _peer_from(d: dict) -> cp.NetworkPolicyPeer:
+    return cp.NetworkPolicyPeer(
+        address_groups=list(d.get("addressGroups", ())),
+        ip_blocks=[_block_from(b) for b in d.get("ipBlocks", ())],
+    )
+
+
+def _service(s: cp.Service) -> dict:
+    return {"protocol": s.protocol, "port": s.port, "endPort": s.end_port}
+
+
+def _service_from(d: dict) -> cp.Service:
+    return cp.Service(
+        protocol=d.get("protocol"), port=d.get("port"), end_port=d.get("endPort")
+    )
+
+
+def _rule(r: cp.NetworkPolicyRule) -> dict:
+    return {
+        "direction": r.direction.value,
+        "from": _peer(r.from_peer),
+        "to": _peer(r.to_peer),
+        "services": [_service(s) for s in r.services],
+        "action": r.action.value,
+        "priority": r.priority,
+        "name": r.name,
+        "appliedToGroups": list(r.applied_to_groups),
+    }
+
+
+def _rule_from(d: dict) -> cp.NetworkPolicyRule:
+    return cp.NetworkPolicyRule(
+        direction=cp.Direction(d["direction"]),
+        from_peer=_peer_from(d.get("from", {})),
+        to_peer=_peer_from(d.get("to", {})),
+        services=[_service_from(s) for s in d.get("services", ())],
+        action=cp.RuleAction(d.get("action", "Allow")),
+        priority=d.get("priority", -1),
+        name=d.get("name", ""),
+        applied_to_groups=list(d.get("appliedToGroups", ())),
+    )
+
+
+def encode_policy(p: cp.NetworkPolicy) -> dict:
+    return {
+        "uid": p.uid,
+        "name": p.name,
+        "namespace": p.namespace,
+        "type": p.type.value,
+        "rules": [_rule(r) for r in p.rules],
+        "appliedToGroups": list(p.applied_to_groups),
+        "policyTypes": [d.value for d in p.policy_types],
+        "tierPriority": p.tier_priority,
+        "priority": p.priority,
+    }
+
+
+def decode_policy(d: dict) -> cp.NetworkPolicy:
+    return cp.NetworkPolicy(
+        uid=d["uid"],
+        name=d.get("name", ""),
+        namespace=d.get("namespace", ""),
+        type=cp.NetworkPolicyType(d.get("type", "K8sNetworkPolicy")),
+        rules=[_rule_from(r) for r in d.get("rules", ())],
+        applied_to_groups=list(d.get("appliedToGroups", ())),
+        policy_types=[cp.Direction(x) for x in d.get("policyTypes", ())],
+        tier_priority=d.get("tierPriority"),
+        priority=d.get("priority"),
+    )
+
+
+def encode_address_group(g: cp.AddressGroup) -> dict:
+    return {
+        "name": g.name,
+        "members": [_member(m) for m in g.members],
+        "ipBlocks": [_block(b) for b in g.ip_blocks],
+    }
+
+
+def decode_address_group(d: dict) -> cp.AddressGroup:
+    return cp.AddressGroup(
+        name=d["name"],
+        members=[_member_from(m) for m in d.get("members", ())],
+        ip_blocks=[_block_from(b) for b in d.get("ipBlocks", ())],
+    )
+
+
+def encode_applied_to_group(g: cp.AppliedToGroup) -> dict:
+    return {"name": g.name, "members": [_member(m) for m in g.members]}
+
+
+def decode_applied_to_group(d: dict) -> cp.AppliedToGroup:
+    return cp.AppliedToGroup(
+        name=d["name"], members=[_member_from(m) for m in d.get("members", ())]
+    )
+
+
+_OBJ_CODECS = {
+    "NetworkPolicy": (encode_policy, decode_policy),
+    "AddressGroup": (encode_address_group, decode_address_group),
+    "AppliedToGroup": (encode_applied_to_group, decode_applied_to_group),
+}
+
+
+# -- PolicySet + services (snapshot surface) ---------------------------------
+
+
+def encode_policy_set(ps: PolicySet) -> dict:
+    return {
+        "policies": [encode_policy(p) for p in ps.policies],
+        "addressGroups": {
+            k: encode_address_group(g) for k, g in ps.address_groups.items()
+        },
+        "appliedToGroups": {
+            k: encode_applied_to_group(g) for k, g in ps.applied_to_groups.items()
+        },
+    }
+
+
+def decode_policy_set(d: dict) -> PolicySet:
+    return PolicySet(
+        policies=[decode_policy(p) for p in d.get("policies", ())],
+        address_groups={
+            k: decode_address_group(g)
+            for k, g in d.get("addressGroups", {}).items()
+        },
+        applied_to_groups={
+            k: decode_applied_to_group(g)
+            for k, g in d.get("appliedToGroups", {}).items()
+        },
+    )
+
+
+def encode_service_entry(s: ServiceEntry) -> dict:
+    return {
+        "clusterIP": s.cluster_ip,
+        "port": s.port,
+        "protocol": s.protocol,
+        "endpoints": [
+            {"ip": e.ip, "port": e.port, "node": e.node} for e in s.endpoints
+        ],
+        "affinitySeconds": s.affinity_timeout_s,
+        "name": s.name,
+        "namespace": s.namespace,
+        "externalIPs": list(s.external_ips),
+        "nodePort": s.node_port,
+        "externalTrafficPolicy": s.external_traffic_policy,
+    }
+
+
+def decode_service_entry(d: dict) -> ServiceEntry:
+    return ServiceEntry(
+        cluster_ip=d["clusterIP"],
+        port=d["port"],
+        protocol=d["protocol"],
+        endpoints=[
+            Endpoint(ip=e["ip"], port=e["port"], node=e.get("node", ""))
+            for e in d.get("endpoints", ())
+        ],
+        affinity_timeout_s=d.get("affinitySeconds", 0),
+        name=d.get("name", ""),
+        namespace=d.get("namespace", ""),
+        external_ips=list(d.get("externalIPs", ())),
+        node_port=d.get("nodePort", 0),
+        external_traffic_policy=d.get("externalTrafficPolicy", "Cluster"),
+    )
+
+
+# -- WatchEvent (the dissemination wire unit) --------------------------------
+
+
+def encode_event(ev: WatchEvent) -> dict:
+    enc = _OBJ_CODECS[ev.obj_type][0] if ev.obj is not None else None
+    return {
+        "v": WIRE_VERSION,
+        "kind": ev.kind,
+        "objType": ev.obj_type,
+        "name": ev.name,
+        "obj": enc(ev.obj) if enc else None,
+        "span": sorted(ev.span),
+        "added": [_member(m) for m in ev.added],
+        "removed": [_member(m) for m in ev.removed],
+        "spanOnly": ev.span_only,
+    }
+
+
+def decode_event(d: dict) -> WatchEvent:
+    v = d.get("v", 0)
+    if v != WIRE_VERSION:
+        raise ValueError(f"unsupported wire version {v}")
+    obj = None
+    if d.get("obj") is not None:
+        obj = _OBJ_CODECS[d["objType"]][1](d["obj"])
+    return WatchEvent(
+        kind=d["kind"],
+        obj_type=d["objType"],
+        name=d["name"],
+        obj=obj,
+        span=set(d.get("span", ())),
+        added=[_member_from(m) for m in d.get("added", ())],
+        removed=[_member_from(m) for m in d.get("removed", ())],
+        span_only=d.get("spanOnly", False),
+    )
+
+
+def event_to_wire(ev: WatchEvent) -> bytes:
+    """One length-free JSON line (newline-delimited framing)."""
+    return (json.dumps(encode_event(ev), separators=(",", ":")) + "\n").encode()
+
+
+def event_from_wire(line: bytes) -> WatchEvent:
+    return decode_event(json.loads(line.decode()))

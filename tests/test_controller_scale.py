@@ -13,40 +13,12 @@ import time
 
 import pytest
 
-from antrea_tpu.apis.crd import (
-    K8sNetworkPolicy,
-    K8sNPRule,
-    K8sPeer,
-    LabelSelector,
-    Namespace,
-    Pod,
-    PortSpec,
-)
+from antrea_tpu.apis.crd import Pod
 from antrea_tpu.controller.networkpolicy import NetworkPolicyController
 
-
-def _populate(ctrl, n_ns: int, pods_per_ns: int, nps_per_ns: int):
-    """The reference's xLargeScale shape: many small namespaces, pods
-    bucketed by an app label, NPs selecting within their namespace."""
-    for i in range(n_ns):
-        ns = f"ns-{i}"
-        ctrl.upsert_namespace(Namespace(name=ns, labels={"team": f"t{i % 50}"}))
-        for j in range(pods_per_ns):
-            ctrl.upsert_pod(Pod(
-                name=f"pod-{j}", namespace=ns,
-                labels={"app": f"app-{j % 2}"},
-                ip=f"10.{(i >> 8) & 255}.{i & 255}.{j + 1}",
-                node=f"node-{(i * pods_per_ns + j) % 64}",
-            ))
-        for k in range(nps_per_ns):
-            ctrl.upsert_k8s_policy(K8sNetworkPolicy(
-                uid=f"np-{i}-{k}", name=f"np-{k}", namespace=ns,
-                pod_selector=LabelSelector.make({"app": f"app-{k % 2}"}),
-                ingress=[K8sNPRule(
-                    peers=[K8sPeer(pod_selector=LabelSelector.make({"app": f"app-{(k + 1) % 2}"}))],
-                    ports=[PortSpec(protocol=6, port=80)],
-                )],
-            ))
+# Single source of truth for the xLargeScale workload builder: the
+# full-scale benchmark script at the repo root.
+from bench_controller import populate as _populate
 
 
 def test_full_compute_10k_pods():

@@ -1,0 +1,152 @@
+"""Restart/recovery: datapath snapshot + agent filestore fallback.
+
+Models the reference's recovery design (SURVEY §5): cookie-round restart
+(pkg/agent/openflow/cookie/allocator.go:76-135, agent.go:486-512), agent
+filestore fallback (pkg/agent/controller/networkpolicy/filestore.go +
+watcher.FallbackFunc).  The test kills and reconstructs a datapath and an
+AgentPolicyController and demands identical verdicts post-restart.
+"""
+
+import numpy as np
+import pytest
+
+from antrea_tpu.agent.controller import AgentPolicyController
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.dissemination import serde
+from antrea_tpu.dissemination.store import RamStore
+from antrea_tpu.apis.crd import LabelSelector, Namespace, Pod, K8sNetworkPolicy, K8sNPRule, K8sPeer, PortSpec
+from antrea_tpu.simulator import gen_cluster, gen_services, gen_traffic
+
+
+def _fields(r):
+    return {
+        "code": r.code.tolist(), "svc": r.svc_idx.tolist(),
+        "dnat_ip": r.dnat_ip.tolist(), "dnat_port": r.dnat_port.tolist(),
+        "reject_kind": r.reject_kind.tolist(), "snat": r.snat.tolist(),
+        "rules_in": r.ingress_rule, "rules_out": r.egress_rule,
+    }
+
+
+def test_serde_roundtrip_policy_set_and_events():
+    cluster = gen_cluster(60, n_nodes=4, pods_per_node=8, seed=11)
+    ps = cluster.ps
+    ps2 = serde.decode_policy_set(serde.encode_policy_set(ps))
+    assert serde.encode_policy_set(ps2) == serde.encode_policy_set(ps)
+    assert len(ps2.policies) == len(ps.policies)
+    assert ps2.address_groups.keys() == ps.address_groups.keys()
+
+    services = gen_services(6, cluster.pod_ips, seed=12)
+    for s in services:
+        s2 = serde.decode_service_entry(serde.encode_service_entry(s))
+        assert serde.encode_service_entry(s2) == serde.encode_service_entry(s)
+
+    from antrea_tpu.controller.networkpolicy import WatchEvent
+
+    ev = WatchEvent(
+        kind="UPDATED", obj_type="AddressGroup", name="g1",
+        obj=list(ps.address_groups.values())[0],
+        span={"n0", "n1"},
+        added=list(list(ps.applied_to_groups.values())[0].members[:2]),
+        removed=[],
+        span_only=False,
+    )
+    ev2 = serde.event_from_wire(serde.event_to_wire(ev))
+    assert serde.event_to_wire(ev2) == serde.event_to_wire(ev)
+    assert ev2.span == ev.span and ev2.kind == ev.kind
+
+
+@pytest.mark.parametrize("dp_cls", [TpuflowDatapath, OracleDatapath])
+def test_datapath_restart_recovers_state(tmp_path, dp_cls):
+    """Kill + reconstruct a datapath from its persist dir: policy and
+    service state and the generation survive; verdicts match a twin that
+    never restarted (established flows re-classify, same verdicts)."""
+    cluster = gen_cluster(80, n_nodes=4, pods_per_node=8, seed=21)
+    services = gen_services(8, cluster.pod_ips, seed=22)
+    traffic = gen_traffic(cluster.pod_ips, batch=128, seed=23,
+                          services=services, svc_fraction=0.4)
+    kw = dict(flow_slots=1 << 12, aff_slots=1 << 8)
+    if dp_cls is TpuflowDatapath:
+        kw["miss_chunk"] = 32
+
+    dp = dp_cls(persist_dir=str(tmp_path), **kw)
+    g1 = dp.install_bundle(ps=cluster.ps, services=services)
+    r_before = dp.step(traffic, now=10)
+    twin = dp_cls(cluster.ps, services, **kw)
+    del dp  # "crash"
+
+    dp2 = dp_cls(persist_dir=str(tmp_path), **kw)
+    assert dp2.generation == g1  # monotonic across restart
+    r_after = dp2.step(traffic, now=20)
+    r_twin = twin.step(traffic, now=20)
+    assert _fields(r_after) == _fields(r_twin)
+    # Verdicts also match the pre-restart run (same inputs, same state).
+    assert r_after.code.tolist() == r_before.code.tolist()
+    # Conntrack state was dropped: the restarted datapath re-commits.
+    assert int(r_after.est.sum()) == 0 and int(r_after.committed.sum()) > 0
+
+    # A post-restart bundle keeps the generation monotonic and persists.
+    g2 = dp2.install_bundle(services=services)
+    assert g2 == g1 + 1
+    dp3 = dp_cls(persist_dir=str(tmp_path), **kw)
+    assert dp3.generation == g2
+
+
+def _mini_cluster_events(store):
+    ctrl = NetworkPolicyController()
+    ctrl.subscribe(store.apply)
+    ctrl.upsert_namespace(Namespace(name="default"))
+    for i, ip in enumerate(("10.0.0.5", "10.0.0.7")):
+        ctrl.upsert_pod(Pod(name=f"p{i}", namespace="default",
+                            labels={"app": f"a{i}"}, ip=ip, node="n0"))
+    ctrl.upsert_k8s_policy(K8sNetworkPolicy(
+        uid="np1", name="np1", namespace="default",
+        pod_selector=LabelSelector.make({"app": "a1"}),
+        ingress=[K8sNPRule(
+            peers=[K8sPeer(pod_selector=LabelSelector.make({"app": "a0"}))],
+            ports=[PortSpec(protocol=6, port=80)],
+        )],
+    ))
+    return ctrl
+
+
+def test_agent_restart_boots_from_filestore(tmp_path):
+    """An agent restarted while the controller is unreachable enforces the
+    last-received policy state from its filestore (FallbackFunc model)."""
+    from antrea_tpu.packet import PacketBatch
+    from antrea_tpu.utils import ip as iputil
+
+    def probe(dp, src, dst, now):
+        b = PacketBatch(
+            src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+            dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+            proto=np.array([6], np.int32),
+            src_port=np.array([41000], np.int32),
+            dst_port=np.array([80], np.int32),
+        )
+        return dp.step(b, now)
+
+    store = RamStore()
+    dp1 = OracleDatapath()
+    agent1 = AgentPolicyController(
+        "n0", dp1, store=None, filestore_dir=str(tmp_path)
+    )
+    store.watch("n0", agent1.handle_event)
+    _mini_cluster_events(store)
+    agent1.sync()
+    r = probe(dp1, "10.0.0.5", "10.0.0.7", 1)
+    assert int(r.code[0]) == 0  # allowed by np1
+    r = probe(dp1, "10.0.0.99", "10.0.0.7", 2)
+    assert int(r.code[0]) == 1  # default-deny on the isolated pod
+    del agent1, store  # agent crash + controller unreachable
+
+    dp2 = OracleDatapath()
+    agent2 = AgentPolicyController(
+        "n0", dp2, store=None, filestore_dir=str(tmp_path)
+    )
+    agent2.sync()  # boots from the filestore
+    r = probe(dp2, "10.0.0.5", "10.0.0.7", 3)
+    assert int(r.code[0]) == 0
+    r = probe(dp2, "10.0.0.99", "10.0.0.7", 4)
+    assert int(r.code[0]) == 1
+    assert len(agent2.policy_set.policies) == 1
