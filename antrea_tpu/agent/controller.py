@@ -163,5 +163,5 @@ class AgentPolicyController:
             return None
         try:
             return serde.decode_policy_set(body)
-        except (ValueError, KeyError):
+        except (ValueError, KeyError, TypeError, AttributeError):
             return None

@@ -20,6 +20,7 @@ from ..apis.controlplane import GroupMember
 from ..compiler.ir import PolicySet
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..packet import PacketBatch
+from . import persist
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 
 
@@ -34,7 +35,7 @@ def _group_ranges(g) -> set:
     return set(iputil.merge_ranges(rs))
 
 
-class OracleDatapath(Datapath):
+class OracleDatapath(persist.PersistableDatapath, Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -50,14 +51,7 @@ class OracleDatapath(Datapath):
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
         self._gen = 0
-        self._persist_dir = persist_dir
-        self._persist_dirty = False
-        if persist_dir is not None and ps is None and services is None:
-            from . import persist
-
-            snap = persist.load_snapshot(persist_dir)
-            if snap is not None:
-                self._ps, self._services, self._gen = snap
+        self._init_persist(persist_dir, ps, services)
         self._oracle = PipelineOracle(
             self._ps, self._services,
             flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
@@ -87,15 +81,6 @@ class OracleDatapath(Datapath):
         self._gen += 1
         self._persist()
         return self._gen
-
-    def _persist(self) -> None:
-        if self._persist_dir is not None:
-            from . import persist
-
-            persist.save_snapshot(
-                self._persist_dir, self._ps, self._services, self._gen
-            )
-        self._persist_dirty = False
 
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
         touched = False
@@ -130,10 +115,6 @@ class OracleDatapath(Datapath):
         self._persist_dirty = True
         return self._gen
 
-    def checkpoint(self) -> None:
-        if getattr(self, "_persist_dirty", False):
-            self._persist()
-
     def stats(self) -> DatapathStats:
         return DatapathStats(
             ingress=dict(self._stats_in),
@@ -141,6 +122,18 @@ class OracleDatapath(Datapath):
             default_allow=self._default_allow,
             default_deny=self._default_deny,
         )
+
+    def cache_stats(self) -> dict:
+        """Flow-cache census (same keys as TpuflowDatapath.cache_stats)."""
+        flow = self._oracle.flow
+        committed = sum(1 for e in flow.values() if e["gen"] is None)
+        return {
+            "occupied": len(flow),
+            "committed": committed,
+            "denials": len(flow) - committed,
+            "slots": self._oracle.flow_slots,
+            "evictions": self._oracle.evictions,
+        }
 
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         """Read-only per-packet trace, same semantics as TpuflowDatapath:

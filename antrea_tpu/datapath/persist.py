@@ -51,14 +51,16 @@ def atomic_write_json(path: str, body: object) -> None:
     os.replace(tmp, path)
 
 
-def read_json(path: str):
-    """-> parsed JSON or None on any read/parse failure (treated as a
-    fresh-boot condition by all consumers)."""
+def read_json(path: str, expect: type = dict):
+    """-> parsed JSON of the expected top-level type, else None (any
+    read/parse/shape failure is a fresh-boot condition for all consumers —
+    including valid-but-foreign JSON like a top-level list)."""
     try:
         with open(path) as f:
-            return json.load(f)
+            body = json.load(f)
     except (OSError, ValueError):
         return None
+    return body if isinstance(body, expect) else None
 
 
 def save_snapshot(
@@ -87,5 +89,31 @@ def load_snapshot(persist_dir: str):
             [serde.decode_service_entry(s) for s in body.get("services", ())],
             int(body["generation"]),
         )
-    except (ValueError, KeyError):
+    except (ValueError, KeyError, TypeError, AttributeError):
         return None
+
+
+class PersistableDatapath:
+    """Shared restart-persistence behavior for Datapath implementations
+    (single source of truth for the recovery contract; both datapaths mix
+    this in).  Expects subclasses to hold _ps, _services, _gen."""
+
+    def _init_persist(self, persist_dir, ps, services) -> None:
+        """Call from __init__ AFTER _ps/_services/_gen defaults are set:
+        loads the snapshot when constructed without explicit state."""
+        self._persist_dir = persist_dir
+        self._persist_dirty = False
+        if persist_dir is not None and ps is None and services is None:
+            snap = load_snapshot(persist_dir)
+            if snap is not None:
+                self._ps, self._services, self._gen = snap
+
+    def _persist(self) -> None:
+        if self._persist_dir is not None:
+            save_snapshot(self._persist_dir, self._ps, self._services, self._gen)
+        self._persist_dirty = False
+
+    def checkpoint(self) -> None:
+        """Flush a pending (delta-dirtied) snapshot to disk."""
+        if self._persist_dirty:
+            self._persist()

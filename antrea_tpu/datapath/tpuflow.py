@@ -46,7 +46,7 @@ from . import persist
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 
 
-class TpuflowDatapath(Datapath):
+class TpuflowDatapath(persist.PersistableDatapath, Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -78,12 +78,7 @@ class TpuflowDatapath(Datapath):
         # constructed WITHOUT explicit state, reload the last committed
         # snapshot and resume with a MONOTONIC generation; flow-cache state
         # is dropped (re-classifies, never re-verdicts differently).
-        self._persist_dir = persist_dir
-        self._persist_dirty = False
-        if persist_dir is not None and ps is None and services is None:
-            snap = persist.load_snapshot(persist_dir)
-            if snap is not None:
-                self._ps, self._services, self._gen = snap
+        self._init_persist(persist_dir, ps, services)
         self._state = pl.init_state(flow_slots, aff_slots)
         # Per-rule packet counters (IngressMetric/EgressMetric analog),
         # keyed by stable rule id so they survive bundle renumbering.
@@ -91,6 +86,7 @@ class TpuflowDatapath(Datapath):
         self._stats_out: Counter = Counter()
         self._default_allow = 0
         self._default_deny = 0
+        self._evictions = 0
         self._compile_rules()
         self._compile_services()
 
@@ -197,6 +193,7 @@ class TpuflowDatapath(Datapath):
         )
         self._state = state
         o = {k: np.asarray(v) for k, v in out.items()}
+        self._evictions += int(o["n_evict"])
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids)
@@ -228,6 +225,14 @@ class TpuflowDatapath(Datapath):
             default_allow=self._default_allow,
             default_deny=self._default_deny,
         )
+
+    def cache_stats(self) -> dict:
+        """Flow-cache census + cumulative evictions (weak-#5 surface):
+        occupied/committed/denial entry counts, slot count, and live
+        entries overwritten by a different tuple since construction."""
+        c = {k: int(v) for k, v in pl.cache_stats(self._state).items()}
+        c["evictions"] = self._evictions
+        return c
 
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         """Traceflow analog: per-packet stage observations, state untouched.
@@ -279,18 +284,6 @@ class TpuflowDatapath(Datapath):
         return out
 
     # -- internals -----------------------------------------------------------
-
-    def _persist(self) -> None:
-        if self._persist_dir is not None:
-            persist.save_snapshot(
-                self._persist_dir, self._ps, self._services, self._gen
-            )
-        self._persist_dirty = False
-
-    def checkpoint(self) -> None:
-        """Flush a pending (delta-dirtied) snapshot to disk."""
-        if getattr(self, "_persist_dirty", False):
-            self._persist()
 
     def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
         for key, ids, ctr in (

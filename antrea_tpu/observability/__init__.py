@@ -1,0 +1,6 @@
+"""Observability plane: NP audit logging + metrics surface (SURVEY §5)."""
+
+from .audit import AuditLogger
+from .metrics import render_metrics
+
+__all__ = ["AuditLogger", "render_metrics"]

@@ -147,6 +147,11 @@ class PipelineOracle:
         # slot -> {key, code, svc, dnat_ip, dnat_port, ts, gen}; gen None = ALLOW/eternal
         self.flow: dict[int, dict] = {}
         self.aff: dict[int, dict] = {}
+        # Live entries overwritten by a DIFFERENT tuple (direct-mapped
+        # collision metric; counted sequentially at insert-apply time —
+        # within-batch collision accounting is implementation-defined, so
+        # this is an operational metric, not a parity field).
+        self.evictions = 0
 
     def _set_services(self, services):
         self.services = services
@@ -374,6 +379,12 @@ class PipelineOracle:
             if slot in self.flow:
                 self.flow[slot]["pref"] = now
         for slot, entry in inserts:
+            old = self.flow.get(slot)
+            if old is not None and (
+                (old["key"], old.get("rpl", False))
+                != (entry["key"], entry.get("rpl", False))
+            ):
+                self.evictions += 1
             self.flow[slot] = entry
         for slot in refreshes:
             if slot in self.flow:

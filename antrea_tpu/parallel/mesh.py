@@ -220,8 +220,9 @@ def make_sharded_pipeline(
             meta=meta,
             hit_combine=_pmin_rule,
         )
-        # scalar per shard -> (D,) vector of per-data-shard miss counts
+        # scalar per shard -> (D,) vector of per-data-shard counts
         out["n_miss"] = out["n_miss"][None]
+        out["n_evict"] = out["n_evict"][None]
         return jax.tree.map(lambda x: x[None], local), out
 
     shmapped = jax.shard_map(

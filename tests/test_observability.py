@@ -1,0 +1,192 @@
+"""Audit-log stream + metrics surface + eviction measurement (SURVEY §5;
+ref audit_logging.go:48-171 dedup buffering, prometheus.go:33-188)."""
+
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.observability import AuditLogger, render_metrics
+from antrea_tpu.observability.audit import deny_rule_ids
+from antrea_tpu.ops import hashing
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+SLOTS = 1 << 10
+
+
+def _deny_ps(target_ip: str) -> PolicySet:
+    ps = PolicySet()
+    ps.applied_to_groups["atg"] = cp.AppliedToGroup(
+        "atg", [cp.GroupMember(ip=target_ip, node="n0")]
+    )
+    ps.policies.append(cp.NetworkPolicy(
+        uid="deny-in", name="deny-in", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["atg"], tier_priority=cp.TIER_APPLICATION,
+        priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN, action=cp.RuleAction.REJECT, priority=0,
+        )],
+    ))
+    return ps
+
+
+def _dps(ps):
+    return [
+        TpuflowDatapath(ps, [], flow_slots=SLOTS, aff_slots=1 << 8, miss_chunk=16),
+        OracleDatapath(ps, [], flow_slots=SLOTS, aff_slots=1 << 8),
+    ]
+
+
+def _batch(rows):
+    return PacketBatch(
+        src_ip=np.array([r[0] for r in rows], np.uint32),
+        dst_ip=np.array([r[1] for r in rows], np.uint32),
+        proto=np.array([r[2] for r in rows], np.int32),
+        src_port=np.array([r[3] for r in rows], np.int32),
+        dst_port=np.array([r[4] for r in rows], np.int32),
+    )
+
+
+def test_audit_dedup_and_parity(tmp_path):
+    """Denied/rejected packets produce dedup-buffered audit records with
+    rule attribution and reject kinds — identical from both datapaths."""
+    target = iputil.ip_to_u32("10.0.0.10")
+    src = iputil.ip_to_u32("10.0.0.5")
+    b = _batch([
+        (src, target, 6, 41000, 80),      # REJECT by rule -> tcp-rst
+        (src, target, 17, 41000, 53),     # REJECT by rule -> icmp-unreach
+        (src, iputil.ip_to_u32("10.0.0.99"), 6, 41000, 80),  # allowed
+    ])
+    lines = []
+    ps = _deny_ps("10.0.0.10")
+    for dp in _dps(ps):
+        log = AuditLogger(dedup_s=5, deny_rules=deny_rule_ids(ps),
+                          path=str(tmp_path / f"{dp.datapath_type.value}.log"))
+        # Same flow observed at t=1,2,3 (inside the window), then at t=20.
+        for now in (1, 2, 3):
+            log.observe(b, dp.step(b, now), now)
+        log.observe(b, dp.step(b, 20), 20)
+        log.flush(now=99, force=True)
+        got = sorted(r.line() for r in log.records)
+        lines.append(got)
+        # Two flows x two windows = 4 records; counts aggregate the window.
+        assert len(got) == 4, got
+        assert any("deny-in/In/0 Reject tcp-rst" in x and "x3" in x for x in got)
+        assert any("icmp-unreach" in x for x in got)
+        assert any("x1" in x for x in got)
+        assert not any("10.0.0.99" in x for x in got)  # allows are not audited
+    assert lines[0] == lines[1]  # audit parity across datapaths
+
+
+def test_default_deny_attribution_in_audit():
+    """K8s isolation drops (no explicit rule) audit as DefaultDeny."""
+    from antrea_tpu.apis.crd import LabelSelector
+    ps = PolicySet()
+    ps.applied_to_groups["atg"] = cp.AppliedToGroup(
+        "atg", [cp.GroupMember(ip="10.0.0.10", node="n0")]
+    )
+    ps.policies.append(cp.NetworkPolicy(
+        uid="np", name="np", namespace="default",
+        type=cp.NetworkPolicyType.K8S, rules=[],
+        applied_to_groups=["atg"], policy_types=[cp.Direction.IN],
+    ))
+    dp = OracleDatapath(ps, [], flow_slots=SLOTS, aff_slots=1 << 8)
+    b = _batch([(iputil.ip_to_u32("10.0.0.5"), iputil.ip_to_u32("10.0.0.10"),
+                 6, 42000, 80)])
+    log = AuditLogger()
+    log.observe(b, dp.step(b, 1), 1)
+    recs = log.flush(99, force=True)
+    assert len(recs) == 1 and recs[0].rule == "DefaultDeny"
+    assert recs[0].verdict == "Drop"
+
+
+def test_deny_attribution_prefers_denying_direction():
+    """An egress Drop + an opposite-direction ingress Allow both attribute
+    on the denied packet; the audit line must name the DENYING rule, not
+    the allow (review finding: `in or out` picked the allow)."""
+    ps = PolicySet()
+    ps.applied_to_groups["atg-src"] = cp.AppliedToGroup(
+        "atg-src", [cp.GroupMember(ip="10.0.0.5", node="n0")]
+    )
+    ps.applied_to_groups["atg-dst"] = cp.AppliedToGroup(
+        "atg-dst", [cp.GroupMember(ip="10.0.0.10", node="n0")]
+    )
+    ps.policies.append(cp.NetworkPolicy(
+        uid="allow-in", name="allow-in", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["atg-dst"], tier_priority=cp.TIER_APPLICATION,
+        priority=2.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN, action=cp.RuleAction.ALLOW, priority=0,
+        )],
+    ))
+    ps.policies.append(cp.NetworkPolicy(
+        uid="drop-out", name="drop-out", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["atg-src"], tier_priority=cp.TIER_APPLICATION,
+        priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.OUT, action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    b = _batch([(iputil.ip_to_u32("10.0.0.5"), iputil.ip_to_u32("10.0.0.10"),
+                 6, 43000, 80)])
+    for dp in _dps(ps):
+        log = AuditLogger(deny_rules=deny_rule_ids(ps))
+        r = dp.step(b, 1)
+        assert int(r.code[0]) == 1
+        log.observe(b, r, 1)
+        recs = log.flush(99, force=True)
+        assert len(recs) == 1, dp.datapath_type
+        assert recs[0].rule == "drop-out/Out/0", (dp.datapath_type, recs[0])
+
+
+def _colliding_flows(n_slots, count=4):
+    """Find distinct 5-tuples sharing one cache slot (forced evictions)."""
+    base = None
+    out = []
+    sport = 30000
+    while len(out) < count:
+        sport += 1
+        src = iputil.ip_to_u32("10.1.0.1")
+        dst = iputil.ip_to_u32("10.1.0.2")
+        h = int(hashing.flow_hash(np.uint32(src), np.uint32(dst), 6, sport, 80))
+        slot = h & (n_slots - 1)
+        if base is None:
+            base = slot
+        if slot == base:
+            # The reply tuple must not share the slot (keep the count exact).
+            rh = int(hashing.flow_hash(np.uint32(dst), np.uint32(src), 6, 80, sport))
+            if (rh & (n_slots - 1)) != base:
+                out.append((src, dst, 6, sport, 80))
+    return out
+
+
+def test_eviction_counting_and_cache_stats():
+    """Direct-mapped collisions are measured (round-2 verdict weak #5):
+    distinct tuples hashed to one slot evict each other, counted identically
+    by kernel and oracle; cache_stats reports the census."""
+    flows = _colliding_flows(SLOTS, count=3)
+    for dp in _dps(PolicySet()):
+        for i, f in enumerate(flows):
+            dp.step(_batch([f]), now=i + 1)  # sequential: each evicts prior
+        c = dp.cache_stats()
+        # flow 1 evicts flow 0's fwd entry, flow 2 evicts flow 1's: 2
+        # forward evictions (reply slots chosen collision-free).
+        assert c["evictions"] == 2, (dp.datapath_type, c)
+        assert c["slots"] == SLOTS
+        assert c["committed"] >= 4  # surviving fwd + all reply entries
+        assert c["occupied"] == c["committed"] + c["denials"]
+
+
+def test_metrics_rendering():
+    dp = OracleDatapath(_deny_ps("10.0.0.10"), [], flow_slots=SLOTS, aff_slots=1 << 8)
+    b = _batch([
+        (iputil.ip_to_u32("10.0.0.5"), iputil.ip_to_u32("10.0.0.10"), 6, 41000, 80),
+        (iputil.ip_to_u32("10.0.0.5"), iputil.ip_to_u32("10.0.0.77"), 6, 41000, 80),
+    ])
+    dp.step(b, 1)
+    text = render_metrics(dp, node="n0")
+    assert 'antrea_tpu_rule_packets_total{direction="ingress",rule="deny-in/In/0",node="n0"} 1' in text
+    assert 'antrea_tpu_default_verdict_packets_total{verdict="allow",node="n0"} 1' in text
+    assert 'antrea_tpu_flow_cache_entries{kind="occupied",node="n0"}' in text
+    assert "antrea_tpu_flow_cache_evictions_total" in text
