@@ -1,0 +1,167 @@
+"""antctl: the operator CLI.
+
+The analog of the reference's antctl command surface
+(/root/reference/pkg/antctl/antctl.go command table; raw commands under
+pkg/antctl/raw — traceflow, query, supportbundle): operates on the on-disk
+state this build persists (datapath snapshots from datapath/persist.py,
+agent filestores) — the way the reference's antctl reads controller/agent
+APIs backed by the same state.
+
+Usage (python -m antrea_tpu.antctl ...):
+  get networkpolicies  --state DIR        list policies in a snapshot
+  get addressgroups    --state DIR
+  get appliedtogroups  --state DIR
+  get services         --state DIR
+  traceflow --state DIR --src IP --dst IP [--proto N] [--sport N] [--dport N]
+        ofproto/trace analog: builds a datapath from the snapshot and
+        reports the per-stage observations for a crafted probe packet.
+  query endpoint --state DIR --namespace NS --pod NAME --ip IP
+        endpoint querier over snapshot policies (group membership by ip).
+  version
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+VERSION = "0.3.0-tpu"
+
+
+def _load(state_dir: str):
+    from .datapath import persist
+
+    snap = persist.load_snapshot(state_dir)
+    if snap is None:
+        raise SystemExit(f"antctl: no readable snapshot in {state_dir}")
+    return snap
+
+
+def _cmd_get(args) -> int:
+    ps, services, gen = _load(args.state)
+    if args.kind == "networkpolicies":
+        rows = [
+            {
+                "uid": p.uid, "name": p.name, "namespace": p.namespace,
+                "type": p.type.value, "tierPriority": p.tier_priority,
+                "priority": p.priority, "rules": len(p.rules),
+            }
+            for p in ps.policies
+        ]
+    elif args.kind == "addressgroups":
+        rows = [
+            {"name": k, "members": len(g.members), "ipBlocks": len(g.ip_blocks)}
+            for k, g in sorted(ps.address_groups.items())
+        ]
+    elif args.kind == "appliedtogroups":
+        rows = [
+            {"name": k, "members": len(g.members)}
+            for k, g in sorted(ps.applied_to_groups.items())
+        ]
+    elif args.kind == "services":
+        rows = [
+            {
+                "name": s.name or s.cluster_ip, "clusterIP": s.cluster_ip,
+                "port": s.port, "protocol": s.protocol,
+                "endpoints": len(s.endpoints), "nodePort": s.node_port,
+                "externalIPs": list(s.external_ips),
+            }
+            for s in services
+        ]
+    else:  # pragma: no cover - argparse restricts choices
+        raise SystemExit(f"unknown kind {args.kind}")
+    print(json.dumps({"generation": gen, "items": rows}, indent=2))
+    return 0
+
+
+def _cmd_traceflow(args) -> int:
+    from .datapath import OracleDatapath
+    from .packet import PacketBatch
+    from .utils import ip as iputil
+
+    ps, services, _gen = _load(args.state)
+    dp = OracleDatapath(ps, services, flow_slots=1 << 10, aff_slots=1 << 8)
+    batch = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(args.src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(args.dst)], np.uint32),
+        proto=np.array([args.proto], np.int32),
+        src_port=np.array([args.sport], np.int32),
+        dst_port=np.array([args.dport], np.int32),
+    )
+    obs = dp.trace(batch, now=0)[0]
+    obs["verdict"] = {0: "Allow", 1: "Drop", 2: "Reject"}[obs["code"]]
+    obs["dnat_ip"] = iputil.u32_to_ip(obs["dnat_ip"])
+    print(json.dumps(obs, indent=2, default=str))
+    return 0
+
+
+def _cmd_query_endpoint(args) -> int:
+    """Snapshot-based endpoint query: membership sets computed by pod IP,
+    then the shared policy scan (controller/endpoint_querier.scan_policies
+    — the live-index variant is query_endpoint there)."""
+    from .controller.endpoint_querier import scan_policies
+
+    ps, _services, _gen = _load(args.state)
+    applied_groups = {
+        k for k, g in ps.applied_to_groups.items()
+        if any(m.ip == args.ip for m in g.members)
+    }
+    peer_groups = {
+        k for k, g in ps.address_groups.items()
+        if any(m.ip == args.ip for m in g.members)
+    }
+    applied, ingress_from, egress_to = scan_policies(
+        ps.policies, applied_groups, peer_groups
+    )
+    print(json.dumps({
+        "endpoint": {"namespace": args.namespace, "pod": args.pod, "ip": args.ip},
+        "appliedPolicies": [
+            {"policy": uid, "rules": rules} for uid, rules in applied
+        ],
+        "ingressFrom": [{"policy": u, "rule": i} for u, i in ingress_from],
+        "egressTo": [{"policy": u, "rule": i} for u, i in egress_to],
+    }, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="antctl")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get", help="list objects from a state snapshot")
+    g.add_argument("kind", choices=[
+        "networkpolicies", "addressgroups", "appliedtogroups", "services",
+    ])
+    g.add_argument("--state", required=True, help="datapath persist dir")
+    g.set_defaults(fn=_cmd_get)
+
+    t = sub.add_parser("traceflow", help="trace a crafted probe packet")
+    t.add_argument("--state", required=True)
+    t.add_argument("--src", required=True)
+    t.add_argument("--dst", required=True)
+    t.add_argument("--proto", type=int, default=6)
+    t.add_argument("--sport", type=int, default=40000)
+    t.add_argument("--dport", type=int, default=80)
+    t.set_defaults(fn=_cmd_traceflow)
+
+    q = sub.add_parser("query", help="query subcommands")
+    qsub = q.add_subparsers(dest="what", required=True)
+    qe = qsub.add_parser("endpoint")
+    qe.add_argument("--state", required=True)
+    qe.add_argument("--namespace", default="default")
+    qe.add_argument("--pod", default="")
+    qe.add_argument("--ip", required=True)
+    qe.set_defaults(fn=_cmd_query_endpoint)
+
+    v = sub.add_parser("version")
+    v.set_defaults(fn=lambda a: (print(VERSION), 0)[1])
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

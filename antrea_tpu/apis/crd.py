@@ -170,11 +170,14 @@ class K8sNetworkPolicy:
 
 @dataclass(frozen=True)
 class AntreaPeer:
-    """ACNP/ANNP rule peer."""
+    """ACNP/ANNP rule peer.  `group` references a ClusterGroup by name
+    (crd NetworkPolicyPeer.group; mutually exclusive with selectors/ipBlock
+    per upstream validation)."""
 
     pod_selector: Optional[LabelSelector] = None
     ns_selector: Optional[LabelSelector] = None
     ip_block: Optional[IPBlock] = None
+    group: str = ""
 
 
 @dataclass(frozen=True)
@@ -201,6 +204,9 @@ class AntreaNetworkPolicy:
     name: str
     namespace: str = ""  # "" = cluster-scoped (ACNP)
     tier_priority: int = 250  # TIER_APPLICATION
+    # Named tier (crd spec.tier): when set, the controller resolves it
+    # against the Tier registry and OVERRIDES tier_priority.
+    tier: str = ""
     priority: float = 5.0
     applied_to: list[AntreaAppliedTo] = field(default_factory=list)
     rules: list[AntreaNPRule] = field(default_factory=list)
@@ -212,3 +218,55 @@ class AntreaNetworkPolicy:
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+# -- Tier CRD (crd/v1beta1 Tier) ---------------------------------------------
+
+
+@dataclass
+class Tier:
+    """Custom evaluation tier for Antrea-native policies.
+
+    Ref: crd/v1beta1.Tier + the controller's static default tiers
+    (/root/reference/pkg/controller/networkpolicy — Emergency(50),
+    SecurityOps(100), NetworkOps(150), Platform(200), Application(250),
+    Baseline(253)); lower priority evaluates earlier.
+    """
+
+    name: str
+    priority: int
+    description: str = ""
+
+
+# The default tiers the reference controller creates at startup.
+DEFAULT_TIERS = [
+    Tier("emergency", 50),
+    Tier("securityops", 100),
+    Tier("networkops", 150),
+    Tier("platform", 200),
+    Tier("application", 250),
+    Tier("baseline", 253),
+]
+
+
+# -- ClusterGroup CRD (crd/v1beta1 ClusterGroup) ------------------------------
+
+
+@dataclass
+class ClusterGroup:
+    """Named reusable group ACNP peers reference by name.
+
+    Ref: crd/v1beta1.ClusterGroup (pkg/controller/networkpolicy group
+    handling): exactly one of (selector form, ipBlocks, childGroups) per
+    upstream validation; childGroups union their members.
+    """
+
+    name: str
+    pod_selector: Optional[LabelSelector] = None
+    ns_selector: Optional[LabelSelector] = None
+    ip_blocks: list[IPBlock] = field(default_factory=list)
+    child_groups: list[str] = field(default_factory=list)
+
+    @property
+    def is_selector(self) -> bool:
+        return self.pod_selector is not None or self.ns_selector is not None

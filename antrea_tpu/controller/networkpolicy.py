@@ -26,15 +26,18 @@ from typing import Callable, Optional
 
 from ..apis import controlplane as cp
 from ..apis.crd import (
+    DEFAULT_TIERS,
     AntreaAppliedTo,
     AntreaNetworkPolicy,
     AntreaPeer,
+    ClusterGroup,
     K8sNetworkPolicy,
     K8sPeer,
     LabelSelector,
     Namespace,
     Pod,
     PortSpec,
+    Tier,
 )
 from ..compiler.ir import PolicySet
 from .grouping import GroupEntityIndex, GroupSelector
@@ -81,7 +84,11 @@ class _GroupState:
 
 
 class NetworkPolicyController:
-    def __init__(self, index: Optional[GroupEntityIndex] = None):
+    def __init__(self, index: Optional[GroupEntityIndex] = None,
+                 feature_gates=None):
+        from ..features import DEFAULT_GATES
+
+        self._gates = feature_gates or DEFAULT_GATES
         self.index = index or GroupEntityIndex()
         self.index.add_event_handler(self._on_groups_changed)
         self._nps: dict[str, cp.NetworkPolicy] = {}
@@ -91,6 +98,13 @@ class NetworkPolicyController:
         self._subs: list[Callable[[WatchEvent], None]] = []
         # Raw-policy bookkeeping so upserts can diff/cleanup.
         self._raw_uid_kind: dict[str, str] = {}
+        # Tier registry: the reference controller pre-creates the static
+        # default tiers at startup (pkg/controller/networkpolicy).
+        self._tiers: dict[str, Tier] = {t.name: t for t in DEFAULT_TIERS}
+        # ClusterGroups (crd group.go): name -> spec; raw ANP specs kept so
+        # a group change can re-convert its referencing policies.
+        self._cluster_groups: dict[str, ClusterGroup] = {}
+        self._raw_anps: dict[str, AntreaNetworkPolicy] = {}
 
     # -- subscriptions -------------------------------------------------------
 
@@ -335,10 +349,106 @@ class NetworkPolicyController:
             groups.append(self._ensure_group(self._ags, sel, np.uid, "AddressGroup"))
         return cp.NetworkPolicyPeer(address_groups=groups, ip_blocks=blocks)
 
+    # -- Tiers (ref: crd Tier + controller default tiers) --------------------
+
+    def upsert_tier(self, tier: Tier) -> None:
+        """Register/replace a custom tier.  Priority changes re-convert the
+        policies referencing it (the reference restricts this via webhook;
+        here it's an explicit re-sync)."""
+        old = self._tiers.get(tier.name)
+        self._tiers[tier.name] = tier
+        if old is not None and old.priority != tier.priority:
+            for anp in list(self._raw_anps.values()):
+                if anp.tier == tier.name:
+                    self.upsert_antrea_policy(anp)
+
+    def delete_tier(self, name: str) -> None:
+        """Refuses while policies reference the tier (the validation-webhook
+        behavior, ref networkpolicy_controller webhooks)."""
+        users = [u for u, a in self._raw_anps.items() if a.tier == name]
+        if users:
+            raise ValueError(f"tier {name!r} is referenced by policies {users}")
+        self._tiers.pop(name, None)
+
+    def _tier_priority(self, anp: AntreaNetworkPolicy) -> int:
+        if not anp.tier:
+            return anp.tier_priority
+        t = self._tiers.get(anp.tier)
+        if t is None:
+            raise ValueError(f"policy {anp.uid}: unknown tier {anp.tier!r}")
+        return t.priority
+
+    # -- ClusterGroups (ref: crd ClusterGroup, controller group.go) ----------
+
+    def upsert_cluster_group(self, cg: ClusterGroup) -> None:
+        self._cluster_groups[cg.name] = cg
+        # Re-convert referencing policies so their peers track the new spec.
+        for anp in list(self._raw_anps.values()):
+            if any(p.group and self._cg_refs(p.group, cg.name)
+                   for r in anp.rules for p in r.peers):
+                self.upsert_antrea_policy(anp)
+
+    def delete_cluster_group(self, name: str) -> None:
+        users = [
+            uid for uid, a in self._raw_anps.items()
+            if any(p.group and self._cg_refs(p.group, name)
+                   for r in a.rules for p in r.peers)
+        ]
+        if users:
+            raise ValueError(f"ClusterGroup {name!r} is referenced by {users}")
+        parents = [
+            g.name for g in self._cluster_groups.values()
+            if g.name != name and name in g.child_groups
+        ]
+        if parents:
+            raise ValueError(
+                f"ClusterGroup {name!r} is a child of {parents}"
+            )
+        self._cluster_groups.pop(name, None)
+
+    def _cg_refs(self, used: str, target: str, _seen=None) -> bool:
+        """Does group `used` (transitively, via childGroups) reference
+        `target`?"""
+        if used == target:
+            return True
+        seen = _seen or set()
+        if used in seen:
+            return False
+        seen.add(used)
+        cg = self._cluster_groups.get(used)
+        return cg is not None and any(
+            self._cg_refs(c, target, seen) for c in cg.child_groups
+        )
+
+    def _resolve_cluster_group(self, name: str, ref_uid: str, _seen=None):
+        """-> (group keys, ip block list) for one ClusterGroup reference,
+        flattening childGroups (union semantics)."""
+        seen = _seen if _seen is not None else set()
+        if name in seen:
+            return [], []  # cycle: upstream validation forbids; be safe
+        seen.add(name)
+        cg = self._cluster_groups.get(name)
+        if cg is None:
+            raise ValueError(f"unknown ClusterGroup {name!r}")
+        if cg.is_selector:
+            sel = GroupSelector(namespace="", pod_selector=cg.pod_selector,
+                                ns_selector=cg.ns_selector)
+            return [self._ensure_group(self._ags, sel, ref_uid, "AddressGroup")], []
+        groups: list[str] = []
+        blocks: list[cp.IPBlock] = list(cg.ip_blocks)
+        for child in cg.child_groups:
+            g, b = self._resolve_cluster_group(child, ref_uid, seen)
+            groups.extend(g)
+            blocks.extend(b)
+        return groups, blocks
+
     # -- Antrea-native policies ----------------------------------------------
 
     def upsert_antrea_policy(self, anp: AntreaNetworkPolicy) -> None:
+        if not self._gates.enabled("AntreaPolicy"):
+            raise RuntimeError("AntreaPolicy feature gate is disabled")
         internal = self._convert_antrea(anp)
+        self._raw_anps[anp.uid] = anp
         self._install(anp.uid, internal, kind="antrea")
 
     def _convert_antrea(self, anp: AntreaNetworkPolicy) -> cp.NetworkPolicy:
@@ -370,7 +480,7 @@ class NetworkPolicyController:
         return cp.NetworkPolicy(
             uid=anp.uid, name=anp.name, namespace=anp.namespace, type=ptype,
             rules=rules, applied_to_groups=policy_atgs,
-            tier_priority=anp.tier_priority, priority=anp.priority,
+            tier_priority=self._tier_priority(anp), priority=anp.priority,
         )
 
     def _convert_antrea_peers(
@@ -381,6 +491,11 @@ class NetworkPolicyController:
         groups: list[str] = []
         blocks: list[cp.IPBlock] = []
         for p in peers:
+            if p.group:
+                g, b = self._resolve_cluster_group(p.group, anp.uid)
+                groups.extend(g)
+                blocks.extend(b)
+                continue
             if p.ip_block is not None:
                 blocks.append(p.ip_block)
                 continue
@@ -426,6 +541,7 @@ class NetworkPolicyController:
             return
         self._np_span.pop(uid, None)
         self._raw_uid_kind.pop(uid, None)
+        self._raw_anps.pop(uid, None)
         for key in self._np_atg_keys(np):
             self._unref_group(self._atgs, key, uid, "AppliedToGroup")
         for key in self._np_ag_keys(np):

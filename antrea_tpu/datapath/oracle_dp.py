@@ -47,7 +47,11 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         node_ips: Optional[list] = None,
         node_name: str = "",
         persist_dir: Optional[str] = None,
+        feature_gates=None,
     ):
+        from ..features import DEFAULT_GATES
+
+        self._gates = feature_gates or DEFAULT_GATES
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
         self._gen = 0
@@ -139,6 +143,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         """Read-only per-packet trace, same semantics as TpuflowDatapath:
         the FRESH pipeline walk for every packet plus the cache overlay
         (effective `code` from the cache on hits)."""
+        if not self._gates.enabled("Traceflow"):
+            raise RuntimeError("Traceflow feature gate is disabled")
         from ..models.pipeline import GEN_ETERNAL
 
         o = self._oracle
@@ -171,6 +177,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
         outs = self._oracle.step(batch, now, gen=self._gen)
+        if not self._gates.enabled("NetworkPolicyStats"):
+            return self._to_result(outs)
         for o in outs:
             if o.ingress_rule is not None:
                 self._stats_in[o.ingress_rule] += 1
@@ -181,6 +189,9 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                     self._default_allow += 1
                 else:
                     self._default_deny += 1
+        return self._to_result(outs)
+
+    def _to_result(self, outs) -> StepResult:
         return StepResult(
             code=np.array([o.code for o in outs], np.int32),
             est=np.array([int(o.est) for o in outs], np.int32),

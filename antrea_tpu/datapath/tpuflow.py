@@ -60,7 +60,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         node_ips: Optional[list[str]] = None,
         node_name: str = "",
         persist_dir: Optional[str] = None,
+        feature_gates=None,
     ):
+        from ..features import DEFAULT_GATES
+
+        self._gates = feature_gates or DEFAULT_GATES
         # Node identity: NodePort frontends bind to these addresses and
         # externalTrafficPolicy=Local filters endpoints to this node
         # (ref proxier.go nodePortAddresses / externalPolicyLocal).
@@ -242,6 +246,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         effective `code` is the cached one while dnat/rule fields show what
         a fresh walk would decide (a probe, not a replay of commit state).
         """
+        if not self._gates.enabled("Traceflow"):
+            raise RuntimeError("Traceflow feature gate is disabled")
         o = pl.pipeline_trace(
             self._state,
             self._drs,
@@ -286,6 +292,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
     # -- internals -----------------------------------------------------------
 
     def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
+        if not self._gates.enabled("NetworkPolicyStats"):
+            return
         for key, ids, ctr in (
             ("ingress_rule", in_ids, self._stats_in),
             ("egress_rule", out_ids, self._stats_out),

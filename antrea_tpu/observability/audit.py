@@ -86,23 +86,29 @@ class AuditLogger:
         dedup_s: int = 5,
         path: Optional[str] = None,
         deny_rules: Optional[set] = None,
+        feature_gates=None,
     ):
+        if feature_gates is not None and not feature_gates.enabled("AuditLogging"):
+            raise RuntimeError("AuditLogging feature gate is disabled")
         self.dedup_s = dedup_s
         self.path = path
         # See deny_rule_ids(); update via set_deny_rules on bundle changes.
         self.deny_rules = deny_rules
         self._pending: dict[tuple, _Pending] = {}
         self.records: list[AuditRecord] = []
+        self._unwritten: list[AuditRecord] = []
 
     def set_deny_rules(self, deny_rules: set) -> None:
         self.deny_rules = deny_rules
 
     def _attribute(self, ingress_rule, egress_rule) -> str:
         if self.deny_rules is None:
-            # No action index available: only an unambiguous single
-            # attribution is trusted.
-            cands = [r for r in (ingress_rule, egress_rule) if r]
-            return cands[0] if len(cands) == 1 else "DefaultDeny"
+            # Without the deny-action index, named attribution is unsafe:
+            # the only populated attribution may be an ALLOW rule of the
+            # direction that did NOT deny (e.g. egress default-deny + an
+            # ingress allow).  Callers wanting rule names pass
+            # deny_rules=deny_rule_ids(ps).
+            return "DefaultDeny"
         for r in (ingress_rule, egress_rule):
             if r and r in self.deny_rules:
                 return r
@@ -129,6 +135,7 @@ class AuditLogger:
                 if p is not None:
                     self._emit(key, p)
                 self._pending[key] = _Pending(first_ts=now, last_ts=now, count=1)
+        self._write_out()
 
     def _emit(self, key: tuple, p: _Pending) -> None:
         rule, code, rk, sip, sp, dip, dp, proto = key
@@ -139,8 +146,16 @@ class AuditLogger:
         )
         self.records.append(rec)
         if self.path is not None:
-            with open(self.path, "a") as f:
+            self._unwritten.append(rec)
+
+    def _write_out(self) -> None:
+        """One open per batch of emissions, not per record."""
+        if self.path is None or not self._unwritten:
+            return
+        with open(self.path, "a") as f:
+            for rec in self._unwritten:
                 f.write(rec.line() + "\n")
+        self._unwritten.clear()
 
     def flush(self, now: int, force: bool = False) -> list[AuditRecord]:
         """Emit records whose dedup window has matured; returns them."""
@@ -150,4 +165,5 @@ class AuditLogger:
             if force or now - p.first_ts > self.dedup_s:
                 self._emit(key, p)
                 del self._pending[key]
+        self._write_out()
         return self.records[start:]

@@ -1,0 +1,234 @@
+"""Breadth components: Tier CRDs, ClusterGroups, endpoint querier, feature
+gates, typed config, antctl CLI."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from antrea_tpu.apis.controlplane import Direction, RuleAction
+from antrea_tpu.apis.crd import (
+    AntreaAppliedTo,
+    AntreaNetworkPolicy,
+    AntreaNPRule,
+    AntreaPeer,
+    ClusterGroup,
+    IPBlock,
+    LabelSelector,
+    Namespace,
+    Pod,
+    Tier,
+)
+from antrea_tpu.controller import NetworkPolicyController
+from antrea_tpu.controller.endpoint_querier import query_endpoint
+from antrea_tpu.features import FeatureGates
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet
+from antrea_tpu.utils import ip as iputil
+
+
+def mk_pod(name, ip, node="n0", ns="default", **labels):
+    return Pod(namespace=ns, name=name, ip=ip, node=node, labels=labels)
+
+
+def _base(ctl):
+    ctl.upsert_namespace(Namespace("default", {}))
+    ctl.upsert_pod(mk_pod("web", "10.0.0.10", app="web"))
+    ctl.upsert_pod(mk_pod("cli", "10.0.0.20", app="cli"))
+
+
+def _anp(uid, tier="", action=RuleAction.DROP, peer=None, prio=5.0):
+    return AntreaNetworkPolicy(
+        uid=uid, name=uid, tier=tier, priority=prio,
+        applied_to=[AntreaAppliedTo(
+            pod_selector=LabelSelector.make({"app": "web"}))],
+        rules=[AntreaNPRule(
+            direction=Direction.IN, action=action,
+            peers=[peer] if peer else [],
+        )],
+    )
+
+
+def _probe(ctl, src="10.0.0.20", dst="10.0.0.10"):
+    o = Oracle(ctl.policy_set())
+    return int(o.classify(Packet(
+        src_ip=iputil.ip_to_u32(src), dst_ip=iputil.ip_to_u32(dst),
+        proto=6, src_port=41000, dst_port=80,
+    )).code)
+
+
+def test_named_tiers_resolve_and_order():
+    """A custom tier with a lower priority than a default tier wins the
+    cross-tier evaluation order (ref default tiers + Tier CRD)."""
+    ctl = NetworkPolicyController()
+    _base(ctl)
+    ctl.upsert_tier(Tier("urgent", priority=10))
+    # application-tier ALLOW vs urgent-tier DROP: urgent evaluates first.
+    ctl.upsert_antrea_policy(_anp("allow-app", tier="application",
+                                  action=RuleAction.ALLOW))
+    ctl.upsert_antrea_policy(_anp("drop-urgent", tier="urgent"))
+    assert _probe(ctl) == 1
+    # Unknown tier is a config error.
+    with pytest.raises(ValueError, match="unknown tier"):
+        ctl.upsert_antrea_policy(_anp("x", tier="nope"))
+    # A referenced tier refuses deletion; a tier priority change re-sorts.
+    with pytest.raises(ValueError, match="referenced"):
+        ctl.delete_tier("urgent")
+    ctl.upsert_tier(Tier("urgent", priority=252))  # now AFTER application
+    assert _probe(ctl) == 0
+
+
+def test_cluster_groups_resolve_union_and_update():
+    """ClusterGroup peers: selector form, ipBlocks form, childGroups union;
+    spec updates re-resolve referencing policies (ref group.go)."""
+    ctl = NetworkPolicyController()
+    _base(ctl)
+    ctl.upsert_cluster_group(ClusterGroup(
+        "clients", pod_selector=LabelSelector.make({"app": "cli"})))
+    ctl.upsert_cluster_group(ClusterGroup(
+        "corp", ip_blocks=[IPBlock(cidr="192.168.0.0/16")]))
+    ctl.upsert_cluster_group(ClusterGroup(
+        "all-sources", child_groups=["clients", "corp"]))
+    ctl.upsert_antrea_policy(_anp(
+        "drop-sources", peer=AntreaPeer(group="all-sources")))
+    assert _probe(ctl, src="10.0.0.20") == 1  # via child selector group
+    assert _probe(ctl, src="192.168.3.4") == 1  # via child ipBlock
+    assert _probe(ctl, src="10.0.0.99") == 0  # not in the union
+
+    # Unknown group is an error; deletion of a referenced group refuses.
+    with pytest.raises(ValueError, match="unknown ClusterGroup"):
+        ctl.upsert_antrea_policy(_anp("y", peer=AntreaPeer(group="ghost")))
+    with pytest.raises(ValueError, match="referenced"):
+        ctl.delete_cluster_group("clients")
+
+    # Spec update re-resolves the referencing policy.
+    ctl.upsert_cluster_group(ClusterGroup(
+        "clients", pod_selector=LabelSelector.make({"app": "other"})))
+    assert _probe(ctl, src="10.0.0.20") == 0  # cli no longer matched
+    assert _probe(ctl, src="192.168.3.4") == 1  # corp block still does
+
+
+def test_endpoint_querier():
+    ctl = NetworkPolicyController()
+    _base(ctl)
+    ctl.upsert_cluster_group(ClusterGroup(
+        "clients", pod_selector=LabelSelector.make({"app": "cli"})))
+    ctl.upsert_antrea_policy(_anp("p1", peer=AntreaPeer(group="clients")))
+    r = query_endpoint(ctl, "default", "web")
+    assert [u for u, _ in r.applied] == ["p1"]
+    r2 = query_endpoint(ctl, "default", "cli")
+    assert r2.applied == [] and r2.ingress_from == [("p1", 0)]
+    assert query_endpoint(ctl, "default", "ghost").applied == []
+
+
+def test_feature_gates_registry_and_wiring(tmp_path):
+    import numpy as np
+
+    from antrea_tpu.datapath import OracleDatapath
+    from antrea_tpu.observability import AuditLogger
+    from antrea_tpu.packet import PacketBatch
+
+    with pytest.raises(ValueError, match="unknown feature gate"):
+        FeatureGates({"NotAGate": True})
+    gates = FeatureGates({"Traceflow": False, "NetworkPolicyStats": False,
+                          "AntreaPolicy": False, "AuditLogging": False})
+
+    ctl = NetworkPolicyController(feature_gates=gates)
+    _base(ctl)
+    with pytest.raises(RuntimeError, match="AntreaPolicy"):
+        ctl.upsert_antrea_policy(_anp("p"))
+
+    dp = OracleDatapath(feature_gates=gates)
+    b = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32("10.0.0.1")], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32("10.0.0.2")], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([1], np.int32), dst_port=np.array([2], np.int32),
+    )
+    dp.step(b, 1)
+    assert dp.stats().default_allow == 0  # stats gated off
+    with pytest.raises(RuntimeError, match="Traceflow"):
+        dp.trace(b, 1)
+    with pytest.raises(RuntimeError, match="AuditLogging"):
+        AuditLogger(feature_gates=gates)
+
+
+def test_agent_config_load_and_build(tmp_path):
+    from antrea_tpu.config import build_datapath, load_agent_config
+
+    cfg_path = tmp_path / "antrea-agent.conf"
+    cfg_path.write_text(
+        "nodeName: n7\n"
+        "nodeIPs: [172.18.0.9]\n"
+        "flowSlots: 4096\n"
+        "affinitySlots: 256\n"
+        "datapathType: oracle\n"
+        "featureGates:\n  Traceflow: false\n"
+    )
+    cfg = load_agent_config(str(cfg_path))
+    assert cfg.node_name == "n7" and cfg.flow_slots == 4096
+    assert not cfg.feature_gates.enabled("Traceflow")
+    dp = build_datapath(cfg)
+    assert dp.datapath_type.value == "oracle"
+
+    bad = tmp_path / "bad.conf"
+    bad.write_text("flowSlots: 1000\n")  # not a power of two
+    with pytest.raises(ValueError, match="power of two"):
+        load_agent_config(str(bad))
+    bad.write_text("noSuchKey: 1\n")
+    with pytest.raises(ValueError, match="unknown agent config key"):
+        load_agent_config(str(bad))
+
+
+def test_antctl_cli(tmp_path):
+    """The CLI surface end-to-end: snapshot a datapath, then get/traceflow/
+    query through the antctl subprocess."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from antrea_tpu.datapath import OracleDatapath
+    from antrea_tpu.compiler.ir import PolicySet
+    from antrea_tpu.apis import controlplane as cp
+
+    ps = PolicySet()
+    ps.applied_to_groups["atg"] = cp.AppliedToGroup(
+        "atg", [cp.GroupMember(ip="10.0.0.10", node="n0",
+                               pod_namespace="default", pod_name="web")]
+    )
+    ps.policies.append(cp.NetworkPolicy(
+        uid="deny-in", name="deny-in", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["atg"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN, action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    services = [ServiceEntry("10.96.0.1", 80, 6,
+                             [Endpoint("10.0.0.10", 8080)], name="svc")]
+    dp = OracleDatapath(persist_dir=str(tmp_path))
+    dp.install_bundle(ps=ps, services=services)
+
+    def antctl(*argv):
+        out = subprocess.run(
+            [sys.executable, "-m", "antrea_tpu.antctl", *argv],
+            capture_output=True, text=True, cwd="/root/repo",
+        )
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    got = json.loads(antctl("get", "networkpolicies", "--state", str(tmp_path)))
+    assert got["items"][0]["uid"] == "deny-in" and got["generation"] == 1
+    got = json.loads(antctl("get", "services", "--state", str(tmp_path)))
+    assert got["items"][0]["clusterIP"] == "10.96.0.1"
+
+    tf = json.loads(antctl(
+        "traceflow", "--state", str(tmp_path),
+        "--src", "10.0.0.5", "--dst", "10.96.0.1", "--dport", "80",
+    ))
+    assert tf["verdict"] == "Drop"  # DNAT to 10.0.0.10, denied there
+    assert tf["dnat_ip"] == "10.0.0.10"
+    assert tf["ingress_rule"] == "deny-in/In/0"
+
+    q = json.loads(antctl(
+        "query", "endpoint", "--state", str(tmp_path), "--ip", "10.0.0.10",
+    ))
+    assert q["appliedPolicies"][0]["policy"] == "deny-in"
+    assert antctl("version").strip()
