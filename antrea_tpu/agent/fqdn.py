@@ -1,0 +1,111 @@
+"""Agent-side FQDN policy controller: the DNS packet-in feedback loop.
+
+The analog of the reference's fqdnController
+(/root/reference/pkg/agent/controller/networkpolicy/fqdn.go:125 — DNS
+responses punted from the dataplane (PacketInCategoryDNS, packetin.go:44)
+are parsed and fed back into the policy state as address-group updates,
+with TTL-based expiry).  Here the feedback target is the datapath's
+incremental delta path: an FQDN rule compiles to an 'fqdn--<pattern>'
+AddressGroup (controller/networkpolicy._ensure_fqdn_group), and every DNS
+observation patches the LOCAL datapath's copy of that group — per-node
+learned state, exactly like the reference's per-agent fqdn cache.
+
+Matching (fqdn.go semantics): exact names case-insensitively; a leading
+'*.' wildcard matches one or more labels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..compiler.ir import PolicySet
+from ..datapath.interface import Datapath
+
+FQDN_PREFIX = "fqdn--"
+
+
+def fqdn_groups(ps: PolicySet) -> dict[str, str]:
+    """group key -> pattern for every FQDN-learned group in a PolicySet."""
+    return {
+        name: name[len(FQDN_PREFIX):]
+        for name in ps.address_groups
+        if name.startswith(FQDN_PREFIX)
+    }
+
+
+def fqdn_matches(pattern: str, name: str) -> bool:
+    pattern = pattern.lower().rstrip(".")
+    name = name.lower().rstrip(".")
+    if pattern.startswith("*."):
+        suffix = pattern[2:]
+        return name.endswith("." + suffix)
+    return name == pattern
+
+
+@dataclass
+class _Learned:
+    expires: int  # seconds
+
+
+class FqdnController:
+    """Per-node DNS-learned membership for fqdn-- groups."""
+
+    def __init__(self, datapath: Datapath):
+        self.datapath = datapath
+        self._patterns: dict[str, str] = {}  # group key -> pattern
+        # (group, ip) -> expiry bookkeeping for TTL-based removal.
+        self._learned: dict[tuple[str, str], _Learned] = {}
+
+    def configure(self, ps: PolicySet) -> None:
+        """(Re)derive the watched patterns AND restore learned membership.
+
+        Call after every structural datapath bundle (and only then): a
+        bundle recompiles groups from the central PolicySet, where fqdn--
+        groups are always empty — without re-applying the per-node learned
+        addresses here, FQDN deny rules would silently fail OPEN until the
+        next DNS response for each name.  This controller is the sole
+        writer of fqdn-- group membership on its datapath, so post-bundle
+        re-apply is exact (the bundle reset the refcounts to zero)."""
+        self._patterns = fqdn_groups(ps)
+        by_group: dict[str, list[str]] = {}
+        for key in list(self._learned):
+            group, ip = key
+            if group not in self._patterns:
+                del self._learned[key]  # rule gone; bundle removed the group
+            else:
+                by_group.setdefault(group, []).append(ip)
+        for group, ips in by_group.items():
+            self.datapath.apply_group_delta(group, ips, [])
+
+    def observe_dns(self, name: str, ips: list[str], ttl_s: int, now: int) -> int:
+        """One DNS response (the packet-in payload): add the resolved
+        addresses to every matching fqdn group; refresh TTLs.  Returns the
+        number of datapath group updates applied."""
+        updates = 0
+        for group, pattern in self._patterns.items():
+            if not fqdn_matches(pattern, name):
+                continue
+            added = []
+            for ip in ips:
+                k = (group, ip)
+                if k in self._learned:
+                    self._learned[k].expires = now + ttl_s
+                else:
+                    self._learned[k] = _Learned(expires=now + ttl_s)
+                    added.append(ip)
+            if added:
+                self.datapath.apply_group_delta(group, added, [])
+                updates += 1
+        return updates
+
+    def tick(self, now: int) -> int:
+        """Expire TTL-stale learned addresses (fqdn.go's TTL GC); returns
+        the number of datapath group updates applied."""
+        by_group: dict[str, list[str]] = {}
+        for (group, ip), st in list(self._learned.items()):
+            if st.expires <= now:
+                by_group.setdefault(group, []).append(ip)
+                del self._learned[(group, ip)]
+        for group, ips in by_group.items():
+            self.datapath.apply_group_delta(group, [], ips)
+        return len(by_group)

@@ -171,13 +171,16 @@ class K8sNetworkPolicy:
 @dataclass(frozen=True)
 class AntreaPeer:
     """ACNP/ANNP rule peer.  `group` references a ClusterGroup by name
-    (crd NetworkPolicyPeer.group; mutually exclusive with selectors/ipBlock
-    per upstream validation)."""
+    (crd NetworkPolicyPeer.group); `fqdn` is a domain-name peer whose
+    membership is learned from the dataplane's DNS responses (ref
+    pkg/agent/controller/networkpolicy/fqdn.go; egress rules only, per
+    upstream).  The forms are mutually exclusive per upstream validation."""
 
     pod_selector: Optional[LabelSelector] = None
     ns_selector: Optional[LabelSelector] = None
     ip_block: Optional[IPBlock] = None
     group: str = ""
+    fqdn: str = ""
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,127 @@
+"""Egress: pod-selected SNAT IP assignment with consistent-hash failover.
+
+The analog of the reference's Egress feature (crd Egress; central group
+computation in /root/reference/pkg/controller/egress; agent-side SNAT-mark
+flows + ownership election in pkg/agent/controller/egress/
+egress_controller.go:154,189): an Egress policy selects pods (via the
+shared grouping index) and names an egress IP; ALL egress-selected pods'
+outbound traffic is SNATted to that IP by whichever node currently OWNS it
+(consistent hash over alive agents, agent/memberlist.py) — ownership moves
+when membership changes, no coordination needed.
+
+Datapath surface: `build_egress_table` compiles the pod->egress mapping
+into sorted range tensors; `egress_ip_for` answers the EgressMark/SNAT
+classification (pipeline.go EgressMark table analog) for a source IP.
+This runs host-side at the gateway boundary, not in the per-packet kernel
+hot path — matching the reference, where SNAT happens at the node egress
+point, after policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..apis.crd import LabelSelector
+from ..utils import ip as iputil
+from .grouping import GroupEntityIndex, GroupSelector
+
+
+@dataclass
+class EgressPolicy:
+    """crd Egress subset: appliedTo selector + the SNAT (egress) IP."""
+
+    name: str
+    egress_ip: str
+    pod_selector: Optional[LabelSelector] = None
+    ns_selector: Optional[LabelSelector] = None
+
+
+class EgressController:
+    """Central computation: Egress CRDs x grouping index -> pod ip ->
+    egress ip; emits change notifications for agents to rebuild tables."""
+
+    def __init__(self, index: GroupEntityIndex):
+        self.index = index
+        self.index.add_event_handler(self._on_groups_changed)
+        self._policies: dict[str, EgressPolicy] = {}
+        self._groups: dict[str, str] = {}  # egress name -> group key
+        self._subs: list[Callable[[], None]] = []
+
+    def subscribe(self, fn: Callable[[], None]) -> None:
+        self._subs.append(fn)
+
+    def _notify(self) -> None:
+        for fn in self._subs:
+            fn()
+
+    def upsert(self, eg: EgressPolicy) -> None:
+        sel = GroupSelector(namespace="", pod_selector=eg.pod_selector,
+                            ns_selector=eg.ns_selector)
+        new_key = self.index.add_group(sel)
+        old_key = self._groups.get(eg.name)
+        self._policies[eg.name] = eg
+        self._groups[eg.name] = new_key
+        if old_key is not None and old_key != new_key:
+            self._gc_group(old_key)  # selector changed: drop the old group
+        self._notify()
+
+    def delete(self, name: str) -> None:
+        self._policies.pop(name, None)
+        key = self._groups.pop(name, None)
+        if key is not None:
+            self._gc_group(key)
+        self._notify()
+
+    def _gc_group(self, key: str) -> None:
+        if key not in self._groups.values():
+            self.index.delete_group(key)
+
+    def _on_groups_changed(self, changed: set) -> None:
+        if changed & set(self._groups.values()):
+            self._notify()
+
+    def assignments(self) -> list[tuple[str, str, str]]:
+        """-> sorted [(pod_ip, egress_ip, egress_name)]; first matching
+        Egress by name wins for multi-selected pods (deterministic —
+        upstream leaves this unspecified; the reference picks one)."""
+        out: dict[str, tuple[str, str]] = {}
+        for name in sorted(self._policies):
+            eg = self._policies[name]
+            for pod in self.index.get_members(self._groups[name]):
+                if pod.ip and pod.ip not in out:
+                    out[pod.ip] = (eg.egress_ip, name)
+        return sorted((ip, e, n) for ip, (e, n) in out.items())
+
+
+@dataclass
+class EgressTable:
+    """Compiled pod->egress mapping (sorted u32 pod IPs + egress ids)."""
+
+    pod_ips: np.ndarray  # (N,) sorted u32
+    egress_idx: np.ndarray  # (N,) i32 into egress_ips
+    egress_ips: list  # [str]
+    names: list = field(default_factory=list)
+
+    def egress_ip_for(self, src_ip_u32: int) -> Optional[str]:
+        """EgressMark classification: the SNAT IP for a source pod, or
+        None (not egress-selected -> node default SNAT / no SNAT)."""
+        i = int(np.searchsorted(self.pod_ips, np.uint32(src_ip_u32)))
+        if i < len(self.pod_ips) and int(self.pod_ips[i]) == src_ip_u32:
+            return self.egress_ips[int(self.egress_idx[i])]
+        return None
+
+
+def build_egress_table(assignments: list[tuple[str, str, str]]) -> EgressTable:
+    ips = sorted(set(e for _, e, _ in assignments))
+    eidx = {e: i for i, e in enumerate(ips)}
+    pods = np.array([iputil.ip_to_u32(p) for p, _, _ in assignments], np.uint32)
+    idx = np.array([eidx[e] for _, e, _ in assignments], np.int32)
+    all_names = [n for _, _, n in assignments]
+    order = np.argsort(pods)
+    return EgressTable(
+        pod_ips=pods[order], egress_idx=idx[order], egress_ips=ips,
+        names=[all_names[int(i)] for i in order],  # parallel to pod_ips
+    )

@@ -442,6 +442,29 @@ class NetworkPolicyController:
             blocks.extend(b)
         return groups, blocks
 
+    # -- FQDN peers (ref fqdn.go) --------------------------------------------
+
+    def _ensure_fqdn_group(self, pattern: str, ref_uid: str) -> str:
+        """An FQDN peer compiles to an AddressGroup whose membership is
+        learned PER NODE from the dataplane's DNS responses (the packet-in
+        feedback loop, fqdn.go:125,:528) — centrally it is empty; the
+        group's name carries the pattern so agents know what to watch:
+        'fqdn--<pattern>'.  Not in the selector index (no pod membership)."""
+        key = f"fqdn--{pattern.lower()}"
+        st = self._ags.get(key)
+        if st is None:
+            st = _GroupState(selector=None)
+            self._ags[key] = st
+            st.refs.add(ref_uid)
+            self._emit(WatchEvent(
+                kind="ADDED", obj_type="AddressGroup", name=key,
+                obj=cp.AddressGroup(name=key),
+                span=self._group_span(st),
+            ))
+        else:
+            st.refs.add(ref_uid)
+        return key
+
     # -- Antrea-native policies ----------------------------------------------
 
     def upsert_antrea_policy(self, anp: AntreaNetworkPolicy) -> None:
@@ -491,6 +514,9 @@ class NetworkPolicyController:
         groups: list[str] = []
         blocks: list[cp.IPBlock] = []
         for p in peers:
+            if p.fqdn:
+                groups.append(self._ensure_fqdn_group(p.fqdn, anp.uid))
+                continue
             if p.group:
                 g, b = self._resolve_cluster_group(p.group, anp.uid)
                 groups.extend(g)

@@ -127,6 +127,34 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             default_deny=self._default_deny,
         )
 
+    def dump_flows(self, now: int) -> list[dict]:
+        """Conntrack-dump analog (same record shape as TpuflowDatapath)."""
+        from ..utils import ip as iputil
+
+        out = []
+        o = self._oracle
+        for e in o.flow.values():
+            if (now - e["ts"]) > o.ct_timeout_s:
+                continue
+            src, dst, pp, proto = e["key"]
+            out.append({
+                "src": iputil.u32_to_ip(src),
+                "dst": iputil.u32_to_ip(dst),
+                "sport": (pp >> 16) & 0xFFFF,
+                "dport": pp & 0xFFFF,
+                "proto": proto,
+                "reply": e.get("rpl", False),
+                "committed": e["gen"] is None,
+                "code": e["code"],
+                "svc_idx": e["svc"],
+                "dnat_ip": iputil.u32_to_ip(e["dnat_ip"]),
+                "dnat_port": e["dnat_port"],
+                "ingress_rule": e["rule_in"],
+                "egress_rule": e["rule_out"],
+                "last_seen": e["ts"],
+            })
+        return out
+
     def cache_stats(self) -> dict:
         """Flow-cache census (same keys as TpuflowDatapath.cache_stats)."""
         flow = self._oracle.flow

@@ -1,0 +1,147 @@
+"""Flow export + aggregation: conntrack-poll -> flow records -> biflows.
+
+The analog of the reference's flow-visibility pipeline
+(/root/reference/pkg/agent/flowexporter — conntrack polling into a
+connection store, exported as IPFIX records — feeding
+pkg/flowaggregator/flowaggregator.go:90-104, which correlates the two
+directions and fans out to sinks).  The wire format here is JSON lines
+(one record per flow event), the correlation semantics are the same:
+
+  FlowExporter.poll(now)  diffs the datapath's dump_flows() against the
+      connection store; NEW connections and active refreshes export
+      records; connections gone past the idle timeout export a final
+      record with reason=idle-end.
+  FlowAggregator.ingest() merges forward and reply records of one
+      connection into a single biflow keyed on the forward tuple.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+def _key(rec: dict) -> tuple:
+    return (rec["src"], rec["dst"], rec["sport"], rec["dport"], rec["proto"],
+            rec["reply"])
+
+
+@dataclass
+class _Conn:
+    first_seen: int
+    last_seen: int
+    last_export: int
+
+
+class FlowExporter:
+    """Per-node exporter: one instance polls one datapath."""
+
+    def __init__(
+        self,
+        datapath,
+        node: str = "",
+        active_timeout_s: int = 60,
+        sink: Optional[Callable[[dict], None]] = None,
+        path: Optional[str] = None,
+    ):
+        self.datapath = datapath
+        self.node = node
+        self.active_timeout_s = active_timeout_s
+        self._conns: dict[tuple, _Conn] = {}
+        self.records: list[dict] = []
+        self._sink = sink
+        self.path = path
+
+    def _emit(self, rec: dict) -> None:
+        self.records.append(rec)
+        if self._sink is not None:
+            self._sink(rec)
+        if self.path is not None:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def poll(self, now: int) -> int:
+        """One conntrack-poll cycle; returns records emitted."""
+        emitted = 0
+        seen: set = set()
+        for rec in self.datapath.dump_flows(now):
+            k = _key(rec)
+            seen.add(k)
+            st = self._conns.get(k)
+            if st is None:
+                self._conns[k] = _Conn(rec["last_seen"], rec["last_seen"], now)
+                self._emit({**rec, "node": self.node, "event": "new",
+                            "export_ts": now})
+                emitted += 1
+            else:
+                st.last_seen = rec["last_seen"]
+                if now - st.last_export >= self.active_timeout_s:
+                    st.last_export = now
+                    self._emit({**rec, "node": self.node, "event": "active",
+                                "export_ts": now})
+                    emitted += 1
+        # Connections that left the live dump ended (idle timeout/evicted).
+        for k in [k for k in self._conns if k not in seen]:
+            st = self._conns.pop(k)
+            src, dst, sport, dport, proto, reply = k
+            self._emit({
+                "src": src, "dst": dst, "sport": sport, "dport": dport,
+                "proto": proto, "reply": reply, "node": self.node,
+                "event": "end", "reason": "idle-end",
+                "last_seen": st.last_seen, "export_ts": now,
+            })
+            emitted += 1
+        return emitted
+
+
+class FlowAggregator:
+    """Correlates the two directions of a connection into one biflow (the
+    flowaggregator correlation step): reply records fold into the forward
+    record keyed on the forward tuple."""
+
+    def __init__(self):
+        self.biflows: dict[tuple, dict] = {}
+
+    def ingest(self, rec: dict) -> None:
+        if rec.get("event") == "end":
+            return
+        if rec["reply"]:
+            # Reply tuple (ep -> client, ports swapped); its forward tuple
+            # is (client=dst, frontend=dnat_ip, sport=dport, dport=
+            # dnat_port) — the un-DNAT info the record carries.  A
+            # reply-first arrival creates a PLACEHOLDER with forward-
+            # oriented fields (dump order is hash-slot order, so either
+            # direction can be seen first); the forward record later
+            # fills in its richer fields.
+            fkey = (rec["dst"], rec["dnat_ip"], rec["dport"],
+                    rec["dnat_port"], rec["proto"])
+            bf = self.biflows.get(fkey)
+            if bf is None:
+                bf = self.biflows[fkey] = {
+                    "src": rec["dst"], "dst": rec["dnat_ip"],
+                    "sport": rec["dport"], "dport": rec["dnat_port"],
+                    "proto": rec["proto"], "reply": False,
+                    "node": rec.get("node", ""), "event": rec.get("event"),
+                    "last_seen": rec["last_seen"],
+                    "_placeholder": True,
+                }
+            bf["reply_seen"] = True
+            bf["last_seen"] = max(bf["last_seen"], rec["last_seen"])
+            return
+        fkey = (rec["src"], rec["dst"], rec["sport"], rec["dport"], rec["proto"])
+        bf = self.biflows.get(fkey)
+        if bf is None:
+            self.biflows[fkey] = {**rec, "reply_seen": False}
+            return
+        if bf.pop("_placeholder", None):
+            seen_reply = bf.get("reply_seen", False)
+            last = bf["last_seen"]
+            bf.clear()
+            bf.update(rec, reply_seen=seen_reply)
+            bf["last_seen"] = max(last, rec["last_seen"])
+        else:
+            bf["last_seen"] = max(bf["last_seen"], rec["last_seen"])
+
+    def snapshot(self) -> list[dict]:
+        return [dict(v) for _, v in sorted(self.biflows.items())]

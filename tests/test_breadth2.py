@@ -1,0 +1,245 @@
+"""Breadth round 2: FQDN feedback loop, Egress + consistent-hash
+ownership, flow export/aggregation."""
+
+import numpy as np
+import pytest
+
+from antrea_tpu.agent.fqdn import FqdnController, fqdn_matches
+from antrea_tpu.agent.memberlist import ConsistentHash, MemberlistCluster
+from antrea_tpu.apis.controlplane import Direction, RuleAction
+from antrea_tpu.apis.crd import (
+    AntreaAppliedTo,
+    AntreaNetworkPolicy,
+    AntreaNPRule,
+    AntreaPeer,
+    LabelSelector,
+    Namespace,
+    Pod,
+)
+from antrea_tpu.controller import NetworkPolicyController
+from antrea_tpu.controller.egress import (
+    EgressController,
+    EgressPolicy,
+    build_egress_table,
+)
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.observability.flowexport import FlowAggregator, FlowExporter
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+
+def _probe(dp, src, dst, dport=443, now=10, proto=6, sport=40000):
+    b = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+        proto=np.array([proto], np.int32),
+        src_port=np.array([sport], np.int32),
+        dst_port=np.array([dport], np.int32),
+    )
+    return dp.step(b, now)
+
+
+def test_fqdn_match_semantics():
+    assert fqdn_matches("example.com", "EXAMPLE.com.")
+    assert not fqdn_matches("example.com", "a.example.com")
+    assert fqdn_matches("*.example.com", "a.example.com")
+    assert fqdn_matches("*.example.com", "b.a.example.com")
+    assert not fqdn_matches("*.example.com", "example.com")
+
+
+@pytest.mark.parametrize("dp_cls", [TpuflowDatapath, OracleDatapath])
+def test_fqdn_feedback_loop(dp_cls):
+    """An FQDN egress rule starts empty; DNS observations (the packet-in
+    feedback) populate it via incremental deltas; TTL expiry removes the
+    learned addresses (fqdn.go model)."""
+    ctl = NetworkPolicyController()
+    ctl.upsert_namespace(Namespace("default", {}))
+    ctl.upsert_pod(Pod(namespace="default", name="c", ip="10.0.0.5",
+                       node="n0", labels={"app": "cli"}))
+    ctl.upsert_antrea_policy(AntreaNetworkPolicy(
+        uid="block-bad", name="block-bad", priority=1.0,
+        applied_to=[AntreaAppliedTo(
+            pod_selector=LabelSelector.make({"app": "cli"}))],
+        rules=[AntreaNPRule(
+            direction=Direction.OUT, action=RuleAction.DROP,
+            peers=[AntreaPeer(fqdn="*.bad.example")],
+        )],
+    ))
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8)
+    if dp_cls is TpuflowDatapath:
+        kw["miss_chunk"] = 16
+    dp = dp_cls(ctl.policy_set(), [], **kw)
+    fq = FqdnController(dp)
+    fq.configure(ctl.policy_set())
+
+    bad_ip = "203.0.113.7"
+    # Before any DNS observation the learned set is empty: allowed.
+    assert int(_probe(dp, "10.0.0.5", bad_ip, now=1).code[0]) == 0
+    # evil.bad.example resolves to bad_ip -> the group learns it.
+    n = fq.observe_dns("evil.bad.example", [bad_ip], ttl_s=30, now=2)
+    assert n == 1
+    r = _probe(dp, "10.0.0.5", bad_ip, now=3, sport=40001)
+    assert int(r.code[0]) == 1
+    assert r.egress_rule[0] == "block-bad/Out/0"
+    # A non-matching name changes nothing.
+    assert fq.observe_dns("good.example", ["198.51.100.9"], 30, now=4) == 0
+    # TTL expiry removes the learned address; new flows pass again.
+    assert fq.tick(now=40) == 1
+    assert int(_probe(dp, "10.0.0.5", bad_ip, now=41, sport=40002).code[0]) == 0
+
+
+def test_consistent_hash_stability_and_failover():
+    """Ownership is deterministic across agents, stable under unrelated
+    churn, and moves ONLY for keys owned by a departed node (the Egress
+    failover property, cluster.go:89 + consistenthash)."""
+    nodes = [f"node-{i}" for i in range(5)]
+    clusters = [MemberlistCluster(n) for n in nodes]
+    for c in clusters:
+        for n in nodes:
+            c.join(n)
+    keys = [f"10.10.{i}.{j}" for i in range(4) for j in range(16)]
+    owners = {k: clusters[0].owner_of(k) for k in keys}
+    # Every agent elects the same owner; exactly one owner claims each key.
+    for k in keys:
+        assert all(c.owner_of(k) == owners[k] for c in clusters)
+        assert sum(c.should_own(k) for c in clusters) == 1
+    # Spread: every node owns something at 64 keys / 5 nodes.
+    assert len(set(owners.values())) == 5
+
+    # node-2 dies: only its keys move; everyone re-elects identically.
+    events = []
+    clusters[0].add_event_handler(lambda alive: events.append(set(alive)))
+    for c in clusters:
+        c.leave("node-2")
+    assert events and "node-2" not in events[-1]
+    for k in keys:
+        new = clusters[0].owner_of(k)
+        assert all(c.owner_of(k) == new for c in clusters)
+        if owners[k] != "node-2":
+            assert new == owners[k], "unrelated ownership must not move"
+        else:
+            assert new != "node-2"
+
+
+def test_egress_assignment_and_table():
+    from antrea_tpu.controller.grouping import GroupEntityIndex
+
+    index = GroupEntityIndex()
+    ctl = EgressController(index)
+    changes = []
+    ctl.subscribe(lambda: changes.append(1))
+    index.upsert_namespace(Namespace("prod", {}))
+    index.upsert_pod(Pod(namespace="prod", name="a", ip="10.0.0.1",
+                         node="n0", labels={"team": "x"}))
+    index.upsert_pod(Pod(namespace="prod", name="b", ip="10.0.0.2",
+                         node="n1", labels={"team": "y"}))
+    ctl.upsert(EgressPolicy("eg-x", "172.16.0.10",
+                            pod_selector=LabelSelector.make({"team": "x"})))
+    ctl.upsert(EgressPolicy("eg-y", "172.16.0.11",
+                            pod_selector=LabelSelector.make({"team": "y"})))
+    asg = ctl.assignments()
+    assert asg == [("10.0.0.1", "172.16.0.10", "eg-x"),
+                   ("10.0.0.2", "172.16.0.11", "eg-y")]
+
+    table = build_egress_table(asg)
+    assert table.egress_ip_for(iputil.ip_to_u32("10.0.0.1")) == "172.16.0.10"
+    assert table.egress_ip_for(iputil.ip_to_u32("10.0.0.2")) == "172.16.0.11"
+    assert table.egress_ip_for(iputil.ip_to_u32("10.0.0.3")) is None
+
+    # Pod churn re-notifies (the agent rebuilds its table).
+    n = len(changes)
+    index.upsert_pod(Pod(namespace="prod", name="c", ip="10.0.0.3",
+                         node="n0", labels={"team": "x"}))
+    assert len(changes) > n
+    assert build_egress_table(ctl.assignments()).egress_ip_for(
+        iputil.ip_to_u32("10.0.0.3")) == "172.16.0.10"
+    ctl.delete("eg-x")
+    assert build_egress_table(ctl.assignments()).egress_ip_for(
+        iputil.ip_to_u32("10.0.0.1")) is None
+
+
+@pytest.mark.parametrize("dp_cls", [TpuflowDatapath, OracleDatapath])
+def test_flow_export_and_aggregation(dp_cls):
+    """Conntrack-poll export: new connections export once, the reply leg
+    correlates into one biflow, idle-ended connections emit a final
+    record (flowexporter -> flowaggregator model)."""
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8, ct_timeout_s=50)
+    if dp_cls is TpuflowDatapath:
+        kw["miss_chunk"] = 16
+    dp = dp_cls(None, [], **kw)
+    agg = FlowAggregator()
+    exp = FlowExporter(dp, node="n0", active_timeout_s=60, sink=agg.ingest)
+
+    _probe(dp, "10.0.0.5", "10.0.0.80", dport=80, now=1)
+    n = exp.poll(now=2)
+    assert n == 2  # fwd + reply conntrack entries -> one new record each
+    # Reply leg arrives; no NEW records on re-poll (same connection).
+    _probe(dp, "10.0.0.80", "10.0.0.5", dport=40000, sport=80, now=3)
+    assert exp.poll(now=4) == 0
+    bi = agg.snapshot()
+    assert len(bi) == 1 and bi[0]["reply_seen"]
+    assert bi[0]["src"] == "10.0.0.5" and bi[0]["dst"] == "10.0.0.80"
+
+    # Idle out: the end record is emitted with reason=idle-end.
+    n = exp.poll(now=120)
+    assert n == 2
+    ends = [r for r in exp.records if r["event"] == "end"]
+    assert len(ends) == 2 and all(r["reason"] == "idle-end" for r in ends)
+
+
+def test_fqdn_membership_survives_bundle():
+    """A structural bundle resets fqdn-- groups to the central (empty)
+    state; configure() must restore the per-node learned membership, or
+    FQDN deny rules fail open (review repro)."""
+    ctl = NetworkPolicyController()
+    ctl.upsert_namespace(Namespace("default", {}))
+    ctl.upsert_pod(Pod(namespace="default", name="c", ip="10.0.0.5",
+                       node="n0", labels={"app": "cli"}))
+    ctl.upsert_antrea_policy(AntreaNetworkPolicy(
+        uid="block-bad", name="block-bad", priority=1.0,
+        applied_to=[AntreaAppliedTo(
+            pod_selector=LabelSelector.make({"app": "cli"}))],
+        rules=[AntreaNPRule(
+            direction=Direction.OUT, action=RuleAction.DROP,
+            peers=[AntreaPeer(fqdn="*.bad.example")],
+        )],
+    ))
+    dp = TpuflowDatapath(ctl.policy_set(), [], flow_slots=1 << 10,
+                         aff_slots=1 << 8, miss_chunk=16)
+    fq = FqdnController(dp)
+    fq.configure(ctl.policy_set())
+    fq.observe_dns("evil.bad.example", ["203.0.113.7"], ttl_s=1000, now=1)
+    assert int(_probe(dp, "10.0.0.5", "203.0.113.7", now=2).code[0]) == 1
+
+    # Unrelated policy change -> agent does a structural bundle + configure.
+    ctl.upsert_antrea_policy(AntreaNetworkPolicy(
+        uid="other", name="other", priority=9.0,
+        applied_to=[AntreaAppliedTo(
+            pod_selector=LabelSelector.make({"app": "zzz"}))],
+        rules=[AntreaNPRule(direction=Direction.IN, action=RuleAction.ALLOW)],
+    ))
+    dp.install_bundle(ps=ctl.policy_set())
+    fq.configure(ctl.policy_set())
+    r = _probe(dp, "10.0.0.5", "203.0.113.7", now=3, sport=40009)
+    assert int(r.code[0]) == 1, "learned FQDN membership must survive bundles"
+
+
+def test_flow_dump_high_ips_and_reply_first_aggregation():
+    """dump_flows must decode IPs >= 128.0.0.0 (numpy-2 uint32 bounds;
+    review repro), and the aggregator must produce forward-oriented
+    biflows regardless of which direction dumps first."""
+    dp = TpuflowDatapath(None, [], flow_slots=1 << 10, aff_slots=1 << 8,
+                         miss_chunk=16)
+    _probe(dp, "192.168.1.1", "203.0.113.250", dport=443, now=1)
+    flows = dp.dump_flows(now=2)
+    assert {f["src"] for f in flows} == {"192.168.1.1", "203.0.113.250"}
+
+    # Reply-first ingestion: feed the records reply-leg first.
+    agg = FlowAggregator()
+    for rec in sorted(flows, key=lambda r: not r["reply"]):
+        agg.ingest({**rec, "node": "n0", "event": "new"})
+    bi = agg.snapshot()
+    assert len(bi) == 1
+    assert bi[0]["src"] == "192.168.1.1" and bi[0]["dst"] == "203.0.113.250"
+    assert bi[0]["sport"] == 40000 and bi[0]["dport"] == 443
+    assert bi[0]["reply_seen"] and not bi[0]["reply"]
