@@ -60,7 +60,7 @@ class EgressController:
     def upsert(self, eg: EgressPolicy) -> None:
         sel = GroupSelector(namespace="", pod_selector=eg.pod_selector,
                             ns_selector=eg.ns_selector)
-        new_key = self.index.add_group(sel)
+        new_key = self.index.add_group(sel, owner="egress")
         old_key = self._groups.get(eg.name)
         self._policies[eg.name] = eg
         self._groups[eg.name] = new_key
@@ -77,7 +77,7 @@ class EgressController:
 
     def _gc_group(self, key: str) -> None:
         if key not in self._groups.values():
-            self.index.delete_group(key)
+            self.index.delete_group(key, owner="egress")
 
     def _on_groups_changed(self, changed: set) -> None:
         if changed & set(self._groups.values()):

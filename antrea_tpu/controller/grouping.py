@@ -92,6 +92,8 @@ class GroupEntityIndex:
         # 26s scoped).
         self._ns_groups: dict[str, set] = {}
         self._cluster_groups: set = set()
+        #   group key -> owner tags (multi-controller deletion safety)
+        self._group_owners: dict[str, set] = {}
 
     # -- subscriptions -------------------------------------------------------
 
@@ -107,10 +109,18 @@ class GroupEntityIndex:
 
     # -- group registration --------------------------------------------------
 
-    def add_group(self, sel: GroupSelector) -> str:
-        """Register (idempotent); returns the group key.  Namespaced
-        selectors match only against their namespace's buckets."""
+    def add_group(self, sel: GroupSelector, owner: str = "default") -> str:
+        """Register (idempotent per owner); returns the group key.
+        Namespaced selectors match only against their namespace's buckets.
+
+        Groups are content-addressed, so INDEPENDENT controllers sharing
+        one index (NP + Egress, like the reference's shared grouping
+        index) can resolve the same selector to the same key — deletion
+        is therefore owner-scoped: the group leaves the index only when
+        its LAST owner deletes it (group_entity_index.go keeps the same
+        multi-consumer contract via per-feature group types)."""
         key = sel.key()
+        self._group_owners.setdefault(key, set()).add(owner)
         if key in self._groups:
             return key
         self._groups[key] = sel
@@ -130,7 +140,13 @@ class GroupEntityIndex:
                 matched.add(_bucket_key(bucket.namespace, bucket.labels))
         return key
 
-    def delete_group(self, key: str) -> None:
+    def delete_group(self, key: str, owner: str = "default") -> None:
+        owners = self._group_owners.get(key)
+        if owners is not None:
+            owners.discard(owner)
+            if owners:
+                return  # another controller still uses this group
+            del self._group_owners[key]
         sel = self._groups.pop(key, None)
         if sel is None:
             return

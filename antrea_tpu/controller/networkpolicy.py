@@ -134,7 +134,7 @@ class NetworkPolicyController:
     def _ensure_group(
         self, table: dict, sel: GroupSelector, ref_uid: str, obj_type: str
     ) -> str:
-        key = self.index.add_group(sel)
+        key = self.index.add_group(sel, owner="networkpolicy")
         st = table.get(key)
         if st is None:
             st = _GroupState(selector=sel)
@@ -162,7 +162,7 @@ class NetworkPolicyController:
             # Drop from the index only when neither table references the key.
             other = self._ags if table is self._atgs else self._atgs
             if key not in other:
-                self.index.delete_group(key)
+                self.index.delete_group(key, owner="networkpolicy")
 
     def _group_obj(self, obj_type: str, key: str, st: _GroupState):
         if obj_type == "AppliedToGroup":

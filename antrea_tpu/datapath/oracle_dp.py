@@ -115,8 +115,10 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         self._oracle.update(ps=self._ps)
         self._gen += 1
         # Delta path marks dirty instead of rewriting the whole snapshot —
-        # see TpuflowDatapath.apply_group_delta for the recovery contract.
+        # see TpuflowDatapath.apply_group_delta for the recovery contract;
+        # the generation itself is journaled (cookie-round append).
         self._persist_dirty = True
+        self._record_round()
         return self._gen
 
     def stats(self) -> DatapathStats:
@@ -129,13 +131,17 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
 
     def dump_flows(self, now: int) -> list[dict]:
         """Conntrack-dump analog (same record shape as TpuflowDatapath)."""
+        from ..models.pipeline import GEN_ETERNAL
         from ..utils import ip as iputil
 
         out = []
         o = self._oracle
+        gen_w = self._gen % GEN_ETERNAL
         for e in o.flow.values():
             if (now - e["ts"]) > o.ct_timeout_s:
                 continue
+            if e["gen"] is not None and e["gen"] != gen_w:
+                continue  # stale-generation denial: dead to lookups
             src, dst, pp, proto = e["key"]
             out.append({
                 "src": iputil.u32_to_ip(src),

@@ -96,21 +96,59 @@ def load_snapshot(persist_dir: str):
 class PersistableDatapath:
     """Shared restart-persistence behavior for Datapath implementations
     (single source of truth for the recovery contract; both datapaths mix
-    this in).  Expects subclasses to hold _ps, _services, _gen."""
+    this in).  Expects subclasses to hold _ps, _services, _gen.
+
+    Two durable pieces, matching the reference's split:
+      * the JSON snapshot (full input state) — written on bundle commits;
+      * the cookie ROUND in the native transactional config store
+        (native/ovsdb_lite, the OVSDB external-IDs analog,
+        cookie/allocator.go:76-135) — a tiny journal append on EVERY
+        generation bump, including the delta path that does not snapshot.
+    On reload the generation is max(snapshot, round journal), so delta
+    bumps taken after the last snapshot can never roll the generation
+    backwards across a crash (a rolled-back generation could alias a
+    pre-crash cached denial).
+    """
+
+    _ROUND_KEY = "cookie/round"
 
     def _init_persist(self, persist_dir, ps, services) -> None:
         """Call from __init__ AFTER _ps/_services/_gen defaults are set:
         loads the snapshot when constructed without explicit state."""
         self._persist_dir = persist_dir
         self._persist_dirty = False
-        if persist_dir is not None and ps is None and services is None:
+        self._conf_store = None
+        if persist_dir is None:
+            return
+        from ..native import ConfigStore
+
+        self._conf_store = ConfigStore(os.path.join(persist_dir, "conf.db"))
+        if ps is None and services is None:
             snap = load_snapshot(persist_dir)
             if snap is not None:
                 self._ps, self._services, self._gen = snap
+        # The round journal is consulted UNCONDITIONALLY: even a datapath
+        # reconstructed with explicit state must resume past the durable
+        # round, or its first bump would overwrite the journal with a
+        # smaller value and a later snapshotless reload could alias
+        # pre-crash cached denials.
+        raw = self._conf_store.get(self._ROUND_KEY)
+        if raw is not None:
+            self._gen = max(self._gen, int.from_bytes(raw, "little"))
+
+    def _record_round(self) -> None:
+        """Durable generation bump without an O(state) snapshot (the
+        delta-path cookie-round append)."""
+        if self._conf_store is not None:
+            self._conf_store.set(
+                self._ROUND_KEY, int(self._gen).to_bytes(8, "little")
+            )
+            self._conf_store.commit()
 
     def _persist(self) -> None:
         if self._persist_dir is not None:
             save_snapshot(self._persist_dir, self._ps, self._services, self._gen)
+            self._record_round()
         self._persist_dirty = False
 
     def checkpoint(self) -> None:

@@ -178,7 +178,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # authoritative crash-recovery source for membership churn is the
         # AGENT's filestore replay (filestore.go model); the datapath
         # snapshot catches up on the next bundle commit or checkpoint().
+        # The GENERATION, however, is journaled now (cookie-round append)
+        # so it stays monotonic across a crash with pending deltas.
         self._persist_dirty = True
+        self._record_round()
         return self._gen
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
@@ -241,12 +244,21 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         meta = np.asarray(flow.meta)[:-1].astype(np.int64)
         ts = np.asarray(flow.ts)[:-1]
         kpg = keys[:, 3]
-        live = (kpg != 0) & ((now - ts) <= self._pipe_kw["ct_timeout_s"])
+        # Live = occupied, within idle timeout, AND valid under the current
+        # generation: stale-gen denial entries survive in the table after a
+        # bundle but are dead to lookups — dumping them would resolve their
+        # packed rule indices against the NEW rule table (misattribution).
+        entry_gen = (kpg >> 9) & pl.GEN_ETERNAL
+        gen_w = self._gen % pl.GEN_ETERNAL
+        live = (
+            (kpg != 0)
+            & ((now - ts) <= self._pipe_kw["ct_timeout_s"])
+            & ((entry_gen == pl.GEN_ETERNAL) | (entry_gen == gen_w))
+        )
         out = []
 
         def unflip_ip(v: int) -> str:
-            # Inverse of iputil.flip_u32 in plain-int space (numpy-2 safe).
-            return iputil.u32_to_ip((int(v) ^ -(2**31)) & 0xFFFFFFFF)
+            return iputil.u32_to_ip(iputil.unflip_u32(v))
 
         def rid(ids: list, idx: int):
             return ids[idx] if 0 <= idx < len(ids) and ids[idx] else None

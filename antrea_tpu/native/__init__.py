@@ -1,0 +1,5 @@
+"""Native (C++) runtime components + ctypes bindings."""
+
+from .store import ConfigStore, native_available
+
+__all__ = ["ConfigStore", "native_available"]

@@ -44,17 +44,24 @@ class FlowExporter:
         active_timeout_s: int = 60,
         sink: Optional[Callable[[dict], None]] = None,
         path: Optional[str] = None,
+        keep_records: Optional[bool] = None,
     ):
         self.datapath = datapath
         self.node = node
         self.active_timeout_s = active_timeout_s
         self._conns: dict[tuple, _Conn] = {}
+        # The in-memory record log is a convenience for consumers with no
+        # sink/path; with one configured it would grow without bound over
+        # the process lifetime, so it defaults OFF then.
+        self._keep = (sink is None and path is None) if keep_records is None \
+            else keep_records
         self.records: list[dict] = []
         self._sink = sink
         self.path = path
 
     def _emit(self, rec: dict) -> None:
-        self.records.append(rec)
+        if self._keep:
+            self.records.append(rec)
         if self._sink is not None:
             self._sink(rec)
         if self.path is not None:
@@ -102,9 +109,23 @@ class FlowAggregator:
 
     def __init__(self):
         self.biflows: dict[tuple, dict] = {}
+        # reply tuple -> forward biflow key, so reply 'end' records (which
+        # carry no un-DNAT fields) can still find their biflow.
+        self._fwd_of_reply: dict[tuple, tuple] = {}
 
     def ingest(self, rec: dict) -> None:
         if rec.get("event") == "end":
+            # Expire the correlated biflow (the reference aggregator
+            # expires records too — without this the table grows with
+            # cumulative connection count forever).
+            rkey = (rec["src"], rec["dst"], rec["sport"], rec["dport"],
+                    rec["proto"])
+            if rec["reply"]:
+                fkey = self._fwd_of_reply.pop(rkey, None)
+                if fkey is not None:
+                    self.biflows.pop(fkey, None)
+            else:
+                self.biflows.pop(rkey, None)
             return
         if rec["reply"]:
             # Reply tuple (ep -> client, ports swapped); its forward tuple
@@ -116,6 +137,10 @@ class FlowAggregator:
             # fills in its richer fields.
             fkey = (rec["dst"], rec["dnat_ip"], rec["dport"],
                     rec["dnat_port"], rec["proto"])
+            self._fwd_of_reply[
+                (rec["src"], rec["dst"], rec["sport"], rec["dport"],
+                 rec["proto"])
+            ] = fkey
             bf = self.biflows.get(fkey)
             if bf is None:
                 bf = self.biflows[fkey] = {

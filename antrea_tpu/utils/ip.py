@@ -98,3 +98,9 @@ def flip_u32(a):
     import numpy as np
 
     return (np.asarray(a, dtype=np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+
+
+def unflip_u32(v) -> int:
+    """Scalar inverse of flip_u32 (plain-int space, numpy-2 safe): the
+    stored sign-flipped i32 value back to its u32 address."""
+    return (int(v) ^ 0x80000000) & 0xFFFFFFFF

@@ -168,7 +168,8 @@ def test_flow_export_and_aggregation(dp_cls):
         kw["miss_chunk"] = 16
     dp = dp_cls(None, [], **kw)
     agg = FlowAggregator()
-    exp = FlowExporter(dp, node="n0", active_timeout_s=60, sink=agg.ingest)
+    exp = FlowExporter(dp, node="n0", active_timeout_s=60, sink=agg.ingest,
+                       keep_records=True)
 
     _probe(dp, "10.0.0.5", "10.0.0.80", dport=80, now=1)
     n = exp.poll(now=2)
@@ -180,11 +181,13 @@ def test_flow_export_and_aggregation(dp_cls):
     assert len(bi) == 1 and bi[0]["reply_seen"]
     assert bi[0]["src"] == "10.0.0.5" and bi[0]["dst"] == "10.0.0.80"
 
-    # Idle out: the end record is emitted with reason=idle-end.
+    # Idle out: the end record is emitted with reason=idle-end, and the
+    # aggregator evicts the correlated biflow (bounded table).
     n = exp.poll(now=120)
     assert n == 2
     ends = [r for r in exp.records if r["event"] == "end"]
     assert len(ends) == 2 and all(r["reason"] == "idle-end" for r in ends)
+    assert agg.snapshot() == []
 
 
 def test_fqdn_membership_survives_bundle():
@@ -243,3 +246,33 @@ def test_flow_dump_high_ips_and_reply_first_aggregation():
     assert bi[0]["src"] == "192.168.1.1" and bi[0]["dst"] == "203.0.113.250"
     assert bi[0]["sport"] == 40000 and bi[0]["dport"] == 443
     assert bi[0]["reply_seen"] and not bi[0]["reply"]
+
+
+def test_shared_index_group_survives_cross_controller_delete():
+    """NP and Egress controllers share one grouping index; a content-
+    addressed group used by BOTH must survive either controller's delete
+    (owner-scoped index deletion; review repro: Egress delete froze the
+    ACNP's group membership)."""
+    ctl = NetworkPolicyController()
+    ec = EgressController(ctl.index)
+    ctl.upsert_namespace(Namespace("default", {}))
+    ctl.upsert_pod(Pod(namespace="default", name="a", ip="10.0.0.1",
+                       node="n0", labels={"team": "x"}))
+    ctl.upsert_antrea_policy(AntreaNetworkPolicy(
+        uid="drop-x", name="drop-x", priority=1.0,
+        applied_to=[AntreaAppliedTo(
+            pod_selector=LabelSelector.make({"team": "x"}),
+            ns_selector=LabelSelector.make({}))],
+        rules=[AntreaNPRule(direction=Direction.IN, action=RuleAction.DROP)],
+    ))
+    # Same content-addressed selector registered by the Egress controller.
+    ec.upsert(EgressPolicy("eg-x", "172.16.0.10",
+                           pod_selector=LabelSelector.make({"team": "x"}),
+                           ns_selector=LabelSelector.make({})))
+    ec.delete("eg-x")  # must NOT delete the NP's group from the index
+    ctl.upsert_pod(Pod(namespace="default", name="b", ip="10.0.0.2",
+                       node="n0", labels={"team": "x"}))
+    atg = next(iter(ctl.policy_set().applied_to_groups.values()))
+    assert {m.ip for m in atg.members} == {"10.0.0.1", "10.0.0.2"}, (
+        "new pod must keep flowing into the shared group after egress delete"
+    )

@@ -91,6 +91,18 @@ def test_datapath_restart_recovers_state(tmp_path, dp_cls):
     dp3 = dp_cls(persist_dir=str(tmp_path), **kw)
     assert dp3.generation == g2
 
+    # Delta-path generation bumps are journaled (cookie-round append in
+    # the native config store) even though the snapshot is not rewritten:
+    # a crash right after deltas must NOT roll the generation back (a
+    # rolled-back gen could alias pre-crash cached denials).
+    ag = sorted(cluster.ps.address_groups)[0]
+    g3 = dp3.apply_group_delta(ag, added_ips=["10.77.0.1"], removed_ips=[])
+    g4 = dp3.apply_group_delta(ag, added_ips=["10.77.0.2"], removed_ips=[])
+    assert g4 > g3 >= g2
+    del dp3  # crash with snapshot stale but round journal current
+    dp4 = dp_cls(persist_dir=str(tmp_path), **kw)
+    assert dp4.generation == g4
+
 
 def _mini_cluster_events(store):
     ctrl = NetworkPolicyController()
