@@ -1,0 +1,151 @@
+"""Multicluster: service export/import across clusters, ACNP replication,
+label identities — driven end-to-end into per-cluster datapaths (the
+BASELINE config-5 'multicluster' scenario; cross-cluster reachability
+rides DNAT to remote pod IPs, the Geneve-tunnel analog)."""
+
+import numpy as np
+
+from antrea_tpu.apis.controlplane import Direction, RuleAction
+from antrea_tpu.apis.crd import (
+    AntreaAppliedTo,
+    AntreaNetworkPolicy,
+    AntreaNPRule,
+    AntreaPeer,
+    IPBlock,
+    LabelSelector,
+)
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.datapath import TpuflowDatapath
+from antrea_tpu.multicluster import ClusterSet, LabelIdentityIndex
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+
+def _probe(dp, src, dst, dport, now=10):
+    b = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([41000], np.int32),
+        dst_port=np.array([dport], np.int32),
+    )
+    return dp.step(b, now)
+
+
+def test_service_export_import_roundtrip():
+    cs = ClusterSet()
+    east = cs.add_member("east")
+    west = cs.add_member("west")
+
+    # east exports prod/web backed by two local pods.
+    svc_east = ServiceEntry("10.96.0.10", 80, 6,
+                            [Endpoint("10.1.0.5", 8080), Endpoint("10.1.0.6", 8080)],
+                            name="web", namespace="prod")
+    east.add_local_service("prod", svc_east)
+    cs.leader.export_service("east", "prod", svc_east)
+
+    # west sees the import with east's endpoints.
+    imp = west.imported[("prod", "web")]
+    assert imp.name == "antrea-mc-web"
+    assert {e.ip for e in imp.endpoints} == {"10.1.0.5", "10.1.0.6"}
+    # east's own import of the same name excludes its own endpoints.
+    assert east.imported[("prod", "web")].endpoints == []
+
+    # west also exports the same service name: endpoints merge; east's
+    # import now carries west's endpoints (and west's still only east's).
+    svc_west = ServiceEntry("10.97.0.10", 80, 6, [Endpoint("10.2.0.9", 8080)],
+                            name="web", namespace="prod")
+    west.add_local_service("prod", svc_west)
+    cs.leader.export_service("west", "prod", svc_west)
+    assert {e.ip for e in east.imported[("prod", "web")].endpoints} == {"10.2.0.9"}
+    assert {e.ip for e in west.imported[("prod", "web")].endpoints} == {
+        "10.1.0.5", "10.1.0.6"}
+
+    # Retraction: west withdraws; east's import empties again.
+    cs.leader.retract_export("west", "prod", "web")
+    assert east.imported[("prod", "web")].endpoints == []
+
+
+def test_cross_cluster_traffic_through_datapath():
+    """The imported MC service compiles into the member's datapath like any
+    Service: traffic to the antrea-mc ClusterIP DNATs to a REMOTE cluster's
+    pod IP (the cross-cluster Geneve path of the reference)."""
+    cs = ClusterSet()
+    east = cs.add_member("east")
+    west = cs.add_member("west")
+    svc_east = ServiceEntry("10.96.0.10", 80, 6, [Endpoint("10.1.0.5", 8080)],
+                            name="web", namespace="prod")
+    east.add_local_service("prod", svc_east)
+    cs.leader.export_service("east", "prod", svc_east)
+
+    dp_west = TpuflowDatapath(None, west.all_services(),
+                              flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=16)
+    mc_ip = west.imported[("prod", "web")].cluster_ip
+    r = _probe(dp_west, "10.2.0.50", mc_ip, 80)
+    assert int(r.code[0]) == 0
+    assert int(r.dnat_ip[0]) == iputil.ip_to_u32("10.1.0.5")  # remote pod
+    assert int(r.dnat_port[0]) == 8080
+
+
+def test_acnp_replication_and_late_join():
+    cs = ClusterSet()
+    east = cs.add_member("east")
+    anp = AntreaNetworkPolicy(
+        uid="cs-deny", name="cs-deny", priority=1.0,
+        applied_to=[AntreaAppliedTo(
+            pod_selector=LabelSelector.make({"app": "db"}))],
+        rules=[AntreaNPRule(
+            direction=Direction.IN, action=RuleAction.DROP,
+            peers=[AntreaPeer(ip_block=IPBlock(cidr="0.0.0.0/0"))],
+        )],
+    )
+    cs.leader.replicate_policy(anp)
+    assert "cs-deny" in east.replicated_policies
+    # A cluster joining LATER receives existing policies and imports.
+    svc = ServiceEntry("10.96.0.10", 80, 6, [Endpoint("10.1.0.5", 8080)],
+                       name="web", namespace="prod")
+    east.add_local_service("prod", svc)
+    cs.leader.export_service("east", "prod", svc)
+    south = cs.add_member("south")
+    assert "cs-deny" in south.replicated_policies
+    assert {e.ip for e in south.imported[("prod", "web")].endpoints} == {"10.1.0.5"}
+
+    # A departing member's exports are GC'd (leader stale controller):
+    # with no exporters left, the import is retracted everywhere, and the
+    # departed member drops ALL its MC state (member-side stale cleanup).
+    east_member = cs.members["east"]
+    cs.remove_member("east")
+    assert ("prod", "web") not in south.imported
+    assert east_member.imported == {} and east_member.replicated_policies == {}
+    assert "east" not in cs.members
+
+
+def test_conflicting_export_specs_surface_not_merge():
+    """Two clusters exporting the same name with DIFFERENT port/protocol:
+    the cluster-id-ordered first exporter defines the import; the
+    conflicting cluster is surfaced in `conflicts` and its endpoints are
+    excluded (the reference marks conflicting ResourceExports)."""
+    cs = ClusterSet()
+    east = cs.add_member("east")
+    west = cs.add_member("west")
+    cs.leader.export_service("west", "prod", ServiceEntry(
+        "10.97.0.10", 443, 6, [Endpoint("10.2.0.9", 8443)],
+        name="web", namespace="prod"))
+    cs.leader.export_service("east", "prod", ServiceEntry(
+        "10.96.0.10", 80, 6, [Endpoint("10.1.0.5", 8080)],
+        name="web", namespace="prod"))
+    ri = cs.leader._imports()[("prod", "web")]
+    assert (ri.port, ri.protocol) == (80, 6)  # east < west: east defines
+    assert ri.conflicts == ["west"]
+    assert [c for c, _ in ri.endpoints] == ["east"]
+    # The import the members hold reflects the deterministic winner.
+    assert west.imported[("prod", "web")].port == 80
+
+
+def test_label_identity_ids_are_clusterset_wide():
+    idx = LabelIdentityIndex()
+    a = idx.id_of({"env": "prod"}, {"app": "web"})
+    b = idx.id_of({"env": "prod"}, {"app": "web"})
+    c = idx.id_of({"env": "prod"}, {"app": "db"})
+    d = idx.id_of({}, {"app": "web"})
+    assert a == b and len({a, c, d}) == 3 and min(a, c, d) >= 1
