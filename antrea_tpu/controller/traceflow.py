@@ -1,0 +1,143 @@
+"""Central Traceflow controller: tag allocation + trace orchestration.
+
+The analog of the reference's Traceflow pipeline
+(/root/reference/pkg/controller/traceflow — allocates a 6-bit dataplane
+tag per live Traceflow and GCs stale ones; the agent injects the probe and
+reconstructs the table-by-table path from packet-in register values,
+pkg/agent/controller/traceflow).  Here the observation source is the
+datapath's trace() (the per-stage observation surface,
+Datapath.trace docstring), so a Traceflow run = allocate tag -> run the
+crafted probe on the target node's datapath -> phase-structured result.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..packet import PacketBatch
+from ..utils import ip as iputil
+
+# 6-bit dataplane tag space, tag 0 reserved (ref traceflow_controller.go).
+_MAX_TAG = 63
+
+
+@dataclass
+class TraceflowSpec:
+    name: str
+    src_ip: str
+    dst_ip: str
+    proto: int = 6
+    src_port: int = 40000
+    dst_port: int = 80
+    timeout_s: int = 300  # stale-GC deadline (ref default 300s)
+
+
+@dataclass
+class TraceflowStatus:
+    name: str
+    tag: int
+    phase: str  # Running / Succeeded / Failed
+    observations: list = field(default_factory=list)
+    verdict: Optional[str] = None
+
+
+class TraceflowController:
+    """Allocates tags, runs probes against registered node datapaths."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._tags: dict[str, tuple[int, float]] = {}  # name -> (tag, deadline)
+        self._free = list(range(_MAX_TAG, 0, -1))
+        self._datapaths: dict[str, object] = {}
+        self.results: dict[str, TraceflowStatus] = {}
+
+    def register_datapath(self, node: str, dp) -> None:
+        self._datapaths[node] = dp
+
+    def _alloc(self, name: str, timeout_s: int) -> int:
+        if name in self._tags:
+            return self._tags[name][0]
+        self.gc()
+        if not self._free:
+            raise RuntimeError("traceflow tag space exhausted (63 live traces)")
+        tag = self._free.pop()
+        self._tags[name] = (tag, self._clock() + timeout_s)
+        return tag
+
+    def release(self, name: str) -> None:
+        ent = self._tags.pop(name, None)
+        if ent is not None:
+            self._free.append(ent[0])
+
+    def gc(self) -> int:
+        """Release tags of traces past their deadline (the reference's
+        periodic stale-Traceflow GC)."""
+        now = self._clock()
+        stale = [n for n, (_t, dl) in self._tags.items() if dl <= now]
+        for n in stale:
+            self.release(n)
+        return len(stale)
+
+    def _fail(self, name: str, tag: int, reason: str) -> TraceflowStatus:
+        """Record a Failed status and return the tag to the pool (no trace
+        flows were realized, so nothing holds it — unlike the reference's
+        live traces, which keep their tag until deletion/GC)."""
+        st = TraceflowStatus(name, tag, "Failed")
+        st.observations = [{"component": "SpoofGuard", "action": reason}]
+        self.results[name] = st
+        self.release(name)
+        return st
+
+    def run(self, tf: TraceflowSpec, node: str, now: int = 0) -> TraceflowStatus:
+        """Synchronous Traceflow: inject the crafted probe on `node`'s
+        datapath (read-only trace, the packet-out + trace-flows analog)
+        and structure the per-stage observations."""
+        tag = self._alloc(tf.name, tf.timeout_s)
+        dp = self._datapaths.get(node)
+        if dp is None:
+            return self._fail(tf.name, tag, f"unknown node {node!r}")
+        batch = PacketBatch(
+            src_ip=np.array([iputil.ip_to_u32(tf.src_ip)], np.uint32),
+            dst_ip=np.array([iputil.ip_to_u32(tf.dst_ip)], np.uint32),
+            proto=np.array([tf.proto], np.int32),
+            src_port=np.array([tf.src_port], np.int32),
+            dst_port=np.array([tf.dst_port], np.int32),
+        )
+        try:
+            obs = dp.trace(batch, now=now)[0]
+        except Exception as e:  # e.g. Traceflow feature gate disabled
+            return self._fail(tf.name, tag, f"{type(e).__name__}: {e}")
+        verdict = {0: "Allow", 1: "Drop", 2: "Reject"}[obs["code"]]
+        stages = [{"component": "Classification", "tag": tag,
+                   "srcIP": tf.src_ip, "dstIP": tf.dst_ip}]
+        if obs["svc_idx"] >= 0:
+            stages.append({
+                "component": "LB", "serviceIndex": obs["svc_idx"],
+                "translatedDstIP": iputil.u32_to_ip(obs["dnat_ip"])
+                if isinstance(obs["dnat_ip"], int) else obs["dnat_ip"],
+                "translatedDstPort": obs["dnat_port"],
+                "noEndpoint": bool(obs["no_ep"]),
+            })
+        stages.append({
+            "component": "EgressSecurity",
+            "action": {0: "Allowed", 1: "Dropped", 2: "Rejected"}[obs["egress_code"]],
+            "networkPolicyRule": obs["egress_rule"],
+        })
+        stages.append({
+            "component": "IngressSecurity",
+            "action": {0: "Allowed", 1: "Dropped", 2: "Rejected"}[obs["ingress_code"]],
+            "networkPolicyRule": obs["ingress_rule"],
+        })
+        stages.append({
+            "component": "Output",
+            "action": verdict,
+            "cacheHit": bool(obs["cache_hit"]),
+            "established": bool(obs["est"]),
+        })
+        st = TraceflowStatus(tf.name, tag, "Succeeded", stages, verdict)
+        self.results[tf.name] = st
+        return st

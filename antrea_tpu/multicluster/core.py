@@ -89,6 +89,7 @@ class MemberCluster:
         self.cluster_id = cluster_id
         self._mc_cidr_prefix = mc_cidr_prefix
         self._next_ip = 1
+        self._free_ips: list[str] = []
         self.local_services: dict[tuple[str, str], ServiceEntry] = {}
         self.imported: dict[tuple[str, str], ServiceEntry] = {}
         self.replicated_policies: dict[str, AntreaNetworkPolicy] = {}
@@ -102,13 +103,16 @@ class MemberCluster:
     def _alloc_mc_ip(self, key: tuple[str, str]) -> str:
         ip = self._import_ips.get(key)
         if ip is None:
-            if self._next_ip > 254:  # /24 range: guard like other compile caps
+            if self._free_ips:
+                ip = self._free_ips.pop()  # retracted imports recycle IPs
+            elif self._next_ip <= 254:
+                ip = f"{self._mc_cidr_prefix}.{self._next_ip}"
+                self._next_ip += 1
+            else:  # /24 range: guard like other compile caps
                 raise ValueError(
                     f"MC service range {self._mc_cidr_prefix}.0/24 exhausted "
-                    f"({self._next_ip - 1} imports); widen mc_cidr_prefix"
+                    f"(254 live imports); widen mc_cidr_prefix"
                 )
-            ip = f"{self._mc_cidr_prefix}.{self._next_ip}"
-            self._next_ip += 1
             self._import_ips[key] = ip
         return ip
 
@@ -133,6 +137,9 @@ class MemberCluster:
 
     def retract_import(self, namespace: str, name: str) -> None:
         self.imported.pop((namespace, name), None)
+        ip = self._import_ips.pop((namespace, name), None)
+        if ip is not None:
+            self._free_ips.append(ip)  # the ClusterIP returns to the pool
 
     def apply_replicated_policy(self, anp: AntreaNetworkPolicy) -> None:
         self.replicated_policies[anp.uid] = anp

@@ -1,0 +1,43 @@
+"""Agent heartbeat info: the AntreaAgentInfo CRD analog.
+
+The reference's monitor publishes AntreaAgentInfo/AntreaControllerInfo
+health CRDs every 60s (/root/reference/pkg/monitor/agent.go:30-96:
+version, node, OVS info, NP counts, conditions).  collect_agent_info is
+the per-tick producer; the dissemination/K8s write is the caller's."""
+
+from __future__ import annotations
+
+import time
+
+from ..antctl import VERSION
+
+
+def collect_agent_info(datapath, node: str, agent=None, now=None) -> dict:
+    stats = datapath.stats()
+    info = {
+        "kind": "AntreaAgentInfo",
+        "version": VERSION,
+        "nodeName": node,
+        "heartbeatUnix": time.time() if now is None else now,
+        "datapath": {
+            "type": str(datapath.datapath_type.value),
+            "generation": datapath.generation,
+            "cache": datapath.cache_stats(),
+        },
+        "networkPolicyStats": {
+            "ingressRules": len(stats.ingress),
+            "egressRules": len(stats.egress),
+            "defaultAllow": stats.default_allow,
+            "defaultDeny": stats.default_deny,
+        },
+        "conditions": [{
+            "type": "AgentHealthy",
+            "status": "True",
+        }],
+    }
+    if agent is not None:
+        ps = agent.policy_set
+        info["networkPolicies"] = len(ps.policies)
+        info["addressGroups"] = len(ps.address_groups)
+        info["appliedToGroups"] = len(ps.applied_to_groups)
+    return info

@@ -1,0 +1,143 @@
+"""Aux subsystems: central Traceflow controller (tag allocation + GC),
+support bundle collection, agent-info heartbeat."""
+
+import json
+import tarfile
+
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.controller.traceflow import TraceflowController, TraceflowSpec
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.observability.agentinfo import collect_agent_info
+from antrea_tpu.observability.supportbundle import collect_bundle
+
+
+def _env():
+    ps = PolicySet()
+    ps.applied_to_groups["atg"] = cp.AppliedToGroup(
+        "atg", [cp.GroupMember(ip="10.0.0.10", node="n0")]
+    )
+    ps.policies.append(cp.NetworkPolicy(
+        uid="deny-in", name="deny-in", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["atg"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN, action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    services = [ServiceEntry("10.96.0.1", 80, 6,
+                             [Endpoint("10.0.0.10", 8080)], name="svc")]
+    return ps, services
+
+
+@pytest.mark.parametrize("dp_cls", [TpuflowDatapath, OracleDatapath])
+def test_traceflow_run_and_observations(dp_cls):
+    ps, services = _env()
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8)
+    if dp_cls is TpuflowDatapath:
+        kw["miss_chunk"] = 16
+    tfc = TraceflowController()
+    tfc.register_datapath("n0", dp_cls(ps, services, **kw))
+
+    # Service traffic: LB observation + post-DNAT denial attribution.
+    st = tfc.run(TraceflowSpec("tf1", "10.0.0.5", "10.96.0.1", dst_port=80), "n0")
+    assert st.phase == "Succeeded" and st.verdict == "Drop"
+    comps = [o["component"] for o in st.observations]
+    assert comps == ["Classification", "LB", "EgressSecurity",
+                     "IngressSecurity", "Output"]
+    lb = st.observations[1]
+    assert lb["translatedDstIP"] == "10.0.0.10" and lb["translatedDstPort"] == 8080
+    ing = st.observations[3]
+    assert ing["action"] == "Dropped" and ing["networkPolicyRule"] == "deny-in/In/0"
+
+    # Unknown node fails cleanly; same name reuses its tag.
+    st2 = tfc.run(TraceflowSpec("tf1", "10.0.0.5", "10.0.0.99"), "ghost")
+    assert st2.phase == "Failed" and st2.tag == st.tag
+
+
+def test_traceflow_tag_allocation_and_gc():
+    clock = [0.0]
+    tfc = TraceflowController(clock=lambda: clock[0])
+    tfc.register_datapath("n0", OracleDatapath(*_env(),
+                                               flow_slots=1 << 10, aff_slots=1 << 8))
+    tags = set()
+    for i in range(63):
+        tags.add(tfc.run(TraceflowSpec(f"tf{i}", "10.0.0.5", "10.0.0.99",
+                                       timeout_s=100), "n0").tag)
+    assert len(tags) == 63 and 0 not in tags  # 6-bit space, 0 reserved
+    with pytest.raises(RuntimeError, match="tag space exhausted"):
+        tfc.run(TraceflowSpec("overflow", "10.0.0.5", "10.0.0.99"), "n0")
+    # After the deadline the stale tags GC and allocation resumes.
+    clock[0] = 200.0
+    st = tfc.run(TraceflowSpec("fresh", "10.0.0.5", "10.0.0.99"), "n0")
+    assert st.phase == "Succeeded"
+    tfc.release("fresh")
+
+
+def test_support_bundle_collection(tmp_path):
+    ps, services = _env()
+    dp = TpuflowDatapath(None, None, flow_slots=1 << 10, aff_slots=1 << 8,
+                         miss_chunk=16, persist_dir=str(tmp_path / "state"))
+    dp.install_bundle(ps=ps, services=services)
+    import numpy as np
+    from antrea_tpu.packet import PacketBatch
+    from antrea_tpu.utils import ip as iputil
+
+    dp.step(PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32("10.0.0.5")], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32("10.0.0.77")], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([40000], np.int32),
+        dst_port=np.array([80], np.int32),
+    ), 5)
+
+    out = tmp_path / "bundle.tar.gz"
+    names = collect_bundle(dp, str(out), node="n0", now=6,
+                           persist_dir=str(tmp_path / "state"))
+    assert {"meta.json", "stats.json", "cache_stats.json", "flows.json",
+            "metrics.prom", "datapath_snapshot.json"} <= set(names)
+    with tarfile.open(out) as tar:
+        flows = json.load(tar.extractfile("flows.json"))
+        assert len(flows) == 2  # fwd + reply conntrack entries
+        meta = json.load(tar.extractfile("meta.json"))
+        assert meta["generation"] == 1 and meta["node"] == "n0"
+        snap = json.load(tar.extractfile("datapath_snapshot.json"))
+        assert snap["generation"] == 1
+
+
+def test_agent_info_heartbeat():
+    ps, services = _env()
+    dp = OracleDatapath(ps, services, flow_slots=1 << 10, aff_slots=1 << 8)
+    info = collect_agent_info(dp, "n0", now=123)
+    assert info["kind"] == "AntreaAgentInfo" and info["nodeName"] == "n0"
+    assert info["heartbeatUnix"] == 123
+    assert info["datapath"]["type"] == "oracle"
+    assert info["conditions"][0]["type"] == "AgentHealthy"
+
+
+def test_traceflow_gate_disabled_fails_cleanly():
+    from antrea_tpu.features import FeatureGates
+
+    tfc = TraceflowController()
+    tfc.register_datapath("n0", OracleDatapath(
+        *_env(), flow_slots=1 << 10, aff_slots=1 << 8,
+        feature_gates=FeatureGates({"Traceflow": False})))
+    st = tfc.run(TraceflowSpec("tf-gated", "10.0.0.5", "10.0.0.99"), "n0")
+    assert st.phase == "Failed"
+    assert "Traceflow" in st.observations[0]["action"]
+    assert "tf-gated" not in tfc._tags  # tag returned to the pool
+
+
+def test_mc_ip_recycling():
+    from antrea_tpu.multicluster import ClusterSet
+    cs = ClusterSet()
+    m = cs.add_member("east")
+    # Cycle far past the /24 capacity: retracted imports recycle their IPs.
+    for i in range(600):
+        svc = ServiceEntry("10.96.0.9", 80, 6, [Endpoint("10.9.0.9", 80)],
+                           name=f"s{i}", namespace="prod")
+        cs.leader.export_service("west", "prod", svc)
+        cs.leader.retract_export("west", "prod", f"s{i}")
+    assert m.imported == {}
