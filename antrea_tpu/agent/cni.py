@@ -1,0 +1,212 @@
+"""Pod lifecycle: the CNI server + IPAM + interface store analog.
+
+The reference's pod path (/root/reference/pkg/agent/cniserver — gRPC Cni
+service, server.go:430 CmdAdd: IPAM allocate -> veth + OVS port ->
+InstallPodFlows; pkg/agent/cniserver/ipam host-local delegation;
+pkg/agent/interfacestore — in-memory port cache rebuilt from OVSDB
+external-IDs on restart, agent.go:279) re-expressed for this runtime:
+
+  * HostLocalIPAM: per-node podCIDR allocator (host-local semantics:
+    smallest free address, gateway/.0/broadcast reserved, idempotent by
+    container id, release returns the address).
+  * InterfaceStore: the authoritative pod-interface table, persisted as
+    external-IDs rows in the NATIVE transactional config store
+    (native/ovsdb_lite — exactly how the reference survives restarts by
+    rebuilding from OVSDB).
+  * CniServer: CmdAdd/CmdDel/CmdCheck orchestration — allocate, record,
+    and feed the pod into the central controller (which fans policy out to
+    datapaths); the veth/netns syscall layer has no analog on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from ..apis.crd import Pod
+from ..native import ConfigStore
+
+_IFACE_PREFIX = "iface/"
+
+
+class IPAMError(Exception):
+    pass
+
+
+class HostLocalIPAM:
+    """host-local range allocator over one podCIDR (ref
+    pkg/agent/cniserver/ipam host-local delegation semantics)."""
+
+    def __init__(self, pod_cidr: str):
+        self.net = ipaddress.ip_network(pod_cidr)
+        # .0 = network, .1 = gateway (antrea-gw0), last = broadcast.
+        self.gateway = str(self.net.network_address + 1)
+        self._first = int(self.net.network_address) + 2
+        self._last = int(self.net.broadcast_address) - 1
+        self._by_id: dict[str, str] = {}
+        self._used: set[int] = set()
+        # Rolling cursor (host-local's last-allocated-ip behavior): the
+        # common allocation is O(1); a wrap-around scan reclaims released
+        # addresses only once the range end is reached.
+        self._cursor = self._first
+
+    def allocate(self, container_id: str) -> str:
+        ip = self._by_id.get(container_id)
+        if ip is not None:
+            return ip  # idempotent retry (CNI ADD may be re-delivered)
+        n = self._last - self._first + 1
+        for _ in range(n):
+            if self._cursor > self._last:
+                self._cursor = self._first  # wrap: pick up released addrs
+            cand = self._cursor
+            self._cursor += 1
+            if cand not in self._used:
+                self._used.add(cand)
+                ip = str(ipaddress.ip_address(cand))
+                self._by_id[container_id] = ip
+                return ip
+        raise IPAMError(f"podCIDR {self.net} exhausted")
+
+    def release(self, container_id: str) -> Optional[str]:
+        ip = self._by_id.pop(container_id, None)
+        if ip is not None:
+            self._used.discard(int(ipaddress.ip_address(ip)))
+        return ip
+
+    def mark_used(self, container_id: str, ip: str) -> None:
+        """Restart path: re-claim an address recorded in the interface
+        store (the reference re-learns host-local state the same way)."""
+        self._by_id[container_id] = ip
+        self._used.add(int(ipaddress.ip_address(ip)))
+
+
+@dataclass
+class InterfaceConfig:
+    """One pod interface (ref interfacestore.InterfaceConfig).  Labels are
+    persisted too so restart recovery re-notifies the controller with the
+    pod's REAL selector-relevant labels (an empty-label upsert would evict
+    the pod from every selector group)."""
+
+    container_id: str
+    pod_namespace: str
+    pod_name: str
+    ip: str
+    ofport: int
+    labels: dict = None
+
+    def __post_init__(self):
+        if self.labels is None:
+            self.labels = {}
+
+    def key(self) -> str:
+        return self.container_id
+
+
+class InterfaceStore:
+    """Pod-interface table persisted in the native config store as
+    external-IDs rows — a restarted agent rebuilds from it (agent.go:279;
+    interface store from OVSDB external-IDs)."""
+
+    def __init__(self, store: ConfigStore):
+        self._store = store
+        self._ifaces: dict[str, InterfaceConfig] = {}
+        for key in store.keys():
+            if not key.startswith(_IFACE_PREFIX):
+                continue
+            d = json.loads(store.get(key))
+            ic = InterfaceConfig(**d)
+            self._ifaces[ic.container_id] = ic
+
+    def add(self, ic: InterfaceConfig) -> None:
+        self._ifaces[ic.container_id] = ic
+        # asdict keeps the persisted row in lockstep with the dataclass
+        # (the load path is InterfaceConfig(**row)).
+        self._store.set(
+            _IFACE_PREFIX + ic.container_id,
+            json.dumps(dataclasses.asdict(ic)).encode(),
+        )
+        self._store.commit()
+
+    def delete(self, container_id: str) -> None:
+        self._ifaces.pop(container_id, None)
+        self._store.delete(_IFACE_PREFIX + container_id)
+        self._store.commit()
+
+    def get(self, container_id: str) -> Optional[InterfaceConfig]:
+        return self._ifaces.get(container_id)
+
+    def all(self) -> list[InterfaceConfig]:
+        return sorted(self._ifaces.values(), key=lambda i: i.container_id)
+
+
+class CniServer:
+    """CmdAdd/CmdDel/CmdCheck orchestration (ref cniserver/server.go:430).
+
+    controller: a NetworkPolicyController (or None) receiving pod upserts —
+    the reference's equivalent is the pod informer seeing the kubelet-
+    created pod; feeding it from CmdAdd keeps the single-process test
+    topology deterministic.
+    """
+
+    def __init__(self, node: str, pod_cidr: str, store: ConfigStore,
+                 controller=None):
+        self.node = node
+        self.ipam = HostLocalIPAM(pod_cidr)
+        self.ifaces = InterfaceStore(store)
+        self.controller = controller
+        self._next_ofport = 10
+        # Restart recovery: re-claim addresses + ofports from the store.
+        for ic in self.ifaces.all():
+            self.ipam.mark_used(ic.container_id, ic.ip)
+            self._next_ofport = max(self._next_ofport, ic.ofport + 1)
+            self._notify(ic)
+
+    def _notify(self, ic: InterfaceConfig) -> None:
+        if self.controller is not None:
+            self.controller.upsert_pod(Pod(
+                namespace=ic.pod_namespace, name=ic.pod_name,
+                ip=ic.ip, node=self.node, labels=dict(ic.labels),
+            ))
+
+    def cmd_add(self, container_id: str, pod_namespace: str, pod_name: str,
+                labels: Optional[dict] = None) -> InterfaceConfig:
+        existing = self.ifaces.get(container_id)
+        if existing is not None:
+            return existing  # idempotent ADD (server.go re-delivery path)
+        ip = self.ipam.allocate(container_id)
+        ic = InterfaceConfig(
+            container_id=container_id, pod_namespace=pod_namespace,
+            pod_name=pod_name, ip=ip, ofport=self._next_ofport,
+            labels=dict(labels or {}),
+        )
+        self._next_ofport += 1
+        self.ifaces.add(ic)
+        self._notify(ic)
+        return ic
+
+    def cmd_del(self, container_id: str) -> bool:
+        ic = self.ifaces.get(container_id)
+        if ic is None:
+            return False  # DEL of unknown container succeeds per CNI spec
+        self.ifaces.delete(container_id)
+        self.ipam.release(container_id)
+        if self.controller is not None:
+            # A late/duplicated DEL for an old sandbox must not remove a
+            # RECREATED pod: only delete when no other interface for the
+            # same namespace/name remains (the CNI spec allows stale DELs).
+            same_pod_lives = any(
+                o.pod_namespace == ic.pod_namespace
+                and o.pod_name == ic.pod_name
+                for o in self.ifaces.all()
+            )
+            if not same_pod_lives:
+                self.controller.delete_pod(
+                    f"{ic.pod_namespace}/{ic.pod_name}"
+                )
+        return True
+
+    def cmd_check(self, container_id: str) -> bool:
+        return self.ifaces.get(container_id) is not None
