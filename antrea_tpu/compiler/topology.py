@@ -1,0 +1,339 @@
+"""Node topology -> forwarding tensors (the L2/L3 forwarding plane).
+
+The reference realizes per-node forwarding as OVS tables the agent programs
+from two sources:
+
+  * the node-route controller — one tunnel/route/ARP flow set per remote
+    Node (/root/reference/pkg/agent/controller/noderoute/node_route_controller.go),
+    compiled into L3Forwarding entries "dst in remote podCIDR -> set tunnel
+    dst = peer node IP, output tunnel port";
+  * the CNI server / interface store — one L2ForwardingCalc entry per local
+    pod "dst ip == pod ip -> output pod ofport"
+    (pkg/agent/openflow/pipeline.go L2ForwardingCalc, podConfigurator);
+  plus SpoofGuard (packets entering on a pod port must carry that pod's
+  bound source IP, pipeline.go SpoofGuard), an ARP responder for gateway /
+  remote-gateway addresses (pipeline.go ARPResponder), TrafficControl
+  mirror/redirect marks (pkg/agent/controller/trafficcontrol), and L3DecTTL
+  for routed legs.
+
+Here the same decisions are compiled into sorted tensor tables consumed by
+batched gathers (models/forwarding.py): a packet's output decision is two
+searchsorted probes (local-pod exact match, remote-CIDR interval match) —
+O(log n) per packet, no per-flow entries, and topology swaps are atomic
+tensor swaps like rule bundles.  Tables are padded to power-of-two capacity
+with device-resident row counts so membership churn never changes tensor
+SHAPES (no XLA recompiles — same rationale as ops/match.DeltaTable).
+
+Port number conventions follow the reference's defaults: tunnel ofport 1,
+gateway ofport 2 (pkg/agent/config/node_config.go DefaultTunOFPort /
+DefaultHostGatewayOFPort), pod ports from 3 up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..utils import ip as iputil
+
+# Well-known ofports (ref pkg/agent/config/node_config.go:
+# DefaultTunOFPort=1, DefaultHostGatewayOFPort=2).
+OFPORT_TUNNEL = 1
+OFPORT_GATEWAY = 2
+FIRST_POD_OFPORT = 3
+
+# Forwarding kinds (the Output-stage disposition, ref pipeline.go
+# L2ForwardingCalc/L3Forwarding/Output tables).
+FWD_LOCAL = 0  # dst is a local pod -> output its ofport
+FWD_TUNNEL = 1  # dst in a remote node's podCIDR -> encap to peer, output tunnel
+FWD_GATEWAY = 2  # everything else (external / host / service ext) -> gateway
+FWD_DROP_SPOOF = 3  # SpoofGuard verdict: src doesn't match the ingress port
+FWD_DROP_UNKNOWN = 4  # dst in the LOCAL podCIDR but no such pod -> drop
+
+# TrafficControl actions (ref pkg/apis/crd TrafficControl: Mirror/Redirect).
+TC_NONE = 0
+TC_MIRROR = 1
+TC_REDIRECT = 2
+
+_I32_MAX = 2**31 - 1
+_I32_MIN = -(2**31)
+
+
+@dataclass(frozen=True)
+class NodeRoute:
+    """One remote node's route (ref noderoute controller's per-Node state:
+    nodeRouteInfo — peer node IP is the tunnel destination, podCIDR the
+    routed prefix)."""
+
+    name: str
+    node_ip: str
+    pod_cidr: str
+
+
+@dataclass(frozen=True)
+class TrafficControlRule:
+    """Mirror/redirect mark for a set of pods (ref TrafficControl CRD,
+    pkg/agent/controller/trafficcontrol: appliedTo pods, direction
+    ingress/egress/both, action mirror/redirect, target device port)."""
+
+    name: str
+    pod_ips: tuple
+    action: int  # TC_MIRROR / TC_REDIRECT
+    target_port: int
+    direction: str = "both"  # "ingress" (to pod) / "egress" (from pod) / "both"
+
+
+@dataclass
+class Topology:
+    """One node's forwarding world — the input the agent-side controllers
+    (CNI server + noderoute + trafficcontrol) maintain."""
+
+    node_name: str = ""
+    gateway_ip: str = ""
+    pod_cidr: str = ""  # this node's local pod CIDR ("" = none)
+    local_pods: list = field(default_factory=list)  # [(ip_str, ofport)]
+    remote_nodes: list = field(default_factory=list)  # [NodeRoute]
+    tc_rules: list = field(default_factory=list)  # [TrafficControlRule]
+
+
+class ForwardingTables(NamedTuple):
+    """Device forwarding tables; padded, with device-resident row counts.
+
+    lp_* rows are sorted by flipped pod IP; rn_* rows are sorted disjoint
+    [lo, hi] (inclusive, flipped-space) remote podCIDR intervals.  tc words
+    pack action | target_port << 2.  local_range holds this node's podCIDR
+    as (lo_f, hi_f) — an empty topology uses an empty interval (lo > hi).
+    """
+
+    lp_ip_f: np.ndarray  # (Lcap,) i32 sorted flipped local pod IPs
+    lp_port: np.ndarray  # (Lcap,) i32 ofports
+    lp_tc_in: np.ndarray  # (Lcap,) i32 packed ingress-direction TC word
+    lp_tc_eg: np.ndarray  # (Lcap,) i32 packed egress-direction TC word
+    n_lp: np.ndarray  # (1,) i32 live row count
+    rn_lo_f: np.ndarray  # (Rcap,) i32
+    rn_hi_f: np.ndarray  # (Rcap,) i32 inclusive
+    rn_peer_f: np.ndarray  # (Rcap,) i32 flipped peer node IP
+    n_rn: np.ndarray  # (1,) i32
+    local_range_f: np.ndarray  # (2,) i32 [lo_f, hi_f] of the local podCIDR
+
+
+def _cap(n: int, floor: int = 8) -> int:
+    c = floor
+    while c < n:
+        c <<= 1
+    return c
+
+
+def _flip(u: int) -> int:
+    return int(iputil.flip_u32(np.uint32(u)))
+
+
+def pack_tc(action: int, target_port: int) -> int:
+    return action | (target_port << 2)
+
+
+def unpack_tc(word: int) -> tuple[int, int]:
+    return word & 3, word >> 2
+
+
+def compile_topology(topo: Topology) -> ForwardingTables:
+    """-> host (numpy) ForwardingTables; models/forwarding.fwd_to_device
+    uploads them.  Raises on overlapping remote podCIDRs or duplicate local
+    pod IPs (config errors, never silent last-writer-wins — same observable
+    rule as compile_services)."""
+    # Local pods, sorted by flipped IP.
+    pods = {}
+    by_port = {}
+    for ip, port in topo.local_pods:
+        u = iputil.ip_to_u32(ip)
+        if u == 0xFFFFFFFF:
+            raise ValueError("255.255.255.255 is not a valid pod IP")
+        if u in pods and pods[u] != port:
+            raise ValueError(f"duplicate local pod IP {ip}")
+        if port < FIRST_POD_OFPORT:
+            raise ValueError(f"pod ofport {port} collides with reserved ports")
+        if by_port.get(port, u) != u:
+            # The device SpoofGuard probe relies on the ip<->ofport bijection
+            # (it resolves the pod by source IP, the scalar spec by port).
+            raise ValueError(f"duplicate pod ofport {port}")
+        pods[u] = port
+        by_port[port] = u
+    # TC marks resolve per-pod at compile time (appliedTo is a pod set, ref
+    # trafficcontrol controller resolving appliedTo to ofports). Later rules
+    # win on overlap, matching dict-update order below.
+    tc_in: dict[int, int] = {}
+    tc_eg: dict[int, int] = {}
+    for r in topo.tc_rules:
+        w = pack_tc(r.action, r.target_port)
+        for ip in r.pod_ips:
+            u = iputil.ip_to_u32(ip)
+            if u not in pods:
+                continue  # appliedTo pod not on this node
+            if r.direction in ("ingress", "both"):
+                tc_in[u] = w
+            if r.direction in ("egress", "both"):
+                tc_eg[u] = w
+
+    order = sorted(pods)
+    L = len(order)
+    Lcap = _cap(L)
+    lp_ip_f = np.full(Lcap, _I32_MAX, np.int32)
+    lp_port = np.zeros(Lcap, np.int32)
+    lp_tc_in = np.zeros(Lcap, np.int32)
+    lp_tc_eg = np.zeros(Lcap, np.int32)
+    for i, u in enumerate(order):
+        lp_ip_f[i] = _flip(u)
+        lp_port[i] = pods[u]
+        lp_tc_in[i] = tc_in.get(u, 0)
+        lp_tc_eg[i] = tc_eg.get(u, 0)
+
+    # Remote node podCIDR intervals, sorted by lo; must be disjoint.
+    ranges = []
+    for nr in topo.remote_nodes:
+        lo, hi = iputil.cidr_to_range(nr.pod_cidr)  # [lo, hi) raw u32
+        ranges.append((lo, hi, iputil.ip_to_u32(nr.node_ip), nr.name))
+    ranges.sort()
+    for a, b in zip(ranges, ranges[1:]):
+        if b[0] < a[1]:
+            raise ValueError(
+                f"overlapping remote podCIDRs: {a[3]} and {b[3]}"
+            )
+    R = len(ranges)
+    Rcap = _cap(R)
+    # Padding rows use lo = hi = I32_MAX so rn_hi_f stays ascending for
+    # searchsorted; lookups additionally guard row < n_rn so a broadcast
+    # dst (flips to I32_MAX) can never match a pad row.
+    rn_lo_f = np.full(Rcap, _I32_MAX, np.int32)
+    rn_hi_f = np.full(Rcap, _I32_MAX, np.int32)
+    rn_peer_f = np.zeros(Rcap, np.int32)
+    for i, (lo, hi, peer, _name) in enumerate(ranges):
+        rn_lo_f[i] = _flip(lo)
+        rn_hi_f[i] = _flip(hi - 1)  # inclusive
+        rn_peer_f[i] = _flip(peer)
+
+    if topo.pod_cidr:
+        llo, lhi = iputil.cidr_to_range(topo.pod_cidr)
+        local_range = np.array([_flip(llo), _flip(lhi - 1)], np.int32)
+    else:
+        local_range = np.array([_I32_MAX, _I32_MIN], np.int32)  # empty
+
+    return ForwardingTables(
+        lp_ip_f=lp_ip_f, lp_port=lp_port,
+        lp_tc_in=lp_tc_in, lp_tc_eg=lp_tc_eg,
+        n_lp=np.array([L], np.int32),
+        rn_lo_f=rn_lo_f, rn_hi_f=rn_hi_f, rn_peer_f=rn_peer_f,
+        n_rn=np.array([R], np.int32),
+        local_range_f=local_range,
+    )
+
+
+# ---- host-side ARP responder / MAC scheme -----------------------------------
+
+
+def mac_of_ip(ip: str) -> str:
+    """Deterministic locally-administered MAC for an IP — the analog of the
+    reference deriving pod/gateway interface MACs at configure time
+    (pkg/agent/cniserver/pod_configuration.go interface MAC generation);
+    deterministic so both datapaths and restarted agents agree."""
+    u = iputil.ip_to_u32(ip)
+    return "0a:00:%02x:%02x:%02x:%02x" % (
+        (u >> 24) & 0xFF, (u >> 16) & 0xFF, (u >> 8) & 0xFF, u & 0xFF
+    )
+
+
+def arp_respond(topo: Topology, target_ip: str) -> Optional[str]:
+    """ARP responder (ref pipeline.go ARPResponder: the agent answers ARP
+    for the local gateway and for remote-node gateway/peer addresses so pod
+    ARP never floods the underlay).  Answers for: the local gateway IP,
+    any local pod IP (proxy for intra-node L2), and remote node IPs.
+    -> MAC string, or None when the address is not ours to answer."""
+    if not target_ip:
+        return None
+    if topo.gateway_ip and target_ip == topo.gateway_ip:
+        return mac_of_ip(target_ip)
+    u = iputil.ip_to_u32(target_ip)
+    for ip, _port in topo.local_pods:
+        if iputil.ip_to_u32(ip) == u:
+            return mac_of_ip(target_ip)
+    for nr in topo.remote_nodes:
+        if iputil.ip_to_u32(nr.node_ip) == u:
+            return mac_of_ip(target_ip)
+    return None
+
+
+# ---- scalar oracle (the spec for models/forwarding.py) ----------------------
+
+
+@dataclass
+class ResolvedTopology:
+    """Topology with IPs pre-parsed to u32 — the scalar-spec working form,
+    built ONCE per install so the per-packet oracle loops never re-parse
+    dotted-quad strings (OracleDatapath steps whole batches through these)."""
+
+    pod_by_u32: dict  # u32 -> ofport
+    pod_by_port: dict  # ofport -> u32
+    remote: list  # [(lo, hi_exclusive, peer_u32)] sorted
+    local: Optional[tuple]  # (lo, hi_exclusive) of the local podCIDR
+
+
+def resolve_topology(topo: Topology) -> ResolvedTopology:
+    pod_by_u32 = {iputil.ip_to_u32(ip): port for ip, port in topo.local_pods}
+    remote = sorted(
+        iputil.cidr_to_range(nr.pod_cidr) + (iputil.ip_to_u32(nr.node_ip),)
+        for nr in topo.remote_nodes
+    )
+    return ResolvedTopology(
+        pod_by_u32=pod_by_u32,
+        pod_by_port={p: u for u, p in pod_by_u32.items()},
+        remote=remote,
+        local=iputil.cidr_to_range(topo.pod_cidr) if topo.pod_cidr else None,
+    )
+
+
+def oracle_spoof(rt: ResolvedTopology, src_ip: int, in_port: int) -> bool:
+    """SpoofGuard spec (ref pipeline.go SpoofGuard table): a packet entering
+    on a pod ofport must carry that pod's bound source IP.  Packets from the
+    tunnel/gateway/unset ports are exempt (they were guarded at their own
+    ingress node).  An unknown pod port has no legitimate sender."""
+    if in_port < FIRST_POD_OFPORT:
+        return False
+    return rt.pod_by_port.get(in_port) != src_ip
+
+
+def oracle_forward(rt: ResolvedTopology, dst_ip: int, in_port: int) -> dict:
+    """Scalar forwarding spec -> {kind, out_port, peer_ip, dec_ttl}."""
+    port = rt.pod_by_u32.get(dst_ip)
+    if port is not None:
+        # Routed legs decrement TTL (ref pipeline.go L3DecTTL: traffic
+        # arriving via tunnel or gateway was routed to this pod).
+        dec = in_port in (OFPORT_TUNNEL, OFPORT_GATEWAY)
+        return {"kind": FWD_LOCAL, "out_port": port, "peer_ip": 0,
+                "dec_ttl": dec}
+    for lo, hi, peer in rt.remote:
+        if lo <= dst_ip < hi:
+            return {"kind": FWD_TUNNEL, "out_port": OFPORT_TUNNEL,
+                    "peer_ip": peer, "dec_ttl": True}
+    if rt.local is not None and rt.local[0] <= dst_ip < rt.local[1]:
+        return {"kind": FWD_DROP_UNKNOWN, "out_port": -1, "peer_ip": 0,
+                "dec_ttl": False}
+    return {"kind": FWD_GATEWAY, "out_port": OFPORT_GATEWAY, "peer_ip": 0,
+            "dec_ttl": True}
+
+
+def _tc_from_tables(t: ForwardingTables, src_ip: int, dst_ip: int):
+    def row_of(u):
+        f = _flip(u)
+        i = int(np.searchsorted(t.lp_ip_f, f))
+        if i < int(t.n_lp[0]) and t.lp_ip_f[i] == f:
+            return i
+        return None
+
+    d = row_of(dst_ip)
+    if d is not None and t.lp_tc_in[d]:
+        return unpack_tc(int(t.lp_tc_in[d]))
+    s = row_of(src_ip)
+    if s is not None and t.lp_tc_eg[s]:
+        return unpack_tc(int(t.lp_tc_eg[s]))
+    return TC_NONE, 0

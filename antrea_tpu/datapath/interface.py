@@ -69,6 +69,16 @@ class StepResult:
     # masqueraded so return traffic re-traverses this node (ref
     # pipeline.go SNATMark/NodePortMark tables, proxier.go).
     snat: np.ndarray = None
+    # Forwarding plane (populated once a topology is installed; ref
+    # pipeline.go SpoofGuard/L2ForwardingCalc/L3Forwarding/TrafficControl/
+    # L3DecTTL/Output tables — see compiler/topology.py):
+    spoofed: np.ndarray = None  # 0/1 SpoofGuard drop (src != ingress-port binding)
+    fwd_kind: np.ndarray = None  # topology.FWD_* disposition
+    out_port: np.ndarray = None  # output ofport; -1 = not deliverable
+    peer_ip: np.ndarray = None  # u32 tunnel peer node IP (FWD_TUNNEL only)
+    dec_ttl: np.ndarray = None  # 0/1 routed leg -> decrement TTL
+    tc_act: np.ndarray = None  # topology.TC_* effective TrafficControl action
+    tc_port: np.ndarray = None  # TC mirror/redirect target port
 
 
 class Datapath(ABC):
@@ -102,6 +112,16 @@ class Datapath(ABC):
     ) -> int:
         """Incremental membership update for a named AddressGroup or
         AppliedToGroup; returns the new generation."""
+
+    @abstractmethod
+    def install_topology(self, topo) -> None:
+        """Atomically swap this node's forwarding topology
+        (compiler/topology.Topology: local pods, remote node routes,
+        TrafficControl marks).  The analog of the noderoute controller +
+        CNI flow installs reprogramming L2ForwardingCalc/L3Forwarding
+        (pkg/agent/controller/noderoute, cniserver).  Does not bump the
+        rule generation: forwarding is stateless per-packet, so no cached
+        verdict can go stale."""
 
     @abstractmethod
     def step(self, batch: PacketBatch, now: int) -> StepResult:

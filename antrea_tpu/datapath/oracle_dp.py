@@ -18,6 +18,20 @@ from collections import Counter
 
 from ..apis.controlplane import GroupMember
 from ..compiler.ir import PolicySet
+from ..compiler.topology import (
+    FWD_DROP_SPOOF,
+    FWD_LOCAL,
+    FWD_GATEWAY,
+    FWD_TUNNEL,
+    TC_REDIRECT,
+    Topology,
+    _tc_from_tables,
+    compile_topology,
+    oracle_forward,
+    oracle_spoof,
+    resolve_topology,
+)
+from ..compiler.compile import ACT_ALLOW
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..packet import PacketBatch
 from . import persist
@@ -48,14 +62,20 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         node_name: str = "",
         persist_dir: Optional[str] = None,
         feature_gates=None,
+        topology: Optional[Topology] = None,
     ):
         from ..features import DEFAULT_GATES
 
         self._gates = feature_gates or DEFAULT_GATES
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
+        self._topo = topology
         self._gen = 0
         self._init_persist(persist_dir, ps, services)
+        if self._topo is None:
+            self._topo = Topology()
+        self._ft = compile_topology(self._topo)
+        self._rt = resolve_topology(self._topo)
         self._oracle = PipelineOracle(
             self._ps, self._services,
             flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
@@ -183,6 +203,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
 
         o = self._oracle
         gen_w = self._gen % GEN_ETERNAL
+        in_ports = batch.in_ports()
         out = []
         for i in range(batch.size):
             p = batch.packet(i)
@@ -190,7 +211,13 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             _slot, e = o.lookup(o.flow, p, h, now, gen_w)
             w = o.fresh_walk(o.aff, p, h, now)
             code = e["code"] if e is not None else w["code"]
+            is_rpl = e is not None and e.get("rpl", False)
+            eff_dst = p.dst_ip if is_rpl else w["dnat_ip"]
+            f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             out.append({
+                "spoofed": oracle_spoof(self._rt, p.src_ip, int(in_ports[i])),
+                "fwd_kind": f["kind"],
+                "out_port": f["out_port"],
                 "cache_hit": e is not None,
                 "est": e is not None and e["gen"] is None,
                 "reply": e is not None and e.get("rpl", False),
@@ -209,11 +236,27 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             })
         return out
 
+    def install_topology(self, topo: Topology) -> None:
+        # Compile-then-assign: a rejected topology leaves state unchanged.
+        ft = compile_topology(topo)
+        self._topo = topo
+        self._ft = ft
+        self._rt = resolve_topology(topo)
+        self._persist_topology()
+
     def step(self, batch: PacketBatch, now: int) -> StepResult:
-        outs = self._oracle.step(batch, now, gen=self._gen)
+        in_ports = batch.in_ports()
+        valid = [
+            not oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i]))
+            for i in range(batch.size)
+        ]
+        outs = self._oracle.step(batch, now, gen=self._gen, valid=valid)
+        fwd = self._forward_fields(batch, outs, in_ports)
         if not self._gates.enabled("NetworkPolicyStats"):
-            return self._to_result(outs)
+            return self._to_result(outs, fwd)
         for o in outs:
+            if o.skipped:
+                continue  # SpoofGuard drop: before the policy tables
             if o.ingress_rule is not None:
                 self._stats_in[o.ingress_rule] += 1
             if o.egress_rule is not None:
@@ -223,9 +266,50 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                     self._default_allow += 1
                 else:
                     self._default_deny += 1
-        return self._to_result(outs)
+        return self._to_result(outs, fwd)
 
-    def _to_result(self, outs) -> StepResult:
+    def _forward_fields(self, batch: PacketBatch, outs, in_ports) -> list[dict]:
+        """Per-lane forwarding decision via the scalar spec
+        (compiler/topology.oracle_forward + TC resolution), mirroring
+        models/forwarding._pipeline_step_full's output gating exactly."""
+        rows = []
+        for i, o in enumerate(outs):
+            if o.skipped:
+                rows.append({"spoofed": 1, "fwd_kind": FWD_DROP_SPOOF,
+                             "out_port": -1, "peer_ip": 0, "dec_ttl": 0,
+                             "tc_act": 0, "tc_port": 0})
+                continue
+            # Replies forward to their literal dst (the client); their dnat
+            # fields carry the source un-rewrite.
+            eff_dst = int(batch.dst_ip[i]) if o.reply else o.dnat_ip
+            f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
+            deliverable = o.code == ACT_ALLOW and f["kind"] in (
+                FWD_LOCAL, FWD_TUNNEL, FWD_GATEWAY
+            )
+            if deliverable:
+                tc_act, tc_port = _tc_from_tables(
+                    self._ft, int(batch.src_ip[i]), eff_dst
+                )
+            else:
+                tc_act, tc_port = 0, 0
+            out_port = f["out_port"] if deliverable else -1
+            if tc_act == TC_REDIRECT:
+                out_port = tc_port
+            rows.append({
+                "spoofed": 0,
+                "fwd_kind": f["kind"],
+                "out_port": out_port,
+                "peer_ip": f["peer_ip"] if deliverable else 0,
+                "dec_ttl": int(f["dec_ttl"]) if deliverable else 0,
+                "tc_act": tc_act,
+                "tc_port": tc_port,
+            })
+        return rows
+
+    def _to_result(self, outs, fwd) -> StepResult:
+        def col(key, dtype=np.int32):
+            return np.array([r[key] for r in fwd], dtype)
+
         return StepResult(
             code=np.array([o.code for o in outs], np.int32),
             est=np.array([int(o.est) for o in outs], np.int32),
@@ -235,8 +319,15 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             ingress_rule=[o.ingress_rule for o in outs],
             egress_rule=[o.egress_rule for o in outs],
             committed=np.array([int(o.committed) for o in outs], np.int32),
-            n_miss=sum(1 for o in outs if not o.hit),
+            n_miss=sum(1 for o in outs if not (o.hit or o.skipped)),
             reply=np.array([int(o.reply) for o in outs], np.int32),
             reject_kind=np.array([o.reject_kind for o in outs], np.int32),
             snat=np.array([o.snat for o in outs], np.int32),
+            spoofed=col("spoofed"),
+            fwd_kind=col("fwd_kind"),
+            out_port=col("out_port"),
+            peer_ip=col("peer_ip", np.uint32),
+            dec_ttl=col("dec_ttl"),
+            tc_act=col("tc_act"),
+            tc_port=col("tc_port"),
         )

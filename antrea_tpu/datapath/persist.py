@@ -93,6 +93,35 @@ def load_snapshot(persist_dir: str):
         return None
 
 
+# Topology persists in its OWN small file, written per topology event —
+# O(topology) disk work, not O(policy-state); the analog of the reference
+# persisting port rows in OVSDB (one row per pod interface) separately from
+# flow state.  Snapshots never carry topology.
+_TOPO_FILE = "topology.json"
+
+
+def topology_path(persist_dir: str) -> str:
+    return os.path.join(persist_dir, _TOPO_FILE)
+
+
+def save_topology(persist_dir: str, topo) -> None:
+    atomic_write_json(topology_path(persist_dir), {
+        "v": SNAPSHOT_VERSION,
+        "topology": serde.encode_topology(topo),
+    })
+
+
+def load_topology(persist_dir: str):
+    """-> Topology or None (absent/unreadable == fresh boot)."""
+    body = read_json(topology_path(persist_dir))
+    if body is None or body.get("v") != SNAPSHOT_VERSION:
+        return None
+    try:
+        return serde.decode_topology(body["topology"])
+    except (ValueError, KeyError, TypeError, AttributeError):
+        return None
+
+
 class PersistableDatapath:
     """Shared restart-persistence behavior for Datapath implementations
     (single source of truth for the recovery contract; both datapaths mix
@@ -127,6 +156,12 @@ class PersistableDatapath:
             snap = load_snapshot(persist_dir)
             if snap is not None:
                 self._ps, self._services, self._gen = snap
+        # Topology restores independently of the rule snapshot; an
+        # explicitly-passed topology wins (same contract as ps/services).
+        if getattr(self, "_topo", None) is None:
+            topo = load_topology(persist_dir)
+            if topo is not None:
+                self._topo = topo
         # The round journal is consulted UNCONDITIONALLY: even a datapath
         # reconstructed with explicit state must resume past the durable
         # round, or its first bump would overwrite the journal with a
@@ -150,6 +185,10 @@ class PersistableDatapath:
             save_snapshot(self._persist_dir, self._ps, self._services, self._gen)
             self._record_round()
         self._persist_dirty = False
+
+    def _persist_topology(self) -> None:
+        if self._persist_dir is not None:
+            save_topology(self._persist_dir, self._topo)
 
     def checkpoint(self) -> None:
         """Flush a pending (delta-dirtied) snapshot to disk."""

@@ -38,6 +38,9 @@ from ..apis.service import ServiceEntry
 from ..compiler.compile import compile_policy_set
 from ..compiler.ir import PolicySet
 from ..compiler.services import compile_services
+from ..compiler import topology
+from ..compiler.topology import FWD_TUNNEL, Topology, compile_topology
+from ..models import forwarding as fwd
 from ..models import pipeline as pl
 from ..ops.match import DeltaTable, to_device
 from ..packet import PacketBatch
@@ -61,6 +64,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         node_name: str = "",
         persist_dir: Optional[str] = None,
         feature_gates=None,
+        topology: Optional[Topology] = None,
     ):
         from ..features import DEFAULT_GATES
 
@@ -77,6 +81,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         )
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
+        self._topo = topology  # None -> snapshot topology, else empty
         self._gen = 0
         # Restart recovery (cookie-round analog, datapath/persist.py): when
         # constructed WITHOUT explicit state, reload the last committed
@@ -91,8 +96,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._default_allow = 0
         self._default_deny = 0
         self._evictions = 0
+        if self._topo is None:
+            self._topo = Topology()
         self._compile_rules()
         self._compile_services()
+        self._compile_topology()
 
     # -- Datapath ------------------------------------------------------------
 
@@ -184,16 +192,29 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._record_round()
         return self._gen
 
+    def install_topology(self, topo: Topology) -> None:
+        # Compile BEFORE assigning: a rejected topology (overlapping CIDRs,
+        # duplicate pods) must leave spec (self._topo, backs trace) and
+        # device tables consistent on the previous value.
+        ft = compile_topology(topo)
+        self._topo = topo
+        self._ft = ft
+        self._rt = topology.resolve_topology(topo)
+        self._dft = fwd.fwd_to_device(ft)
+        self._persist_topology()
+
     def step(self, batch: PacketBatch, now: int) -> StepResult:
-        state, out = pl.pipeline_step(
+        state, out = fwd.pipeline_step_full(
             self._state,
             self._drs,
             self._dsvc,
+            self._dft,
             jnp.asarray(iputil.flip_u32(batch.src_ip)),
             jnp.asarray(iputil.flip_u32(batch.dst_ip)),
             jnp.asarray(batch.proto.astype(np.int32)),
             jnp.asarray(batch.src_port.astype(np.int32)),
             jnp.asarray(batch.dst_port.astype(np.int32)),
+            jnp.asarray(batch.in_ports()),
             jnp.int32(now),
             jnp.int32(self._gen),
             meta=self._meta,
@@ -204,6 +225,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids)
+
+        def unflip(col):
+            return (col.astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32)
+
         return StepResult(
             code=o["code"],
             est=o["est"],
@@ -211,7 +236,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             reject_kind=o["reject_kind"],
             snat=o["snat"],
             svc_idx=o["svc_idx"],
-            dnat_ip=(o["dnat_ip_f"].astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32),
+            dnat_ip=unflip(o["dnat_ip_f"]),
             dnat_port=o["dnat_port"],
             ingress_rule=[
                 in_ids[i] if 0 <= i < len(in_ids) and in_ids[i] else None
@@ -223,6 +248,18 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             ],
             committed=o["committed"],
             n_miss=int(o["n_miss"]),
+            spoofed=o["spoofed"],
+            fwd_kind=o["fwd_kind"],
+            out_port=o["out_port"],
+            # peer_f is zeroed for non-deliverable lanes in the kernel; the
+            # (kind==TUNNEL & deliverable) gate avoids un-flipping that 0.
+            peer_ip=np.where(
+                (o["fwd_kind"] == FWD_TUNNEL) & (o["out_port"] != -1),
+                unflip(o["peer_f"]), 0,
+            ).astype(np.uint32),
+            dec_ttl=o["dec_ttl"],
+            tc_act=o["tc_act"],
+            tc_port=o["tc_port"],
         )
 
     def stats(self) -> DatapathStats:
@@ -326,8 +363,18 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         def rid(ids, i):
             return ids[i] if 0 <= i < len(ids) and ids[i] else None
 
+        from ..compiler.topology import oracle_forward, oracle_spoof
+
+        in_ports = batch.in_ports()
         out = []
         for i in range(batch.size):
+            # Forwarding observations via the scalar spec (read-only slow
+            # path; identical semantics to the fused kernel — test-enforced
+            # via the step() parity suite).
+            dnat_u = iputil.unflip_u32(o["dnat_ip_f"][i])
+            eff_dst = int(batch.dst_ip[i]) if o["reply"][i] else dnat_u
+            spoofed = oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i]))
+            f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             out.append({
                 "cache_hit": bool(o["cache_hit"][i]),
                 "est": bool(o["est"][i]),
@@ -336,7 +383,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 "snat": int(o["snat"][i]),
                 "svc_idx": int(o["svc_idx"][i]),
                 "no_ep": bool(o["no_ep"][i]),
-                "dnat_ip": int(np.uint32(o["dnat_ip_f"][i] ^ np.int32(-(2**31)))),
+                "dnat_ip": dnat_u,
                 "dnat_port": int(o["dnat_port"][i]),
                 "egress_code": int(o["egress_code"][i]),
                 "egress_rule": rid(out_ids, int(o["egress_rule"][i])),
@@ -344,6 +391,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 "ingress_rule": rid(in_ids, int(o["ingress_rule"][i])),
                 "fresh_code": int(o["fresh_code"][i]),
                 "code": int(o["code"][i]),
+                "spoofed": spoofed,
+                "fwd_kind": f["kind"],
+                "out_port": f["out_port"],
             })
         return out
 
@@ -352,6 +402,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
     def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
         if not self._gates.enabled("NetworkPolicyStats"):
             return
+        # SpoofGuard drops happen BEFORE the policy tables (stage order) and
+        # must not pollute NetworkPolicy metrics.
+        spoofed = o.get("spoofed")
+        not_spoofed = None if spoofed is None else (spoofed == 0)
         for key, ids, ctr in (
             ("ingress_rule", in_ids, self._stats_in),
             ("egress_rule", out_ids, self._stats_out),
@@ -366,6 +420,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                     if ids[r]:
                         ctr[ids[r]] += int(bc[r])
         none_mask = (o["ingress_rule"] < 0) & (o["egress_rule"] < 0)
+        if not_spoofed is not None:
+            none_mask = none_mask & not_spoofed
         self._default_allow += int(((o["code"] == 0) & none_mask).sum())
         self._default_deny += int(((o["code"] != 0) & none_mask).sum())
 
@@ -435,6 +491,14 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._dsvc = pl.svc_to_device(compile_services(
             self._services, node_ips=self._node_ips, node_name=self._node_name
         ))
+
+    def _compile_topology(self) -> None:
+        # Atomic swap, like rule bundles: the next step() sees either the
+        # old or the new forwarding tables, never a mix.  The host copy
+        # backs trace() (slow-path observability, scalar spec functions).
+        self._ft = compile_topology(self._topo)
+        self._rt = topology.resolve_topology(self._topo)
+        self._dft = fwd.fwd_to_device(self._ft)
 
     def _ranges_of(self, name: str) -> list[tuple[int, int]]:
         """Current merged ranges of a named group (members + static blocks)."""

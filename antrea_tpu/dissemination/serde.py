@@ -218,6 +218,49 @@ def decode_service_entry(d: dict) -> ServiceEntry:
     )
 
 
+# -- Topology (forwarding plane; datapath snapshots) -------------------------
+
+
+def encode_topology(t) -> dict:
+    return {
+        "node": t.node_name,
+        "gatewayIP": t.gateway_ip,
+        "podCIDR": t.pod_cidr,
+        "localPods": [[ip, port] for ip, port in t.local_pods],
+        "remoteNodes": [
+            {"name": n.name, "nodeIP": n.node_ip, "podCIDR": n.pod_cidr}
+            for n in t.remote_nodes
+        ],
+        "tcRules": [
+            {"name": r.name, "podIPs": list(r.pod_ips), "action": r.action,
+             "targetPort": r.target_port, "direction": r.direction}
+            for r in t.tc_rules
+        ],
+    }
+
+
+def decode_topology(d: dict):
+    from ..compiler.topology import NodeRoute, Topology, TrafficControlRule
+
+    return Topology(
+        node_name=d.get("node", ""),
+        gateway_ip=d.get("gatewayIP", ""),
+        pod_cidr=d.get("podCIDR", ""),
+        local_pods=[(ip, port) for ip, port in d.get("localPods", ())],
+        remote_nodes=[
+            NodeRoute(name=n["name"], node_ip=n["nodeIP"], pod_cidr=n["podCIDR"])
+            for n in d.get("remoteNodes", ())
+        ],
+        tc_rules=[
+            TrafficControlRule(
+                name=r["name"], pod_ips=tuple(r["podIPs"]), action=r["action"],
+                target_port=r["targetPort"], direction=r.get("direction", "both"),
+            )
+            for r in d.get("tcRules", ())
+        ],
+    )
+
+
 # -- WatchEvent (the dissemination wire unit) --------------------------------
 
 

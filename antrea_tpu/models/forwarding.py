@@ -1,0 +1,201 @@
+"""Batched forwarding stage: SpoofGuard -> (pipeline) -> L2/L3 forward -> Output.
+
+Device twin of compiler/topology.py's scalar spec — the forwarding tables
+the reference programs as OVS L2ForwardingCalc / L3Forwarding / SpoofGuard /
+TrafficControl / L3DecTTL / Output entries
+(/root/reference/pkg/agent/openflow/pipeline.go:114-195), evaluated here as
+two searchsorted probes + row gathers per packet, fused into the same XLA
+program as the policy pipeline (`pipeline_step_full`) so the whole
+per-packet walk is one device dispatch.
+
+Placement of SpoofGuard matters for state parity: in the reference it sits
+BEFORE conntrack/policy tables (framework.go stage order), so a spoofed
+packet must neither refresh nor commit conntrack state — realized by
+threading its mask as the pipeline's `valid` lane mask, which excludes
+those lanes from cache refresh, slow-path classification and commit (a
+spoofed ALLOW that committed an eternal entry would est-bypass a later
+deny for the legitimate tuple).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..compiler.compile import ACT_ALLOW, ACT_DROP
+from ..compiler.topology import (
+    FIRST_POD_OFPORT,
+    FWD_DROP_SPOOF,
+    FWD_DROP_UNKNOWN,
+    FWD_GATEWAY,
+    FWD_LOCAL,
+    FWD_TUNNEL,
+    OFPORT_GATEWAY,
+    OFPORT_TUNNEL,
+    TC_REDIRECT,
+    ForwardingTables,
+)
+from . import pipeline as pl
+
+
+class DeviceForwardingTables(NamedTuple):
+    lp_ip_f: jax.Array
+    lp_port: jax.Array
+    lp_tc_in: jax.Array
+    lp_tc_eg: jax.Array
+    n_lp: jax.Array
+    rn_lo_f: jax.Array
+    rn_hi_f: jax.Array
+    rn_peer_f: jax.Array
+    n_rn: jax.Array
+    local_range_f: jax.Array
+
+
+def fwd_to_device(ft: ForwardingTables) -> DeviceForwardingTables:
+    return DeviceForwardingTables(*[jnp.asarray(c) for c in ft])
+
+
+def _lp_row(dft: DeviceForwardingTables, ip_f: jax.Array):
+    """-> (row, known) local-pod probe by flipped IP."""
+    cap = dft.lp_ip_f.shape[0]
+    row = jnp.clip(jnp.searchsorted(dft.lp_ip_f, ip_f), 0, cap - 1)
+    known = (row < dft.n_lp[0]) & (dft.lp_ip_f[row] == ip_f)
+    return row, known
+
+
+def spoof_lookup(dft: DeviceForwardingTables, src_f: jax.Array, in_port: jax.Array):
+    """SpoofGuard (ref pipeline.go SpoofGuard): packets entering on a pod
+    ofport must source the IP bound to that port.  Resolves the pod by
+    source IP (the table is an ip<->ofport bijection, enforced at compile)."""
+    row, known = _lp_row(dft, src_f)
+    pod_in = in_port >= FIRST_POD_OFPORT
+    return pod_in & (~known | (dft.lp_port[row] != in_port))
+
+
+def forwarding_lookup(
+    dft: DeviceForwardingTables, dst_f: jax.Array, in_port: jax.Array
+):
+    """L2ForwardingCalc + L3Forwarding + L3DecTTL
+    -> dict(kind, out_port, peer_f, dec_ttl, lp_row, is_local)."""
+    row, is_local = _lp_row(dft, dst_f)
+    rcap = dft.rn_lo_f.shape[0]
+    r = jnp.clip(jnp.searchsorted(dft.rn_hi_f, dst_f), 0, rcap - 1)
+    in_rn = (
+        (r < dft.n_rn[0])
+        & (dft.rn_lo_f[r] <= dst_f)
+        & (dst_f <= dft.rn_hi_f[r])
+    )
+    in_local_cidr = (dft.local_range_f[0] <= dst_f) & (
+        dst_f <= dft.local_range_f[1]
+    )
+    kind = jnp.where(
+        is_local,
+        FWD_LOCAL,
+        jnp.where(
+            in_rn,
+            FWD_TUNNEL,
+            jnp.where(in_local_cidr, FWD_DROP_UNKNOWN, FWD_GATEWAY),
+        ),
+    ).astype(jnp.int32)
+    out_port = jnp.where(
+        is_local,
+        dft.lp_port[row],
+        jnp.where(
+            in_rn,
+            OFPORT_TUNNEL,
+            jnp.where(in_local_cidr, -1, OFPORT_GATEWAY),
+        ),
+    ).astype(jnp.int32)
+    peer_f = jnp.where(in_rn & ~is_local, dft.rn_peer_f[r], 0)
+    # L3DecTTL: every routed leg — egress via tunnel/gateway, or local
+    # delivery of traffic that ARRIVED routed (tunnel/gateway ingress).
+    routed_in = (in_port == OFPORT_TUNNEL) | (in_port == OFPORT_GATEWAY)
+    dec_ttl = jnp.where(
+        is_local, routed_in, in_rn | (kind == FWD_GATEWAY)
+    ).astype(jnp.int32)
+    return {
+        "kind": kind,
+        "out_port": out_port,
+        "peer_f": peer_f,
+        "dec_ttl": dec_ttl,
+        "lp_row": row,
+        "is_local": is_local,
+    }
+
+
+def tc_lookup(
+    dft: DeviceForwardingTables,
+    src_f: jax.Array,
+    dst_row: jax.Array,
+    dst_is_local: jax.Array,
+):
+    """TrafficControl mark (ref trafficcontrol controller): dst pod's
+    ingress word wins, else src pod's egress word.  -> packed word."""
+    srow, sknown = _lp_row(dft, src_f)
+    w_in = jnp.where(dst_is_local, dft.lp_tc_in[dst_row], 0)
+    w_eg = jnp.where(sknown, dft.lp_tc_eg[srow], 0)
+    return jnp.where(w_in != 0, w_in, w_eg)
+
+
+def _pipeline_step_full(
+    state: pl.PipelineState,
+    drs,
+    dsvc,
+    dft: DeviceForwardingTables,
+    src_f: jax.Array,
+    dst_f: jax.Array,
+    proto: jax.Array,
+    sport: jax.Array,
+    dport: jax.Array,
+    in_port: jax.Array,
+    now: jax.Array,
+    gen: jax.Array,
+    *,
+    meta: pl.PipelineMeta,
+    hit_combine=None,
+):
+    """Full per-packet walk: SpoofGuard -> policy/service pipeline ->
+    forwarding -> Output; one jit, one dispatch."""
+    spoof = spoof_lookup(dft, src_f, in_port)
+    state, out = pl._pipeline_step(
+        state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
+        meta=meta, hit_combine=hit_combine, valid=~spoof,
+    )
+    code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
+    # Forward toward the packet's effective destination: the DNAT-resolved
+    # endpoint — except reply-direction hits, whose dnat fields carry the
+    # SOURCE un-rewrite; a reply forwards to its literal dst (the client).
+    eff_dst = jnp.where(out["reply"] == 1, dst_f, out["dnat_ip_f"])
+    fwd = forwarding_lookup(dft, eff_dst, in_port)
+    kind = jnp.where(spoof, FWD_DROP_SPOOF, fwd["kind"]).astype(jnp.int32)
+    deliverable = (code == ACT_ALLOW) & (
+        (kind == FWD_LOCAL) | (kind == FWD_TUNNEL) | (kind == FWD_GATEWAY)
+    )
+    tc_w = jnp.where(
+        deliverable, tc_lookup(dft, src_f, fwd["lp_row"], fwd["is_local"]), 0
+    )
+    tc_act = tc_w & 3
+    tc_port = tc_w >> 2
+    out_port = jnp.where(deliverable, fwd["out_port"], -1)
+    # Redirect replaces the output port (ref TrafficControl redirect action:
+    # the packet leaves via the target device instead of its computed port).
+    out_port = jnp.where(tc_act == TC_REDIRECT, tc_port, out_port)
+    out.update(
+        code=code,
+        reject_kind=pl.reject_kind_of(code, proto),
+        spoofed=spoof.astype(jnp.int32),
+        fwd_kind=kind,
+        out_port=out_port.astype(jnp.int32),
+        peer_f=jnp.where(deliverable, fwd["peer_f"], 0),
+        dec_ttl=jnp.where(deliverable, fwd["dec_ttl"], 0),
+        tc_act=tc_act,
+        tc_port=tc_port,
+    )
+    return state, out
+
+
+pipeline_step_full = jax.jit(
+    _pipeline_step_full, static_argnames=("meta", "hit_combine")
+)

@@ -429,6 +429,7 @@ def _pipeline_step(
     *,
     meta: PipelineMeta,
     hit_combine=None,
+    valid=None,
 ):
     flow, aff = state.flow, state.aff
     B = src_f.shape[0]
@@ -449,6 +450,14 @@ def _pipeline_step(
     hit, est, rpl, mr = _cache_lookup(
         flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
     )
+    if valid is not None:
+        # Lane mask (SpoofGuard gating, models/forwarding.py): excluded
+        # lanes neither refresh nor commit any state and take the fast-path
+        # default image — the stage order of the reference, where
+        # SpoofGuard drops happen BEFORE conntrack/policy tables.
+        hit = hit & valid
+        est = est & valid
+        rpl = rpl & valid
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
     c_dnat_ip = mr[:, 0]
     c_rule_in, c_rule_out = _unpack_rules(mr[:, 2])
@@ -508,7 +517,7 @@ def _pipeline_step(
 
     flow = jax.lax.cond(p_need.any(), partner_refresh, lambda f: f, flow)
 
-    miss = ~hit
+    miss = ~hit if valid is None else (~hit & valid)
     n_miss = miss.sum(dtype=jnp.int32)
 
     # Fast-path output images (+1 dump element for masked slow-path scatter).

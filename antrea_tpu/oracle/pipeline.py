@@ -59,6 +59,9 @@ class ScalarOutcome:
     reply: bool = False  # reverse-tuple (reply-direction) conntrack hit
     reject_kind: int = 0  # 0 none / 1 tcp-rst / 2 icmp-port-unreachable
     snat: int = 0  # SNAT mark: external frontend under ETP=Cluster
+    # Lane excluded by the caller's valid mask (SpoofGuard gating): dropped
+    # BEFORE the pipeline — no state touched, not a cache miss either.
+    skipped: bool = False
 
 
 def _reject_kind(code: int, proto: int) -> int:
@@ -250,7 +253,9 @@ class PipelineOracle:
             "egress_rule": v.egress.rule,
         }
 
-    def step(self, batch: PacketBatch, now: int, gen: int = 0) -> list[ScalarOutcome]:
+    def step(
+        self, batch: PacketBatch, now: int, gen: int = 0, valid=None
+    ) -> list[ScalarOutcome]:
         # The device packs entry generations into GEN_BITS (22) bits, with
         # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
         # against the same wrapped value so spec and device agree across the
@@ -265,8 +270,19 @@ class PipelineOracle:
         pref_updates: list[int] = []
         learns: list[tuple[int, dict]] = []
 
+        from ..compiler.compile import ACT_DROP
+
         for i in range(batch.size):
             p = batch.packet(i)
+            if valid is not None and not valid[i]:
+                # SpoofGuard-gated lane: dropped before conntrack/policy
+                # tables — no lookup, no refresh, no commit (stage order of
+                # the reference, framework.go; see models/forwarding.py).
+                outs.append(ScalarOutcome(
+                    ACT_DROP, False, -1, p.dst_ip, p.dst_port, None, None,
+                    False, skipped=True,
+                ))
+                continue
             h = self._flow_hash(p)
             slot, e = self.lookup(flow0, p, h, now, gen)
             if e is not None:

@@ -39,10 +39,21 @@ class PacketBatch:
     proto: np.ndarray
     src_port: np.ndarray
     dst_port: np.ndarray
+    # Ingress ofport per packet (SpoofGuard input; compiler/topology.py
+    # conventions: 1 tunnel, 2 gateway, >=3 pod ports, -1 unset/external —
+    # the reference's Classifier-stage in_port match, pipeline.go
+    # Classifier/SpoofGuard).  None == all -1 (no pod-port ingress).
+    in_port: np.ndarray = None
 
     @property
     def size(self) -> int:
         return int(self.src_ip.shape[0])
+
+    def in_ports(self) -> np.ndarray:
+        """in_port column, defaulting to -1 (non-pod ingress)."""
+        if self.in_port is None:
+            return np.full(self.size, -1, np.int32)
+        return self.in_port.astype(np.int32)
 
     @staticmethod
     def from_packets(packets: list[Packet]) -> "PacketBatch":

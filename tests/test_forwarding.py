@@ -1,0 +1,399 @@
+"""Forwarding-plane tests: SpoofGuard, L2/L3 forwarding, TrafficControl,
+L3DecTTL, ARP responder, node-route controller — semantics from the
+reference's table inventory (pkg/agent/openflow/pipeline.go SpoofGuard /
+L2ForwardingCalc / L3Forwarding / TrafficControl / L3DecTTL / Output) and
+the noderoute controller (pkg/agent/controller/noderoute).
+
+The differential discipline matches tests/test_datapath.py: everything
+drives the Datapath boundary and diffs tpuflow against the oracle.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from antrea_tpu.compiler.topology import (
+    FWD_DROP_SPOOF,
+    FWD_DROP_UNKNOWN,
+    FWD_GATEWAY,
+    FWD_LOCAL,
+    FWD_TUNNEL,
+    OFPORT_GATEWAY,
+    OFPORT_TUNNEL,
+    TC_MIRROR,
+    TC_NONE,
+    TC_REDIRECT,
+    NodeRoute,
+    Topology,
+    TrafficControlRule,
+    arp_respond,
+    compile_topology,
+    mac_of_ip,
+)
+from antrea_tpu.agent.noderoute import NodeRouteController
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.simulator import gen_cluster, gen_traffic
+from antrea_tpu.simulator.genservice import gen_services
+from antrea_tpu.utils import ip as iputil
+
+
+def _topo(tc_rules=()):
+    """A 3-node world as seen from node-a: pods 10.10.0.0/24 local (ofports
+    3/4/5), nodes b/c remote."""
+    return Topology(
+        node_name="node-a",
+        gateway_ip="10.10.0.1",
+        pod_cidr="10.10.0.0/24",
+        local_pods=[("10.10.0.5", 3), ("10.10.0.6", 4), ("10.10.0.7", 5)],
+        remote_nodes=[
+            NodeRoute(name="node-b", node_ip="192.168.1.2", pod_cidr="10.10.1.0/24"),
+            NodeRoute(name="node-c", node_ip="192.168.1.3", pod_cidr="10.10.2.0/24"),
+        ],
+        tc_rules=list(tc_rules),
+    )
+
+
+def _batch(rows):
+    """rows: [(src, dst, in_port)] -> TCP/80 PacketBatch."""
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(s) for s, _, _ in rows], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(d) for _, d, _ in rows], np.uint32),
+        proto=np.full(len(rows), 6, np.int32),
+        src_port=np.full(len(rows), 40000, np.int32),
+        dst_port=np.full(len(rows), 80, np.int32),
+        in_port=np.array([p for _, _, p in rows], np.int32),
+    )
+
+
+def _pair(topo, ps=None, services=None):
+    tpu = TpuflowDatapath(
+        copy.deepcopy(ps), services, flow_slots=1 << 12, aff_slots=1 << 10,
+        miss_chunk=64, topology=topo,
+    )
+    orc = OracleDatapath(
+        copy.deepcopy(ps), services, flow_slots=1 << 12, aff_slots=1 << 10,
+        topology=topo,
+    )
+    return tpu, orc
+
+
+def _diff_fwd(a, b):
+    for f in ("code", "spoofed", "fwd_kind", "out_port", "peer_ip",
+              "dec_ttl", "tc_act", "tc_port", "est", "committed"):
+        av, bv = getattr(a, f), getattr(b, f)
+        assert av.tolist() == bv.tolist(), f
+    assert a.n_miss == b.n_miss
+
+
+# ---- forwarding kinds -------------------------------------------------------
+
+
+def test_forward_kinds_and_ports():
+    tpu, orc = _pair(_topo())
+    rows = [
+        ("10.10.0.5", "10.10.0.6", 3),   # pod->pod local
+        ("10.10.0.5", "10.10.1.9", 3),   # pod->remote node-b
+        ("10.10.0.6", "10.10.2.20", 4),  # pod->remote node-c
+        ("10.10.0.5", "8.8.8.8", 3),     # pod->external via gateway
+        ("10.10.0.5", "10.10.0.99", 3),  # local CIDR, no such pod
+        ("10.10.1.9", "10.10.0.5", OFPORT_TUNNEL),  # tunnel ingress -> local
+    ]
+    b = _batch(rows)
+    ra, rb = tpu.step(b, now=100), orc.step(b, now=100)
+    _diff_fwd(ra, rb)
+    assert ra.fwd_kind.tolist() == [
+        FWD_LOCAL, FWD_TUNNEL, FWD_TUNNEL, FWD_GATEWAY,
+        FWD_DROP_UNKNOWN, FWD_LOCAL,
+    ]
+    assert ra.out_port.tolist() == [4, OFPORT_TUNNEL, OFPORT_TUNNEL,
+                                    OFPORT_GATEWAY, -1, 3]
+    assert ra.peer_ip.tolist() == [
+        0, iputil.ip_to_u32("192.168.1.2"), iputil.ip_to_u32("192.168.1.3"),
+        0, 0, 0,
+    ]
+    # L3DecTTL: routed legs only — intra-node pod->pod keeps its TTL;
+    # tunnel/gateway egress and routed local delivery decrement.
+    assert ra.dec_ttl.tolist() == [0, 1, 1, 1, 0, 1]
+
+
+def test_empty_topology_routes_to_gateway():
+    tpu, orc = _pair(Topology())
+    b = _batch([("1.2.3.4", "5.6.7.8", -1)])
+    ra, rb = tpu.step(b, now=1), orc.step(b, now=1)
+    _diff_fwd(ra, rb)
+    assert ra.fwd_kind.tolist() == [FWD_GATEWAY]
+    assert ra.out_port.tolist() == [OFPORT_GATEWAY]
+
+
+# ---- SpoofGuard -------------------------------------------------------------
+
+
+def test_spoofguard_drops_wrong_source():
+    tpu, orc = _pair(_topo())
+    rows = [
+        ("10.10.0.5", "10.10.0.6", 3),   # correct binding
+        ("10.10.0.6", "10.10.0.7", 3),   # pod 3 spoofing pod 4's address
+        ("9.9.9.9", "10.10.0.6", 4),     # unknown source from a pod port
+        ("9.9.9.9", "10.10.0.6", OFPORT_TUNNEL),  # tunnel ingress: exempt
+        ("10.10.0.5", "10.10.0.6", 77),  # unknown pod port: nothing legit
+    ]
+    b = _batch(rows)
+    ra, rb = tpu.step(b, now=5), orc.step(b, now=5)
+    _diff_fwd(ra, rb)
+    assert ra.spoofed.tolist() == [0, 1, 1, 0, 1]
+    assert ra.fwd_kind.tolist()[1] == FWD_DROP_SPOOF
+    assert ra.code.tolist()[1] == 1  # dropped
+    assert ra.out_port.tolist()[1] == -1
+
+
+def test_spoofed_packet_commits_no_state():
+    """SpoofGuard sits before conntrack (framework.go stage order): a
+    spoofed packet must not create an established entry that would later
+    bypass a deny for the same tuple."""
+    from antrea_tpu.compiler.ir import PolicySet
+
+    tpu, orc = _pair(_topo(), ps=PolicySet())
+    spoofed = _batch([("10.10.0.6", "10.10.0.7", 3)])  # wrong port binding
+    ra = tpu.step(spoofed, now=10)
+    rb = orc.step(spoofed, now=10)
+    _diff_fwd(ra, rb)
+    assert tpu.cache_stats()["occupied"] == 0
+    assert orc.cache_stats()["occupied"] == 0
+    # The same tuple from the RIGHT port (4) classifies fresh — not est.
+    legit = _batch([("10.10.0.6", "10.10.0.7", 4)])
+    ra2, rb2 = tpu.step(legit, now=11), orc.step(legit, now=11)
+    _diff_fwd(ra2, rb2)
+    assert ra2.est.tolist() == [0]
+    assert ra2.committed.tolist() == [1]
+
+
+# ---- TrafficControl ---------------------------------------------------------
+
+
+def test_trafficcontrol_mirror_and_redirect():
+    tc = [
+        TrafficControlRule(name="mirror-7", pod_ips=("10.10.0.7",),
+                           action=TC_MIRROR, target_port=99, direction="ingress"),
+        TrafficControlRule(name="redirect-5", pod_ips=("10.10.0.5",),
+                           action=TC_REDIRECT, target_port=88, direction="egress"),
+    ]
+    tpu, orc = _pair(_topo(tc))
+    rows = [
+        ("10.10.0.6", "10.10.0.7", 4),  # to mirrored pod: mirror, port kept
+        ("10.10.0.5", "10.10.1.9", 3),  # from redirected pod: output -> 88
+        ("10.10.0.6", "10.10.1.9", 4),  # unaffected
+    ]
+    b = _batch(rows)
+    ra, rb = tpu.step(b, now=20), orc.step(b, now=20)
+    _diff_fwd(ra, rb)
+    assert ra.tc_act.tolist() == [TC_MIRROR, TC_REDIRECT, TC_NONE]
+    assert ra.out_port.tolist() == [5, 88, OFPORT_TUNNEL]
+    assert ra.tc_port.tolist() == [99, 88, 0]
+
+
+# ---- service DNAT + forwarding composition ---------------------------------
+
+
+def test_service_dnat_forwards_to_endpoint_and_reply_to_client():
+    """A ClusterIP flow DNATs to an endpoint and forwards toward IT (local
+    or tunnel); the reply leg forwards toward the CLIENT, not the un-DNAT
+    frontend (UnSNAT restores the source only)."""
+    svc = ServiceEntry(
+        cluster_ip="10.96.0.10", port=80, protocol=6,
+        endpoints=[Endpoint(ip="10.10.1.9", port=8080, node="node-b")],
+        name="web", namespace="default",
+    )
+    tpu, orc = _pair(_topo(), services=[svc])
+    fwd = _batch([("10.10.0.5", "10.96.0.10", 3)])
+    ra, rb = tpu.step(fwd, now=30), orc.step(fwd, now=30)
+    _diff_fwd(ra, rb)
+    # DNAT to the node-b endpoint -> tunnel to node-b.
+    assert ra.fwd_kind.tolist() == [FWD_TUNNEL]
+    assert ra.peer_ip.tolist() == [iputil.ip_to_u32("192.168.1.2")]
+    # Reply: endpoint -> client, entering via the tunnel.
+    reply = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32("10.10.1.9")], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32("10.10.0.5")], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([8080], np.int32),
+        dst_port=np.array([40000], np.int32),
+        in_port=np.array([OFPORT_TUNNEL], np.int32),
+    )
+    ra2, rb2 = tpu.step(reply, now=31), orc.step(reply, now=31)
+    _diff_fwd(ra2, rb2)
+    assert ra2.reply.tolist() == [1]
+    # Un-DNAT source rewrite reported in dnat fields; forwarding goes to
+    # the client pod locally.
+    assert ra2.dnat_ip.tolist() == [iputil.ip_to_u32("10.96.0.10")]
+    assert ra2.fwd_kind.tolist() == [FWD_LOCAL]
+    assert ra2.out_port.tolist() == [3]
+    assert ra2.dec_ttl.tolist() == [1]  # arrived via tunnel: routed leg
+
+
+# ---- randomized differential ------------------------------------------------
+
+
+def test_forwarding_parity_random():
+    """Random policy/service/topology world; every packet gets a random
+    in_port (pod/tunnel/gateway/unset) — full StepResult parity."""
+    rng = np.random.default_rng(11)
+    cluster = gen_cluster(150, n_nodes=4, pods_per_node=8, seed=9)
+    services = gen_services(10, cluster.pod_ips, seed=10)
+    # Build a topology over the cluster's pods: node 0 is "us".
+    pod_ips = [iputil.u32_to_ip(u) for u in cluster.pod_ips]
+    local = pod_ips[:8]
+    topo = Topology(
+        node_name="node-0",
+        gateway_ip="10.0.0.1",
+        pod_cidr="10.0.0.0/26",
+        local_pods=[(ip, 3 + i) for i, ip in enumerate(local)],
+        remote_nodes=[
+            NodeRoute(name=f"node-{k}", node_ip=f"192.168.0.{k+1}",
+                      pod_cidr=f"10.0.{k}.0/26")
+            for k in range(1, 4)
+        ],
+        tc_rules=[TrafficControlRule(
+            name="mirror-0", pod_ips=(local[0],), action=TC_MIRROR,
+            target_port=200, direction="both",
+        )],
+    )
+    # gen_cluster pods may not align with /26 splits; rebuild ranges from
+    # actual pod ips per node instead if needed — keep packets synthetic.
+    tpu, orc = _pair(topo, ps=cluster.ps, services=services)
+    tr = gen_traffic(cluster.pod_ips, 256, n_flows=96, seed=12,
+                     services=services, svc_fraction=0.3)
+    ports = rng.choice(
+        np.array([-1, OFPORT_TUNNEL, OFPORT_GATEWAY, 3, 4, 5, 6], np.int32),
+        size=256,
+    )
+    for t in range(4):
+        b = PacketBatch(
+            src_ip=tr.src_ip, dst_ip=tr.dst_ip, proto=tr.proto,
+            src_port=tr.src_port, dst_port=tr.dst_port, in_port=ports,
+        )
+        ra, rb = tpu.step(b, now=40 + t), orc.step(b, now=40 + t)
+        _diff_fwd(ra, rb)
+
+
+# ---- compile-time validation ------------------------------------------------
+
+
+def test_compile_rejects_bad_topologies():
+    with pytest.raises(ValueError):
+        compile_topology(Topology(local_pods=[("10.0.0.5", 3), ("10.0.0.5", 4)]))
+    with pytest.raises(ValueError):
+        compile_topology(Topology(local_pods=[("10.0.0.5", 3), ("10.0.0.6", 3)]))
+    with pytest.raises(ValueError):
+        compile_topology(Topology(local_pods=[("10.0.0.5", OFPORT_TUNNEL)]))
+    with pytest.raises(ValueError):
+        compile_topology(Topology(remote_nodes=[
+            NodeRoute("b", "1.1.1.1", "10.0.0.0/24"),
+            NodeRoute("c", "1.1.1.2", "10.0.0.128/25"),
+        ]))
+
+
+# ---- ARP responder / MACs ---------------------------------------------------
+
+
+def test_arp_responder():
+    t = _topo()
+    assert arp_respond(t, "10.10.0.1") == mac_of_ip("10.10.0.1")  # gateway
+    assert arp_respond(t, "10.10.0.5") == mac_of_ip("10.10.0.5")  # local pod
+    assert arp_respond(t, "192.168.1.2") is not None  # remote node
+    assert arp_respond(t, "8.8.8.8") is None  # not ours
+    assert mac_of_ip("10.10.0.5") == "0a:00:0a:0a:00:05"
+
+
+# ---- node-route controller --------------------------------------------------
+
+
+def test_noderoute_controller_reconciles():
+    tpu = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    ctl = NodeRouteController(tpu, "node-a", pod_cidr="10.10.0.0/24",
+                              gateway_ip="10.10.0.1")
+    ctl.pod_added("10.10.0.5", 3)
+    ctl.upsert_node("node-b", "192.168.1.2", "10.10.1.0/24")
+    ctl.upsert_node("node-a", "192.168.1.1", "10.10.0.0/24")  # self: ignored
+    b = _batch([("10.10.0.5", "10.10.1.9", 3)])
+    r = tpu.step(b, now=1)
+    assert r.fwd_kind.tolist() == [FWD_TUNNEL]
+    assert r.peer_ip.tolist() == [iputil.ip_to_u32("192.168.1.2")]
+    # Node deletion: the route disappears, dst falls back to gateway.
+    ctl.delete_node("node-b")
+    r2 = tpu.step(b, now=2)
+    assert r2.fwd_kind.tolist() == [FWD_GATEWAY]
+    # Pod deletion: local delivery stops.
+    ctl.pod_deleted("10.10.0.5")
+    b2 = _batch([("10.10.1.9", "10.10.0.5", OFPORT_TUNNEL)])
+    r3 = tpu.step(b2, now=3)
+    assert r3.fwd_kind.tolist() == [FWD_DROP_UNKNOWN]
+
+
+def test_noderoute_syncs_from_interface_store(tmp_path):
+    """CNI-created interfaces feed the topology; a restarted controller
+    rebuilds local-pod forwarding from the persisted interface store
+    (agent.go:279 restart model)."""
+    from antrea_tpu.agent.cni import CniServer
+    from antrea_tpu.native import ConfigStore
+
+    store = ConfigStore(str(tmp_path / "conf.db"))
+    cni = CniServer("node-a", "10.10.0.0/26", store)
+    ic = cni.cmd_add("c1", "default", "web-1")
+    tpu = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    ctl = NodeRouteController(tpu, "node-a", pod_cidr="10.10.0.0/26")
+    ctl.sync_interfaces(cni.ifaces.all())
+    b = _batch([("10.10.1.9", ic.ip, OFPORT_TUNNEL)])
+    assert tpu.step(b, now=1).fwd_kind.tolist() == [FWD_LOCAL]
+    assert tpu.step(b, now=1).out_port.tolist() == [ic.ofport]
+
+    # Restart: fresh store handle, fresh controller — same forwarding.
+    store2 = ConfigStore(str(tmp_path / "conf.db"))
+    cni2 = CniServer("node-a", "10.10.0.0/26", store2)
+    tpu2 = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    ctl2 = NodeRouteController(tpu2, "node-a", pod_cidr="10.10.0.0/26")
+    ctl2.sync_interfaces(cni2.ifaces.all())
+    assert tpu2.step(b, now=2).out_port.tolist() == [ic.ofport]
+
+
+# ---- topology persistence ---------------------------------------------------
+
+
+def test_topology_survives_datapath_restart(tmp_path):
+    topo = _topo()
+    tpu = TpuflowDatapath(
+        flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64,
+        persist_dir=str(tmp_path),
+    )
+    tpu.install_topology(topo)
+    b = _batch([("10.10.0.5", "10.10.1.9", 3)])
+    assert tpu.step(b, now=1).fwd_kind.tolist() == [FWD_TUNNEL]
+    # Reconstruct without explicit state: snapshot restores the topology.
+    tpu2 = TpuflowDatapath(
+        flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64,
+        persist_dir=str(tmp_path),
+    )
+    r = tpu2.step(b, now=2)
+    assert r.fwd_kind.tolist() == [FWD_TUNNEL]
+    assert r.peer_ip.tolist() == [iputil.ip_to_u32("192.168.1.2")]
+
+
+# ---- trace parity -----------------------------------------------------------
+
+
+def test_trace_reports_forwarding():
+    tpu, orc = _pair(_topo())
+    b = _batch([
+        ("10.10.0.5", "10.10.0.6", 3),
+        ("10.10.0.6", "10.10.0.7", 3),  # spoofed
+        ("10.10.0.5", "10.10.1.9", 3),
+    ])
+    ta, tb = tpu.trace(b, now=1), orc.trace(b, now=1)
+    for ra, rb in zip(ta, tb):
+        assert ra["spoofed"] == rb["spoofed"]
+        assert ra["fwd_kind"] == rb["fwd_kind"]
+        assert ra["out_port"] == rb["out_port"]
+    assert [r["spoofed"] for r in ta] == [False, True, False]
+    assert [r["fwd_kind"] for r in ta] == [FWD_LOCAL, FWD_LOCAL, FWD_TUNNEL]
