@@ -38,6 +38,7 @@ class NodeRouteController:
         self._nodes: dict[str, NodeRoute] = {}
         self._pods: dict[str, int] = {}  # pod ip -> ofport
         self._tc: dict[str, TrafficControlRule] = {}
+        self._mcast: list = []  # [McastGroup], owned by MulticastController
         # No install at construction: the datapath may hold a
         # snapshot-restored topology, and clobbering it with this (still
         # empty) view would blackhole forwarding until the first
@@ -94,6 +95,17 @@ class NodeRouteController:
             del tc[name]
             self._commit(tc=tc)
 
+    # -- multicast groups (owned by MulticastController) ---------------------
+
+    def set_mcast_groups(self, groups: list) -> None:
+        prev = self._mcast
+        self._mcast = list(groups)
+        try:
+            self._dp.install_topology(self.topology)
+        except Exception:
+            self._mcast = prev
+            raise
+
     # -- state ---------------------------------------------------------------
 
     @property
@@ -105,6 +117,7 @@ class NodeRouteController:
             local_pods=sorted(self._pods.items()),
             remote_nodes=[self._nodes[k] for k in sorted(self._nodes)],
             tc_rules=[self._tc[k] for k in sorted(self._tc)],
+            mcast_groups=list(self._mcast),
         )
 
     def node_route(self, name: str) -> Optional[NodeRoute]:

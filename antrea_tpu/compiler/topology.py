@@ -51,6 +51,21 @@ FWD_TUNNEL = 1  # dst in a remote node's podCIDR -> encap to peer, output tunnel
 FWD_GATEWAY = 2  # everything else (external / host / service ext) -> gateway
 FWD_DROP_SPOOF = 3  # SpoofGuard verdict: src doesn't match the ingress port
 FWD_DROP_UNKNOWN = 4  # dst in the LOCAL podCIDR but no such pod -> drop
+FWD_MCAST = 5  # dst is a joined multicast group -> replicate (MulticastOutput)
+FWD_DROP_MCAST = 6  # multicast dst with no receivers -> drop (MulticastRouting miss)
+FWD_PUNT = 7  # punted to the controller (IGMP packet-in, packetin.go:44)
+
+# Pseudo-port for multicast replication (the consumer resolves the actual
+# port list via Datapath.mcast_group(mcast_idx)).
+OFPORT_REPLICATE = -2
+
+# IGMP protocol number (membership reports/queries are punted, never
+# forwarded — ref pkg/agent/multicast IGMP snooping via packet-in).
+PROTO_IGMP = 2
+
+# 224.0.0.0/4 in flipped-i32 space (iputil.flip_u32 semantics).
+MCAST_LO_F = 0x60000000
+MCAST_HI_F = 0x6FFFFFFF
 
 # TrafficControl actions (ref pkg/apis/crd TrafficControl: Mirror/Redirect).
 TC_NONE = 0
@@ -85,10 +100,21 @@ class TrafficControlRule:
     direction: str = "both"  # "ingress" (to pod) / "egress" (from pod) / "both"
 
 
+@dataclass(frozen=True)
+class McastGroup:
+    """One joined multicast group (ref pkg/agent/multicast GroupMemberStatus:
+    local receiver ofports from IGMP snooping + remote nodes with interest
+    for the inter-node replication leg)."""
+
+    group_ip: str
+    local_ports: tuple = ()
+    remote_nodes: tuple = ()  # node names; resolved to peer IPs at replicate
+
+
 @dataclass
 class Topology:
     """One node's forwarding world — the input the agent-side controllers
-    (CNI server + noderoute + trafficcontrol) maintain."""
+    (CNI server + noderoute + trafficcontrol + multicast) maintain."""
 
     node_name: str = ""
     gateway_ip: str = ""
@@ -96,6 +122,7 @@ class Topology:
     local_pods: list = field(default_factory=list)  # [(ip_str, ofport)]
     remote_nodes: list = field(default_factory=list)  # [NodeRoute]
     tc_rules: list = field(default_factory=list)  # [TrafficControlRule]
+    mcast_groups: list = field(default_factory=list)  # [McastGroup]
 
 
 class ForwardingTables(NamedTuple):
@@ -117,6 +144,8 @@ class ForwardingTables(NamedTuple):
     rn_peer_f: np.ndarray  # (Rcap,) i32 flipped peer node IP
     n_rn: np.ndarray  # (1,) i32
     local_range_f: np.ndarray  # (2,) i32 [lo_f, hi_f] of the local podCIDR
+    mc_ip_f: np.ndarray  # (Mcap,) i32 sorted flipped joined group IPs
+    n_mc: np.ndarray  # (1,) i32
 
 
 def _cap(n: int, floor: int = 8) -> int:
@@ -219,6 +248,19 @@ def compile_topology(topo: Topology) -> ForwardingTables:
     else:
         local_range = np.array([_I32_MAX, _I32_MIN], np.int32)  # empty
 
+    # Joined multicast groups, sorted by flipped group IP; the row index is
+    # the mcast_idx the kernel reports (Datapath.mcast_group resolves it).
+    mg = sorted({_flip(iputil.ip_to_u32(g.group_ip)) for g in topo.mcast_groups})
+    if len(mg) != len(topo.mcast_groups):
+        raise ValueError("duplicate multicast group")
+    for f in mg:
+        if not (MCAST_LO_F <= f <= MCAST_HI_F):
+            raise ValueError("mcast group outside 224.0.0.0/4")
+    M = len(mg)
+    Mcap = _cap(M)
+    mc_ip_f = np.full(Mcap, _I32_MAX, np.int32)
+    mc_ip_f[:M] = np.array(mg, np.int32) if M else mc_ip_f[:0]
+
     return ForwardingTables(
         lp_ip_f=lp_ip_f, lp_port=lp_port,
         lp_tc_in=lp_tc_in, lp_tc_eg=lp_tc_eg,
@@ -226,6 +268,8 @@ def compile_topology(topo: Topology) -> ForwardingTables:
         rn_lo_f=rn_lo_f, rn_hi_f=rn_hi_f, rn_peer_f=rn_peer_f,
         n_rn=np.array([R], np.int32),
         local_range_f=local_range,
+        mc_ip_f=mc_ip_f,
+        n_mc=np.array([M], np.int32),
     )
 
 
@@ -276,6 +320,11 @@ class ResolvedTopology:
     pod_by_port: dict  # ofport -> u32
     remote: list  # [(lo, hi_exclusive, peer_u32)] sorted
     local: Optional[tuple]  # (lo, hi_exclusive) of the local podCIDR
+    # Multicast: groups in table order (sorted by u32 == sorted by flipped
+    # i32, so idx here == the kernel's mcast_idx) + the idx lookup map.
+    mcast: list = field(default_factory=list)  # [McastGroup], table order
+    mcast_idx: dict = field(default_factory=dict)  # group u32 -> idx
+    node_ip_by_name: dict = field(default_factory=dict)  # remote node -> u32
 
 
 def resolve_topology(topo: Topology) -> ResolvedTopology:
@@ -284,12 +333,43 @@ def resolve_topology(topo: Topology) -> ResolvedTopology:
         iputil.cidr_to_range(nr.pod_cidr) + (iputil.ip_to_u32(nr.node_ip),)
         for nr in topo.remote_nodes
     )
+    mg = sorted(
+        (iputil.ip_to_u32(g.group_ip), g) for g in topo.mcast_groups
+    )
     return ResolvedTopology(
         pod_by_u32=pod_by_u32,
         pod_by_port={p: u for u, p in pod_by_u32.items()},
         remote=remote,
         local=iputil.cidr_to_range(topo.pod_cidr) if topo.pod_cidr else None,
+        mcast=[g for _u, g in mg],
+        mcast_idx={u: i for i, (u, _g) in enumerate(mg)},
+        node_ip_by_name={
+            nr.name: iputil.ip_to_u32(nr.node_ip) for nr in topo.remote_nodes
+        },
     )
+
+
+def is_mcast_u32(ip: int) -> bool:
+    return 0xE0000000 <= ip <= 0xEFFFFFFF
+
+
+def mcast_group_of(rt: ResolvedTopology, idx: int) -> Optional[dict]:
+    """mcast_idx -> {group, ports (local receiver ofports), peers (remote
+    node IPs, u32)} — the MulticastOutput replication bucket list (ref
+    pkg/agent/openflow/multicast.go group buckets: one bucket per local
+    receiver port + one per interested remote node)."""
+    if not (0 <= idx < len(rt.mcast)):
+        return None
+    g = rt.mcast[idx]
+    return {
+        "group": g.group_ip,
+        "ports": list(g.local_ports),
+        "peers": [
+            rt.node_ip_by_name[n]
+            for n in g.remote_nodes
+            if n in rt.node_ip_by_name
+        ],
+    }
 
 
 def oracle_spoof(rt: ResolvedTopology, src_ip: int, in_port: int) -> bool:
@@ -303,7 +383,16 @@ def oracle_spoof(rt: ResolvedTopology, src_ip: int, in_port: int) -> bool:
 
 
 def oracle_forward(rt: ResolvedTopology, dst_ip: int, in_port: int) -> dict:
-    """Scalar forwarding spec -> {kind, out_port, peer_ip, dec_ttl}."""
+    """Scalar forwarding spec -> {kind, out_port, peer_ip, dec_ttl
+    [, mcast_idx]}."""
+    if is_mcast_u32(dst_ip):
+        idx = rt.mcast_idx.get(dst_ip)
+        if idx is None:
+            # MulticastRouting miss: no receivers anywhere -> drop.
+            return {"kind": FWD_DROP_MCAST, "out_port": -1, "peer_ip": 0,
+                    "dec_ttl": False, "mcast_idx": -1}
+        return {"kind": FWD_MCAST, "out_port": OFPORT_REPLICATE, "peer_ip": 0,
+                "dec_ttl": False, "mcast_idx": idx}
     port = rt.pod_by_u32.get(dst_ip)
     if port is not None:
         # Routed legs decrement TTL (ref pipeline.go L3DecTTL: traffic

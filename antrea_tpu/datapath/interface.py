@@ -79,6 +79,13 @@ class StepResult:
     dec_ttl: np.ndarray = None  # 0/1 routed leg -> decrement TTL
     tc_act: np.ndarray = None  # topology.TC_* effective TrafficControl action
     tc_port: np.ndarray = None  # TC mirror/redirect target port
+    # 0/1 — punted to the controller instead of forwarded (IGMP membership
+    # traffic; ref packetin.go PacketInCategoryIGMP).  Punted lanes touch no
+    # conntrack/policy state.
+    punt: np.ndarray = None
+    # Joined-group table row for FWD_MCAST lanes (-1 otherwise); resolve the
+    # replication set via Datapath.mcast_group(idx).
+    mcast_idx: np.ndarray = None
 
 
 class Datapath(ABC):

@@ -22,11 +22,16 @@ from ..compiler.topology import (
     FWD_DROP_SPOOF,
     FWD_LOCAL,
     FWD_GATEWAY,
+    FWD_MCAST,
+    FWD_PUNT,
     FWD_TUNNEL,
+    PROTO_IGMP,
     TC_REDIRECT,
     Topology,
     _tc_from_tables,
     compile_topology,
+    is_mcast_u32,
+    mcast_group_of,
     oracle_forward,
     oracle_spoof,
     resolve_topology,
@@ -244,14 +249,29 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         self._rt = resolve_topology(topo)
         self._persist_topology()
 
+    def mcast_group(self, idx: int) -> Optional[dict]:
+        """Resolve a StepResult.mcast_idx to its replication set (the
+        MulticastOutput bucket list, ref pkg/agent/openflow/multicast.go)."""
+        return mcast_group_of(self._rt, idx)
+
     def step(self, batch: PacketBatch, now: int) -> StepResult:
         in_ports = batch.in_ports()
-        valid = [
-            not oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i]))
-            for i in range(batch.size)
-        ]
-        outs = self._oracle.step(batch, now, gen=self._gen, valid=valid)
-        fwd = self._forward_fields(batch, outs, in_ports)
+        O = self._oracle
+        lane_modes = []
+        no_commit = []
+        for i in range(batch.size):
+            if oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i])):
+                lane_modes.append(O.LANE_SPOOF)
+            elif int(batch.proto[i]) == PROTO_IGMP:
+                lane_modes.append(O.LANE_PUNT)
+            else:
+                lane_modes.append(O.LANE_NORMAL)
+            no_commit.append(is_mcast_u32(int(batch.dst_ip[i])))
+        outs = self._oracle.step(
+            batch, now, gen=self._gen, lane_modes=lane_modes,
+            no_commit=no_commit,
+        )
+        fwd = self._forward_fields(batch, outs, in_ports, lane_modes)
         if not self._gates.enabled("NetworkPolicyStats"):
             return self._to_result(outs, fwd)
         for o in outs:
@@ -268,25 +288,35 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                     self._default_deny += 1
         return self._to_result(outs, fwd)
 
-    def _forward_fields(self, batch: PacketBatch, outs, in_ports) -> list[dict]:
+    def _forward_fields(
+        self, batch: PacketBatch, outs, in_ports, lane_modes
+    ) -> list[dict]:
         """Per-lane forwarding decision via the scalar spec
         (compiler/topology.oracle_forward + TC resolution), mirroring
         models/forwarding._pipeline_step_full's output gating exactly."""
+        O = self._oracle
         rows = []
         for i, o in enumerate(outs):
-            if o.skipped:
-                rows.append({"spoofed": 1, "fwd_kind": FWD_DROP_SPOOF,
+            if lane_modes[i] == O.LANE_SPOOF:
+                rows.append({"spoofed": 1, "punt": 0,
+                             "fwd_kind": FWD_DROP_SPOOF,
                              "out_port": -1, "peer_ip": 0, "dec_ttl": 0,
-                             "tc_act": 0, "tc_port": 0})
+                             "tc_act": 0, "tc_port": 0, "mcast_idx": -1})
+                continue
+            if lane_modes[i] == O.LANE_PUNT:
+                rows.append({"spoofed": 0, "punt": 1, "fwd_kind": FWD_PUNT,
+                             "out_port": -1, "peer_ip": 0, "dec_ttl": 0,
+                             "tc_act": 0, "tc_port": 0, "mcast_idx": -1})
                 continue
             # Replies forward to their literal dst (the client); their dnat
             # fields carry the source un-rewrite.
             eff_dst = int(batch.dst_ip[i]) if o.reply else o.dnat_ip
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             deliverable = o.code == ACT_ALLOW and f["kind"] in (
-                FWD_LOCAL, FWD_TUNNEL, FWD_GATEWAY
+                FWD_LOCAL, FWD_TUNNEL, FWD_GATEWAY, FWD_MCAST
             )
-            if deliverable:
+            uni_deliverable = deliverable and f["kind"] != FWD_MCAST
+            if uni_deliverable:
                 tc_act, tc_port = _tc_from_tables(
                     self._ft, int(batch.src_ip[i]), eff_dst
                 )
@@ -297,12 +327,14 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 out_port = tc_port
             rows.append({
                 "spoofed": 0,
+                "punt": 0,
                 "fwd_kind": f["kind"],
                 "out_port": out_port,
-                "peer_ip": f["peer_ip"] if deliverable else 0,
-                "dec_ttl": int(f["dec_ttl"]) if deliverable else 0,
+                "peer_ip": f["peer_ip"] if uni_deliverable else 0,
+                "dec_ttl": int(f["dec_ttl"]) if uni_deliverable else 0,
                 "tc_act": tc_act,
                 "tc_port": tc_port,
+                "mcast_idx": f.get("mcast_idx", -1) if deliverable else -1,
             })
         return rows
 
@@ -324,6 +356,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             reject_kind=np.array([o.reject_kind for o in outs], np.int32),
             snat=np.array([o.snat for o in outs], np.int32),
             spoofed=col("spoofed"),
+            punt=col("punt"),
+            mcast_idx=col("mcast_idx"),
             fwd_kind=col("fwd_kind"),
             out_port=col("out_port"),
             peer_ip=col("peer_ip", np.uint32),

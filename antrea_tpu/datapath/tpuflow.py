@@ -249,6 +249,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             committed=o["committed"],
             n_miss=int(o["n_miss"]),
             spoofed=o["spoofed"],
+            punt=o["punt"],
+            mcast_idx=o["mcast_idx"],
             fwd_kind=o["fwd_kind"],
             out_port=o["out_port"],
             # peer_f is zeroed for non-deliverable lanes in the kernel; the
@@ -324,6 +326,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 "last_seen": int(ts[i]),
             })
         return out
+
+    def mcast_group(self, idx: int) -> Optional[dict]:
+        """Resolve a StepResult.mcast_idx to its replication set (the
+        MulticastOutput bucket list, ref pkg/agent/openflow/multicast.go)."""
+        return topology.mcast_group_of(self._rt, idx)
 
     def cache_stats(self) -> dict:
         """Flow-cache census + cumulative evictions (weak-#5 surface):
@@ -402,10 +409,13 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
     def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
         if not self._gates.enabled("NetworkPolicyStats"):
             return
-        # SpoofGuard drops happen BEFORE the policy tables (stage order) and
-        # must not pollute NetworkPolicy metrics.
+        # SpoofGuard drops and IGMP punts happen BEFORE the policy tables
+        # (stage order) and must not pollute NetworkPolicy metrics.
         spoofed = o.get("spoofed")
         not_spoofed = None if spoofed is None else (spoofed == 0)
+        punt = o.get("punt")
+        if punt is not None and not_spoofed is not None:
+            not_spoofed = not_spoofed & (punt == 0)
         for key, ids, ctr in (
             ("ingress_rule", in_ids, self._stats_in),
             ("egress_rule", out_ids, self._stats_out),

@@ -236,11 +236,18 @@ def encode_topology(t) -> dict:
              "targetPort": r.target_port, "direction": r.direction}
             for r in t.tc_rules
         ],
+        "mcastGroups": [
+            {"group": g.group_ip, "ports": list(g.local_ports),
+             "nodes": list(g.remote_nodes)}
+            for g in t.mcast_groups
+        ],
     }
 
 
 def decode_topology(d: dict):
-    from ..compiler.topology import NodeRoute, Topology, TrafficControlRule
+    from ..compiler.topology import (
+        McastGroup, NodeRoute, Topology, TrafficControlRule,
+    )
 
     return Topology(
         node_name=d.get("node", ""),
@@ -257,6 +264,11 @@ def decode_topology(d: dict):
                 target_port=r["targetPort"], direction=r.get("direction", "both"),
             )
             for r in d.get("tcRules", ())
+        ],
+        mcast_groups=[
+            McastGroup(group_ip=g["group"], local_ports=tuple(g["ports"]),
+                       remote_nodes=tuple(g["nodes"]))
+            for g in d.get("mcastGroups", ())
         ],
     )
 

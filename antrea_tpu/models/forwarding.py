@@ -27,13 +27,20 @@ import jax.numpy as jnp
 from ..compiler.compile import ACT_ALLOW, ACT_DROP
 from ..compiler.topology import (
     FIRST_POD_OFPORT,
+    FWD_DROP_MCAST,
     FWD_DROP_SPOOF,
     FWD_DROP_UNKNOWN,
     FWD_GATEWAY,
     FWD_LOCAL,
+    FWD_MCAST,
+    FWD_PUNT,
     FWD_TUNNEL,
+    MCAST_HI_F,
+    MCAST_LO_F,
     OFPORT_GATEWAY,
+    OFPORT_REPLICATE,
     OFPORT_TUNNEL,
+    PROTO_IGMP,
     TC_REDIRECT,
     ForwardingTables,
 )
@@ -51,6 +58,8 @@ class DeviceForwardingTables(NamedTuple):
     rn_peer_f: jax.Array
     n_rn: jax.Array
     local_range_f: jax.Array
+    mc_ip_f: jax.Array
+    n_mc: jax.Array
 
 
 def fwd_to_device(ft: ForwardingTables) -> DeviceForwardingTables:
@@ -90,30 +99,53 @@ def forwarding_lookup(
     in_local_cidr = (dft.local_range_f[0] <= dst_f) & (
         dst_f <= dft.local_range_f[1]
     )
+    # Multicast (ref pipeline.go MulticastRouting/MulticastOutput): a
+    # 224.0.0.0/4 dst resolves against the joined-group table; a hit
+    # replicates (the consumer resolves the port list from mcast_idx), a
+    # miss drops.  Precedence over the unicast branches — mcast addresses
+    # can't collide with pod IPs or podCIDRs.
+    is_mc = (dst_f >= MCAST_LO_F) & (dst_f <= MCAST_HI_F)
+    mcap = dft.mc_ip_f.shape[0]
+    mrow = jnp.clip(jnp.searchsorted(dft.mc_ip_f, dst_f), 0, mcap - 1)
+    mc_hit = is_mc & (mrow < dft.n_mc[0]) & (dft.mc_ip_f[mrow] == dst_f)
+    mcast_idx = jnp.where(mc_hit, mrow, -1).astype(jnp.int32)
+
     kind = jnp.where(
-        is_local,
-        FWD_LOCAL,
+        is_mc,
+        jnp.where(mc_hit, FWD_MCAST, FWD_DROP_MCAST),
         jnp.where(
-            in_rn,
-            FWD_TUNNEL,
-            jnp.where(in_local_cidr, FWD_DROP_UNKNOWN, FWD_GATEWAY),
+            is_local,
+            FWD_LOCAL,
+            jnp.where(
+                in_rn,
+                FWD_TUNNEL,
+                jnp.where(in_local_cidr, FWD_DROP_UNKNOWN, FWD_GATEWAY),
+            ),
         ),
     ).astype(jnp.int32)
     out_port = jnp.where(
-        is_local,
-        dft.lp_port[row],
+        is_mc,
+        jnp.where(mc_hit, OFPORT_REPLICATE, -1),
         jnp.where(
-            in_rn,
-            OFPORT_TUNNEL,
-            jnp.where(in_local_cidr, -1, OFPORT_GATEWAY),
+            is_local,
+            dft.lp_port[row],
+            jnp.where(
+                in_rn,
+                OFPORT_TUNNEL,
+                jnp.where(in_local_cidr, -1, OFPORT_GATEWAY),
+            ),
         ),
     ).astype(jnp.int32)
-    peer_f = jnp.where(in_rn & ~is_local, dft.rn_peer_f[r], 0)
+    peer_f = jnp.where(in_rn & ~is_local & ~is_mc, dft.rn_peer_f[r], 0)
     # L3DecTTL: every routed leg — egress via tunnel/gateway, or local
     # delivery of traffic that ARRIVED routed (tunnel/gateway ingress).
+    # Multicast replication does not decrement here (the reference's
+    # multicast pipeline skips L3DecTTL).
     routed_in = (in_port == OFPORT_TUNNEL) | (in_port == OFPORT_GATEWAY)
     dec_ttl = jnp.where(
-        is_local, routed_in, in_rn | (kind == FWD_GATEWAY)
+        is_mc,
+        0,
+        jnp.where(is_local, routed_in, in_rn | (kind == FWD_GATEWAY)),
     ).astype(jnp.int32)
     return {
         "kind": kind,
@@ -122,6 +154,8 @@ def forwarding_lookup(
         "dec_ttl": dec_ttl,
         "lp_row": row,
         "is_local": is_local,
+        "is_mc": is_mc,
+        "mcast_idx": mcast_idx,
     }
 
 
@@ -156,12 +190,21 @@ def _pipeline_step_full(
     meta: pl.PipelineMeta,
     hit_combine=None,
 ):
-    """Full per-packet walk: SpoofGuard -> policy/service pipeline ->
-    forwarding -> Output; one jit, one dispatch."""
+    """Full per-packet walk: SpoofGuard -> (IGMP punt) -> policy/service
+    pipeline -> forwarding -> Output; one jit, one dispatch."""
     spoof = spoof_lookup(dft, src_f, in_port)
+    # IGMP membership traffic is punted to the controller, never forwarded
+    # (ref packetin.go PacketInCategoryIGMP; pkg/agent/multicast snooping):
+    # excluded from the policy pipeline like spoofed lanes so reports
+    # neither commit conntrack state nor count as policy verdicts.
+    igmp = ~spoof & (proto == PROTO_IGMP)
+    # Multicast data traffic bypasses conntrack (multicast.go): classified
+    # every step, never cached.
+    is_mc = (dst_f >= MCAST_LO_F) & (dst_f <= MCAST_HI_F)
     state, out = pl._pipeline_step(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
-        meta=meta, hit_combine=hit_combine, valid=~spoof,
+        meta=meta, hit_combine=hit_combine, valid=~spoof & ~igmp,
+        no_commit=is_mc,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
     # Forward toward the packet's effective destination: the DNAT-resolved
@@ -169,12 +212,16 @@ def _pipeline_step_full(
     # SOURCE un-rewrite; a reply forwards to its literal dst (the client).
     eff_dst = jnp.where(out["reply"] == 1, dst_f, out["dnat_ip_f"])
     fwd = forwarding_lookup(dft, eff_dst, in_port)
-    kind = jnp.where(spoof, FWD_DROP_SPOOF, fwd["kind"]).astype(jnp.int32)
+    kind = jnp.where(
+        spoof, FWD_DROP_SPOOF, jnp.where(igmp, FWD_PUNT, fwd["kind"])
+    ).astype(jnp.int32)
     deliverable = (code == ACT_ALLOW) & (
         (kind == FWD_LOCAL) | (kind == FWD_TUNNEL) | (kind == FWD_GATEWAY)
+        | (kind == FWD_MCAST)
     )
+    uni_deliverable = deliverable & (kind != FWD_MCAST)
     tc_w = jnp.where(
-        deliverable, tc_lookup(dft, src_f, fwd["lp_row"], fwd["is_local"]), 0
+        uni_deliverable, tc_lookup(dft, src_f, fwd["lp_row"], fwd["is_local"]), 0
     )
     tc_act = tc_w & 3
     tc_port = tc_w >> 2
@@ -186,12 +233,14 @@ def _pipeline_step_full(
         code=code,
         reject_kind=pl.reject_kind_of(code, proto),
         spoofed=spoof.astype(jnp.int32),
+        punt=igmp.astype(jnp.int32),
         fwd_kind=kind,
         out_port=out_port.astype(jnp.int32),
-        peer_f=jnp.where(deliverable, fwd["peer_f"], 0),
-        dec_ttl=jnp.where(deliverable, fwd["dec_ttl"], 0),
+        peer_f=jnp.where(uni_deliverable, fwd["peer_f"], 0),
+        dec_ttl=jnp.where(uni_deliverable, fwd["dec_ttl"], 0),
         tc_act=tc_act,
         tc_port=tc_port,
+        mcast_idx=jnp.where(deliverable, fwd["mcast_idx"], -1),
     )
     return state, out
 

@@ -430,6 +430,7 @@ def _pipeline_step(
     meta: PipelineMeta,
     hit_combine=None,
     valid=None,
+    no_commit=None,
 ):
     flow, aff = state.flow, state.aff
     B = src_f.shape[0]
@@ -580,6 +581,17 @@ def _pipeline_step(
             rule_in = jnp.where(no_ep, MISS, cls["ingress_rule"])
             rule_out = jnp.where(no_ep, MISS, cls["egress_rule"])
 
+            # no_commit lanes (multicast dst — the reference's multicast
+            # pipeline bypasses conntrack entirely, pkg/agent/openflow/
+            # multicast.go) classify fresh every time: no cache entry in
+            # either direction, and `committed` reports 0.
+            committed_m = code == ACT_ALLOW
+            ins = valid
+            if no_commit is not None:
+                nc_m = no_commit[safe]
+                committed_m = committed_m & ~nc_m
+                ins = ins & ~nc_m
+
             # Scatter results into the output images.
             tgt = jnp.where(valid, idx, B)
             out_code = out_code.at[tgt].set(code)
@@ -588,12 +600,11 @@ def _pipeline_step(
             out_dnat_port = out_dnat_port.at[tgt].set(dnat_port)
             out_rule_in = out_rule_in.at[tgt].set(rule_in)
             out_rule_out = out_rule_out.at[tgt].set(rule_out)
-            out_committed = out_committed.at[tgt].set((code == ACT_ALLOW).astype(jnp.int32))
+            out_committed = out_committed.at[tgt].set(committed_m.astype(jnp.int32))
             out_snat = out_snat.at[tgt].set(snat_m)
 
             # Insert into the flow cache: ALLOW entries as ETERNAL
             # (conntrack commit), denials tagged with the current gen.
-            committed_m = code == ACT_ALLOW
             egen = jnp.where(committed_m, GEN_ETERNAL, gen_w)
             pg_ins = p_m | 0x100 | (egen << 9)
             m1 = _pack_meta1(code, svc_idx, dnat_port)
@@ -603,7 +614,6 @@ def _pipeline_step(
             # connection's lifetime).
             pref_col = jnp.full((M,), now, jnp.int32)
             zcol = pref_col | jnp.where(snat_m > 0, REPLY_BIT, 0)
-            ins = valid
             key_rows = jnp.stack([s_f, d_f, pp_m, pg_ins], axis=1)
             meta_rows = jnp.stack([dnat_ip, m1, rules_p, zcol], axis=1)
 
@@ -614,7 +624,7 @@ def _pipeline_step(
             # (endpoint -> client), whose meta carries the un-DNAT rewrite —
             # the original frontend (pre-DNAT dst ip/port) the reply's
             # source must be restored to (UnSNAT/EndpointDNAT reverse).
-            rev_ins = valid & committed_m
+            rev_ins = ins & committed_m
             rev_h = hashing.flow_hash(
                 _raw_bits(dnat_ip), _raw_bits(s_f), p_m, dnat_port, sp_m, xp=jnp
             )

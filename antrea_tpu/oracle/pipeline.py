@@ -59,8 +59,9 @@ class ScalarOutcome:
     reply: bool = False  # reverse-tuple (reply-direction) conntrack hit
     reject_kind: int = 0  # 0 none / 1 tcp-rst / 2 icmp-port-unreachable
     snat: int = 0  # SNAT mark: external frontend under ETP=Cluster
-    # Lane excluded by the caller's valid mask (SpoofGuard gating): dropped
-    # BEFORE the pipeline — no state touched, not a cache miss either.
+    # Lane excluded by the caller's lane modes (SpoofGuard drop or IGMP
+    # punt): handled BEFORE the pipeline — no state touched, not a cache
+    # miss either.
     skipped: bool = False
 
 
@@ -253,8 +254,17 @@ class PipelineOracle:
             "egress_rule": v.egress.rule,
         }
 
+    # Lane modes for step(): process normally / SpoofGuard drop (code DROP,
+    # nothing touched) / punt to controller (code ALLOW, nothing touched) —
+    # the device twin realizes these as the valid mask + kind overrides in
+    # models/forwarding._pipeline_step_full.
+    LANE_NORMAL = 0
+    LANE_SPOOF = 1
+    LANE_PUNT = 2
+
     def step(
-        self, batch: PacketBatch, now: int, gen: int = 0, valid=None
+        self, batch: PacketBatch, now: int, gen: int = 0, lane_modes=None,
+        no_commit=None,
     ) -> list[ScalarOutcome]:
         # The device packs entry generations into GEN_BITS (22) bits, with
         # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
@@ -274,12 +284,16 @@ class PipelineOracle:
 
         for i in range(batch.size):
             p = batch.packet(i)
-            if valid is not None and not valid[i]:
-                # SpoofGuard-gated lane: dropped before conntrack/policy
-                # tables — no lookup, no refresh, no commit (stage order of
-                # the reference, framework.go; see models/forwarding.py).
+            mode = self.LANE_NORMAL if lane_modes is None else lane_modes[i]
+            if mode != self.LANE_NORMAL:
+                # SpoofGuard-gated or punted lane: handled before the
+                # conntrack/policy tables — no lookup, no refresh, no
+                # commit (stage order of the reference, framework.go; see
+                # models/forwarding.py).  Spoof reports DROP, punt ALLOW
+                # (the fast-path default image on the device).
+                code = ACT_DROP if mode == self.LANE_SPOOF else ACT_ALLOW
                 outs.append(ScalarOutcome(
-                    ACT_DROP, False, -1, p.dst_ip, p.dst_port, None, None,
+                    code, False, -1, p.dst_ip, p.dst_port, None, None,
                     False, skipped=True,
                 ))
                 continue
@@ -342,24 +356,30 @@ class PipelineOracle:
             # classifier's what-if attribution.
             rule_in = None if w["no_ep"] else w["ingress_rule"]
             rule_out = None if w["no_ep"] else w["egress_rule"]
-            committed = code == ACT_ALLOW
+            # no_commit lanes (multicast dst): conntrack is bypassed —
+            # fresh classification every packet, nothing cached (ref
+            # pkg/agent/openflow/multicast.go pipeline skips ct).
+            nc = no_commit is not None and bool(no_commit[i])
+            committed = code == ACT_ALLOW and not nc
             outs.append(
                 ScalarOutcome(code, False, w["svc_idx"], w["dnat_ip"],
                               w["dnat_port"], rule_out, rule_in, committed,
                               reject_kind=_reject_kind(code, p.proto),
                               snat=w["snat"])
             )
-            key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
-            inserts.append(
-                (slot, {
-                    "key": key, "code": code, "svc": w["svc_idx"],
-                    "dnat_ip": w["dnat_ip"], "dnat_port": w["dnat_port"],
-                    "ts": now, "pref": now, "snat": w["snat"],
-                    "gen": None if committed else gen,
-                    "rule_in": rule_in, "rule_out": rule_out,
-                    "rpl": False,
-                })
-            )
+            if not nc:
+                key = (p.src_ip, p.dst_ip,
+                       (p.src_port << 16) | p.dst_port, p.proto)
+                inserts.append(
+                    (slot, {
+                        "key": key, "code": code, "svc": w["svc_idx"],
+                        "dnat_ip": w["dnat_ip"], "dnat_port": w["dnat_port"],
+                        "ts": now, "pref": now, "snat": w["snat"],
+                        "gen": None if committed else gen,
+                        "rule_in": rule_in, "rule_out": rule_out,
+                        "rpl": False,
+                    })
+                )
             if committed:
                 # Conntrack commits both directions: the reverse-tuple entry
                 # is keyed on the post-DNAT tuple with ports swapped

@@ -1,0 +1,237 @@
+"""Multicast + IGMP snooping + packet-in dispatcher tests.
+
+Semantics from the reference's multicast subsystem (pkg/agent/multicast:
+IGMP report/leave snooping, member timeouts, MulticastRouting/Output
+tables; pkg/agent/openflow/multicast.go: conntrack bypass) and the
+packet-in plumbing (pkg/agent/openflow/packetin.go:44-130 categories +
+rate-limited queues).  Differential discipline: both datapaths behind the
+Datapath boundary.
+"""
+
+import numpy as np
+
+from antrea_tpu.agent.multicast import (
+    IGMP_LEAVE,
+    IGMP_REPORT,
+    MulticastController,
+)
+from antrea_tpu.agent.noderoute import NodeRouteController
+from antrea_tpu.agent.packetin import (
+    CAT_IGMP,
+    PacketInDispatcher,
+)
+from antrea_tpu.compiler.topology import (
+    FWD_DROP_MCAST,
+    FWD_MCAST,
+    FWD_PUNT,
+    OFPORT_REPLICATE,
+    PROTO_IGMP,
+    McastGroup,
+    NodeRoute,
+    Topology,
+)
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+
+def _topo(mcast=()):
+    return Topology(
+        node_name="node-a",
+        gateway_ip="10.10.0.1",
+        pod_cidr="10.10.0.0/24",
+        local_pods=[("10.10.0.5", 3), ("10.10.0.6", 4)],
+        remote_nodes=[
+            NodeRoute(name="node-b", node_ip="192.168.1.2",
+                      pod_cidr="10.10.1.0/24"),
+        ],
+        mcast_groups=list(mcast),
+    )
+
+
+def _pair(topo):
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8)
+    return (
+        TpuflowDatapath(topology=topo, miss_chunk=64, **kw),
+        OracleDatapath(topology=topo, **kw),
+    )
+
+
+def _batch(rows, proto=17):
+    """rows: [(src, dst, in_port, sport)]"""
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(s) for s, _, _, _ in rows], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(d) for _, d, _, _ in rows], np.uint32),
+        proto=np.full(len(rows), proto, np.int32),
+        src_port=np.array([sp for _, _, _, sp in rows], np.int32),
+        dst_port=np.full(len(rows), 5000, np.int32),
+        in_port=np.array([p for _, _, p, _ in rows], np.int32),
+    )
+
+
+def _diff(a, b):
+    for f in ("code", "spoofed", "punt", "fwd_kind", "out_port", "mcast_idx",
+              "dec_ttl", "committed", "est"):
+        assert getattr(a, f).tolist() == getattr(b, f).tolist(), f
+    assert a.n_miss == b.n_miss
+
+
+def test_mcast_delivery_and_miss():
+    groups = [
+        McastGroup("239.1.1.1", local_ports=(3, 4), remote_nodes=("node-b",)),
+        McastGroup("239.1.1.2", local_ports=(4,)),
+    ]
+    tpu, orc = _pair(_topo(groups))
+    b = _batch([
+        ("10.10.0.5", "239.1.1.1", 3, 40000),  # joined group -> replicate
+        ("10.10.0.6", "239.1.1.2", 4, 40000),  # joined group -> replicate
+        ("10.10.0.5", "239.9.9.9", 3, 40000),  # no receivers -> drop
+    ])
+    ra, rb = tpu.step(b, now=10), orc.step(b, now=10)
+    _diff(ra, rb)
+    assert ra.fwd_kind.tolist() == [FWD_MCAST, FWD_MCAST, FWD_DROP_MCAST]
+    assert ra.out_port.tolist() == [OFPORT_REPLICATE, OFPORT_REPLICATE, -1]
+    # mcast_idx rows are sorted by group IP: 239.1.1.1 < 239.1.1.2.
+    assert ra.mcast_idx.tolist() == [0, 1, -1]
+    g0 = tpu.mcast_group(0)
+    assert g0 == orc.mcast_group(0)
+    assert g0["ports"] == [3, 4]
+    assert g0["peers"] == [iputil.ip_to_u32("192.168.1.2")]
+    # Multicast bypasses conntrack: nothing committed, nothing cached.
+    assert ra.committed.tolist() == [0, 0, 0]
+    assert tpu.cache_stats()["occupied"] == 0
+    assert orc.cache_stats()["occupied"] == 0
+    # Re-step: still classified fresh (n_miss counts all mcast lanes).
+    ra2, rb2 = tpu.step(b, now=11), orc.step(b, now=11)
+    _diff(ra2, rb2)
+    assert ra2.est.tolist() == [0, 0, 0]
+
+
+def test_igmp_punt_no_state():
+    tpu, orc = _pair(_topo())
+    b = _batch([("10.10.0.5", "239.1.1.1", 3, IGMP_REPORT)], proto=PROTO_IGMP)
+    ra, rb = tpu.step(b, now=5), orc.step(b, now=5)
+    _diff(ra, rb)
+    assert ra.punt.tolist() == [1]
+    assert ra.fwd_kind.tolist() == [FWD_PUNT]
+    assert ra.out_port.tolist() == [-1]
+    assert tpu.cache_stats()["occupied"] == 0
+    # Punted lanes are invisible to policy metrics on both sides.
+    assert tpu.stats().default_allow == orc.stats().default_allow == 0
+
+
+def test_igmp_snooping_feedback_loop():
+    """IGMP report punt -> dispatcher -> MulticastController -> topology
+    reinstall -> subsequent multicast traffic replicates; leave withdraws;
+    timeout expires members (the queryInterval/timeout model)."""
+    tpu = TpuflowDatapath(topology=_topo(), flow_slots=1 << 10,
+                          aff_slots=1 << 8, miss_chunk=64)
+    nrc = NodeRouteController(tpu, "node-a", pod_cidr="10.10.0.0/24")
+    nrc.pod_added("10.10.0.5", 3)
+    nrc.pod_added("10.10.0.6", 4)
+    nrc.upsert_node("node-b", "192.168.1.2", "10.10.1.0/24")
+    disp = PacketInDispatcher()
+    mc = MulticastController(nrc, dispatcher=disp, member_timeout_s=100)
+
+    # Pod 4 joins 239.2.2.2 via an IGMP report.
+    rep = _batch([("10.10.0.6", "239.2.2.2", 4, IGMP_REPORT)],
+                 proto=PROTO_IGMP)
+    r = tpu.step(rep, now=10)
+    assert disp.collect(rep, r, now=10) == 1
+    assert disp.drain(now=10) == 1
+
+    data = _batch([("10.10.0.5", "239.2.2.2", 3, 40000)])
+    r2 = tpu.step(data, now=11)
+    assert r2.fwd_kind.tolist() == [FWD_MCAST]
+    assert tpu.mcast_group(int(r2.mcast_idx[0]))["ports"] == [4]
+
+    # Leave: group withdrawn, traffic drops again.
+    leave = _batch([("10.10.0.6", "239.2.2.2", 4, IGMP_LEAVE)],
+                   proto=PROTO_IGMP)
+    r3 = tpu.step(leave, now=12)
+    disp.collect(leave, r3, now=12)
+    disp.drain(now=12)
+    assert tpu.step(data, now=13).fwd_kind.tolist() == [FWD_DROP_MCAST]
+
+    # Rejoin, then let it expire.
+    r4 = tpu.step(rep, now=20)
+    disp.collect(rep, r4, now=20)
+    disp.drain(now=20)
+    assert tpu.step(data, now=21).fwd_kind.tolist() == [FWD_MCAST]
+    assert mc.expire(now=121) == 1  # 101s > 100s timeout
+    assert tpu.step(data, now=122).fwd_kind.tolist() == [FWD_DROP_MCAST]
+
+
+def test_remote_interest_replication():
+    tpu = TpuflowDatapath(topology=_topo(), flow_slots=1 << 10,
+                          aff_slots=1 << 8, miss_chunk=64)
+    nrc = NodeRouteController(tpu, "node-a", pod_cidr="10.10.0.0/24")
+    nrc.upsert_node("node-b", "192.168.1.2", "10.10.1.0/24")
+    nrc.pod_added("10.10.0.5", 3)
+    mc = MulticastController(nrc)
+    mc.set_remote_interest("239.3.3.3", ["node-b"])
+    data = _batch([("10.10.0.5", "239.3.3.3", 3, 40000)])
+    r = tpu.step(data, now=1)
+    assert r.fwd_kind.tolist() == [FWD_MCAST]
+    g = tpu.mcast_group(int(r.mcast_idx[0]))
+    assert g["ports"] == [] and g["peers"] == [iputil.ip_to_u32("192.168.1.2")]
+    mc.set_remote_interest("239.3.3.3", [])
+    assert tpu.step(data, now=2).fwd_kind.tolist() == [FWD_DROP_MCAST]
+
+
+def test_packetin_rate_limit_and_categories():
+    disp = PacketInDispatcher(rate=0, burst=3)  # 3 tokens, no refill
+    got = []
+    disp.register(CAT_IGMP, lambda item, now: got.append(item))
+    for i in range(5):
+        disp.submit(CAT_IGMP, {"i": i}, now=0)
+    assert disp.drain(now=0) == 3
+    assert disp.dropped(CAT_IGMP) == 2
+    assert [g["i"] for g in got] == [0, 1, 2]
+
+
+def test_mcast_policy_applies_without_caching():
+    """Multicast still traverses the security tables (MulticastEgressRule
+    analog): an egress drop on the sender applies — and is re-evaluated
+    every step (no cached denial)."""
+    from antrea_tpu.apis import controlplane as cp
+    from antrea_tpu.compiler.ir import PolicySet
+
+    deny = cp.NetworkPolicy(
+        uid="np-deny-mcast", name="deny-mcast", namespace="default",
+        type=cp.NetworkPolicyType.ANNP,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.OUT, action=cp.RuleAction.DROP, priority=0,
+            to_peer=cp.NetworkPolicyPeer(
+                ip_blocks=[cp.IPBlock(cidr="239.0.0.0/8")]
+            ),
+        )],
+        applied_to_groups=["atg-sender"],
+        tier_priority=cp.TIER_APPLICATION, priority=5,
+    )
+    ps = PolicySet(
+        policies=[deny],
+        applied_to_groups={"atg-sender": cp.AppliedToGroup(
+            name="atg-sender",
+            members=[cp.GroupMember(ip="10.10.0.5")],
+        )},
+        address_groups={},
+    )
+    topo = _topo([McastGroup("239.1.1.1", local_ports=(4,))])
+    import copy
+
+    tpu = TpuflowDatapath(copy.deepcopy(ps), topology=topo,
+                          flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    orc = OracleDatapath(copy.deepcopy(ps), topology=topo,
+                         flow_slots=1 << 10, aff_slots=1 << 8)
+    b = _batch([
+        ("10.10.0.5", "239.1.1.1", 3, 40000),  # denied sender
+        ("10.10.0.6", "239.1.1.1", 4, 40000),  # allowed sender
+    ])
+    for t in (1, 2):
+        ra, rb = tpu.step(b, now=t), orc.step(b, now=t)
+        _diff(ra, rb)
+        assert ra.code.tolist() == [1, 0]
+        assert ra.fwd_kind.tolist()[1] == FWD_MCAST
+        assert ra.out_port.tolist() == [-1, OFPORT_REPLICATE]
+    assert tpu.cache_stats()["occupied"] == 0  # denials not cached either
