@@ -1,0 +1,174 @@
+"""Agent API server: localhost REST for the operator CLI.
+
+The analog of /root/reference/pkg/agent/apiserver (3,800 LoC): the agent
+serves a loopback HTTPS API that antctl reaches for live node state —
+handlers under pkg/agent/apiserver/handlers/: agentinfo, podinterface,
+ovsflows, ovstracing, networkpolicy, memberlist, featuregates, plus the
+Prometheus metrics endpoint (pkg/agent/metrics).
+
+Here: a stdlib ThreadingHTTPServer bound to 127.0.0.1 serving JSON (and
+Prometheus text for /metrics) straight off the live objects the agent
+already holds — the same state those reference handlers query.  antctl's
+`--server` mode consumes it (antctl.py), mirroring the reference's antctl
+"agent mode" via the localhost endpoint (docs/design/architecture.md:82-90).
+
+Routes:
+  GET /agentinfo        AntreaAgentInfo heartbeat body (observability/agentinfo)
+  GET /metrics          Prometheus text (observability/metrics)
+  GET /podinterfaces    interface store rows
+  GET /networkpolicies  agent-held computed policies
+  GET /addressgroups    agent-held address groups
+  GET /appliedtogroups  agent-held appliedTo groups
+  GET /ovsflows?now=N   conntrack/flow-cache dump (Datapath.dump_flows)
+  GET /cache            flow-cache census (Datapath.cache_stats)
+  GET /memberlist       alive members of the gossip cluster
+  GET /featuregates     feature gate states
+  GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
+                        live ofproto/trace analog (Datapath.trace probe)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+
+class AgentApiServer:
+    def __init__(
+        self,
+        datapath,
+        node: str = "",
+        agent=None,  # AgentPolicyController (policy_set)
+        ifaces=None,  # InterfaceStore
+        memberlist=None,  # MemberlistCluster
+        gates=None,  # FeatureGates
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._dp = datapath
+        self._node = node
+        self._agent = agent
+        self._ifaces = ifaces
+        self._memberlist = memberlist
+        self._gates = gates
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet test output
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = outer._route(self.path)
+                except KeyError:
+                    self.send_error(404)
+                    return
+                except ValueError as e:
+                    self.send_error(400, str(e))
+                    return
+                data = body if isinstance(body, bytes) else body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def address(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> "AgentApiServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, path: str):
+        u = urlparse(path)
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        route = u.path.rstrip("/")
+        if route == "/metrics":
+            from ..observability.metrics import render_metrics
+
+            return render_metrics(self._dp, node=self._node), "text/plain"
+        return json.dumps(self._json_route(route, q)), "application/json"
+
+    def _json_route(self, route: str, q: dict):
+        from ..utils import ip as iputil
+
+        if route == "/agentinfo":
+            from ..observability.agentinfo import collect_agent_info
+
+            return collect_agent_info(
+                self._dp, self._node, agent=self._agent,
+                now=int(q.get("now", 0)),
+            )
+        if route == "/podinterfaces":
+            rows = self._ifaces.all() if self._ifaces is not None else []
+            return [
+                {"containerID": ic.container_id, "namespace": ic.pod_namespace,
+                 "pod": ic.pod_name, "ip": ic.ip, "ofport": ic.ofport}
+                for ic in rows
+            ]
+        if route in ("/networkpolicies", "/addressgroups", "/appliedtogroups"):
+            ps = self._agent.policy_set if self._agent is not None else None
+            if ps is None:
+                return []
+            if route == "/networkpolicies":
+                return [
+                    {"uid": p.uid, "name": p.name, "namespace": p.namespace,
+                     "type": p.type.value, "rules": len(p.rules)}
+                    for p in ps.policies
+                ]
+            table = (
+                ps.address_groups if route == "/addressgroups"
+                else ps.applied_to_groups
+            )
+            return [
+                {"name": k, "members": len(g.members)}
+                for k, g in sorted(table.items())
+            ]
+        if route == "/ovsflows":
+            return self._dp.dump_flows(now=int(q.get("now", 0)))
+        if route == "/cache":
+            return self._dp.cache_stats()
+        if route == "/memberlist":
+            if self._memberlist is None:
+                return []
+            alive = self._memberlist.alive
+            return sorted(alive() if callable(alive) else alive)
+        if route == "/featuregates":
+            if self._gates is None:
+                return {}
+            return self._gates.as_dict()
+        if route == "/traceflow":
+            if "src" not in q or "dst" not in q:
+                raise ValueError("traceflow needs src= and dst=")
+            from ..packet import PacketBatch
+
+            batch = PacketBatch(
+                src_ip=np.array([iputil.ip_to_u32(q["src"])], np.uint32),
+                dst_ip=np.array([iputil.ip_to_u32(q["dst"])], np.uint32),
+                proto=np.array([int(q.get("proto", 6))], np.int32),
+                src_port=np.array([int(q.get("sport", 0))], np.int32),
+                dst_port=np.array([int(q.get("dport", 0))], np.int32),
+                in_port=np.array([int(q.get("in_port", -1))], np.int32),
+            )
+            obs = self._dp.trace(batch, now=int(q.get("now", 0)))[0]
+            obs["dnat_ip"] = iputil.u32_to_ip(obs["dnat_ip"])
+            return obs
+        raise KeyError(route)

@@ -18,6 +18,13 @@ Usage (python -m antrea_tpu.antctl ...):
   query endpoint --state DIR --namespace NS --pod NAME --ip IP
         endpoint querier over snapshot policies (group membership by ip).
   version
+
+Live-agent mode (the reference's antctl "agent mode" over the localhost
+API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
+  get {networkpolicies,addressgroups,appliedtogroups,podinterfaces,
+       ovsflows,memberlist,featuregates,agentinfo,cache} --server URL
+  traceflow --server URL --src IP --dst IP [...]
+  metrics --server URL
 """
 
 from __future__ import annotations
@@ -30,6 +37,10 @@ import numpy as np
 
 VERSION = "0.3.0-tpu"
 
+# Verdict code -> name (single copy for this CLI; .get-safe like
+# observability/audit.py's map).
+_VERDICT = {0: "Allow", 1: "Drop", 2: "Reject"}
+
 
 def _load(state_dir: str):
     from .datapath import persist
@@ -40,7 +51,35 @@ def _load(state_dir: str):
     return snap
 
 
+def _fetch(server: str, path: str) -> str:
+    from urllib.error import HTTPError, URLError
+    from urllib.request import urlopen
+
+    try:
+        with urlopen(server.rstrip("/") + path, timeout=10) as r:
+            return r.read().decode()
+    except HTTPError as e:
+        raise SystemExit(f"antctl: agent returned {e.code} for {path}")
+    except (URLError, OSError) as e:
+        raise SystemExit(f"antctl: cannot reach agent at {server}: {e}")
+
+
 def _cmd_get(args) -> int:
+    if getattr(args, "server", None):
+        if args.kind == "services":
+            raise SystemExit(
+                "antctl: services is snapshot-only (--state); the live "
+                "agent serves the installed frontends via ovsflows/cache"
+            )
+        print(json.dumps(json.loads(_fetch(args.server, "/" + args.kind)),
+                         indent=2))
+        return 0
+    if args.state is None:
+        raise SystemExit("antctl: get needs --state or --server")
+    if args.kind not in (
+        "networkpolicies", "addressgroups", "appliedtogroups", "services"
+    ):
+        raise SystemExit(f"antctl: {args.kind} is only served live (--server)")
     ps, services, gen = _load(args.state)
     if args.kind == "networkpolicies":
         rows = [
@@ -82,6 +121,15 @@ def _cmd_traceflow(args) -> int:
     from .packet import PacketBatch
     from .utils import ip as iputil
 
+    if getattr(args, "server", None):
+        qs = (f"/traceflow?src={args.src}&dst={args.dst}&proto={args.proto}"
+              f"&sport={args.sport}&dport={args.dport}")
+        obs = json.loads(_fetch(args.server, qs))
+        obs["verdict"] = _VERDICT.get(obs["code"], "?")
+        print(json.dumps(obs, indent=2, default=str))
+        return 0
+    if args.state is None:
+        raise SystemExit("antctl: traceflow needs --state or --server")
     ps, services, _gen = _load(args.state)
     dp = OracleDatapath(ps, services, flow_slots=1 << 10, aff_slots=1 << 8)
     batch = PacketBatch(
@@ -92,7 +140,7 @@ def _cmd_traceflow(args) -> int:
         dst_port=np.array([args.dport], np.int32),
     )
     obs = dp.trace(batch, now=0)[0]
-    obs["verdict"] = {0: "Allow", 1: "Drop", 2: "Reject"}[obs["code"]]
+    obs["verdict"] = _VERDICT.get(obs["code"], "?")
     obs["dnat_ip"] = iputil.u32_to_ip(obs["dnat_ip"])
     print(json.dumps(obs, indent=2, default=str))
     return 0
@@ -131,15 +179,23 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="antctl")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    g = sub.add_parser("get", help="list objects from a state snapshot")
+    g = sub.add_parser("get", help="list objects (snapshot or live agent)")
     g.add_argument("kind", choices=[
         "networkpolicies", "addressgroups", "appliedtogroups", "services",
+        "podinterfaces", "ovsflows", "memberlist", "featuregates",
+        "agentinfo", "cache",
     ])
-    g.add_argument("--state", required=True, help="datapath persist dir")
+    g.add_argument("--state", help="datapath persist dir")
+    g.add_argument("--server", help="live agent API base URL")
     g.set_defaults(fn=_cmd_get)
 
+    m = sub.add_parser("metrics", help="Prometheus metrics from a live agent")
+    m.add_argument("--server", required=True)
+    m.set_defaults(fn=lambda a: (print(_fetch(a.server, "/metrics"), end=""), 0)[1])
+
     t = sub.add_parser("traceflow", help="trace a crafted probe packet")
-    t.add_argument("--state", required=True)
+    t.add_argument("--state")
+    t.add_argument("--server", help="live agent API base URL")
     t.add_argument("--src", required=True)
     t.add_argument("--dst", required=True)
     t.add_argument("--proto", type=int, default=6)

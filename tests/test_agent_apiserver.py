@@ -1,0 +1,105 @@
+"""Agent API server tests: the localhost REST surface antctl's live mode
+consumes (ref pkg/agent/apiserver handlers: agentinfo, podinterface,
+ovsflows, ovstracing, networkpolicy, memberlist, featuregates + the
+Prometheus metrics endpoint)."""
+
+import json
+from urllib.request import urlopen
+
+import numpy as np
+import pytest
+
+from antrea_tpu import antctl
+from antrea_tpu.agent.apiserver import AgentApiServer
+from antrea_tpu.agent.memberlist import MemberlistCluster
+from antrea_tpu.datapath import TpuflowDatapath
+from antrea_tpu.features import FeatureGates
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.simulator import gen_cluster, gen_traffic
+from antrea_tpu.simulator.genservice import gen_services
+from antrea_tpu.utils import ip as iputil
+
+
+@pytest.fixture(scope="module")
+def server():
+    cluster = gen_cluster(60, n_nodes=2, pods_per_node=4, seed=21)
+    services = gen_services(4, cluster.pod_ips, seed=22)
+    dp = TpuflowDatapath(cluster.ps, services, flow_slots=1 << 10,
+                         aff_slots=1 << 8, miss_chunk=64)
+    tr = gen_traffic(cluster.pod_ips, 64, n_flows=32, seed=23,
+                     services=services, svc_fraction=0.3)
+    dp.step(PacketBatch(src_ip=tr.src_ip, dst_ip=tr.dst_ip, proto=tr.proto,
+                        src_port=tr.src_port, dst_port=tr.dst_port), now=50)
+    ml = MemberlistCluster("node-a")
+    ml.join("node-b")
+    srv = AgentApiServer(
+        dp, node="node-a", memberlist=ml, gates=FeatureGates(),
+    ).start()
+    yield srv, dp, cluster
+    srv.close()
+
+
+def _get(srv, path):
+    with urlopen(srv.address + path, timeout=10) as r:
+        return r.read().decode()
+
+
+def test_metrics_endpoint(server):
+    srv, dp, _ = server
+    text = _get(srv, "/metrics")
+    assert "antrea_tpu_flow_cache_entries" in text
+    assert "antrea_tpu_default_verdict_packets_total" in text
+
+
+def test_agentinfo_and_cache(server):
+    srv, dp, _ = server
+    info = json.loads(_get(srv, "/agentinfo?now=60"))
+    assert info["nodeName"] == "node-a"
+    cache = json.loads(_get(srv, "/cache"))
+    assert cache == dp.cache_stats()
+    assert cache["occupied"] > 0
+
+
+def test_ovsflows_dump(server):
+    srv, dp, _ = server
+    flows = json.loads(_get(srv, "/ovsflows?now=55"))
+    assert flows and {"src", "dst", "committed"} <= set(flows[0])
+
+
+def test_memberlist_and_featuregates(server):
+    srv, _, _ = server
+    assert json.loads(_get(srv, "/memberlist")) == ["node-a", "node-b"]
+    gates = json.loads(_get(srv, "/featuregates"))
+    assert gates.get("Traceflow") is True
+
+
+def test_live_traceflow(server):
+    srv, dp, cluster = server
+    src = iputil.u32_to_ip(int(cluster.pod_ips[0]))
+    dst = iputil.u32_to_ip(int(cluster.pod_ips[1]))
+    obs = json.loads(_get(srv, f"/traceflow?src={src}&dst={dst}&dport=80"))
+    assert "code" in obs and "fwd_kind" in obs
+
+
+def test_unknown_route_404(server):
+    srv, _, _ = server
+    from urllib.error import HTTPError
+
+    with pytest.raises(HTTPError) as e:
+        _get(srv, "/nope")
+    assert e.value.code == 404
+
+
+def test_antctl_live_mode(server, capsys):
+    srv, _, cluster = server
+    assert antctl.main(["get", "memberlist", "--server", srv.address]) == 0
+    assert json.loads(capsys.readouterr().out) == ["node-a", "node-b"]
+    assert antctl.main(["metrics", "--server", srv.address]) == 0
+    assert "antrea_tpu" in capsys.readouterr().out
+    src = iputil.u32_to_ip(int(cluster.pod_ips[0]))
+    dst = iputil.u32_to_ip(int(cluster.pod_ips[1]))
+    assert antctl.main([
+        "traceflow", "--server", srv.address, "--src", src, "--dst", dst,
+    ]) == 0
+    obs = json.loads(capsys.readouterr().out)
+    assert obs["verdict"] in ("Allow", "Drop", "Reject")

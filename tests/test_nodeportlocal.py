@@ -1,0 +1,103 @@
+"""NodePortLocal tests: port-cache allocation, persistence, and DNAT
+through the datapath (semantics from pkg/agent/nodeportlocal: portcache
+allocation + iptables DNAT + pod annotation)."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from antrea_tpu.agent.nodeportlocal import (
+    DEFAULT_PORT_RANGE,
+    NplController,
+    PortAllocationError,
+)
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+NODE_IP = "192.168.1.10"
+
+
+def _batch(dst_ip, dst_port, src="203.0.113.7", sport=40000):
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst_ip)], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([sport], np.int32),
+        dst_port=np.array([dst_port], np.int32),
+    )
+
+
+def test_allocation_idempotent_and_release():
+    npl = NplController([NODE_IP], port_range=(61000, 61010))
+    p1 = npl.add_pod_port("10.0.0.5", 6, 8080)
+    assert p1 == npl.add_pod_port("10.0.0.5", 6, 8080)  # idempotent
+    p2 = npl.add_pod_port("10.0.0.5", 6, 9090)
+    assert p2 != p1
+    assert npl.remove_pod_port("10.0.0.5", 6, 8080)
+    p3 = npl.add_pod_port("10.0.0.6", 17, 53)
+    assert 61000 <= p3 < 61010
+    assert npl.remove_pod("10.0.0.5") == 1  # 9090 mapping remains -> released
+    assert npl.mappings() == {("10.0.0.6", 17, 53): p3}
+
+
+def test_range_exhaustion():
+    npl = NplController([NODE_IP], port_range=(61000, 61002))
+    npl.add_pod_port("10.0.0.5", 6, 1)
+    npl.add_pod_port("10.0.0.5", 6, 2)
+    with pytest.raises(PortAllocationError):
+        npl.add_pod_port("10.0.0.5", 6, 3)
+
+
+def test_persisted_port_cache_survives_restart(tmp_path):
+    from antrea_tpu.native import ConfigStore
+
+    store = ConfigStore(str(tmp_path / "conf.db"))
+    npl = NplController([NODE_IP], store=store)
+    p = npl.add_pod_port("10.0.0.5", 6, 8080)
+    # Restart: fresh store handle, fresh controller — same node port (the
+    # portcache rule-restore contract: advertised ports never change).
+    npl2 = NplController([NODE_IP], store=ConfigStore(str(tmp_path / "conf.db")))
+    assert npl2.add_pod_port("10.0.0.5", 6, 8080) == p
+    # And the allocator won't hand the restored port to someone else.
+    q = npl2.add_pod_port("10.0.0.5", 6, 9090)
+    assert q != p
+
+
+def test_npl_dnat_through_datapath():
+    """External client -> node_ip:npl_port DNATs to the pod, client IP
+    preserved (snat=0), reply leg un-DNATs — on both datapaths."""
+    npl = NplController([NODE_IP], port_range=DEFAULT_PORT_RANGE)
+    port = npl.add_pod_port("10.0.0.5", 6, 8080)
+    svcs = npl.service_entries()
+    tpu = TpuflowDatapath(None, copy.deepcopy(svcs), flow_slots=1 << 10,
+                          aff_slots=1 << 8, miss_chunk=64)
+    orc = OracleDatapath(None, copy.deepcopy(svcs), flow_slots=1 << 10,
+                         aff_slots=1 << 8)
+    b = _batch(NODE_IP, port)
+    ra, rb = tpu.step(b, now=1), orc.step(b, now=1)
+    for f in ("code", "snat", "dnat_port", "committed"):
+        assert getattr(ra, f).tolist() == getattr(rb, f).tolist(), f
+    assert ra.dnat_ip.tolist() == rb.dnat_ip.tolist()
+    assert ra.code.tolist() == [0]
+    assert ra.dnat_ip.tolist() == [iputil.ip_to_u32("10.0.0.5")]
+    assert ra.dnat_port.tolist() == [8080]
+    assert ra.snat.tolist() == [0]  # client IP preserved
+    # Reply: pod -> client restores the node frontend as source.
+    reply = _batch("203.0.113.7", 40000, src="10.0.0.5", sport=8080)
+    ra2, rb2 = tpu.step(reply, now=2), orc.step(reply, now=2)
+    assert ra2.reply.tolist() == rb2.reply.tolist() == [1]
+    assert ra2.dnat_ip.tolist() == [iputil.ip_to_u32(NODE_IP)]
+    assert ra2.dnat_port.tolist() == [port]
+
+
+def test_annotation_shape():
+    npl = NplController([NODE_IP])
+    assert npl.annotation("10.0.0.5") is None
+    p = npl.add_pod_port("10.0.0.5", 6, 8080)
+    import json
+
+    rows = json.loads(npl.annotation("10.0.0.5"))
+    assert rows == [{"podPort": 8080, "nodeIP": NODE_IP, "nodePort": p,
+                     "protocol": 6}]
