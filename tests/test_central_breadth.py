@@ -1,0 +1,213 @@
+"""Central/agent breadth tests: ExternalIPPool, ServiceExternalIP with
+failover, BGP reconciliation, ClusterIdentity, stats aggregation,
+NodeLatencyMonitor — reference semantics cited in each module."""
+
+import pytest
+
+from antrea_tpu.agent.bgp import BgpController, BgpPeer, BgpPolicy
+from antrea_tpu.agent.memberlist import MemberlistCluster
+from antrea_tpu.agent.monitortool import NodeLatencyMonitor
+from antrea_tpu.clusteridentity import get_or_create_cluster_identity
+from antrea_tpu.controller.externalippool import (
+    ExternalIPPool,
+    ExternalIPPoolController,
+    IPRange,
+    PoolExhaustedError,
+)
+from antrea_tpu.controller.serviceexternalip import ServiceExternalIPController
+from antrea_tpu.controller.stats import StatsAggregator
+from antrea_tpu.datapath.interface import DatapathStats
+
+
+# ---- ExternalIPPool ---------------------------------------------------------
+
+
+def _pool(name="pool-a", start="10.100.0.1", end="10.100.0.3"):
+    return ExternalIPPool(name=name, ip_ranges=[IPRange(start=start, end=end)])
+
+
+def test_pool_allocate_release_usage():
+    c = ExternalIPPoolController()
+    c.upsert(_pool())
+    a = c.allocate("pool-a", "egress:a")
+    b = c.allocate("pool-a", "egress:b")
+    assert a == "10.100.0.1" and b == "10.100.0.2"
+    assert c.allocate("pool-a", "egress:a") == a  # idempotent per owner
+    assert c.usage("pool-a") == {"total": 3, "used": 2}
+    assert c.release("pool-a", "egress:a") == a
+    assert c.usage("pool-a")["used"] == 1
+    c.allocate("pool-a", "c")
+    c.allocate("pool-a", "d")
+    with pytest.raises(PoolExhaustedError):
+        c.allocate("pool-a", "e")
+
+
+def test_pool_pinned_ip_and_validation():
+    c = ExternalIPPoolController()
+    c.upsert(ExternalIPPool("p", ip_ranges=[IPRange(cidr="10.200.0.0/30")]))
+    assert c.allocate("p", "x", ip="10.200.0.2") == "10.200.0.2"
+    with pytest.raises(ValueError):
+        c.allocate("p", "y", ip="10.200.0.2")  # taken
+    with pytest.raises(ValueError):
+        c.allocate("p", "z", ip="10.9.9.9")  # outside pool
+    with pytest.raises(ValueError):  # shrink strands the allocation
+        c.upsert(ExternalIPPool("p", ip_ranges=[
+            IPRange(start="10.200.0.0", end="10.200.0.1")]))
+    with pytest.raises(ValueError):  # delete with live allocations
+        c.delete("p")
+    c.release("p", "x")
+    c.delete("p")
+
+
+# ---- ServiceExternalIP ------------------------------------------------------
+
+
+def test_service_external_ip_failover():
+    pools = ExternalIPPoolController()
+    pools.upsert(_pool())
+    sc = ServiceExternalIPController(pools)
+    ip = sc.assign("default/web", "pool-a")
+    assert sc.assign("default/web", "pool-a") == ip  # idempotent
+    nodes = {"node-a": {}, "node-b": {}, "node-c": {}}
+    a1 = sc.owner_for("default/web", {"node-a", "node-b", "node-c"}, nodes)
+    assert a1.owner in nodes
+    # The owner fails: election re-evaluates among survivors (memberlist
+    # event -> re-hash, service_external_ip_controller.go failover).
+    survivors = set(nodes) - {a1.owner}
+    a2 = sc.owner_for("default/web", survivors, nodes)
+    assert a2.owner in survivors
+    # All nodes gone: unhosted.
+    assert sc.owner_for("default/web", set(), nodes).owner is None
+    assert sc.unassign("default/web") == ip
+    assert pools.usage("pool-a")["used"] == 0
+
+
+def test_service_external_ip_pool_scoping():
+    pools = ExternalIPPoolController()
+    from antrea_tpu.apis.crd import LabelSelector
+
+    pools.upsert(ExternalIPPool(
+        "edge", ip_ranges=[IPRange(start="10.101.0.1", end="10.101.0.9")],
+        node_selector=LabelSelector.make({"role": "edge"}),
+    ))
+    sc = ServiceExternalIPController(pools)
+    sc.assign("default/lb", "edge")
+    nodes = {"node-a": {"role": "edge"}, "node-b": {"role": "core"}}
+    a = sc.owner_for("default/lb", {"node-a", "node-b"}, nodes)
+    assert a.owner == "node-a"  # only the selector-matching node hosts
+
+
+def test_service_external_ip_assign_rollback():
+    """A failed pool/pin change must leave the previous assignment intact
+    (release-then-reallocate with rollback)."""
+    pools = ExternalIPPoolController()
+    pools.upsert(_pool())
+    sc = ServiceExternalIPController(pools)
+    ip = sc.assign("default/web", "pool-a")
+    with pytest.raises(KeyError):
+        sc.assign("default/web", "no-such-pool")
+    assert sc.assign("default/web", "pool-a") == ip  # still held
+    assert pools.usage("pool-a")["used"] == 1
+
+
+def test_pool_cidr_excludes_network_and_broadcast():
+    c = ExternalIPPoolController()
+    c.upsert(ExternalIPPool("p", ip_ranges=[IPRange(cidr="10.50.0.0/29")]))
+    ips = {c.allocate("p", f"o{i}") for i in range(6)}
+    assert "10.50.0.0" not in ips and "10.50.0.7" not in ips
+    with pytest.raises(PoolExhaustedError):
+        c.allocate("p", "o9")
+
+
+# ---- BGP --------------------------------------------------------------------
+
+
+def test_bgp_reconcile_advertise_withdraw():
+    events = []
+    peer1 = BgpPeer("192.0.2.1", 64512)
+    peer2 = BgpPeer("192.0.2.2", 64513)
+    ctl = BgpController("node-a", speaker=lambda p, a, pfx: events.append((p.address, a, pfx)))
+    ctl.set_policy(BgpPolicy(
+        name="bgp", local_asn=64500, peers=[peer1, peer2],
+        advertise_service_ips=True, advertise_pod_cidrs=True,
+    ))
+    ctl.set_pod_cidrs({"10.10.0.0/24"})
+    ctl.set_service_ips({"10.96.0.10"})
+    assert ctl.rib() == {"10.10.0.0/24", "10.96.0.10/32"}
+    assert ctl.advertised(peer1) == ctl.rib()
+    assert ctl.sessions()[0]["advertised"] == 2
+    events.clear()
+    # Service IP withdrawn -> one withdraw per peer, nothing else.
+    ctl.set_service_ips(set())
+    assert sorted(events) == [
+        ("192.0.2.1", "withdraw", "10.96.0.10/32"),
+        ("192.0.2.2", "withdraw", "10.96.0.10/32"),
+    ]
+    # Peer removed from the policy -> full withdraw for it.
+    events.clear()
+    ctl.set_policy(BgpPolicy(name="bgp", local_asn=64500, peers=[peer1],
+                             advertise_pod_cidrs=True))
+    assert ("192.0.2.2", "withdraw", "10.10.0.0/24") in events
+    # Policy deleted -> RIB empty.
+    ctl.set_policy(None)
+    assert ctl.rib() == set() and ctl.sessions() == []
+
+
+# ---- ClusterIdentity --------------------------------------------------------
+
+
+def test_cluster_identity_minted_once(tmp_path):
+    from antrea_tpu.native import ConfigStore
+
+    s1 = ConfigStore(str(tmp_path / "conf.db"))
+    ident = get_or_create_cluster_identity(s1)
+    assert len(ident) == 36
+    s2 = ConfigStore(str(tmp_path / "conf.db"))
+    assert get_or_create_cluster_identity(s2) == ident
+
+
+# ---- stats aggregation ------------------------------------------------------
+
+
+def test_stats_aggregator_sums_nodes():
+    agg = StatsAggregator()
+    agg.report("node-a", DatapathStats(
+        ingress={"np-1/in/0": 10}, egress={"np-1/out/0": 5},
+        default_allow=7, default_deny=3,
+    ))
+    agg.report("node-b", DatapathStats(
+        ingress={"np-1/in/0": 1, "np-2/in/0": 2}, egress={},
+        default_allow=1, default_deny=0,
+    ))
+    assert agg.rule_stats()["np-1/in/0"] == 11
+    assert agg.policy_stats() == {"np-1": 16, "np-2": 2}
+    s = agg.summary()
+    assert s["nodes"] == 2 and s["defaultAllow"] == 8 and s["defaultDeny"] == 3
+    # Re-report replaces (cumulative counters, not deltas).
+    agg.report("node-b", DatapathStats(ingress={"np-2/in/0": 9}, egress={}))
+    assert agg.policy_stats() == {"np-1": 15, "np-2": 9}
+    agg.drop_node("node-a")
+    assert agg.summary()["nodes"] == 1
+
+
+# ---- NodeLatencyMonitor -----------------------------------------------------
+
+
+def test_node_latency_monitor():
+    rtts = {"10.0.0.2": 0.004, "10.0.0.3": None}
+    mon = NodeLatencyMonitor("node-a", probe=rtts.get, interval_s=60)
+    mon.upsert_peer("node-b", "10.0.0.2")
+    mon.upsert_peer("node-c", "10.0.0.3")
+    mon.upsert_peer("node-a", "10.0.0.1")  # self: ignored
+    assert mon.tick(now=100) == 2
+    assert mon.tick(now=130) == 0  # interval not elapsed
+    rtts["10.0.0.2"] = 0.002
+    assert mon.tick(now=170) == 2
+    rep = mon.report()
+    assert rep["nodeName"] == "node-a"
+    by = {r["nodeName"]: r for r in rep["peerNodeLatencyStats"]}
+    assert by["node-b"]["minRTT"] == 0.002 and by["node-b"]["maxRTT"] == 0.004
+    assert by["node-b"]["lost"] == 0 and by["node-c"]["lost"] == 2
+    assert by["node-c"]["lastMeasuredRTT"] is None
+    mon.delete_peer("node-c")
+    assert len(mon.report()["peerNodeLatencyStats"]) == 1
