@@ -66,10 +66,19 @@ class ExternalIPPoolController:
         self._cursor: dict[str, int] = {}
 
     def upsert(self, pool: ExternalIPPool) -> None:
-        # Validate ranges before committing; shrinking a pool below its
-        # current allocations is refused (the reference's validation webhook
-        # rejects removing in-use ranges).
+        # Validate ranges before committing; overlapping ranges are refused
+        # (they would double-count capacity and break the count-based
+        # exhaustion check) and shrinking a pool below its current
+        # allocations is refused — both mirror the reference's validation
+        # webhook on ExternalIPPool updates.
         bounds = [r.bounds() for r in pool.ip_ranges]
+        for a, b in zip(sorted(bounds), sorted(bounds)[1:]):
+            if b[0] <= a[1]:
+                raise ValueError(
+                    f"pool {pool.name}: overlapping ipRanges "
+                    f"{iputil.u32_to_ip(a[0])}-{iputil.u32_to_ip(a[1])} and "
+                    f"{iputil.u32_to_ip(b[0])}-{iputil.u32_to_ip(b[1])}"
+                )
         used = self._alloc.get(pool.name, {})
         for ip in used:
             if not any(lo <= ip <= hi for lo, hi in bounds):

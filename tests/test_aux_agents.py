@@ -1,0 +1,212 @@
+"""PacketCapture, SecondaryNetwork, WireGuard, ExternalNode tests —
+reference semantics cited per module."""
+
+import numpy as np
+import pytest
+
+from antrea_tpu.agent.packetcapture import (
+    CaptureSpec,
+    PacketCaptureController,
+    write_capture_file,
+)
+from antrea_tpu.agent.secondarynetwork import (
+    FIRST_SECONDARY_OFPORT,
+    NetworkAttachment,
+    SecondaryNetworkController,
+)
+from antrea_tpu.agent.wireguard import WireGuardClient
+from antrea_tpu.datapath import TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+
+def _batch(rows):
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(s) for s, _, _ in rows], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(d) for _, d, _ in rows], np.uint32),
+        proto=np.full(len(rows), 6, np.int32),
+        src_port=np.full(len(rows), 40000, np.int32),
+        dst_port=np.array([p for _, _, p in rows], np.int32),
+    )
+
+
+# ---- PacketCapture ----------------------------------------------------------
+
+
+def test_packetcapture_first_n_and_upload(tmp_path):
+    uploads = {}
+    pc = PacketCaptureController(
+        uploader=lambda name, recs: uploads.__setitem__(name, recs)
+    )
+    dp = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    pc.start(CaptureSpec(name="cap1", src_cidr="10.1.0.0/24", dst_port=80,
+                         first_n=3, timeout_s=100), now=0)
+    b = _batch([
+        ("10.1.0.5", "10.2.0.1", 80),
+        ("10.9.0.5", "10.2.0.1", 80),  # src outside filter
+        ("10.1.0.6", "10.2.0.2", 443),  # port outside filter
+        ("10.1.0.7", "10.2.0.3", 80),
+    ])
+    r = dp.step(b, now=1)
+    assert pc.observe(b, r, now=1) == 2
+    assert pc.status("cap1")["captured"] == 2 and not pc.status("cap1")["done"]
+    r2 = dp.step(b, now=2)
+    assert pc.observe(b, r2, now=2) == 1  # budget hits 3 -> done
+    st = pc.status("cap1")
+    assert st["done"] and st["reason"] == "firstNCaptured"
+    assert "cap1" in uploads and len(uploads["cap1"]) == 3
+    rec = uploads["cap1"][0]
+    assert rec["src"] == "10.1.0.5" and rec["dport"] == 80
+    assert "verdict" in rec and "fwd_kind" in rec
+    path = write_capture_file(str(tmp_path / "cap1.jsonl"), "cap1", uploads["cap1"])
+    assert len(open(path).read().splitlines()) == 4
+
+
+def test_packetcapture_timeout():
+    pc = PacketCaptureController()
+    dp = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    pc.start(CaptureSpec(name="idle", src_cidr="10.1.0.0/24", timeout_s=5), now=0)
+    b = _batch([("10.9.0.1", "10.2.0.1", 80)])  # never matches
+    r = dp.step(b, now=10)
+    pc.observe(b, r, now=10)
+    assert pc.status("idle")["done"] and pc.status("idle")["reason"] == "timeout"
+    assert pc.stop("idle") == []
+    assert pc.status("idle") is None
+
+
+# ---- SecondaryNetwork -------------------------------------------------------
+
+
+def test_secondary_attach_detach_and_restart(tmp_path):
+    from antrea_tpu.native import ConfigStore
+
+    store = ConfigStore(str(tmp_path / "conf.db"))
+    sn = SecondaryNetworkController(store=store)
+    sn.upsert_network(NetworkAttachment("vlan100", vlan=100, cidr="172.16.0.0/28"))
+    a = sn.attach("c1", "vlan100")
+    assert a.vlan == 100 and a.ofport >= FIRST_SECONDARY_OFPORT
+    assert sn.attach("c1", "vlan100") == a  # idempotent CmdAdd replay
+    b = sn.attach("c2", "vlan100")
+    assert b.ip != a.ip and b.ofport != a.ofport
+    with pytest.raises(KeyError):
+        sn.attach("c3", "nope")
+
+    # Restart: interfaces re-claimed from the persisted store; the IPAM
+    # won't re-hand out held addresses, ofports stay unique.
+    sn2 = SecondaryNetworkController(store=ConfigStore(str(tmp_path / "conf.db")))
+    sn2.upsert_network(NetworkAttachment("vlan100", vlan=100, cidr="172.16.0.0/28"))
+    assert [s.ip for s in sn2.interfaces("c1")] == [a.ip]
+    c = sn2.attach("c3", "vlan100")
+    assert c.ip not in {a.ip, b.ip} and c.ofport > b.ofport
+    assert sn2.detach("c1") == 1
+    assert sn2.interfaces("c1") == []
+
+
+def test_secondary_network_redefinition_refused_after_restart(tmp_path):
+    from antrea_tpu.native import ConfigStore
+
+    store = ConfigStore(str(tmp_path / "conf.db"))
+    sn = SecondaryNetworkController(store=store)
+    sn.upsert_network(NetworkAttachment("v", vlan=100, cidr="172.16.0.0/28"))
+    sn.attach("c1", "v")
+    sn2 = SecondaryNetworkController(store=ConfigStore(str(tmp_path / "conf.db")))
+    with pytest.raises(ValueError):
+        sn2.upsert_network(NetworkAttachment("v", vlan=200, cidr="192.168.0.0/24"))
+
+
+def test_packetcapture_full_range_filters():
+    """/0 and top-of-space /32 filters must not overflow uint32."""
+    pc = PacketCaptureController()
+    pc.start(CaptureSpec(name="all", src_cidr="0.0.0.0/0",
+                         dst_cidr="255.255.255.255/32", first_n=5), now=0)
+    dp = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    b = _batch([("10.1.0.5", "255.255.255.255", 80)])
+    r = dp.step(b, now=1)
+    assert pc.observe(b, r, now=1) == 1
+
+
+# ---- WireGuard --------------------------------------------------------------
+
+
+def test_wireguard_key_persistence_and_peers(tmp_path):
+    from antrea_tpu.native import ConfigStore
+
+    store = ConfigStore(str(tmp_path / "conf.db"))
+    wg = WireGuardClient("node-a", store=store)
+    pub = wg.public_key
+    # Key persists: restart publishes the same public key (client_linux.go
+    # loads the stored private key).
+    wg2 = WireGuardClient("node-a", store=ConfigStore(str(tmp_path / "conf.db")))
+    assert wg2.public_key == pub
+
+    assert wg.upsert_peer("node-b", "PKB", "192.168.1.2", ["10.10.1.0/24"])
+    assert not wg.upsert_peer("node-b", "PKB", "192.168.1.2", ["10.10.1.0/24"])
+    assert not wg.upsert_peer("node-a", "SELF", "192.168.1.1", ["10.10.0.0/24"])
+    assert wg.upsert_peer("node-c", "PKC", "192.168.1.3", ["10.10.2.0/24"])
+    assert [p.node for p in wg.peers()] == ["node-b", "node-c"]
+    # Cryptokey routing: destination -> owning peer.
+    p = wg.peer_for_ip(iputil.ip_to_u32("10.10.2.7"))
+    assert p is not None and p.node == "node-c"
+    assert wg.peer_for_ip(iputil.ip_to_u32("8.8.8.8")) is None
+    assert wg.delete_peer("node-b") and not wg.delete_peer("node-b")
+
+
+def test_wireguard_longest_prefix_routing():
+    wg = WireGuardClient("node-a")
+    wg.upsert_peer("aggregate", "PKA", "192.168.1.9", ["10.0.0.0/8"])
+    wg.upsert_peer("zspecific", "PKZ", "192.168.1.8", ["10.1.0.0/16"])
+    # Cryptokey routing is most-specific-prefix, not first-by-name.
+    assert wg.peer_for_ip(iputil.ip_to_u32("10.1.2.3")).node == "zspecific"
+    assert wg.peer_for_ip(iputil.ip_to_u32("10.2.0.1")).node == "aggregate"
+
+
+# ---- ExternalNode -----------------------------------------------------------
+
+
+def test_externalnode_policies_reach_vm_agent():
+    """An ACNP selecting VM labels applies to the ExternalEntity, spans to
+    the VM's agent, and enforces on a policy-only datapath — the
+    externalnode end-to-end (controller -> entities -> span -> enforcement)."""
+    from antrea_tpu.apis import crd
+    from antrea_tpu.controller.externalnode import (
+        ExternalNode,
+        ExternalNodeController,
+    )
+    from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+
+    npc = NetworkPolicyController()
+    enc = ExternalNodeController(npc)
+    en = ExternalNode(name="vm-1", namespace="vms",
+                      interface_ips=["172.20.0.5"],
+                      labels={"role": "db-vm"})
+    keys = enc.upsert(en)
+    assert keys == ["vms/vm-1-if0"]
+
+    acnp = crd.AntreaNetworkPolicy(
+        uid="acnp-vm", name="deny-vm-ingress", namespace="",
+        tier_priority=250, priority=1,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"role": "db-vm"}),
+            ns_selector=crd.LabelSelector.make(),
+        )],
+        rules=[crd.AntreaNPRule(
+            direction=crd.Direction.IN, action=crd.RuleAction.DROP,
+        )],
+    )
+    npc.upsert_antrea_policy(acnp)
+    # Span: the VM's own "node" (its agent identity) receives the policy.
+    ps = npc.policy_set_for_node("vm-1")
+    assert [p.uid for p in ps.policies] == ["acnp-vm"]
+    assert npc.policy_set_for_node("some-k8s-node").policies == []
+
+    # Enforcement on the VM agent's policy-only datapath.
+    dp = TpuflowDatapath(ps, flow_slots=1 << 10, aff_slots=1 << 8,
+                         miss_chunk=64)
+    b = _batch([("10.9.9.9", "172.20.0.5", 5432)])
+    assert dp.step(b, now=1).code.tolist() == [1]
+
+    # Interface removal drops the entity; deletion cleans up.
+    enc.upsert(ExternalNode(name="vm-1", namespace="vms",
+                            interface_ips=[], labels=en.labels))
+    assert npc.policy_set_for_node("vm-1").policies == []
+    assert enc.delete("vms/vm-1") == 0

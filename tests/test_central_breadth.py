@@ -110,6 +110,15 @@ def test_service_external_ip_assign_rollback():
     assert pools.usage("pool-a")["used"] == 1
 
 
+def test_pool_overlapping_ranges_rejected():
+    c = ExternalIPPoolController()
+    with pytest.raises(ValueError):
+        c.upsert(ExternalIPPool("p", ip_ranges=[
+            IPRange(cidr="10.0.0.0/30"),
+            IPRange(start="10.0.0.1", end="10.0.0.2"),
+        ]))
+
+
 def test_pool_cidr_excludes_network_and_broadcast():
     c = ExternalIPPoolController()
     c.upsert(ExternalIPPool("p", ip_ranges=[IPRange(cidr="10.50.0.0/29")]))
