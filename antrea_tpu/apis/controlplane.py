@@ -61,6 +61,11 @@ TIER_SECURITYOPS = 100
 TIER_NETWORKOPS = 150
 TIER_PLATFORM = 200
 TIER_APPLICATION = 250
+# AdminNetworkPolicy band: its own tier ahead of K8s NPs (the sig-network
+# precedence contract ANP > K8s NP > BANP; the reference materializes ANPs
+# as NetworkPolicyType.ADMIN internal policies in their own band).  ANP
+# priorities (0-1000) order WITHIN the band.
+TIER_ADMINNP = 245
 TIER_BASELINE = 253
 
 

@@ -227,6 +227,42 @@ class AntreaNetworkPolicy:
 
 
 @dataclass
+class AdminNetworkPolicy:
+    """sig-network-api AdminNetworkPolicy subset (the reference implements
+    it in pkg/controller/networkpolicy/adminnetworkpolicy handling;
+    NetworkPolicyType.ADMIN in controlplane types.go:200-218).
+
+    Cluster-scoped; `priority` 0-1000, LOWER evaluates earlier; subject is
+    either whole namespaces (ns selector only) or pods (ns + pod selector);
+    rule actions Allow / Deny / Pass.  Evaluated BEFORE K8s NetworkPolicies
+    (its own band ahead of the Antrea application tier)."""
+
+    name: str
+    priority: int  # 0-1000
+    subject: AntreaAppliedTo = None
+    rules: list[AntreaNPRule] = field(default_factory=list)
+
+    @property
+    def uid(self) -> str:
+        return f"anp-{self.name}"
+
+
+@dataclass
+class BaselineAdminNetworkPolicy:
+    """sig-network-api BaselineAdminNetworkPolicy: a cluster singleton
+    (name must be 'default') evaluated AFTER K8s NetworkPolicies — the
+    baseline tier; actions Allow / Deny only."""
+
+    subject: AntreaAppliedTo = None
+    rules: list[AntreaNPRule] = field(default_factory=list)
+    name: str = "default"
+
+    @property
+    def uid(self) -> str:
+        return f"banp-{self.name}"
+
+
+@dataclass
 class Tier:
     """Custom evaluation tier for Antrea-native policies.
 

@@ -31,19 +31,26 @@ from .grouping import GroupEntityIndex, GroupSelector
 
 @dataclass
 class EgressPolicy:
-    """crd Egress subset: appliedTo selector + the SNAT (egress) IP."""
+    """crd Egress subset: appliedTo selector + the SNAT (egress) IP.
+
+    egress_ip empty + external_ip_pool set = pool-allocated (crd Egress
+    spec.externalIPPool; the reference's controller allocates from the
+    named ExternalIPPool and writes it back to status, egress
+    controller + pkg/controller/externalippool)."""
 
     name: str
-    egress_ip: str
+    egress_ip: str = ""
     pod_selector: Optional[LabelSelector] = None
     ns_selector: Optional[LabelSelector] = None
+    external_ip_pool: str = ""
 
 
 class EgressController:
     """Central computation: Egress CRDs x grouping index -> pod ip ->
     egress ip; emits change notifications for agents to rebuild tables."""
 
-    def __init__(self, index: GroupEntityIndex):
+    def __init__(self, index: GroupEntityIndex, pools=None):
+        self._pools = pools  # ExternalIPPoolController (optional)
         self.index = index
         self.index.add_event_handler(self._on_groups_changed)
         self._policies: dict[str, EgressPolicy] = {}
@@ -58,6 +65,52 @@ class EgressController:
             fn()
 
     def upsert(self, eg: EgressPolicy) -> None:
+        from dataclasses import replace
+
+        old = self._policies.get(eg.name)
+        owner = f"egress:{eg.name}"
+        if eg.external_ip_pool:
+            # Pool-backed egress IP (crd spec.externalIPPool): allocate
+            # BEFORE touching any state so a failed allocation (unknown /
+            # exhausted pool, pinned IP taken) leaves the previous version
+            # intact.  A set egress_ip PINS that address in the pool — two
+            # Egresses must never SNAT to the same IP.
+            if self._pools is None:
+                raise ValueError(
+                    f"egress {eg.name}: no ExternalIPPool controller wired"
+                )
+            requested = eg.egress_ip or None
+            if (old is not None
+                    and old.external_ip_pool == eg.external_ip_pool
+                    and requested is not None
+                    and old.egress_ip != requested):
+                # Pinned-IP change within the pool: release-then-reallocate
+                # with rollback (single-threaded controller).
+                self._pools.release(eg.external_ip_pool, owner)
+                try:
+                    ip = self._pools.allocate(
+                        eg.external_ip_pool, owner, ip=requested
+                    )
+                except Exception:
+                    self._pools.allocate(
+                        eg.external_ip_pool, owner, ip=old.egress_ip
+                    )
+                    raise
+            else:
+                ip = self._pools.allocate(
+                    eg.external_ip_pool, owner, ip=requested
+                )
+            eg = replace(eg, egress_ip=ip)
+        elif not eg.egress_ip:
+            raise ValueError(
+                f"egress {eg.name}: needs egress_ip or external_ip_pool"
+            )
+        # A previous version's allocation in a DIFFERENT (or dropped) pool
+        # is stale now — release it, or the pool leaks forever.
+        if (old is not None and old.external_ip_pool
+                and old.external_ip_pool != eg.external_ip_pool
+                and self._pools is not None):
+            self._pools.release(old.external_ip_pool, owner)
         sel = GroupSelector(namespace="", pod_selector=eg.pod_selector,
                             ns_selector=eg.ns_selector)
         new_key = self.index.add_group(sel, owner="egress")
@@ -69,7 +122,9 @@ class EgressController:
         self._notify()
 
     def delete(self, name: str) -> None:
-        self._policies.pop(name, None)
+        eg = self._policies.pop(name, None)
+        if (eg is not None and eg.external_ip_pool and self._pools is not None):
+            self._pools.release(eg.external_ip_pool, f"egress:{name}")
         key = self._groups.pop(name, None)
         if key is not None:
             self._gc_group(key)

@@ -27,9 +27,11 @@ from typing import Callable, Optional
 from ..apis import controlplane as cp
 from ..apis.crd import (
     DEFAULT_TIERS,
+    AdminNetworkPolicy,
     AntreaAppliedTo,
     AntreaNetworkPolicy,
     AntreaPeer,
+    BaselineAdminNetworkPolicy,
     ClusterGroup,
     K8sNetworkPolicy,
     K8sPeer,
@@ -358,9 +360,22 @@ class NetworkPolicyController:
         old = self._tiers.get(tier.name)
         self._tiers[tier.name] = tier
         if old is not None and old.priority != tier.priority:
-            for anp in list(self._raw_anps.values()):
+            for uid, anp in list(self._raw_anps.items()):
                 if anp.tier == tier.name:
-                    self.upsert_antrea_policy(anp)
+                    self._resync_raw(uid)
+
+    def _resync_raw(self, uid: str) -> None:
+        """Re-convert + re-install a stored raw policy PRESERVING its kind:
+        ANP/BANP shadows live in _raw_anps alongside Antrea-native policies
+        (they share the conversion machinery), and a ClusterGroup/Tier
+        re-sync must not flip their internal type from ADMIN back to ACNP."""
+        shadow = self._raw_anps[uid]
+        if self._raw_uid_kind.get(uid) == "admin":
+            internal = self._convert_antrea(shadow)
+            internal.type = cp.NetworkPolicyType.ADMIN
+            self._install(uid, internal, kind="admin")
+        else:
+            self.upsert_antrea_policy(shadow)
 
     def delete_tier(self, name: str) -> None:
         """Refuses while policies reference the tier (the validation-webhook
@@ -383,10 +398,10 @@ class NetworkPolicyController:
     def upsert_cluster_group(self, cg: ClusterGroup) -> None:
         self._cluster_groups[cg.name] = cg
         # Re-convert referencing policies so their peers track the new spec.
-        for anp in list(self._raw_anps.values()):
+        for uid, anp in list(self._raw_anps.items()):
             if any(p.group and self._cg_refs(p.group, cg.name)
                    for r in anp.rules for p in r.peers):
-                self.upsert_antrea_policy(anp)
+                self._resync_raw(uid)
 
     def delete_cluster_group(self, name: str) -> None:
         users = [
@@ -464,6 +479,46 @@ class NetworkPolicyController:
         else:
             st.refs.add(ref_uid)
         return key
+
+    # -- AdminNetworkPolicy / BaselineAdminNetworkPolicy ---------------------
+    # (sig-network policy-api; ref NetworkPolicyType.ADMIN types.go:200-218
+    # and the reference controller's ANP/BANP conversion.)  Both reuse the
+    # Antrea-native conversion machinery — an ANP is structurally a
+    # cluster-scoped policy in a dedicated tier band — with the internal
+    # type overridden to ADMIN so consumers can tell them apart.
+
+    def upsert_admin_policy(self, anp: AdminNetworkPolicy) -> None:
+        if not (0 <= anp.priority <= 1000):
+            raise ValueError("AdminNetworkPolicy priority must be 0-1000")
+        for r in anp.rules:
+            if r.action not in (cp.RuleAction.ALLOW, cp.RuleAction.DROP,
+                                cp.RuleAction.PASS):
+                raise ValueError(f"ANP action {r.action} not allowed")
+        self._install_admin(anp, cp.TIER_ADMINNP, float(anp.priority))
+
+    def upsert_baseline_admin_policy(
+        self, banp: BaselineAdminNetworkPolicy
+    ) -> None:
+        if banp.name != "default":
+            raise ValueError(
+                "BaselineAdminNetworkPolicy is a singleton named 'default'"
+            )
+        for r in banp.rules:
+            if r.action == cp.RuleAction.PASS:
+                raise ValueError("BANP rules cannot use Pass")
+        self._install_admin(banp, cp.TIER_BASELINE, 0.0)
+
+    def _install_admin(self, obj, tier_priority: int, priority: float) -> None:
+        shadow = AntreaNetworkPolicy(
+            uid=obj.uid, name=obj.name, namespace="",
+            tier_priority=tier_priority, priority=priority,
+            applied_to=[obj.subject] if obj.subject is not None else [],
+            rules=list(obj.rules),
+        )
+        internal = self._convert_antrea(shadow)
+        internal.type = cp.NetworkPolicyType.ADMIN
+        self._raw_anps[obj.uid] = shadow
+        self._install(obj.uid, internal, kind="admin")
 
     # -- Antrea-native policies ----------------------------------------------
 

@@ -1,0 +1,68 @@
+"""Fake-agent fleet: scale-testing the controller's watch fan-out.
+
+The analog of /root/reference/cmd/antrea-agent-simulator
+(simulator.go:15-18; docs/antrea-agent-simulator.md): watch-only fake
+agents deployed at scale to stress the controller's dissemination plane —
+they subscribe like real agents, track what they receive, and never touch a
+dataplane.  BASELINE.json names this as the CPU-reference driver.
+
+Each FakeAgent holds a queued watcher on the RamStore under its node name
+and maintains the same local object tables a real AgentPolicyController
+would, so fleet-wide assertions can check span filtering (an agent sees a
+policy iff the policy spans its node) and fan-out cost (events delivered
+vs objects changed)."""
+
+from __future__ import annotations
+
+from ..controller.networkpolicy import WatchEvent
+
+
+class FakeAgent:
+    def __init__(self, store, node: str):
+        self.node = node
+        self._watcher = store.watch_queue(node)
+        self.policies: dict[str, object] = {}
+        self.address_groups: dict[str, object] = {}
+        self.applied_to_groups: dict[str, object] = {}
+        self.events_seen = 0
+
+    def pump(self) -> int:
+        """Drain pending events into the local tables; -> events consumed."""
+        n = 0
+        for ev in self._watcher.drain():
+            self._apply(ev)
+            n += 1
+        self.events_seen += n
+        return n
+
+    def _apply(self, ev: WatchEvent) -> None:
+        table = {
+            "NetworkPolicy": self.policies,
+            "AddressGroup": self.address_groups,
+            "AppliedToGroup": self.applied_to_groups,
+        }[ev.obj_type]
+        if ev.kind == "DELETED":
+            table.pop(ev.name, None)
+        else:
+            table[ev.name] = ev.obj
+
+    def stop(self) -> None:
+        self._watcher.stop()
+
+
+class FakeAgentFleet:
+    def __init__(self, store, nodes: list[str]):
+        self.agents = {n: FakeAgent(store, n) for n in nodes}
+
+    def pump(self) -> int:
+        return sum(a.pump() for a in self.agents.values())
+
+    def total_events(self) -> int:
+        return sum(a.events_seen for a in self.agents.values())
+
+    def policies_on(self, node: str) -> set:
+        return set(self.agents[node].policies)
+
+    def stop(self) -> None:
+        for a in self.agents.values():
+            a.stop()

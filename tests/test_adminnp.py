@@ -1,0 +1,164 @@
+"""AdminNetworkPolicy / BaselineAdminNetworkPolicy tests.
+
+Precedence contract under test (sig-network policy-api, realized by the
+reference as NetworkPolicyType.ADMIN internal policies): ANP evaluates
+before K8s NetworkPolicies (Deny/Allow terminal, Pass delegates), BANP
+evaluates after them (only for pods no K8s NP isolates)."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import crd
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+
+def _controller_with_pods():
+    ctl = NetworkPolicyController()
+    ctl.upsert_namespace(crd.Namespace(name="prod", labels={"env": "prod"}))
+    for name, ip, labels in [
+        ("web", "10.0.0.10", {"app": "web"}),
+        ("db", "10.0.0.11", {"app": "db"}),
+        ("client", "10.0.0.12", {"app": "client"}),
+    ]:
+        ctl.upsert_pod(crd.Pod(namespace="prod", name=name, ip=ip,
+                               node="node-a", labels=labels))
+    return ctl
+
+
+def _step(ps, src, dst, port=80):
+    tpu = TpuflowDatapath(copy.deepcopy(ps), flow_slots=1 << 10,
+                          aff_slots=1 << 8, miss_chunk=64)
+    orc = OracleDatapath(copy.deepcopy(ps), flow_slots=1 << 10, aff_slots=1 << 8)
+    b = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([40000], np.int32),
+        dst_port=np.array([port], np.int32),
+    )
+    ra, rb = tpu.step(b, now=1), orc.step(b, now=1)
+    assert ra.code.tolist() == rb.code.tolist()
+    return int(ra.code[0])
+
+
+def _subject(app):
+    return crd.AntreaAppliedTo(
+        pod_selector=crd.LabelSelector.make({"app": app}),
+        ns_selector=crd.LabelSelector.make(),
+    )
+
+
+def _peer(app):
+    return crd.AntreaPeer(pod_selector=crd.LabelSelector.make({"app": app}),
+                          ns_selector=crd.LabelSelector.make())
+
+
+def test_anp_deny_beats_k8s_allow():
+    ctl = _controller_with_pods()
+    # K8s NP allows client -> web.
+    ctl.upsert_k8s_policy(crd.K8sNetworkPolicy(
+        uid="np-allow", name="allow-client", namespace="prod",
+        pod_selector=crd.LabelSelector.make({"app": "web"}),
+        ingress=[crd.K8sNPRule(peers=[crd.K8sPeer(
+            pod_selector=crd.LabelSelector.make({"app": "client"}))])],
+        policy_types=[cp.Direction.IN],
+    ))
+    assert _step(ctl.policy_set(), "10.0.0.12", "10.0.0.10") == 0
+    # ANP Deny wins over the K8s allow (evaluated earlier).
+    ctl.upsert_admin_policy(crd.AdminNetworkPolicy(
+        name="lockdown", priority=10, subject=_subject("web"),
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP,
+                                peers=[_peer("client")])],
+    ))
+    assert _step(ctl.policy_set(), "10.0.0.12", "10.0.0.10") == 1
+    # ANP Pass delegates back to the K8s NP (allow again); lower priority
+    # value evaluates first, so the Pass at priority 5 shadows the Deny.
+    ctl.upsert_admin_policy(crd.AdminNetworkPolicy(
+        name="exempt", priority=5, subject=_subject("web"),
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.PASS,
+                                peers=[_peer("client")])],
+    ))
+    assert _step(ctl.policy_set(), "10.0.0.12", "10.0.0.10") == 0
+
+
+def test_banp_applies_only_without_k8s_isolation():
+    ctl = _controller_with_pods()
+    # BANP denies everything to db pods.
+    ctl.upsert_baseline_admin_policy(crd.BaselineAdminNetworkPolicy(
+        subject=_subject("db"),
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP)],
+    ))
+    ps = ctl.policy_set()
+    assert _step(ps, "10.0.0.12", "10.0.0.11") == 1  # baseline deny
+    assert _step(ps, "10.0.0.12", "10.0.0.10") == 0  # web unaffected
+    # A K8s NP isolating db takes over: its allow decides, baseline is
+    # never consulted for isolated pods.
+    ctl.upsert_k8s_policy(crd.K8sNetworkPolicy(
+        uid="np-db", name="allow-web-to-db", namespace="prod",
+        pod_selector=crd.LabelSelector.make({"app": "db"}),
+        ingress=[crd.K8sNPRule(peers=[crd.K8sPeer(
+            pod_selector=crd.LabelSelector.make({"app": "web"}))])],
+        policy_types=[cp.Direction.IN],
+    ))
+    ps2 = ctl.policy_set()
+    assert _step(ps2, "10.0.0.10", "10.0.0.11") == 0  # K8s allow
+    assert _step(ps2, "10.0.0.12", "10.0.0.11") == 1  # K8s default deny
+
+
+def test_admin_validation():
+    ctl = _controller_with_pods()
+    with pytest.raises(ValueError):
+        ctl.upsert_admin_policy(crd.AdminNetworkPolicy(
+            name="bad", priority=2000, subject=_subject("web")))
+    with pytest.raises(ValueError):
+        ctl.upsert_baseline_admin_policy(crd.BaselineAdminNetworkPolicy(
+            name="not-default", subject=_subject("web")))
+    with pytest.raises(ValueError):
+        ctl.upsert_baseline_admin_policy(crd.BaselineAdminNetworkPolicy(
+            subject=_subject("web"),
+            rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                    action=cp.RuleAction.PASS)],
+        ))
+    # Internal type is ADMIN; deletion cleans up.
+    ctl.upsert_admin_policy(crd.AdminNetworkPolicy(
+        name="ok", priority=1, subject=_subject("web"),
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP)],
+    ))
+    ps = ctl.policy_set()
+    admin = [p for p in ps.policies if p.type == cp.NetworkPolicyType.ADMIN]
+    assert [p.uid for p in admin] == ["anp-ok"]
+    ctl.delete_policy("anp-ok")
+    assert all(p.type != cp.NetworkPolicyType.ADMIN
+               for p in ctl.policy_set().policies)
+
+
+def test_admin_type_survives_cluster_group_resync():
+    """A ClusterGroup update re-converts referencing policies; an ANP
+    referencing one must come back as type ADMIN, not flip to ACNP."""
+    ctl = _controller_with_pods()
+    ctl.upsert_cluster_group(crd.ClusterGroup(
+        name="clients", pod_selector=crd.LabelSelector.make({"app": "client"}),
+        ns_selector=crd.LabelSelector.make(),
+    ))
+    ctl.upsert_admin_policy(crd.AdminNetworkPolicy(
+        name="via-cg", priority=3, subject=_subject("web"),
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP,
+                                peers=[crd.AntreaPeer(group="clients")])],
+    ))
+    ctl.upsert_cluster_group(crd.ClusterGroup(
+        name="clients", pod_selector=crd.LabelSelector.make({"app": "db"}),
+        ns_selector=crd.LabelSelector.make(),
+    ))
+    types = {p.uid: p.type for p in ctl.policy_set().policies}
+    assert types["anp-via-cg"] == cp.NetworkPolicyType.ADMIN

@@ -128,6 +128,42 @@ def test_pool_cidr_excludes_network_and_broadcast():
         c.allocate("p", "o9")
 
 
+def test_egress_allocates_from_pool():
+    """crd Egress spec.externalIPPool: the controller allocates the SNAT IP
+    from the pool and releases on delete."""
+    from antrea_tpu.apis.crd import LabelSelector
+    from antrea_tpu.controller.egress import EgressController, EgressPolicy
+    from antrea_tpu.controller.grouping import GroupEntityIndex
+
+    pools = ExternalIPPoolController()
+    pools.upsert(_pool())
+    idx = GroupEntityIndex()
+    ec = EgressController(idx, pools=pools)
+    ec.upsert(EgressPolicy(
+        name="eg-1", pod_selector=LabelSelector.make({"team": "a"}),
+        external_ip_pool="pool-a",
+    ))
+    assert pools.usage("pool-a")["used"] == 1
+    with pytest.raises(KeyError):  # unknown pool: previous state intact
+        ec.upsert(EgressPolicy(name="eg-2", external_ip_pool="nope"))
+    with pytest.raises(ValueError):  # neither ip nor pool
+        ec.upsert(EgressPolicy(name="eg-3"))
+    ec.delete("eg-1")
+    assert pools.usage("pool-a")["used"] == 0
+
+    # Spec edits must not leak allocations: pool -> static releases; a
+    # static IP WITH a pool pins that address in the pool.
+    ec.upsert(EgressPolicy(name="eg-4", external_ip_pool="pool-a"))
+    ec.upsert(EgressPolicy(name="eg-4", egress_ip="9.9.9.9"))
+    assert pools.usage("pool-a")["used"] == 0
+    ec.upsert(EgressPolicy(name="eg-5", egress_ip="10.100.0.2",
+                           external_ip_pool="pool-a"))
+    assert pools.usage("pool-a")["used"] == 1
+    with pytest.raises(ValueError):  # pinned IP already taken
+        ec.upsert(EgressPolicy(name="eg-6", egress_ip="10.100.0.2",
+                               external_ip_pool="pool-a"))
+
+
 # ---- BGP --------------------------------------------------------------------
 
 

@@ -1,0 +1,100 @@
+"""Fake-agent fleet scale test: span-filtered fan-out over many agents
+(the antrea-agent-simulator model, cmd/antrea-agent-simulator)."""
+
+from antrea_tpu.apis import crd
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.dissemination import RamStore
+from antrea_tpu.simulator.fleet import FakeAgentFleet
+
+N_NODES = 40
+PODS_PER_NODE = 4
+
+
+def _world():
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    nodes = [f"node-{i:03d}" for i in range(N_NODES)]
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ip = 0
+    for ni, node in enumerate(nodes):
+        for p in range(PODS_PER_NODE):
+            ip += 1
+            ctl.upsert_pod(crd.Pod(
+                namespace="default", name=f"pod-{ni}-{p}",
+                ip=f"10.{(ip >> 8) & 0xFF}.{ip & 0xFF}.1", node=node,
+                # Tag pods on even nodes so policies can target half the
+                # fleet.
+                labels={"tier": "even" if ni % 2 == 0 else "odd"},
+            ))
+    return ctl, store, nodes
+
+
+def test_span_filtered_fanout_at_fleet_scale():
+    ctl, store, nodes = _world()
+    fleet = FakeAgentFleet(store, nodes)
+    fleet.pump()
+
+    # A policy applying to even-node pods must reach exactly the even
+    # nodes' agents.
+    ctl.upsert_antrea_policy(crd.AntreaNetworkPolicy(
+        uid="acnp-even", name="even-only", namespace="",
+        tier_priority=250, priority=1,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"tier": "even"}),
+            ns_selector=crd.LabelSelector.make(),
+        )],
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP)],
+    ))
+    fleet.pump()
+    for i, node in enumerate(nodes):
+        expect = {"acnp-even"} if i % 2 == 0 else set()
+        assert fleet.policies_on(node) == expect, node
+
+    # Fan-out cost: the policy event went only to spanned agents — the
+    # whole point of span dissemination (architecture.md:57-60).  Every
+    # agent also got the appliedTo group (spanned the same way), so the
+    # per-change delivery is O(span), not O(agents).
+    before = fleet.total_events()
+    ctl.upsert_pod(crd.Pod(
+        namespace="default", name="pod-0-0", ip="10.0.1.1",
+        node="node-000", labels={"tier": "even", "extra": "1"},
+    ))
+    delta = fleet.pump()
+    # A single-pod relabel churns only the groups containing it: events
+    # reach the spanned half of the fleet at most, not everyone.
+    assert delta <= N_NODES // 2 + 2, delta
+    assert fleet.total_events() == before + delta
+
+    # Deletion withdraws everywhere it was delivered.
+    ctl.delete_policy("acnp-even")
+    fleet.pump()
+    assert all(not fleet.policies_on(n) for n in nodes)
+    fleet.stop()
+    assert store.n_watchers == 0
+
+
+def test_fleet_sees_consistent_groups():
+    ctl, store, nodes = _world()
+    fleet = FakeAgentFleet(store, nodes)
+    ctl.upsert_antrea_policy(crd.AntreaNetworkPolicy(
+        uid="acnp-all", name="all-pods", namespace="",
+        tier_priority=250, priority=2,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make(),
+            ns_selector=crd.LabelSelector.make(),
+        )],
+        rules=[crd.AntreaNPRule(direction=cp.Direction.OUT,
+                                action=cp.RuleAction.ALLOW)],
+    ))
+    fleet.pump()
+    # Every agent got the policy and its appliedTo group; the group object
+    # an agent holds contains members (the full group — per-node member
+    # filtering is the agent's own concern in this build).
+    for node in nodes:
+        a = fleet.agents[node]
+        assert set(a.policies) == {"acnp-all"}
+        assert len(a.applied_to_groups) == 1
+    fleet.stop()
