@@ -2,6 +2,7 @@ from .mesh import (  # noqa: F401
     make_mesh,
     make_sharded_classifier,
     make_sharded_pipeline,
+    make_sharded_pipeline_full,
     shard_rule_set,
     shard_state,
 )

@@ -44,6 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..compiler.compile import CompiledPolicySet
 from ..compiler.services import ServiceTables
+from ..compiler.topology import ForwardingTables
+from ..models import forwarding as fw
 from ..models import pipeline as pl
 from ..ops import match as m
 
@@ -170,6 +172,87 @@ def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh):
     return fn, drs
 
 
+def _fwd_specs() -> fw.DeviceForwardingTables:
+    # Forwarding tables are the small, read-mostly side (pods + nodes of
+    # ONE node's world): replicated, like the interval-bounds tables.
+    return fw.DeviceForwardingTables(
+        *([P()] * len(fw.DeviceForwardingTables._fields))
+    )
+
+
+def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
+                        ct_timeout_s, miss_chunk):
+    """Shared builder behind make_sharded_pipeline[_full] — one place for
+    the capacity check, placement, meta/state construction and shard_map
+    scaffolding so the two public variants can never drift."""
+    pl.check_rule_capacity(cps)
+    drs, match_meta = shard_rule_set(cps, mesh)
+    repl = NamedSharding(mesh, P())
+    dsvc = jax.tree.map(
+        lambda x: jax.device_put(x, repl), pl.svc_to_device(svc)
+    )
+    dft = None
+    if ft is not None:
+        dft = jax.tree.map(
+            lambda x: jax.device_put(x, repl), fw.fwd_to_device(ft)
+        )
+    meta = pl.PipelineMeta(
+        match=match_meta,
+        flow_slots=flow_slots,
+        aff_slots=aff_slots,
+        ct_timeout_s=ct_timeout_s,
+        miss_chunk=miss_chunk,
+    )
+    state = shard_state(pl.init_state(flow_slots, aff_slots), mesh)
+
+    def finish(local, out):
+        # scalar per shard -> (D,) vector of per-data-shard counts
+        out["n_miss"] = out["n_miss"][None]
+        out["n_evict"] = out["n_evict"][None]
+        return jax.tree.map(lambda x: x[None], local), out
+
+    if ft is None:
+        def body(state, drs, dsvc, src_f, dst_f, proto, sport, dport,
+                 now, gen):
+            # Local view: strip the leading data axis (size 1 per shard).
+            local = jax.tree.map(lambda x: x[0], state)
+            local, out = pl._pipeline_step(
+                local, drs, dsvc, src_f, dst_f, proto, sport, dport,
+                now, gen, meta=meta, hit_combine=_pmin_rule,
+            )
+            return finish(local, out)
+
+        in_specs = (
+            _state_specs(), _drs_specs(), _svc_specs(),
+            P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(), P(),
+        )
+    else:
+        def body(state, drs, dsvc, dft, src_f, dst_f, proto, sport,
+                 dport, in_port, now, gen):
+            local = jax.tree.map(lambda x: x[0], state)
+            local, out = fw._pipeline_step_full(
+                local, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
+                in_port, now, gen, meta=meta, hit_combine=_pmin_rule,
+            )
+            return finish(local, out)
+
+        in_specs = (
+            _state_specs(), _drs_specs(), _svc_specs(), _fwd_specs(),
+            P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(), P(),
+        )
+
+    step = jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(_state_specs(), P(DATA)),
+        # Verdicts after the pmin are replicated over `rule` by
+        # construction; check_vma cannot prove it (module docstring).
+        check_vma=False,
+    ))
+    return step, state, drs, dsvc, dft
+
+
 def make_sharded_pipeline(
     cps: CompiledPolicySet,
     svc: ServiceTables,
@@ -188,60 +271,34 @@ def make_sharded_pipeline(
     flow-cache/affinity tables.  Each data shard takes its own slow path
     only when ITS slice of the batch has cache misses.
     """
-    pl.check_rule_capacity(cps)
-    drs, match_meta = shard_rule_set(cps, mesh)
-    dsvc = jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P())),
-        pl.svc_to_device(svc),
+    step, state, drs, dsvc, _dft = _build_sharded_step(
+        cps, svc, mesh, None, flow_slots, aff_slots, ct_timeout_s, miss_chunk
     )
-    meta = pl.PipelineMeta(
-        match=match_meta,
-        flow_slots=flow_slots,
-        aff_slots=aff_slots,
-        ct_timeout_s=ct_timeout_s,
-        miss_chunk=miss_chunk,
-    )
-    state = shard_state(pl.init_state(flow_slots, aff_slots), mesh)
-
-    def body(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen):
-        # Local view: strip the leading data axis (size 1 per shard).
-        local = jax.tree.map(lambda x: x[0], state)
-        local, out = pl._pipeline_step(
-            local,
-            drs,
-            dsvc,
-            src_f,
-            dst_f,
-            proto,
-            sport,
-            dport,
-            now,
-            gen,
-            meta=meta,
-            hit_combine=_pmin_rule,
-        )
-        # scalar per shard -> (D,) vector of per-data-shard counts
-        out["n_miss"] = out["n_miss"][None]
-        out["n_evict"] = out["n_evict"][None]
-        return jax.tree.map(lambda x: x[None], local), out
-
-    shmapped = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(
-            _state_specs(),
-            _drs_specs(),
-            _svc_specs(),
-            P(DATA),
-            P(DATA),
-            P(DATA),
-            P(DATA),
-            P(DATA),
-            P(),
-            P(),
-        ),
-        out_specs=(_state_specs(), P(DATA)),
-        check_vma=False,
-    )
-    step = jax.jit(shmapped)
     return step, state, (drs, dsvc)
+
+
+def make_sharded_pipeline_full(
+    cps: CompiledPolicySet,
+    svc: ServiceTables,
+    ft: ForwardingTables,
+    mesh: Mesh,
+    *,
+    flow_slots: int = 1 << 20,
+    aff_slots: int = 1 << 18,
+    ct_timeout_s: int = 3600,
+    miss_chunk: int = 4096,
+):
+    """The FULL per-packet walk (SpoofGuard -> policy/service pipeline ->
+    L2/L3 forward -> Output, models/forwarding._pipeline_step_full), SPMD
+    over (data, rule) — the production multi-chip step.
+
+    -> (step, state, (drs, dsvc, dft)); step(state, drs, dsvc, dft, src_f,
+    dst_f, proto, sport, dport, in_port, now, gen) -> (state', out).
+    Forwarding is stateless per-packet, so it shards trivially over the
+    data axis with replicated topology tables; the rule axis participates
+    only in the classification pmin, exactly as in make_sharded_pipeline.
+    """
+    step, state, drs, dsvc, dft = _build_sharded_step(
+        cps, svc, mesh, ft, flow_slots, aff_slots, ct_timeout_s, miss_chunk
+    )
+    return step, state, (drs, dsvc, dft)

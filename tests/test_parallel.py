@@ -102,3 +102,71 @@ def test_sharded_pipeline_matches_single(cluster, batch):
             )
     # Established fast path engaged on step 2 for repeat flows.
     assert int(np.asarray(outN["est"]).sum()) > 0
+
+
+def test_sharded_full_walk_matches_single(cluster):
+    """The FULL sharded walk (SpoofGuard -> pipeline -> forward -> Output,
+    make_sharded_pipeline_full) is bit-identical to the single-chip
+    pipeline_step_full — the production multi-chip step the driver
+    dry-runs (__graft_entry__.dryrun_multichip)."""
+    from antrea_tpu.compiler.topology import (
+        NodeRoute, Topology, compile_topology,
+    )
+    from antrea_tpu.models import forwarding as fwd
+    from antrea_tpu.parallel import make_sharded_pipeline_full
+
+    cps = compile_policy_set(cluster.ps)
+    services = gen_services(8, cluster.pod_ips, seed=9)
+    svc = compile_services(services)
+    topo = Topology(
+        node_name="node-0",
+        pod_cidr="10.0.0.0/24",
+        local_pods=[
+            (iputil.u32_to_ip(int(u)), 3 + i)
+            for i, u in enumerate(cluster.pod_ips[:10])
+        ],
+        # node-1's REAL podCIDR (gen_cluster pods live at 10.0.<node>.x) so
+        # cross-node traffic exercises the FWD_TUNNEL/peer_f branch.
+        remote_nodes=[NodeRoute(name="node-1", node_ip="192.168.0.2",
+                                pod_cidr="10.0.1.0/24")],
+    )
+    ft = compile_topology(topo)
+    tr = gen_traffic(cluster.pod_ips, 1024, n_flows=256, seed=11,
+                     services=services, svc_fraction=0.3)
+    rng = np.random.default_rng(5)
+    in_port = rng.choice(
+        np.array([-1, 1, 2, 3, 4, 5], np.int32), size=1024
+    )
+    src_f, dst_f, proto, sport, dport = _cols(tr)
+
+    step1, st1, (drs1, dsvc1) = make_pipeline(
+        cps, svc, flow_slots=1 << 14, aff_slots=1 << 12
+    )
+    dft1 = fwd.fwd_to_device(ft)
+    mesh = _mesh(2, 4)
+    stepN, stN, (drsN, dsvcN, dftN) = make_sharded_pipeline_full(
+        cps, svc, ft, mesh, flow_slots=1 << 14, aff_slots=1 << 12
+    )
+
+    for t in range(2):
+        st1, out1 = fwd.pipeline_step_full(
+            st1, drs1, dsvc1, dft1, jnp.asarray(src_f), jnp.asarray(dst_f),
+            jnp.asarray(proto), jnp.asarray(sport), jnp.asarray(dport),
+            jnp.asarray(in_port), jnp.int32(1000 + t), jnp.int32(0),
+            meta=step1.meta,
+        )
+        stN, outN = stepN(
+            stN, drsN, dsvcN, dftN, src_f, dst_f, proto, sport, dport,
+            in_port, jnp.int32(1000 + t), jnp.int32(0),
+        )
+        for k in ("code", "est", "spoofed", "fwd_kind", "out_port",
+                  "peer_f", "dec_ttl", "mcast_idx", "dnat_ip_f"):
+            np.testing.assert_array_equal(
+                np.asarray(outN[k]), np.asarray(out1[k]),
+                err_msg=f"step{t}:{k}",
+            )
+    assert int(np.asarray(outN["est"]).sum()) > 0
+    # The interesting branches actually fired in this world.
+    from antrea_tpu.compiler.topology import FWD_TUNNEL
+    assert int((np.asarray(outN["fwd_kind"]) == FWD_TUNNEL).sum()) > 0
+    assert int(np.asarray(outN["spoofed"]).sum()) > 0
