@@ -146,6 +146,25 @@ def _cmd_traceflow(args) -> int:
     return 0
 
 
+def _cmd_supportbundle(args) -> int:
+    """Collect a support bundle from persisted state (the antctl
+    supportbundle raw command, ref pkg/antctl/raw/supportbundle): rebuilds
+    a datapath from the snapshot and tars its observable surfaces."""
+    from .datapath import OracleDatapath
+    from .observability.supportbundle import collect_bundle
+
+    _load(args.state)  # fail fast with the CLI error on a bad state dir
+    # Reconstruct THROUGH the persistence path so the bundle's meta.json
+    # carries the snapshot's real generation (cookie round), not 0.
+    dp = OracleDatapath(flow_slots=1 << 10, aff_slots=1 << 8,
+                        persist_dir=args.state)
+    members = collect_bundle(
+        dp, args.out, node=args.node, now=0, persist_dir=args.state,
+    )
+    print(json.dumps({"bundle": args.out, "members": members}, indent=2))
+    return 0
+
+
 def _cmd_query_endpoint(args) -> int:
     """Snapshot-based endpoint query: membership sets computed by pod IP,
     then the shared policy scan (controller/endpoint_querier.scan_policies
@@ -211,6 +230,12 @@ def main(argv=None) -> int:
     qe.add_argument("--pod", default="")
     qe.add_argument("--ip", required=True)
     qe.set_defaults(fn=_cmd_query_endpoint)
+
+    sb = sub.add_parser("supportbundle", help="collect a diagnostic bundle")
+    sb.add_argument("--state", required=True)
+    sb.add_argument("--out", required=True, help="output .tar.gz path")
+    sb.add_argument("--node", default="")
+    sb.set_defaults(fn=_cmd_supportbundle)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=lambda a: (print(VERSION), 0)[1])

@@ -58,15 +58,16 @@ class FlowExporter:
         self.records: list[dict] = []
         self._sink = sink
         self.path = path
+        # path= is sugar for a JSONL log sink (one format, one place).
+        self._path_sink = JsonlFileSink(path) if path is not None else None
 
     def _emit(self, rec: dict) -> None:
         if self._keep:
             self.records.append(rec)
         if self._sink is not None:
             self._sink(rec)
-        if self.path is not None:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        if self._path_sink is not None:
+            self._path_sink(rec)
 
     def poll(self, now: int) -> int:
         """One conntrack-poll cycle; returns records emitted."""
@@ -100,6 +101,96 @@ class FlowExporter:
             })
             emitted += 1
         return emitted
+
+
+class JsonlFileSink:
+    """Log exporter analog (flowaggregator logger exporter): one JSON line
+    per record appended to a file."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __call__(self, rec: dict) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+
+class TableSink:
+    """ClickHouse-exporter analog: records land as rows in an in-memory
+    table with a fixed column set, queryable by equality filters (the
+    export schema of pkg/flowaggregator/clickhouseclient)."""
+
+    COLUMNS = (
+        "src", "dst", "sport", "dport", "proto", "node", "event",
+        "export_ts",
+    )
+
+    def __init__(self):
+        self.rows: list[tuple] = []
+
+    def __call__(self, rec: dict) -> None:
+        self.rows.append(tuple(rec.get(c) for c in self.COLUMNS))
+
+    def query(self, **eq) -> list[tuple]:
+        idx = {c: i for i, c in enumerate(self.COLUMNS)}
+        return [
+            r for r in self.rows
+            if all(r[idx[k]] == v for k, v in eq.items())
+        ]
+
+
+class BatchDirSink:
+    """S3-uploader analog (pkg/flowaggregator s3uploader): records buffer
+    into objects of `batch_size` and each full batch is written as one
+    object file in the target directory; flush() uploads a partial tail."""
+
+    def __init__(self, directory: str, batch_size: int = 100):
+        import os
+        import re
+
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.batch_size = batch_size
+        self._buf: list[dict] = []
+        # Resume past existing objects — restarting over a populated
+        # directory must append, never overwrite exported batches.
+        taken = [
+            int(m.group(1))
+            for f in os.listdir(directory)
+            if (m := re.fullmatch(r"records-(\d{6})\.jsonl", f))
+        ]
+        self._n_objects = max(taken) + 1 if taken else 0
+
+    def __call__(self, rec: dict) -> None:
+        self._buf.append(rec)
+        if len(self._buf) >= self.batch_size:
+            self.flush()
+
+    def flush(self) -> Optional[str]:
+        import os
+
+        if not self._buf:
+            return None
+        path = os.path.join(self.dir, f"records-{self._n_objects:06d}.jsonl")
+        with open(path, "w") as f:
+            for rec in self._buf:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._n_objects += 1
+        self._buf = []
+        return path
+
+
+def fanout(*sinks) -> Callable[[dict], None]:
+    """Compose sinks into one FlowExporter/aggregator callback — the
+    aggregator's multi-exporter fan-out
+    (pkg/flowaggregator/flowaggregator.go:90-104 wiring IPFIX + ClickHouse
+    + S3 + log exporters side by side)."""
+
+    def emit(rec: dict) -> None:
+        for s in sinks:
+            s(rec)
+
+    return emit
 
 
 class FlowAggregator:

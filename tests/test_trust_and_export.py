@@ -1,0 +1,206 @@
+"""IPsec certificate workflow, flow-export sinks, antctl supportbundle."""
+
+import json
+import os
+import tarfile
+
+import pytest
+
+from antrea_tpu.agent.ipsec import IpsecCertificateController
+from antrea_tpu.controller.certificates import (
+    SIGNER_IPSEC,
+    CertificateAuthority,
+    Csr,
+    CsrController,
+)
+from antrea_tpu.observability.flowexport import (
+    BatchDirSink,
+    FlowExporter,
+    JsonlFileSink,
+    TableSink,
+    fanout,
+)
+
+
+# ---- CSR / CA ---------------------------------------------------------------
+
+
+def _ca(tmp_path, name="ca.db"):
+    from antrea_tpu.native import ConfigStore
+
+    return CertificateAuthority(ConfigStore(str(tmp_path / name)))
+
+
+def test_csr_auto_approval_and_verify(tmp_path):
+    ca = _ca(tmp_path)
+    ctl = CsrController(ca)
+    # Identity-matching IPsec CSR: auto-approved + signed (approver.go).
+    csr = ctl.submit(Csr(name="n1-1", node="node-1", public_key="PK1"),
+                     requestor="node-1", now=100)
+    assert csr.approved and csr.certificate is not None
+    assert ca.verify(csr.certificate, now=200)
+    assert not ca.verify(csr.certificate, now=100 + 11 * 24 * 3600)  # expired
+    # Tampered subject fails verification.
+    forged = dict(csr.certificate, subject="node-x")
+    assert not ca.verify(forged, now=200)
+    # Identity MISMATCH: no auto-approval; manual deny blocks approve.
+    csr2 = ctl.submit(Csr(name="evil", node="node-9", public_key="PK9"),
+                      requestor="node-1", now=100)
+    assert not csr2.approved and csr2.certificate is None
+    ctl.deny("evil")
+    with pytest.raises(ValueError):
+        ctl.approve("evil", now=101)
+
+
+def test_csr_name_immutable(tmp_path):
+    """K8s CSR immutability: a name resubmit with different content is
+    refused (no pending-CSR hijack, no denied-CSR resurrection)."""
+    ctl = CsrController(_ca(tmp_path))
+    ctl.submit(Csr(name="x", node="node-1", public_key="PK1"),
+               requestor="other", now=1)  # pending (identity mismatch)
+    with pytest.raises(ValueError):
+        ctl.submit(Csr(name="x", node="node-1", public_key="ATTACKER"),
+                   requestor="evil", now=2)
+    ctl.deny("x")
+    with pytest.raises(ValueError):
+        ctl.submit(Csr(name="x", node="node-1", public_key="ATTACKER"),
+                   requestor="node-1", now=3)
+    assert ctl.get("x").denied
+
+
+def test_ipsec_manual_approval_polled(tmp_path):
+    """A CSR awaiting manual approval is polled on later syncs — the agent
+    adopts the admin-approved certificate instead of abandoning the name."""
+    ca = _ca(tmp_path)
+    csrs = CsrController(ca)
+    agent = IpsecCertificateController("node-1", csrs)
+
+    # Force the manual path: submit under a different requestor identity by
+    # making auto-approval fail — simulate by monkeypatching submit's
+    # requestor via a wrapper controller.
+    class ManualCsrs:
+        def submit(self, csr, requestor, now):
+            return csrs.submit(csr, requestor="someone-else", now=now)
+
+        def get(self, name):
+            return csrs.get(name)
+
+    agent._csrs = ManualCsrs()
+    assert agent.sync(now=0) is False
+    pending = agent._pending
+    assert pending is not None
+    assert agent.sync(now=1) is False  # still waiting, SAME csr polled
+    assert agent._pending == pending
+    csrs.approve(pending, now=2)
+    assert agent.sync(now=3) is True
+    assert ca.verify(agent.certificate, now=4)
+
+
+def test_ca_secret_persists(tmp_path):
+    ca1 = _ca(tmp_path)
+    cert = ca1.sign("node-1", "PK", now=10)
+    ca2 = _ca(tmp_path)  # fresh handle, same store
+    assert ca2.verify(cert, now=20)
+
+
+def test_ipsec_agent_rotation(tmp_path):
+    from antrea_tpu.native import ConfigStore
+
+    ca = _ca(tmp_path, "ca.db")
+    csrs = CsrController(ca)
+    store = ConfigStore(str(tmp_path / "agent.db"))
+    agent = IpsecCertificateController("node-1", csrs, store=store)
+    assert agent.sync(now=0) is True
+    cert1 = agent.certificate
+    assert ca.verify(cert1, now=1)
+    # Not yet rotation-due: no re-issue; a restarted agent reuses the
+    # persisted certificate (ipseccertificate controller restart path).
+    assert agent.sync(now=1000) is False
+    agent2 = IpsecCertificateController("node-1", csrs, store=ConfigStore(
+        str(tmp_path / "agent.db")))
+    assert agent2.certificate == cert1
+    # Past half the validity: rotation issues a fresh certificate.
+    half = (cert1["notAfter"] - cert1["notBefore"]) // 2
+    assert agent2.sync(now=cert1["notBefore"] + half + 1) is True
+    assert agent2.certificate != cert1
+    assert ca.verify(agent2.certificate, now=cert1["notBefore"] + half + 2)
+
+
+# ---- flow-export sinks ------------------------------------------------------
+
+
+def test_multi_sink_fanout(tmp_path):
+    from antrea_tpu.datapath import TpuflowDatapath
+    from antrea_tpu.packet import PacketBatch
+    from antrea_tpu.utils import ip as iputil
+    import numpy as np
+
+    dp = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8, miss_chunk=64)
+    b = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32("10.0.0.1")] * 3, np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(f"10.0.1.{i}") for i in range(3)],
+                        np.uint32),
+        proto=np.full(3, 6, np.int32),
+        src_port=np.full(3, 40000, np.int32),
+        dst_port=np.full(3, 80, np.int32),
+    )
+    dp.step(b, now=10)
+    log = JsonlFileSink(str(tmp_path / "flows.jsonl"))
+    table = TableSink()
+    s3 = BatchDirSink(str(tmp_path / "objects"), batch_size=4)
+    exp = FlowExporter(dp, node="node-a", sink=fanout(log, table, s3))
+    n = exp.poll(now=11)
+    assert n >= 3  # fwd + reply entries
+    # Log sink: one JSON line per record.
+    lines = open(log.path).read().splitlines()
+    assert len(lines) == n and json.loads(lines[0])["node"] == "node-a"
+    # Table sink: rows queryable by column equality.
+    assert len(table.rows) == n
+    assert len(table.query(node="node-a", event="new")) == n
+    # Batch sink: one full object written, tail flushed on demand.
+    assert len(os.listdir(s3.dir)) == n // 4
+    s3.flush()
+    total = sum(
+        len(open(os.path.join(s3.dir, f)).read().splitlines())
+        for f in os.listdir(s3.dir)
+    )
+    assert total == n
+
+
+def test_batch_sink_resumes_past_existing_objects(tmp_path):
+    d = str(tmp_path / "objects")
+    s1 = BatchDirSink(d, batch_size=1)
+    s1({"a": 1})
+    s2 = BatchDirSink(d, batch_size=1)  # restart over the same directory
+    s2({"b": 2})
+    files = sorted(os.listdir(d))
+    assert files == ["records-000000.jsonl", "records-000001.jsonl"]
+    assert json.loads(open(os.path.join(d, files[0])).read())["a"] == 1
+
+
+# ---- antctl supportbundle ---------------------------------------------------
+
+
+def test_antctl_supportbundle(tmp_path, capsys):
+    from antrea_tpu import antctl
+    from antrea_tpu.datapath import OracleDatapath
+    from antrea_tpu.simulator import gen_cluster
+    from antrea_tpu.simulator.genservice import gen_services
+
+    cluster = gen_cluster(40, n_nodes=2, pods_per_node=4, seed=31)
+    services = gen_services(3, cluster.pod_ips, seed=32)
+    state = str(tmp_path / "state")
+    dp = OracleDatapath(cluster.ps, services, flow_slots=1 << 10,
+                        aff_slots=1 << 8, persist_dir=state)
+    dp.install_bundle(cluster.ps, services)
+    out = str(tmp_path / "bundle.tar.gz")
+    assert antctl.main(["supportbundle", "--state", state, "--out", out,
+                        "--node", "node-a"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert "stats.json" in rep["members"]
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+        meta = json.loads(tar.extractfile("meta.json").read())
+    assert {"meta.json", "metrics.prom", "datapath_snapshot.json"} <= set(names)
+    # The bundle reports the snapshot's REAL generation, not a fresh 0.
+    assert meta["generation"] == dp.generation >= 1
