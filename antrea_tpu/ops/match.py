@@ -375,6 +375,21 @@ def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, i
 # path cost is the fused gather+scan loop itself, so the next lever is a
 # pallas kernel that pipelines incidence-row loads against the bit scan,
 # not more XLA-level slicing.
+#
+# Negative result (round 3, measured on the 100k-rule bench world): a
+# TWO-LEVEL incidence hierarchy (per-dimension 32-word block summaries,
+# AND the summaries, walk only candidate blocks) does NOT pay: per-DIM
+# summary density is 0.90/0.94/1.00 (at/peer/svc), so the summary AND
+# leaves ~86% of blocks as candidates (51 of 59 per packet) even though
+# true matches average 0.7 rules/packet — the sparsity lives in the 3-way
+# intersection, which is only knowable after the gathers the hierarchy
+# was meant to avoid.  Cold-path cost accounting at 5.2M pps: raw gather
+# bytes are ~37KB/packet (~190 GB/s), but each (B, W) gathered row set
+# that XLA materializes as an intermediate multiplies that by the number
+# of unfused consumers — the realistic lever remains a pallas kernel
+# keeping row tiles resident in VMEM across AND + phase scans (blocked on
+# the per-lane dynamic-row gather pattern; see pallas_guide tiling
+# constraints).
 
 
 def _resolve(action: jax.Array, hits, pod_iso: jax.Array):
