@@ -165,6 +165,70 @@ def _cmd_supportbundle(args) -> int:
     return 0
 
 
+def _cmd_check(args) -> int:
+    """Installation checkers (ref pkg/antctl/raw/check: post-install
+    validation probes run as test pods; here: in-process self-diagnostics
+    over the same surfaces).  Exit 0 iff every check passes."""
+    import tempfile
+
+    checks: list[tuple[str, str]] = []
+
+    def run(name, fn):
+        try:
+            fn()
+            checks.append((name, "ok"))
+        except Exception as e:
+            checks.append((name, f"FAIL: {type(e).__name__}: {e}"))
+
+    def chk_native():
+        from .native import ConfigStore, native_available
+
+        with tempfile.TemporaryDirectory() as d:
+            s = ConfigStore(d + "/c.db")
+            s.set("k", b"v")
+            s.commit()
+            assert s.get("k") == b"v"
+            # The check is named native-store: a silent Python-journal
+            # fallback must FAIL it, not masquerade as healthy.
+            assert native_available(), (
+                "native backend unavailable (python fallback active)"
+            )
+
+    def chk_datapath_parity():
+        import copy
+
+        from .datapath import OracleDatapath, TpuflowDatapath
+        from .packet import PacketBatch
+        from .simulator import gen_cluster, gen_traffic
+
+        cluster = gen_cluster(40, n_nodes=2, pods_per_node=4, seed=99)
+        b = gen_traffic(cluster.pod_ips, 32, n_flows=16, seed=98)
+        tpu = TpuflowDatapath(copy.deepcopy(cluster.ps), flow_slots=1 << 10,
+                              aff_slots=1 << 8, miss_chunk=32)
+        orc = OracleDatapath(copy.deepcopy(cluster.ps), flow_slots=1 << 10,
+                             aff_slots=1 << 8)
+        ra, rb = tpu.step(b, now=1), orc.step(b, now=1)
+        assert ra.code.tolist() == rb.code.tolist()
+
+    def chk_persistence():
+        from .datapath import TpuflowDatapath
+
+        with tempfile.TemporaryDirectory() as d:
+            dp = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8,
+                                 miss_chunk=32, persist_dir=d)
+            g = dp.install_bundle()
+            dp2 = TpuflowDatapath(flow_slots=1 << 10, aff_slots=1 << 8,
+                                  miss_chunk=32, persist_dir=d)
+            assert dp2.generation >= g
+
+    run("native-store", chk_native)
+    run("datapath-parity", chk_datapath_parity)
+    run("persistence-roundtrip", chk_persistence)
+    for name, status in checks:
+        print(f"{name}: {status}")
+    return 0 if all(s == "ok" for _, s in checks) else 1
+
+
 def _cmd_query_endpoint(args) -> int:
     """Snapshot-based endpoint query: membership sets computed by pod IP,
     then the shared policy scan (controller/endpoint_querier.scan_policies
@@ -230,6 +294,9 @@ def main(argv=None) -> int:
     qe.add_argument("--pod", default="")
     qe.add_argument("--ip", required=True)
     qe.set_defaults(fn=_cmd_query_endpoint)
+
+    c = sub.add_parser("check", help="installation self-diagnostics")
+    c.set_defaults(fn=_cmd_check)
 
     sb = sub.add_parser("supportbundle", help="collect a diagnostic bundle")
     sb.add_argument("--state", required=True)
