@@ -255,7 +255,10 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         return mcast_group_of(self._rt, idx)
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
+        from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
+
         in_ports = batch.in_ports()
+        flags = batch.flags()
         O = self._oracle
         lane_modes = []
         no_commit = []
@@ -266,10 +269,17 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 lane_modes.append(O.LANE_PUNT)
             else:
                 lane_modes.append(O.LANE_NORMAL)
-            no_commit.append(is_mcast_u32(int(batch.dst_ip[i])))
+            # Multicast bypasses conntrack; a FIN/RST-flagged TCP miss
+            # never establishes (the closing-segment rule — same gating as
+            # models/forwarding._pipeline_step_full).
+            no_commit.append(
+                is_mcast_u32(int(batch.dst_ip[i]))
+                or (int(batch.proto[i]) == PROTO_TCP
+                    and (int(flags[i]) & _TEARDOWN_FLAGS) != 0)
+            )
         outs = self._oracle.step(
             batch, now, gen=self._gen, lane_modes=lane_modes,
-            no_commit=no_commit,
+            no_commit=no_commit, flags=flags,
         )
         fwd = self._forward_fields(batch, outs, in_ports, lane_modes)
         if not self._gates.enabled("NetworkPolicyStats"):

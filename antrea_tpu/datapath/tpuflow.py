@@ -217,6 +217,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             jnp.asarray(batch.in_ports()),
             jnp.int32(now),
             jnp.int32(self._gen),
+            jnp.asarray(batch.flags()),
             meta=self._meta,
         )
         self._state = state

@@ -186,6 +186,7 @@ def _pipeline_step_full(
     in_port: jax.Array,
     now: jax.Array,
     gen: jax.Array,
+    flags: jax.Array = None,
     *,
     meta: pl.PipelineMeta,
     hit_combine=None,
@@ -201,10 +202,18 @@ def _pipeline_step_full(
     # Multicast data traffic bypasses conntrack (multicast.go): classified
     # every step, never cached.
     is_mc = (dst_f >= MCAST_LO_F) & (dst_f <= MCAST_HI_F)
+    no_commit = is_mc
+    if flags is not None:
+        # A FIN/RST-flagged TCP miss classifies but never ESTABLISHES a
+        # connection (a closing segment is not a new flow); established
+        # hits tear down inside the pipeline (pl._TEARDOWN_FLAGS path).
+        no_commit = no_commit | (
+            (proto == pl.PROTO_TCP) & ((flags & pl._TEARDOWN_FLAGS) != 0)
+        )
     state, out = pl._pipeline_step(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
         meta=meta, hit_combine=hit_combine, valid=~spoof & ~igmp,
-        no_commit=is_mc,
+        no_commit=no_commit, flags=flags,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
     # Forward toward the packet's effective destination: the DNAT-resolved

@@ -84,6 +84,11 @@ REJECT_NONE = 0
 REJECT_TCP_RST = 1
 REJECT_ICMP_UNREACH = 2
 
+# TCP wire flag bits consumed by conntrack teardown.
+TCP_FIN = 0x01
+TCP_RST = 0x04
+_TEARDOWN_FLAGS = TCP_FIN | TCP_RST
+
 
 def reject_kind_of(code, proto, xp=jnp):
     """REJECT synthesis kind for a verdict (scalar or array): TCP -> RST,
@@ -431,6 +436,7 @@ def _pipeline_step(
     hit_combine=None,
     valid=None,
     no_commit=None,
+    flags=None,
 ):
     flow, aff = state.flow, state.aff
     B = src_f.shape[0]
@@ -488,7 +494,11 @@ def _pipeline_step(
     c_pref = mr[:, 3] & 0x7FFFFFFF  # strip the cached snat bit
     p_need = est & ((now - c_pref) >= p_half)
 
-    def partner_refresh(flow):
+    def partner_probe(keys, mask):
+        """Derive each lane's PARTNER tuple (the other conntrack direction
+        of its hit entry, un/re-DNAT applied) and key-verify it against
+        `keys` — shared by the deferred partner refresh and the FIN/RST
+        teardown so the two can never drift.  -> (p_slot, live_mask)."""
         p_src = jnp.where(rpl, dst_f, c_dnat_ip)
         p_dst = jnp.where(rpl, c_dnat_ip, src_f)
         p_sport = jnp.where(rpl, dport, c_dport)
@@ -498,14 +508,18 @@ def _pipeline_step(
             _raw_bits(p_src), _raw_bits(p_dst), proto, p_sport, p_dport, xp=jnp
         )
         p_slot = (p_h & jnp.uint32(N - 1)).astype(jnp.int32)
-        pkr = flow.keys[p_slot]
-        p_live = (
-            p_need
+        pkr = keys[p_slot]
+        live = (
+            mask
             & (pkr[:, 0] == p_src)
             & (pkr[:, 1] == p_dst)
             & (pkr[:, 2] == ((p_sport << 16) | p_dport))
             & (pkr[:, 3] == p_pg)
         )
+        return p_slot, live
+
+    def partner_refresh(flow):
+        p_slot, p_live = partner_probe(flow.keys, p_need)
         return flow._replace(
             ts=flow.ts.at[jnp.where(p_live, p_slot, dump)].set(now),
             # Attempt-time update even when the partner is gone, so an
@@ -517,6 +531,25 @@ def _pipeline_step(
         )
 
     flow = jax.lax.cond(p_need.any(), partner_refresh, lambda f: f, flow)
+
+    # TCP connection teardown (conntrack close): a FIN or RST on an
+    # established entry removes BOTH tuple directions after this packet's
+    # own (still-established) verdict — subsequent same-tuple packets
+    # re-classify under the CURRENT policy instead of est-bypassing a
+    # connection that no longer exists.  Conservative vs kernel ct (which
+    # walks FIN_WAIT/TIME_WAIT): trailing segments of a closing connection
+    # re-classify; nothing ever bypasses policy MORE than the kernel.
+    # Out-of-window teardown cost: zero when no lane carries the flags.
+    if flags is not None:
+        td = est & (proto == PROTO_TCP) & ((flags & _TEARDOWN_FLAGS) != 0)
+
+        def teardown(flow):
+            keys = flow.keys.at[jnp.where(td, slot, dump)].set(0)
+            t_slot, t_live = partner_probe(keys, td)
+            keys = keys.at[jnp.where(t_live, t_slot, dump)].set(0)
+            return flow._replace(keys=keys)
+
+        flow = jax.lax.cond(td.any(), teardown, lambda f: f, flow)
 
     miss = ~hit if valid is None else (~hit & valid)
     n_miss = miss.sum(dtype=jnp.int32)

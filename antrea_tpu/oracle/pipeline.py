@@ -37,6 +37,7 @@ from ..models.pipeline import (
     REJECT_ICMP_UNREACH,
     REJECT_NONE,
     REJECT_TCP_RST,
+    _TEARDOWN_FLAGS,
 )
 from ..compiler.ir import PolicySet
 from ..ops import hashing
@@ -179,6 +180,37 @@ class PipelineOracle:
             )
         )
 
+    def _partner_of(self, e: dict, p: Packet):
+        """Partner-direction tuple of a hit entry (the device twin is
+        models/pipeline partner_probe — shared by refresh and teardown):
+        -> (slot, key, want_rpl)."""
+        rpl = e.get("rpl", False)
+        t_src = p.dst_ip if rpl else e["dnat_ip"]
+        t_dst = e["dnat_ip"] if rpl else p.src_ip
+        t_sport = p.dst_port if rpl else e["dnat_port"]
+        t_dport = e["dnat_port"] if rpl else p.src_port
+        t_h = int(hashing.flow_hash(
+            np.uint32(t_src), np.uint32(t_dst), p.proto, t_sport, t_dport,
+        ))
+        return (
+            t_h & (self.flow_slots - 1),
+            (t_src, t_dst, (t_sport << 16) | t_dport, p.proto),
+            not rpl,
+        )
+
+    def _partner_live(self, flow_view: dict, e: dict, p: Packet):
+        """-> verified partner slot or None."""
+        slot, key, want_rpl = self._partner_of(e, p)
+        e2 = flow_view.get(slot)
+        if (
+            e2 is not None
+            and e2["key"] == key
+            and e2["gen"] is None
+            and e2.get("rpl", False) == want_rpl
+        ):
+            return slot
+        return None
+
     def lookup(self, flow_view: dict, p: Packet, h: int, now: int, gen_w: int):
         """Read-only flow-cache probe -> (slot, entry-or-None)."""
         slot = h & (self.flow_slots - 1)
@@ -264,7 +296,7 @@ class PipelineOracle:
 
     def step(
         self, batch: PacketBatch, now: int, gen: int = 0, lane_modes=None,
-        no_commit=None,
+        no_commit=None, flags=None,
     ) -> list[ScalarOutcome]:
         # The device packs entry generations into GEN_BITS (22) bits, with
         # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
@@ -279,6 +311,7 @@ class PipelineOracle:
         refreshes: list[int] = []
         pref_updates: list[int] = []
         learns: list[tuple[int, dict]] = []
+        teardowns: list[int] = []
 
         from ..compiler.compile import ACT_DROP
 
@@ -317,6 +350,18 @@ class PipelineOracle:
                     )
                 )
                 refreshes.append(slot)
+                # TCP FIN/RST on an established entry: tear down BOTH tuple
+                # directions after this packet's verdict (the conntrack
+                # close; conservative vs kernel FIN_WAIT — see the device
+                # twin's comment in models/pipeline.py).  Partner verified
+                # against start-of-batch state.
+                fl = 0 if flags is None else int(flags[i])
+                if (est and p.proto == PROTO_TCP
+                        and (fl & _TEARDOWN_FLAGS) != 0):
+                    teardowns.append(slot)
+                    t_slot = self._partner_live(flow0, e, p)
+                    if t_slot is not None:
+                        teardowns.append(t_slot)
                 half = max(1, self.ct_timeout_s // 2)
                 if est and (now - e.get("pref", e["ts"])) >= half:
                     # Conntrack refreshes BOTH directions; like the device,
@@ -326,26 +371,8 @@ class PipelineOracle:
                     # resurrects an idle-expired partner of a provably live
                     # connection.
                     pref_updates.append(slot)
-                    rpl = e.get("rpl", False)
-                    p_src = p.dst_ip if rpl else e["dnat_ip"]
-                    p_dst = e["dnat_ip"] if rpl else p.src_ip
-                    p_sport = p.dst_port if rpl else e["dnat_port"]
-                    p_dport = e["dnat_port"] if rpl else p.src_port
-                    p_h = int(
-                        hashing.flow_hash(
-                            np.uint32(p_src), np.uint32(p_dst),
-                            p.proto, p_sport, p_dport,
-                        )
-                    )
-                    p_slot = p_h & (self.flow_slots - 1)
-                    e2 = flow0.get(p_slot)
-                    if (
-                        e2 is not None
-                        and e2["key"] == (p_src, p_dst,
-                                          (p_sport << 16) | p_dport, p.proto)
-                        and e2["gen"] is None
-                        and e2.get("rpl", False) == (not rpl)
-                    ):
+                    p_slot = self._partner_live(flow0, e, p)
+                    if p_slot is not None:
                         refreshes.append(p_slot)
                 continue
 
@@ -414,6 +441,10 @@ class PipelineOracle:
         for slot in pref_updates:
             if slot in self.flow:
                 self.flow[slot]["pref"] = now
+        # Teardowns BEFORE inserts (the device clears keys before the slow
+        # path scatters — a miss lane may legitimately re-occupy the slot).
+        for slot in teardowns:
+            self.flow.pop(slot, None)
         for slot, entry in inserts:
             old = self.flow.get(slot)
             if old is not None and (

@@ -44,6 +44,10 @@ class PacketBatch:
     # the reference's Classifier-stage in_port match, pipeline.go
     # Classifier/SpoofGuard).  None == all -1 (no pod-port ingress).
     in_port: np.ndarray = None
+    # TCP flags byte per packet (real wire bit positions: FIN 0x01,
+    # SYN 0x02, RST 0x04, ACK 0x10); consumed by the conntrack teardown
+    # path (models/pipeline.py).  None == all 0 (no teardown signals).
+    tcp_flags: np.ndarray = None
 
     @property
     def size(self) -> int:
@@ -54,6 +58,12 @@ class PacketBatch:
         if self.in_port is None:
             return np.full(self.size, -1, np.int32)
         return self.in_port.astype(np.int32)
+
+    def flags(self) -> np.ndarray:
+        """tcp_flags column, defaulting to 0."""
+        if self.tcp_flags is None:
+            return np.zeros(self.size, np.int32)
+        return self.tcp_flags.astype(np.int32)
 
     @staticmethod
     def from_packets(packets: list[Packet]) -> "PacketBatch":

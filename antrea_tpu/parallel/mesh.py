@@ -228,17 +228,18 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
         )
     else:
         def body(state, drs, dsvc, dft, src_f, dst_f, proto, sport,
-                 dport, in_port, now, gen):
+                 dport, in_port, flags, now, gen):
             local = jax.tree.map(lambda x: x[0], state)
             local, out = fw._pipeline_step_full(
                 local, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
-                in_port, now, gen, meta=meta, hit_combine=_pmin_rule,
+                in_port, now, gen, flags, meta=meta, hit_combine=_pmin_rule,
             )
             return finish(local, out)
 
         in_specs = (
             _state_specs(), _drs_specs(), _svc_specs(), _fwd_specs(),
-            P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(), P(),
+            P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA),
+            P(), P(),
         )
 
     step = jax.jit(jax.shard_map(

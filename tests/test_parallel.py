@@ -148,16 +148,18 @@ def test_sharded_full_walk_matches_single(cluster):
         cps, svc, ft, mesh, flow_slots=1 << 14, aff_slots=1 << 12
     )
 
+    flags = np.where(np.arange(1024) % 9 == 0, 1, 0).astype(np.int32)
     for t in range(2):
         st1, out1 = fwd.pipeline_step_full(
             st1, drs1, dsvc1, dft1, jnp.asarray(src_f), jnp.asarray(dst_f),
             jnp.asarray(proto), jnp.asarray(sport), jnp.asarray(dport),
             jnp.asarray(in_port), jnp.int32(1000 + t), jnp.int32(0),
+            jnp.asarray(flags),
             meta=step1.meta,
         )
         stN, outN = stepN(
             stN, drsN, dsvcN, dftN, src_f, dst_f, proto, sport, dport,
-            in_port, jnp.int32(1000 + t), jnp.int32(0),
+            in_port, flags, jnp.int32(1000 + t), jnp.int32(0),
         )
         for k in ("code", "est", "spoofed", "fwd_kind", "out_port",
                   "peer_f", "dec_ttl", "mcast_idx", "dnat_ip_f"):

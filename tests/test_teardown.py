@@ -1,0 +1,143 @@
+"""TCP conntrack teardown: FIN/RST ends the established fast path.
+
+The semantic under test (kernel-ct close, conservatively simplified — see
+models/pipeline.py teardown comment): after a FIN or RST on an established
+connection, BOTH tuple directions leave the cache, so the next same-tuple
+packet re-classifies under the CURRENT policy — a closed connection can
+never est-bypass a deny installed after it closed.  Closing segments that
+MISS the cache classify but never establish."""
+
+import copy
+
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.models.pipeline import TCP_FIN, TCP_RST
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+CLIENT, SERVER = "10.0.0.5", "10.0.0.9"
+
+
+def _pair(ps=None):
+    kw = dict(flow_slots=1 << 10, aff_slots=1 << 8)
+    return (
+        TpuflowDatapath(copy.deepcopy(ps), miss_chunk=32, **kw),
+        OracleDatapath(copy.deepcopy(ps), **kw),
+    )
+
+
+def _b(src, dst, sport, dport, flags=0):
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([sport], np.int32),
+        dst_port=np.array([dport], np.int32),
+        tcp_flags=np.array([flags], np.int32),
+    )
+
+
+def _deny_all():
+    return PolicySet(
+        policies=[cp.NetworkPolicy(
+            uid="np-deny", name="deny", namespace="d",
+            type=cp.NetworkPolicyType.ANNP,
+            rules=[cp.NetworkPolicyRule(
+                direction=cp.Direction.IN, action=cp.RuleAction.DROP,
+                priority=0,
+            )],
+            applied_to_groups=["atg"],
+            tier_priority=cp.TIER_APPLICATION, priority=1,
+        )],
+        applied_to_groups={"atg": cp.AppliedToGroup(
+            name="atg", members=[cp.GroupMember(ip=SERVER)],
+        )},
+        address_groups={},
+    )
+
+
+def _diff(a, b):
+    for f in ("code", "est", "reply", "committed"):
+        assert getattr(a, f).tolist() == getattr(b, f).tolist(), f
+    assert a.n_miss == b.n_miss
+
+
+def test_fin_ends_est_bypass_for_new_policy():
+    tpu, orc = _pair()
+    fwd = _b(CLIENT, SERVER, 40000, 80)
+    for dp in (tpu, orc):
+        assert dp.step(fwd, now=1).committed.tolist() == [1]
+        assert dp.step(fwd, now=2).est.tolist() == [1]
+    # Deny installed mid-connection: established traffic still bypasses
+    # (ovs-pipeline.md:1685-1691) — on both datapaths.
+    for dp in (tpu, orc):
+        dp.install_bundle(_deny_all())
+    ra, rb = tpu.step(fwd, now=3), orc.step(fwd, now=3)
+    _diff(ra, rb)
+    assert ra.code.tolist() == [0] and ra.est.tolist() == [1]
+    # FIN closes the connection (the FIN itself still rides est)...
+    fin = _b(CLIENT, SERVER, 40000, 80, flags=TCP_FIN)
+    ra, rb = tpu.step(fin, now=4), orc.step(fin, now=4)
+    _diff(ra, rb)
+    assert ra.est.tolist() == [1]
+    # ...after which the same tuple re-classifies under the deny, and the
+    # reply direction is gone too.
+    ra, rb = tpu.step(fwd, now=5), orc.step(fwd, now=5)
+    _diff(ra, rb)
+    assert ra.code.tolist() == [1] and ra.est.tolist() == [0]
+    rev = _b(SERVER, CLIENT, 80, 40000)
+    ra, rb = tpu.step(rev, now=6), orc.step(rev, now=6)
+    _diff(ra, rb)
+    assert ra.reply.tolist() == [0]
+    assert tpu.cache_stats()["committed"] == orc.cache_stats()["committed"]
+
+
+def test_rst_on_reply_direction_tears_down_both():
+    tpu, orc = _pair()
+    fwd = _b(CLIENT, SERVER, 41000, 80)
+    for dp in (tpu, orc):
+        dp.step(fwd, now=1)
+    rst = _b(SERVER, CLIENT, 80, 41000, flags=TCP_RST)
+    ra, rb = tpu.step(rst, now=2), orc.step(rst, now=2)
+    _diff(ra, rb)
+    assert ra.reply.tolist() == [1]  # the RST itself is the reply leg
+    for dp, name in ((tpu, "tpu"), (orc, "orc")):
+        assert dp.cache_stats()["committed"] == 0, name
+    ra, rb = tpu.step(fwd, now=3), orc.step(fwd, now=3)
+    _diff(ra, rb)
+    assert ra.est.tolist() == [0]
+
+
+def test_closing_segment_never_establishes():
+    """A FIN that MISSES the cache (no prior connection) classifies but
+    commits nothing — a closing segment is not a new flow."""
+    tpu, orc = _pair()
+    fin = _b(CLIENT, SERVER, 42000, 80, flags=TCP_FIN)
+    ra, rb = tpu.step(fin, now=1), orc.step(fin, now=1)
+    _diff(ra, rb)
+    assert ra.code.tolist() == [0] and ra.committed.tolist() == [0]
+    assert tpu.cache_stats()["occupied"] == orc.cache_stats()["occupied"] == 0
+
+
+def test_plain_flags_do_not_tear_down():
+    """SYN/ACK/PSH traffic never touches the teardown path; UDP with the
+    same flag bits set is ignored entirely."""
+    tpu, orc = _pair()
+    fwd = _b(CLIENT, SERVER, 43000, 80, flags=0x18)  # PSH|ACK
+    for dp in (tpu, orc):
+        dp.step(fwd, now=1)
+        assert dp.step(fwd, now=2).est.tolist() == [1]
+    udp = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(CLIENT)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(SERVER)], np.uint32),
+        proto=np.array([17], np.int32),
+        src_port=np.array([5353], np.int32),
+        dst_port=np.array([53], np.int32),
+        tcp_flags=np.array([TCP_RST], np.int32),  # nonsense on UDP: ignored
+    )
+    for dp in (tpu, orc):
+        assert dp.step(udp, now=3).committed.tolist() == [1]
+        assert dp.step(udp, now=4).est.tolist() == [1]
