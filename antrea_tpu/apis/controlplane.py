@@ -164,6 +164,11 @@ class NetworkPolicyRule:
     # ref: types.go:248 NetworkPolicyRule.AppliedToGroups). Empty = inherit
     # the policy-level appliedToGroups.
     applied_to_groups: list[str] = field(default_factory=list)
+    # L7 protocols (ref types.go NetworkPolicyRule.L7Protocols; enforced by
+    # handing matched traffic to the L7 engine over the VLAN seam,
+    # network_policy.go:2213 l7NPTrafficControlFlows): non-empty marks an
+    # ALLOW rule whose matches must be redirected for L7 inspection.
+    l7_protocols: list = field(default_factory=list)
 
     @property
     def peer(self) -> NetworkPolicyPeer:

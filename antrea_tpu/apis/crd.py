@@ -197,6 +197,9 @@ class AntreaNPRule:
     ports: list[PortSpec] = field(default_factory=list)  # empty = any
     applied_to: list[AntreaAppliedTo] = field(default_factory=list)  # override
     name: str = ""
+    # crd L7Protocols (http/tls rule specs in the reference); upstream
+    # validation: L7 rules must be action Allow.
+    l7_protocols: tuple = ()
 
 
 @dataclass

@@ -170,6 +170,9 @@ class DirectionTensors:
     n_k8s: int
     n_baseline: int
     rule_ids: list[str] = field(default_factory=list)
+    # (R,) i32 0/1 — L7-inspection redirect mark of each rule (ref
+    # NetworkPolicyRule.L7Protocols; seam network_policy.go:2213).
+    l7: np.ndarray = None
 
     @property
     def n_rules(self) -> int:
@@ -313,6 +316,7 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
                 svc_space.intern(_svc_key_ranges(r.services)),
                 _ACTION_CODE[r.action],
                 rule_id(p, i),
+                1 if r.l7_protocols else 0,
             )
             rows[r.direction][phase].append(row)
 
@@ -352,9 +356,10 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
         pg = np.full(R, ip_space.empty, dtype=np.int32)
         sg = np.full(R, svc_space.empty, dtype=np.int32)
         act = np.full(R, ACT_DROP, dtype=np.int32)
+        l7 = np.zeros(R, dtype=np.int32)
         ids: list[str] = [""] * R
-        for j, (_, a, g, s, ac, rid) in enumerate(ordered):
-            at[j], pg[j], sg[j], act[j], ids[j] = a, g, s, ac, rid
+        for j, (_, a, g, s, ac, rid, l7f) in enumerate(ordered):
+            at[j], pg[j], sg[j], act[j], ids[j], l7[j] = a, g, s, ac, rid, l7f
         return DirectionTensors(
             at_gid=at,
             peer_gid=pg,
@@ -364,6 +369,7 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
             n_k8s=nk,
             n_baseline=nb,
             rule_ids=ids,
+            l7=l7,
         )
 
     # NOTE: emit() interns nothing new (all gids interned above), so the

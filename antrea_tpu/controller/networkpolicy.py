@@ -509,6 +509,7 @@ class NetworkPolicyController:
         self._install_admin(banp, cp.TIER_BASELINE, 0.0)
 
     def _install_admin(self, obj, tier_priority: int, priority: float) -> None:
+        self._validate_l7(obj.uid, obj.rules)
         shadow = AntreaNetworkPolicy(
             uid=obj.uid, name=obj.name, namespace="",
             tier_priority=tier_priority, priority=priority,
@@ -522,9 +523,26 @@ class NetworkPolicyController:
 
     # -- Antrea-native policies ----------------------------------------------
 
+    def _validate_l7(self, uid: str, rules) -> None:
+        """L7 rule validation, BEFORE any conversion/group interning (a
+        rejected policy must leak no group refs or watch events — the
+        webhook runs before the controller sees the object in the
+        reference).  Upstream rules: L7 requires action Allow (the L7
+        engine enforces the protocol) and the L7NetworkPolicy gate."""
+        for i, rr in enumerate(rules):
+            if not rr.l7_protocols:
+                continue
+            if rr.action != cp.RuleAction.ALLOW:
+                raise ValueError(
+                    f"policy {uid} rule {i}: L7 rules must be Allow"
+                )
+            if not self._gates.enabled("L7NetworkPolicy"):
+                raise RuntimeError("L7NetworkPolicy feature gate is disabled")
+
     def upsert_antrea_policy(self, anp: AntreaNetworkPolicy) -> None:
         if not self._gates.enabled("AntreaPolicy"):
             raise RuntimeError("AntreaPolicy feature gate is disabled")
+        self._validate_l7(anp.uid, anp.rules)
         internal = self._convert_antrea(anp)
         self._raw_anps[anp.uid] = anp
         self._install(anp.uid, internal, kind="antrea")
@@ -552,6 +570,7 @@ class NetworkPolicyController:
                 priority=i,
                 name=rr.name,
                 applied_to_groups=[atg_of(at) for at in rr.applied_to],
+                l7_protocols=list(rr.l7_protocols),
             ))
         ptype = (cp.NetworkPolicyType.ACNP if anp.is_cluster_scoped
                  else cp.NetworkPolicyType.ANNP)

@@ -86,6 +86,10 @@ class StepResult:
     # Joined-group table row for FWD_MCAST lanes (-1 otherwise); resolve the
     # replication set via Datapath.mcast_group(idx).
     mcast_idx: np.ndarray = None
+    # 0/1 — allowed by an L7 rule: hand the packet to the L7 engine over
+    # the VLAN seam instead of normal output (ref network_policy.go:2213
+    # l7NPTrafficControlFlows; reg0 L7 redirect bit, fields.go).
+    l7_redirect: np.ndarray = None
 
 
 class Datapath(ABC):

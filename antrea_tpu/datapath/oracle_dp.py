@@ -90,6 +90,20 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         self._stats_out: Counter = Counter()
         self._default_allow = 0
         self._default_deny = 0
+        self._rebuild_l7_ids()
+
+    def _rebuild_l7_ids(self) -> None:
+        """Stable ids of rules carrying L7 protocols in the CURRENT policy
+        set — attribution resolves against the current table, matching the
+        device's post-resolve l7 gather (ct_label caveat shared)."""
+        from ..compiler.ir import rule_id
+
+        self._l7_ids = {
+            rule_id(p, i)
+            for p in self._ps.policies
+            for i, r in enumerate(p.rules)
+            if r.l7_protocols
+        }
 
     @property
     def datapath_type(self) -> DatapathType:
@@ -102,6 +116,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
     def install_bundle(self, ps=None, services=None) -> int:
         if ps is not None:
             self._ps = ps
+            self._rebuild_l7_ids()
         if services is not None:
             self._services = list(services)
         self._oracle.update(
@@ -368,6 +383,13 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             spoofed=col("spoofed"),
             punt=col("punt"),
             mcast_idx=col("mcast_idx"),
+            l7_redirect=np.array([
+                1 if (o.code == ACT_ALLOW and not o.skipped
+                      and (o.ingress_rule in self._l7_ids
+                           or o.egress_rule in self._l7_ids))
+                else 0
+                for o in outs
+            ], np.int32),
             fwd_kind=col("fwd_kind"),
             out_port=col("out_port"),
             peer_ip=col("peer_ip", np.uint32),

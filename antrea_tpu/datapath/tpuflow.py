@@ -252,6 +252,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             spoofed=o["spoofed"],
             punt=o["punt"],
             mcast_idx=o["mcast_idx"],
+            l7_redirect=o["l7_redirect"],
             fwd_kind=o["fwd_kind"],
             out_port=o["out_port"],
             # peer_f is zeroed for non-deliverable lanes in the kernel; the

@@ -77,6 +77,7 @@ def _rule(r: cp.NetworkPolicyRule) -> dict:
         "priority": r.priority,
         "name": r.name,
         "appliedToGroups": list(r.applied_to_groups),
+        "l7Protocols": list(r.l7_protocols),
     }
 
 
@@ -90,6 +91,7 @@ def _rule_from(d: dict) -> cp.NetworkPolicyRule:
         priority=d.get("priority", -1),
         name=d.get("name", ""),
         applied_to_groups=list(d.get("appliedToGroups", ())),
+        l7_protocols=list(d.get("l7Protocols", ())),
     )
 
 

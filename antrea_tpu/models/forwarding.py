@@ -238,10 +238,28 @@ def _pipeline_step_full(
     # Redirect replaces the output port (ref TrafficControl redirect action:
     # the packet leaves via the target device instead of its computed port).
     out_port = jnp.where(tc_act == TC_REDIRECT, tc_port, out_port)
+    # L7 redirect mark (ref network_policy.go:2213 l7NPTrafficControlFlows
+    # — the reg0 L7 bit + VLAN handoff to the L7 engine): set when the
+    # DECIDING allow rule carries L7 protocols.  Resolved by attribution
+    # index against the CURRENT rule table — cached hits inherit the
+    # ct_label caveat documented on stats (datapath/tpuflow.py).
+    def l7_of(dd, idx):
+        n = dd.l7.shape[0]
+        safe = jnp.clip(idx, 0, n - 1)
+        return jnp.where((idx >= 0) & (idx < n), dd.l7[safe], 0)
+
+    l7 = jnp.where(
+        code == ACT_ALLOW,
+        l7_of(drs.ingress, out["ingress_rule"])
+        | l7_of(drs.egress, out["egress_rule"]),
+        0,
+    ).astype(jnp.int32)
+
     out.update(
         code=code,
         reject_kind=pl.reject_kind_of(code, proto),
         spoofed=spoof.astype(jnp.int32),
+        l7_redirect=l7,
         punt=igmp.astype(jnp.int32),
         fwd_kind=kind,
         out_port=out_port.astype(jnp.int32),

@@ -75,6 +75,9 @@ class DeviceDirection(NamedTuple):
     peer: DimTable  # peer, probed with the other side's IP
     svc: DimTable  # service, probed with (proto << 16 | dst_port)
     action: jax.Array  # (W*32,) i32 flat, for post-resolve gather
+    # (W*32,) i32 0/1 L7-redirect mark per rule, replicated like `action`
+    # (indexed post-pmin by the deciding rule).
+    l7: jax.Array
     # (W,) global word index — carried as data (not an arange built in the
     # kernel) so a rule-axis shard_map slice still knows its global rule
     # offsets and cross-shard first-match combines stay a plain lax.pmin.
@@ -244,11 +247,15 @@ def _direction_host(
 ) -> DeviceDirection:
     action = np.full(w * 32, ACT_DROP, dtype=np.int32)
     action[: dt.n_rules] = dt.action
+    l7 = np.zeros(w * 32, dtype=np.int32)
+    if dt.l7 is not None:
+        l7[: dt.n_rules] = dt.l7
     return DeviceDirection(
         at=_dim_table_host(dt.at_gid, cps.ip_groups, w, ip_dim=True),
         peer=_dim_table_host(dt.peer_gid, cps.ip_groups, w, ip_dim=True),
         svc=_dim_table_host(dt.svc_gid, cps.svc_groups, w, ip_dim=False),
         action=action,
+        l7=l7,
         word_idx=np.arange(w, dtype=np.int32),
     )
 

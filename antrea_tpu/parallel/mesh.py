@@ -84,6 +84,7 @@ def _drs_specs() -> m.DeviceRuleSet:
         peer=dim(),
         svc=dim(),
         action=P(),  # small flat gather table, replicated (indexed post-pmin)
+        l7=P(),  # same discipline as action
         word_idx=P(RULE),
     )
     iso = m.IsoTable(bounds=P(), val=P())

@@ -1,0 +1,113 @@
+"""L7 NetworkPolicy seam: matched-allow traffic marks for the L7 engine.
+
+The reference enforces L7 rules by redirecting their matches to Suricata
+over a VLAN tap (network_policy.go:2213 l7NPTrafficControlFlows; reg0 L7
+bit in fields.go).  Here the datapath emits l7_redirect for packets whose
+DECIDING allow rule carries L7 protocols — the handoff seam, with the
+inspection engine itself out of scope exactly as in SURVEY §2.5."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis import crd
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.features import FeatureGates
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+WEB, CLIENT, OTHER = "10.0.0.10", "10.0.0.12", "10.0.0.13"
+
+
+def _controller():
+    ctl = NetworkPolicyController(
+        feature_gates=FeatureGates({"L7NetworkPolicy": True})
+    )
+    ctl.upsert_namespace(crd.Namespace(name="prod", labels={}))
+    for name, ip, labels in [
+        ("web", WEB, {"app": "web"}),
+        ("client", CLIENT, {"app": "client"}),
+        ("other", OTHER, {"app": "other"}),
+    ]:
+        ctl.upsert_pod(crd.Pod(namespace="prod", name=name, ip=ip,
+                               node="n1", labels=labels))
+    return ctl
+
+
+def _anp_l7():
+    return crd.AntreaNetworkPolicy(
+        uid="acnp-l7", name="l7-http", namespace="",
+        tier_priority=cp.TIER_APPLICATION, priority=1,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"app": "web"}),
+            ns_selector=crd.LabelSelector.make(),
+        )],
+        rules=[
+            crd.AntreaNPRule(
+                direction=cp.Direction.IN, action=cp.RuleAction.ALLOW,
+                peers=[crd.AntreaPeer(
+                    pod_selector=crd.LabelSelector.make({"app": "client"}),
+                    ns_selector=crd.LabelSelector.make(),
+                )],
+                l7_protocols=("http",),
+            ),
+            crd.AntreaNPRule(
+                direction=cp.Direction.IN, action=cp.RuleAction.ALLOW,
+                peers=[crd.AntreaPeer(
+                    pod_selector=crd.LabelSelector.make({"app": "other"}),
+                    ns_selector=crd.LabelSelector.make(),
+                )],
+            ),
+        ],
+    )
+
+
+def _b(src, dst):
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(src)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(dst)], np.uint32),
+        proto=np.array([6], np.int32),
+        src_port=np.array([40000], np.int32),
+        dst_port=np.array([80], np.int32),
+    )
+
+
+def test_l7_mark_on_deciding_rule():
+    ctl = _controller()
+    ctl.upsert_antrea_policy(_anp_l7())
+    ps = ctl.policy_set()
+    tpu = TpuflowDatapath(copy.deepcopy(ps), flow_slots=1 << 10,
+                          aff_slots=1 << 8, miss_chunk=32)
+    orc = OracleDatapath(copy.deepcopy(ps), flow_slots=1 << 10,
+                         aff_slots=1 << 8)
+    for t, (src, want) in enumerate([
+        (CLIENT, 1),  # decided by the L7 http rule -> redirect
+        (OTHER, 0),   # decided by the plain allow rule -> normal output
+    ]):
+        b = _b(src, WEB)
+        ra, rb = tpu.step(b, now=t + 1), orc.step(b, now=t + 1)
+        assert ra.code.tolist() == rb.code.tolist() == [0]
+        assert ra.l7_redirect.tolist() == rb.l7_redirect.tolist() == [want]
+        # Cached hit keeps the mark (attribution rides the flow entry).
+        ra2, rb2 = tpu.step(b, now=t + 10), orc.step(b, now=t + 10)
+        assert ra2.est.tolist() == [1]
+        assert ra2.l7_redirect.tolist() == rb2.l7_redirect.tolist() == [want]
+
+
+def test_l7_validation_and_gate():
+    ctl = _controller()
+    bad = _anp_l7()
+    bad.rules[0].action = cp.RuleAction.DROP
+    with pytest.raises(ValueError):
+        ctl.upsert_antrea_policy(bad)
+    gated = NetworkPolicyController()  # default gates: L7 off
+    gated.upsert_namespace(crd.Namespace(name="prod", labels={}))
+    with pytest.raises(RuntimeError):
+        gated.upsert_antrea_policy(_anp_l7())
+    # Rejected policies leak NOTHING: validation runs before conversion,
+    # so no group refs or watch events exist for them.
+    assert ctl.policy_set().applied_to_groups == {}
+    assert gated.policy_set().applied_to_groups == {}
