@@ -41,3 +41,27 @@ def collect_agent_info(datapath, node: str, agent=None, now=None) -> dict:
         info["addressGroups"] = len(ps.address_groups)
         info["appliedToGroups"] = len(ps.applied_to_groups)
     return info
+
+
+def collect_controller_info(controller, store=None, now=None) -> dict:
+    """AntreaControllerInfo heartbeat (ref pkg/monitor controller side:
+    version, connected-agent count, NP/group counts, conditions, service
+    CIDR/cluster identity when known).  `controller` is a
+    NetworkPolicyController; `store` an optional RamStore whose watcher
+    count is the connected-agent gauge."""
+    ps = controller.policy_set()
+    info = {
+        "kind": "AntreaControllerInfo",
+        "version": VERSION,
+        "heartbeatUnix": time.time() if now is None else now,
+        "networkPolicies": len(ps.policies),
+        "addressGroups": len(ps.address_groups),
+        "appliedToGroups": len(ps.applied_to_groups),
+        "conditions": [{
+            "type": "ControllerHealthy",
+            "status": "True",
+        }],
+    }
+    if store is not None:
+        info["connectedAgentNum"] = store.n_watchers
+    return info

@@ -70,3 +70,24 @@ def test_antctl_check(capsys):
     assert "native-store: ok" in out
     assert "datapath-parity: ok" in out
     assert "persistence-roundtrip: ok" in out
+
+
+def test_controller_info_heartbeat():
+    from antrea_tpu.apis import crd
+    from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+    from antrea_tpu.dissemination import RamStore
+    from antrea_tpu.observability.agentinfo import collect_controller_info
+
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    ctl.upsert_namespace(crd.Namespace(name="d", labels={}))
+    ctl.upsert_pod(crd.Pod(namespace="d", name="p", ip="10.0.0.1",
+                           node="n1", labels={"a": "1"}))
+    w = store.watch_queue("n1")
+    info = collect_controller_info(ctl, store=store, now=42)
+    assert info["kind"] == "AntreaControllerInfo"
+    assert info["connectedAgentNum"] == 1
+    assert info["conditions"][0]["type"] == "ControllerHealthy"
+    w.stop()
+    assert collect_controller_info(ctl, store=store)["connectedAgentNum"] == 0
