@@ -650,6 +650,15 @@ class NetworkPolicyController:
 
     # -- snapshots (compiler input) ------------------------------------------
 
+    def object_counts(self) -> dict:
+        """O(1) live-object gauges (for heartbeats/metrics — policy_set()
+        would copy every group's membership just to be counted)."""
+        return {
+            "networkPolicies": len(self._nps),
+            "addressGroups": len(self._ags),
+            "appliedToGroups": len(self._atgs),
+        }
+
     def policy_set(self) -> PolicySet:
         return PolicySet(
             policies=list(self._nps.values()),

@@ -49,14 +49,11 @@ def collect_controller_info(controller, store=None, now=None) -> dict:
     CIDR/cluster identity when known).  `controller` is a
     NetworkPolicyController; `store` an optional RamStore whose watcher
     count is the connected-agent gauge."""
-    ps = controller.policy_set()
     info = {
         "kind": "AntreaControllerInfo",
         "version": VERSION,
         "heartbeatUnix": time.time() if now is None else now,
-        "networkPolicies": len(ps.policies),
-        "addressGroups": len(ps.address_groups),
-        "appliedToGroups": len(ps.applied_to_groups),
+        **controller.object_counts(),
         "conditions": [{
             "type": "ControllerHealthy",
             "status": "True",

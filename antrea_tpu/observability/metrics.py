@@ -16,6 +16,28 @@ def _esc(s: str) -> str:
     return s.replace("\\", "\\\\").replace('"', '\\"')
 
 
+def render_controller_metrics(controller, store=None) -> str:
+    """Controller-side Prometheus text (ref pkg/controller/metrics/
+    prometheus.go: antrea_controller_network_policy_processed etc. — here
+    the live object gauges + the connected-agent gauge)."""
+    counts = controller.object_counts()
+    lines = ["# TYPE antrea_tpu_controller_objects gauge"]
+    for key, kind in (
+        ("networkPolicies", "network_policies"),
+        ("addressGroups", "address_groups"),
+        ("appliedToGroups", "applied_to_groups"),
+    ):
+        lines.append(
+            f'antrea_tpu_controller_objects{{kind="{kind}"}} {counts[key]}'
+        )
+    if store is not None:
+        lines += [
+            "# TYPE antrea_tpu_controller_connected_agents gauge",
+            f"antrea_tpu_controller_connected_agents {store.n_watchers}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(datapath, node: str = "") -> str:
     """One Prometheus-text snapshot of a Datapath's observable state."""
     stats = datapath.stats()

@@ -91,3 +91,18 @@ def test_controller_info_heartbeat():
     assert info["conditions"][0]["type"] == "ControllerHealthy"
     w.stop()
     assert collect_controller_info(ctl, store=store)["connectedAgentNum"] == 0
+
+
+def test_controller_metrics_render():
+    from antrea_tpu.apis import crd
+    from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+    from antrea_tpu.dissemination import RamStore
+    from antrea_tpu.observability.metrics import render_controller_metrics
+
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    ctl.upsert_namespace(crd.Namespace(name="d", labels={}))
+    text = render_controller_metrics(ctl, store=store)
+    assert 'antrea_tpu_controller_objects{kind="network_policies"} 0' in text
+    assert "antrea_tpu_controller_connected_agents 0" in text
