@@ -106,3 +106,41 @@ def test_controller_metrics_render():
     text = render_controller_metrics(ctl, store=store)
     assert 'antrea_tpu_controller_objects{kind="network_policies"} 0' in text
     assert "antrea_tpu_controller_connected_agents 0" in text
+
+
+def test_egress_qos_meters():
+    """EgressQoS: per-Egress token buckets drop over-rate traffic at the
+    egress boundary (the OVS-meter analog, pipeline.go EgressQoS)."""
+    from antrea_tpu.apis.crd import LabelSelector
+    from antrea_tpu.controller.egress import (
+        EgressController,
+        EgressPolicy,
+        EgressQoSMeters,
+        build_egress_table,
+    )
+    from antrea_tpu.controller.grouping import GroupEntityIndex
+
+    idx = GroupEntityIndex()
+    ec = EgressController(idx)
+    ec.upsert(EgressPolicy(name="eg-fast", egress_ip="203.0.113.1",
+                           pod_selector=LabelSelector.make({"t": "a"})))
+    ec.upsert(EgressPolicy(name="eg-slow", egress_ip="203.0.113.2",
+                           pod_selector=LabelSelector.make({"t": "b"}),
+                           rate_pps=100, burst_pkts=150))
+    assert ec.qos_limits() == {"eg-slow": (100, 150)}
+    meters = EgressQoSMeters(ec.qos_limits())
+    # Burst admits up to 150, then the bucket is empty.
+    assert meters.admit("eg-slow", 120, now=0) == 120
+    assert meters.admit("eg-slow", 100, now=0) == 30
+    assert meters.dropped["eg-slow"] == 70
+    # Refill at rate: 1s -> 100 tokens.
+    assert meters.admit("eg-slow", 100, now=1) == 100
+    # Unmetered egress admits everything.
+    assert meters.admit("eg-fast", 10_000, now=1) == 10_000
+    assert meters.admit(None, 5, now=1) == 5
+    # Table name resolution feeds the meter key.
+    from antrea_tpu.utils import ip as iputil
+
+    table = build_egress_table([("10.0.0.5", "203.0.113.2", "eg-slow")])
+    assert table.egress_name_for(iputil.ip_to_u32("10.0.0.5")) == "eg-slow"
+    assert table.egress_name_for(iputil.ip_to_u32("10.0.0.6")) is None
