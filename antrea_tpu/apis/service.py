@@ -52,3 +52,11 @@ class ServiceEntry:
     # ETP_CLUSTER (default) or ETP_LOCAL; applies to external frontends
     # (LoadBalancer/external IPs + NodePort), never to the ClusterIP.
     external_traffic_policy: str = ETP_CLUSTER
+    # LoadBalancerMode=DSR (ref service.antrea.io/load-balancer-mode
+    # annotation; pipeline.go DSRServiceMark table, proxier DSR handling):
+    # external-frontend traffic is delivered to the selected endpoint
+    # WITHOUT rewriting the L3 destination and WITHOUT SNAT — the endpoint
+    # owns the VIP and replies directly to the client, never re-traversing
+    # this node.  Applies to external frontends only; the ClusterIP path
+    # stays regular DNAT.
+    dsr: bool = False

@@ -65,6 +65,11 @@ class ServiceTables:
     # per-program: a ClusterIP and a NodePort of the same service share a
     # program but only the external entry is marked.
     slot_snat: np.ndarray
+    # (P,) i32 0/1 per PROGRAM — DSR delivery (ref pipeline.go
+    # DSRServiceMark): DSR external frontends compile to a DEDICATED
+    # program so the mark is recoverable from the cached svc_idx on
+    # fast-path hits without any extra flow-entry bits.
+    prog_dsr: np.ndarray
     names: list[str]
 
     @property
@@ -91,6 +96,7 @@ def compile_services(
             "eps": list(svc.endpoints),
             "aff": svc.affinity_timeout_s,
             "name": f"{svc.namespace}/{svc.name}" if svc.name else f"svc-{si}",
+            "dsr": False,  # the ClusterIP path is always regular DNAT
         })
     frontends: list[tuple[int, int, int, int]] = []  # (ip_u, key, prog, snat)
     for si, svc in enumerate(services):
@@ -109,6 +115,17 @@ def compile_services(
                 "eps": [e for e in svc.endpoints if e.node == node_name],
                 "aff": svc.affinity_timeout_s,
                 "name": progs[si]["name"],
+                "dsr": svc.dsr,
+            })
+        elif svc.dsr:
+            # DSR: dedicated program (full endpoint view) carrying the
+            # per-program mark; no SNAT — replies bypass this node.
+            ext_prog, ext_snat = len(progs), 0
+            progs.append({
+                "eps": list(svc.endpoints),
+                "aff": svc.affinity_timeout_s,
+                "name": progs[si]["name"],
+                "dsr": True,
             })
         else:
             # Cluster policy: identical endpoint view — share the cluster
@@ -134,6 +151,7 @@ def compile_services(
     n_ep = np.ones(P, dtype=np.int32)
     has_ep = np.zeros(P, dtype=np.int32)
     aff = np.zeros(P, dtype=np.int32)
+    prog_dsr = np.zeros(P, dtype=np.int32)
     ep_base = np.zeros(P, dtype=np.int32)
     names: list[str] = [""] * P
     flat_ip: list[int] = []
@@ -144,6 +162,7 @@ def compile_services(
         n_ep[pi] = max(1, len(eps))
         has_ep[pi] = 1 if eps else 0
         aff[pi] = pr["aff"]
+        prog_dsr[pi] = 1 if pr.get("dsr") else 0
         names[pi] = pr["name"]
         for ep in eps:
             flat_ip.append(iputil.ip_to_u32(ep.ip))
@@ -190,5 +209,6 @@ def compile_services(
         ep_ip_f=_flip(np.asarray(flat_ip, dtype=np.uint32)),
         ep_port=np.asarray(flat_port, dtype=np.int32),
         slot_snat=slot_snat[order],
+        prog_dsr=prog_dsr,
         names=names,
     )

@@ -166,6 +166,7 @@ class DeviceServiceTables(NamedTuple):
     ep_ip_f: jax.Array  # (E,) flat — unbounded endpoints per program
     ep_port: jax.Array  # (E,) flat
     slot_snat: jax.Array  # (NU, MAXP) 0/1 per-frontend SNAT-mark flag
+    prog_dsr: jax.Array  # (P,) 0/1 per-program DSR delivery flag
 
 
 class PipelineMeta(NamedTuple):
@@ -189,6 +190,7 @@ def svc_to_host(st: ServiceTables) -> DeviceServiceTables:
         ep_ip_f=np.asarray(st.ep_ip_f),
         ep_port=np.asarray(st.ep_port),
         slot_snat=np.asarray(st.slot_snat),
+        prog_dsr=np.asarray(st.prog_dsr),
     )
 
 
