@@ -42,12 +42,19 @@ class AgentPolicyController:
         store: Optional[RamStore] = None,
         *,
         filestore_dir: Optional[str] = None,
+        status_reporter=None,
     ):
         self.node = node
         self.datapath = datapath
         self._ps = PolicySet()
         self._rules_dirty = False
         self._deltas: list[tuple[str, list, list]] = []
+        # Realization-status reporting (the agent statusManager analog, ref
+        # pkg/agent/controller/networkpolicy status reporting feeding
+        # controller status_controller.go:140 UpdateStatus): after every
+        # successful datapath apply, report {policy uid: realized spec
+        # generation} for this node.  None disables reporting.
+        self._status_reporter = status_reporter
         # Filestore fallback (ref pkg/agent/controller/networkpolicy/
         # filestore.go + watcher.FallbackFunc, networkpolicy_controller.go:
         # 923,948): the last-received computed policy state is persisted so
@@ -119,10 +126,15 @@ class AgentPolicyController:
         if self._rules_dirty:
             # A bundle folds any pending deltas too (membership is already
             # reflected in the local PolicySet).
-            self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
+            try:
+                self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
+            except Exception as e:
+                self._report_status(failure=str(e))
+                raise
             self._rules_dirty = False
             self._deltas.clear()
             self._save_filestore()
+            self._report_status()
             return
         for name, added, removed in self._deltas:
             try:
@@ -134,6 +146,23 @@ class AgentPolicyController:
                 break
         self._deltas.clear()
         self._save_filestore()
+        self._report_status()
+
+    def realized_generations(self) -> dict:
+        """{policy uid: spec generation} this agent has applied to its
+        datapath — the per-node realization the status plane aggregates."""
+        return {p.uid: p.generation for p in self._ps.policies}
+
+    def _report_status(self, failure: str = "") -> None:
+        if self._status_reporter is None:
+            return
+        if failure:
+            self._status_reporter(
+                self.node, self.realized_generations(),
+                failure=failure,
+            )
+        else:
+            self._status_reporter(self.node, self.realized_generations())
 
     @property
     def policy_set(self) -> PolicySet:

@@ -82,13 +82,17 @@ class GroupMember:
     """A pod/external endpoint in a group. Ref: types.go:80.
 
     The reference carries Pod/ExternalEntity references + IPs + ports; the
-    datapath cares about IPs (and node placement for span computation).
+    datapath cares about IPs (+ node placement for span computation) and
+    the member's NAMED ports (types.go:87-88 GroupMember.Ports): (name,
+    port, protocol) triples consumed by the named-port resolution pass
+    (compiler/ir.resolve_named_ports).
     """
 
     ip: str
     node: str = ""
     pod_namespace: str = ""
     pod_name: str = ""
+    ports: tuple = ()  # ((name, port, protocol), ...)
 
 
 @dataclass
@@ -124,12 +128,16 @@ class Service:
     """One port/protocol entry of a rule. Ref: types.go:299.
 
     protocol None means any protocol; port None means any port;
-    end_port extends port to a range [port, end_port].
+    end_port extends port to a range [port, end_port].  port_name is a
+    NAMED container port (the IntOrString string form of the reference's
+    Service.Port): resolved per destination member by
+    compiler/ir.resolve_named_ports before any matching happens.
     """
 
     protocol: Optional[int] = None
     port: Optional[int] = None
     end_port: Optional[int] = None
+    port_name: str = ""
 
 
 @dataclass
@@ -194,6 +202,11 @@ class NetworkPolicy:
     # Antrea-native only:
     tier_priority: Optional[int] = None  # None for K8s NP
     priority: Optional[float] = None  # policy priority within tier
+    # Spec generation (ref types.go NetworkPolicy.Generation): bumped by the
+    # central controller on every spec change of the same uid.  Agents echo
+    # it in realization-status reports so the controller can tell realized
+    # state of the CURRENT spec from a stale one (status_controller.go:194).
+    generation: int = 0
 
     @property
     def is_k8s(self) -> bool:

@@ -242,6 +242,9 @@ _flip = iputil.flip_u32
 
 
 def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
+    from .ir import resolve_named_ports
+
+    ps = resolve_named_ports(ps)
     ip_space = _GroupSpace()
     svc_space = _GroupSpace()
 

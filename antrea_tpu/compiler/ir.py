@@ -11,6 +11,7 @@ verdict-parity testing meaningful.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
 from ..apis.controlplane import (
     AddressGroup,
@@ -19,6 +20,7 @@ from ..apis.controlplane import (
     NetworkPolicy,
     NetworkPolicyPeer,
     NetworkPolicyRule,
+    Service,
 )
 from ..utils import ip as iputil
 
@@ -75,3 +77,151 @@ class PolicySet:
                     if iputil.ip_to_u32(m.ip) == ip_u32:
                         return True
         return False
+
+
+def _resolve_member(m, service) -> Optional[int]:
+    """Member's numeric port for a named service, or None (no such port —
+    the member cannot match; K8s named-port semantics)."""
+    for name, port, proto in m.ports:
+        if name == service.port_name and (
+            service.protocol is None or proto == service.protocol
+        ):
+            return int(port)
+    return None
+
+
+def resolve_named_ports(ps: PolicySet) -> PolicySet:
+    """Named-port resolution pass (ref GroupMember.Ports, types.go:87-88;
+    the reference's agents resolve `port: "http"` per matched member when
+    installing flows).
+
+    Rules whose services carry a port NAME expand into per-resolved-value
+    rules: members exposing the name at value V form a synthetic narrowed
+    group, paired with a numeric Service(V).  The pod side resolves for
+    ingress (appliedTo members), the peer side for egress (to_peer address
+    groups); ipBlocks cannot resolve names and contribute nothing.  Rules
+    keep their original `priority` so cross-rule ordering is unchanged
+    (expansion siblings share an action, so their relative order is
+    irrelevant).
+
+    Consumed by BOTH compile_policy_set and the scalar Oracle — a single
+    source of truth, so the twins cannot drift on named-port semantics.
+    Idempotent: an already-resolved set has no named services.
+    """
+    from ..apis.controlplane import (
+        AddressGroup,
+        AppliedToGroup,
+        Direction,
+        NetworkPolicyPeer,
+    )
+
+    if not any(
+        s.port_name
+        for p in ps.policies
+        for r in p.rules
+        for s in r.services
+    ):
+        return ps
+
+    out = PolicySet(
+        policies=[],
+        address_groups=dict(ps.address_groups),
+        applied_to_groups=dict(ps.applied_to_groups),
+    )
+
+    def narrowed_atg(group_names: list, service, value: int) -> Optional[str]:
+        members = [
+            m
+            for gn in group_names
+            for m in (ps.applied_to_groups.get(gn).members
+                      if ps.applied_to_groups.get(gn) else [])
+            if _resolve_member(m, service) == value
+        ]
+        if not members:
+            return None
+        key = (f"{'+'.join(group_names)}#np:{service.port_name}"
+               f"/{service.protocol}={value}")
+        out.applied_to_groups.setdefault(
+            key, AppliedToGroup(name=key, members=members)
+        )
+        return key
+
+    def narrowed_peer(peer: NetworkPolicyPeer, service, value: int):
+        members = [
+            m
+            for gn in peer.address_groups
+            for m in (ps.address_groups.get(gn).members
+                      if ps.address_groups.get(gn) else [])
+            if _resolve_member(m, service) == value
+        ]
+        if not members:
+            return None
+        key = (f"{'+'.join(peer.address_groups)}#np:{service.port_name}"
+               f"/{service.protocol}={value}")
+        out.address_groups.setdefault(
+            key, AddressGroup(name=key, members=members)
+        )
+        return NetworkPolicyPeer(address_groups=[key])
+
+    for p in ps.policies:
+        new_rules = []
+        for r in p.rules:
+            named = [s for s in r.services if s.port_name]
+            if not named:
+                new_rules.append(r)
+                continue
+            numeric = [s for s in r.services if not s.port_name]
+            if numeric:
+                new_rules.append(replace_rule(r, services=numeric))
+            for s in named:
+                # Collect the distinct resolved values on the DESTINATION
+                # side of the rule.
+                if r.direction == Direction.IN:
+                    groups = r.applied_to_groups or p.applied_to_groups
+                    src_members = [
+                        m for gn in groups
+                        for m in (ps.applied_to_groups.get(gn).members
+                                  if ps.applied_to_groups.get(gn) else [])
+                    ]
+                else:
+                    src_members = [
+                        m for gn in r.to_peer.address_groups
+                        for m in (ps.address_groups.get(gn).members
+                                  if ps.address_groups.get(gn) else [])
+                    ]
+                values = sorted({
+                    v for m in src_members
+                    if (v := _resolve_member(m, s)) is not None
+                })
+                for v in values:
+                    resolved = Service(protocol=s.protocol, port=v)
+                    if r.direction == Direction.IN:
+                        groups = r.applied_to_groups or p.applied_to_groups
+                        key = narrowed_atg(groups, s, v)
+                        if key is None:
+                            continue
+                        new_rules.append(replace_rule(
+                            r, services=[resolved], applied_to_groups=[key]
+                        ))
+                    else:
+                        np_peer = narrowed_peer(r.to_peer, s, v)
+                        if np_peer is None:
+                            continue
+                        new_rules.append(replace_rule(
+                            r, services=[resolved], to_peer=np_peer
+                        ))
+        q = NetworkPolicy(
+            uid=p.uid, name=p.name, namespace=p.namespace, type=p.type,
+            rules=new_rules, applied_to_groups=list(p.applied_to_groups),
+            policy_types=list(p.policy_types),
+            tier_priority=p.tier_priority, priority=p.priority,
+            generation=p.generation,
+        )
+        out.policies.append(q)
+    return out
+
+
+def replace_rule(r: NetworkPolicyRule, **kw) -> NetworkPolicyRule:
+    from dataclasses import replace
+
+    return replace(r, **kw)

@@ -67,8 +67,10 @@ class ServiceTables:
     slot_snat: np.ndarray
     # (P,) i32 0/1 per PROGRAM — DSR delivery (ref pipeline.go
     # DSRServiceMark): DSR external frontends compile to a DEDICATED
-    # program so the mark is recoverable from the cached svc_idx on
-    # fast-path hits without any extra flow-entry bits.
+    # program (never shared with the ClusterIP view).  The slow path reads
+    # this flag at LB time; commits then pin it into the flow entry
+    # (models/pipeline.py meta3 bit 30) like the SNAT mark, so established
+    # connections keep their delivery mode across program renumbering.
     prog_dsr: np.ndarray
     names: list[str]
 

@@ -42,6 +42,7 @@ from ..apis.crd import (
     Tier,
 )
 from ..compiler.ir import PolicySet
+from . import admission
 from .grouping import GroupEntityIndex, GroupSelector
 
 
@@ -303,6 +304,7 @@ class NetworkPolicyController:
     # -- K8s NetworkPolicy ---------------------------------------------------
 
     def upsert_k8s_policy(self, np: K8sNetworkPolicy) -> None:
+        admission.validate_k8s_policy(np)
         internal = self._convert_k8s(np)
         self._install(np.uid, internal, kind="k8s")
 
@@ -357,6 +359,7 @@ class NetworkPolicyController:
         """Register/replace a custom tier.  Priority changes re-convert the
         policies referencing it (the reference restricts this via webhook;
         here it's an explicit re-sync)."""
+        admission.validate_tier(tier, self._tiers)
         old = self._tiers.get(tier.name)
         self._tiers[tier.name] = tier
         if old is not None and old.priority != tier.priority:
@@ -396,6 +399,7 @@ class NetworkPolicyController:
     # -- ClusterGroups (ref: crd ClusterGroup, controller group.go) ----------
 
     def upsert_cluster_group(self, cg: ClusterGroup) -> None:
+        admission.validate_cluster_group(cg, self._cluster_groups)
         self._cluster_groups[cg.name] = cg
         # Re-convert referencing policies so their peers track the new spec.
         for uid, anp in list(self._raw_anps.items()):
@@ -542,6 +546,11 @@ class NetworkPolicyController:
     def upsert_antrea_policy(self, anp: AntreaNetworkPolicy) -> None:
         if not self._gates.enabled("AntreaPolicy"):
             raise RuntimeError("AntreaPolicy feature gate is disabled")
+        # Admission webhooks run BEFORE the controller sees the object
+        # (mutate.go then validate.go): a rejected policy leaks no group
+        # refs, no watch events, no compiler input.
+        anp = admission.mutate_antrea_policy(anp)
+        admission.validate_antrea_policy(anp, self._tiers, self._cluster_groups)
         self._validate_l7(anp.uid, anp.rules)
         internal = self._convert_antrea(anp)
         self._raw_anps[anp.uid] = anp
@@ -612,6 +621,11 @@ class NetworkPolicyController:
 
     def _install(self, uid: str, internal: cp.NetworkPolicy, kind: str) -> None:
         old = self._nps.get(uid)
+        # Spec generation (types.go NetworkPolicy.Generation): every install
+        # of the same uid bumps it; agents echo the generation they realized
+        # so the status aggregation can tell current from stale
+        # (status_controller.go:270 syncHandler compares them).
+        internal.generation = (old.generation if old is not None else 0) + 1
         self._nps[uid] = internal
         self._raw_uid_kind[uid] = kind
         span: set = set()
@@ -649,6 +663,15 @@ class NetworkPolicyController:
         self._emit(WatchEvent(kind="DELETED", obj_type="NetworkPolicy", name=uid))
 
     # -- snapshots (compiler input) ------------------------------------------
+
+    def np_realization_view(self) -> dict:
+        """uid -> (current generation, desired node span) — the internal-NP
+        store view the status aggregation reads (status_controller.go:270
+        reads internalNP.Generation + SpanMeta.NodeNames)."""
+        return {
+            uid: (p.generation, frozenset(self._np_span.get(uid, set())))
+            for uid, p in self._nps.items()
+        }
 
     def object_counts(self) -> dict:
         """O(1) live-object gauges (for heartbeats/metrics — policy_set()

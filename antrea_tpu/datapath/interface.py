@@ -90,6 +90,13 @@ class StepResult:
     # the VLAN seam instead of normal output (ref network_policy.go:2213
     # l7NPTrafficControlFlows; reg0 L7 redirect bit, fields.go).
     l7_redirect: np.ndarray = None
+    # 0/1 — DSR delivery (ref pipeline.go:145 DSRServiceMarkTable, DSR
+    # service flows :698-708): dnat_ip/dnat_port carry the SELECTED
+    # endpoint (it drives out_port/forwarding), but the emitted packet's L3
+    # destination must NOT be rewritten and no SNAT applies; the endpoint
+    # owns the VIP and replies directly to the client, so no reply-direction
+    # conntrack leg exists on this node.
+    dsr: np.ndarray = None
 
 
 class Datapath(ABC):

@@ -63,6 +63,9 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         flow_slots: int = 1 << 20,
         aff_slots: int = 1 << 18,
         ct_timeout_s: int = 3600,
+        ct_syn_timeout_s=None,
+        ct_other_new_s=None,
+        ct_other_est_s=None,
         node_ips: Optional[list] = None,
         node_name: str = "",
         persist_dir: Optional[str] = None,
@@ -84,6 +87,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         self._oracle = PipelineOracle(
             self._ps, self._services,
             flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
+            ct_syn_timeout_s=ct_syn_timeout_s,
+            ct_other_new_s=ct_other_new_s, ct_other_est_s=ct_other_est_s,
             node_ips=list(node_ips or []), node_name=node_name,
         )
         self._stats_in: Counter = Counter()
@@ -95,15 +100,32 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
     def _rebuild_l7_ids(self) -> None:
         """Stable ids of rules carrying L7 protocols in the CURRENT policy
         set — attribution resolves against the current table, matching the
-        device's post-resolve l7 gather (ct_label caveat shared)."""
-        from ..compiler.ir import rule_id
+        device's post-resolve l7 gather (ct_label caveat shared).  Computed
+        over the named-port-RESOLVED set so ids line up with the expanded
+        rule indices both engines attribute against."""
+        from ..compiler.ir import resolve_named_ports, rule_id
 
+        rps = resolve_named_ports(self._ps)
         self._l7_ids = {
             rule_id(p, i)
-            for p in self._ps.policies
+            for p in rps.policies
             for i, r in enumerate(p.rules)
             if r.l7_protocols
         }
+        self._has_named_ports = any(
+            s.port_name
+            for p in self._ps.policies for r in p.rules for s in r.services
+        )
+        # Exemplar member per (group, ip) so a delta re-add restores the
+        # full member (node + named ports), mirroring TpuflowDatapath's
+        # _member_meta bookkeeping — the twins must rebuild identical
+        # membership from identical delta sequences.
+        self._exemplars = {}
+        for table in (self._ps.address_groups, self._ps.applied_to_groups):
+            for name, g in table.items():
+                ex = self._exemplars.setdefault(name, {})
+                for m in g.members:
+                    ex.setdefault(m.ip, m)
 
     @property
     def datapath_type(self) -> DatapathType:
@@ -135,8 +157,9 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 continue
             touched = True
             before = _group_ranges(g)
+            ex = self._exemplars.get(group_name, {})
             for ip in added_ips:
-                g.members.append(GroupMember(ip=ip))
+                g.members.append(ex.get(ip) or GroupMember(ip=ip))
             for ip in removed_ips:
                 for i, m in enumerate(g.members):
                     if m.ip == ip:
@@ -146,6 +169,11 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 changed = True
         if not touched:
             raise KeyError(f"unknown group {group_name!r}")
+        if self._has_named_ports:
+            # Named-port synthetic membership can change even when merged
+            # ranges do not (see TpuflowDatapath.apply_group_delta): every
+            # delta is a full resync.
+            changed = True
         if not changed:
             # Refcount-only delta (e.g. re-add of an already-present member):
             # no verdict can differ — keep the generation, matching
@@ -178,7 +206,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         o = self._oracle
         gen_w = self._gen % GEN_ETERNAL
         for e in o.flow.values():
-            if (now - e["ts"]) > o.ct_timeout_s:
+            if (now - e["ts"]) > o.timeout_of(e, e["key"][3]):
                 continue
             if e["gen"] is not None and e["gen"] != gen_w:
                 continue  # stale-generation denial: dead to lookups
@@ -232,7 +260,15 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             w = o.fresh_walk(o.aff, p, h, now)
             code = e["code"] if e is not None else w["code"]
             is_rpl = e is not None and e.get("rpl", False)
-            eff_dst = p.dst_ip if is_rpl else w["dnat_ip"]
+            # Forward-leg destination mirrors step()/_forward_fields: replies
+            # route to their literal dst, non-reply HITS by the cached
+            # entry's DNAT resolution, misses by the fresh walk.
+            if is_rpl:
+                eff_dst = p.dst_ip
+            elif e is not None:
+                eff_dst = e["dnat_ip"]
+            else:
+                eff_dst = w["dnat_ip"]
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             out.append({
                 "spoofed": oracle_spoof(self._rt, p.src_ip, int(in_ports[i])),
@@ -243,6 +279,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 "reply": e is not None and e.get("rpl", False),
                 "reject_kind": _reject_kind(code, p.proto),
                 "snat": w["snat"],
+                "dsr": w["dsr"],
                 "svc_idx": w["svc_idx"],
                 "no_ep": w["no_ep"],
                 "dnat_ip": w["dnat_ip"],
@@ -380,6 +417,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             reply=np.array([int(o.reply) for o in outs], np.int32),
             reject_kind=np.array([o.reject_kind for o in outs], np.int32),
             snat=np.array([o.snat for o in outs], np.int32),
+            dsr=np.array([o.dsr for o in outs], np.int32),
             spoofed=col("spoofed"),
             punt=col("punt"),
             mcast_idx=col("mcast_idx"),

@@ -60,6 +60,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         ct_timeout_s: int = 3600,
         miss_chunk: int = 4096,
         delta_slots: int = 128,
+        ct_syn_timeout_s=None,
+        ct_other_new_s=None,
+        ct_other_est_s=None,
         node_ips: Optional[list[str]] = None,
         node_name: str = "",
         persist_dir: Optional[str] = None,
@@ -78,6 +81,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._pipe_kw = dict(
             flow_slots=flow_slots, aff_slots=aff_slots,
             ct_timeout_s=ct_timeout_s, miss_chunk=miss_chunk,
+            ct_syn_timeout_s=ct_syn_timeout_s,
+            ct_other_new_s=ct_other_new_s,
+            ct_other_est_s=ct_other_est_s,
         )
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -130,7 +136,13 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         rows: list[tuple[tuple[int, int], int, int]] = []  # (range, gid, sign)
         own = self._group_members.setdefault(group_name, Counter())
         ranges_before = self._ranges_of(group_name)
-        need_recompile = False
+        # Named-port rules bind membership to per-member port values via
+        # synthetic narrowed groups (compiler/ir.resolve_named_ports) whose
+        # interned columns a raw-group delta cannot patch — and whose
+        # membership can change even when the raw group's merged ranges do
+        # not.  With named ports in play every delta is a full resync (the
+        # OracleDatapath twin applies the same rule).
+        need_recompile = self._has_named_ports
 
         for ip in added_ips:
             r = iputil.cidr_to_range(ip)
@@ -236,6 +248,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             reply=o["reply"],
             reject_kind=o["reject_kind"],
             snat=o["snat"],
+            dsr=o["dsr"],
             svc_idx=o["svc_idx"],
             dnat_ip=unflip(o["dnat_ip_f"]),
             dnat_port=o["dnat_port"],
@@ -291,9 +304,15 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # packed rule indices against the NEW rule table (misattribution).
         entry_gen = (kpg >> 9) & pl.GEN_ETERNAL
         gen_w = self._gen % pl.GEN_ETERNAL
+        # Liveness uses the per-STATE timeout (entry_timeout), matching the
+        # lookup path: a half-open TCP entry past its syn lifetime is dead
+        # to lookups and must not appear in the conntrack dump either.
+        tmo = pl.entry_timeout(
+            (meta[:, 3] >> 29) & 1, kpg & 0xFF, self._meta.timeouts, xp=np
+        )
         live = (
             (kpg != 0)
-            & ((now - ts) <= self._pipe_kw["ct_timeout_s"])
+            & ((now - ts) <= tmo)
             & ((entry_gen == pl.GEN_ETERNAL) | (entry_gen == gen_w))
         )
         out = []
@@ -381,7 +400,16 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             # path; identical semantics to the fused kernel — test-enforced
             # via the step() parity suite).
             dnat_u = iputil.unflip_u32(o["dnat_ip_f"][i])
-            eff_dst = int(batch.dst_ip[i]) if o["reply"][i] else dnat_u
+            # Forward-leg destination mirrors step(): non-reply cache hits
+            # route by the CACHED entry's DNAT resolution (service updates
+            # after commit must not flip the reported forwarding); replies
+            # go to their literal dst; misses use the fresh walk.
+            if o["reply"][i]:
+                eff_dst = int(batch.dst_ip[i])
+            elif o["cache_hit"][i]:
+                eff_dst = iputil.unflip_u32(o["cached_dnat_ip_f"][i])
+            else:
+                eff_dst = dnat_u
             spoofed = oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i]))
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             out.append({
@@ -390,6 +418,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 "reply": bool(o["reply"][i]),
                 "reject_kind": int(o["reject_kind"][i]),
                 "snat": int(o["snat"][i]),
+                "dsr": int(o["dsr"][i]),
                 "svc_idx": int(o["svc_idx"][i]),
                 "no_ep": bool(o["no_ep"][i]),
                 "dnat_ip": dnat_u,
@@ -438,6 +467,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._default_deny += int(((o["code"] != 0) & none_mask).sum())
 
     def _compile_rules(self) -> None:
+        self._has_named_ports = any(
+            s.port_name
+            for p in self._ps.policies for r in p.rules for s in r.services
+        )
         cps = compile_policy_set(self._ps)
         pl.check_rule_capacity(cps)
         drs, match_meta = to_device(cps, delta_slots=self._delta_slots)
@@ -449,6 +482,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             aff_slots=self._pipe_kw["aff_slots"],
             ct_timeout_s=self._pipe_kw["ct_timeout_s"],
             miss_chunk=self._pipe_kw["miss_chunk"],
+            ct_syn_timeout_s=self._pipe_kw["ct_syn_timeout_s"],
+            ct_other_new_s=self._pipe_kw["ct_other_new_s"],
+            ct_other_est_s=self._pipe_kw["ct_other_est_s"],
         )
         # Reset incremental bookkeeping: the compile folded all prior deltas.
         D = self._delta_slots

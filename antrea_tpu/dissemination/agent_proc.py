@@ -73,7 +73,15 @@ def main() -> int:
         try:
             if cmd == "sync":
                 agent.sync()
-                respond({"ok": True, "generation": dp.generation})
+                # Realization report rides the sync response: {policy uid:
+                # realized spec generation} — the wire form of the agent's
+                # UpdateStatus RPC (status_controller.go:140); the parent
+                # relays it into the StatusAggregator.
+                respond({
+                    "ok": True,
+                    "generation": dp.generation,
+                    "realized": agent.realized_generations(),
+                })
             elif cmd == "step":
                 p = msg["packets"]
                 batch = PacketBatch(

@@ -25,13 +25,20 @@ WIRE_VERSION = 1
 
 
 def _member(m: cp.GroupMember) -> dict:
-    return {"ip": m.ip, "node": m.node, "ns": m.pod_namespace, "pod": m.pod_name}
+    out = {"ip": m.ip, "node": m.node, "ns": m.pod_namespace, "pod": m.pod_name}
+    if m.ports:
+        # Named ports (types.go:87-88): [[name, port, protocol], ...]
+        out["ports"] = [list(t) for t in m.ports]
+    return out
 
 
 def _member_from(d: dict) -> cp.GroupMember:
     return cp.GroupMember(
         ip=d["ip"], node=d.get("node", ""),
         pod_namespace=d.get("ns", ""), pod_name=d.get("pod", ""),
+        ports=tuple(
+            (str(n), int(pt), int(pr)) for n, pt, pr in d.get("ports", ())
+        ),
     )
 
 
@@ -58,12 +65,16 @@ def _peer_from(d: dict) -> cp.NetworkPolicyPeer:
 
 
 def _service(s: cp.Service) -> dict:
-    return {"protocol": s.protocol, "port": s.port, "endPort": s.end_port}
+    out = {"protocol": s.protocol, "port": s.port, "endPort": s.end_port}
+    if s.port_name:
+        out["portName"] = s.port_name  # IntOrString string form
+    return out
 
 
 def _service_from(d: dict) -> cp.Service:
     return cp.Service(
-        protocol=d.get("protocol"), port=d.get("port"), end_port=d.get("endPort")
+        protocol=d.get("protocol"), port=d.get("port"),
+        end_port=d.get("endPort"), port_name=d.get("portName", ""),
     )
 
 
@@ -106,6 +117,7 @@ def encode_policy(p: cp.NetworkPolicy) -> dict:
         "policyTypes": [d.value for d in p.policy_types],
         "tierPriority": p.tier_priority,
         "priority": p.priority,
+        "generation": p.generation,
     }
 
 
@@ -120,6 +132,7 @@ def decode_policy(d: dict) -> cp.NetworkPolicy:
         policy_types=[cp.Direction(x) for x in d.get("policyTypes", ())],
         tier_priority=d.get("tierPriority"),
         priority=d.get("priority"),
+        generation=int(d.get("generation", 0)),
     )
 
 

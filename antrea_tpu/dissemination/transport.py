@@ -102,7 +102,10 @@ class SubprocessAgent:
         return resp
 
     def sync(self) -> dict:
-        """Reconcile received state into the agent's datapath."""
+        """Reconcile received state into the agent's datapath.  The response
+        carries "realized" ({policy uid: realized spec generation}) — relay
+        it to a StatusAggregator via update_node_statuses(node, realized)
+        to close the realization-status loop across the process boundary."""
         return self._rpc({"cmd": "sync"})
 
     def step(self, batch, now: int) -> dict:

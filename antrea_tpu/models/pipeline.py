@@ -53,7 +53,7 @@ within-batch slot duplicates (see _scatter_last).
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +77,16 @@ GEN_ETERNAL = (1 << GEN_BITS) - 1
 # /root/reference/pkg/agent/openflow/pipeline.go UnSNAT/ConntrackState;
 # docs/design/ovs-pipeline.md ct sections).
 REPLY_BIT = -(2**31)
+
+# Flow-entry meta column 3 layout: bit 31 SNAT mark, bit 30 DSR mark,
+# bit 29 CONFIRMED (two-way traffic seen — the kernel-conntrack
+# SYN_SENT -> ESTABLISHED transition; set on the first reply-direction hit
+# and propagated to the partner entry), bits 0-28 the partner-refresh
+# stamp (seconds mod 2^29; ages compare in mod arithmetic, exact for any
+# live entry).
+PREF_MASK = (1 << 29) - 1
+CONF_BIT = 1 << 29
+DSR_BIT = 1 << 30
 
 # REJECT synthesis kinds (ref pkg/agent/controller/networkpolicy/reject.go:
 # TCP gets an RST, everything else an ICMP port-unreachable).
@@ -114,13 +124,17 @@ class FlowCache(NamedTuple):
         key_pg packs proto (8 bits + valid bit 8) with the entry generation
         (GEN_BITS): zero rows (valid bit unset) can never match a packet.
         Bit 31 (REPLY_BIT) marks a reply-direction entry (below).
-      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules, snat<<31 | pref]
+      meta (N+1, 4) i32: [dnat_ip_f, meta1, rules,
+                          snat<<31|dsr<<30|conf<<29|pref]
         meta1 = code(2) | (svc_idx+1)(14) | dnat_port(16)
         rules = (rule_in+1)(16) | (rule_out+1)(16); 0 = default/none
-        pref = last partner-refresh attempt seconds (31 bits, see below);
-        bit 31 caches the frontend SNAT mark at commit time, so an
-        established external connection keeps its mark even if later
-        service updates renumber programs (ct-mark persistence analog)
+        pref = last partner-refresh attempt seconds mod 2^29 (29 bits;
+        ages compare in mod arithmetic, exact for any live entry);
+        bits 31/30 cache the frontend SNAT mark and the DSR delivery mark
+        at commit time, so an established connection keeps both marks even
+        if later service updates renumber LB programs (the ct-mark
+        persistence analog — both marks live in ct_mark in the reference);
+        bit 29 is the conntrack CONFIRMED state (see CONF_BIT)
       ts   (N+1,)  i32: last-seen seconds (refreshed on every hit)
 
     dst in keys is the ORIGINAL (pre-DNAT) dst; dnat_ip_f the resolved one.
@@ -173,8 +187,28 @@ class PipelineMeta(NamedTuple):
     match: StaticMeta
     flow_slots: int
     aff_slots: int
+    # Per-state conntrack lifetimes (the kernel's nf_conntrack_tcp_timeout_*
+    # distinctions, polled by the reference's flow exporter via
+    # conntrack_linux.go): ct_timeout_s is the TCP ESTABLISHED (confirmed)
+    # lifetime; syn covers half-open TCP (committed, no reply seen);
+    # other_* cover non-TCP (kernel UDP unreplied/stream).  None = inherit
+    # ct_timeout_s (per-state handling compiles out entirely).
     ct_timeout_s: int
     miss_chunk: int  # slow-path round size
+    ct_syn_timeout_s: Optional[int] = None
+    ct_other_new_s: Optional[int] = None
+    ct_other_est_s: Optional[int] = None
+
+    @property
+    def timeouts(self) -> tuple[int, int, int, int]:
+        """(tcp_syn, tcp_est, other_new, other_est), Nones resolved."""
+        t = self.ct_timeout_s
+        return (
+            self.ct_syn_timeout_s if self.ct_syn_timeout_s is not None else t,
+            t,
+            self.ct_other_new_s if self.ct_other_new_s is not None else t,
+            self.ct_other_est_s if self.ct_other_est_s is not None else t,
+        )
 
 
 def svc_to_host(st: ServiceTables) -> DeviceServiceTables:
@@ -287,6 +321,9 @@ def make_pipeline(
     ct_timeout_s: int = 3600,
     miss_chunk: int = 4096,
     host: bool = False,
+    ct_syn_timeout_s: Optional[int] = None,
+    ct_other_new_s: Optional[int] = None,
+    ct_other_est_s: Optional[int] = None,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -314,6 +351,9 @@ def make_pipeline(
         aff_slots=aff_slots,
         ct_timeout_s=ct_timeout_s,
         miss_chunk=miss_chunk,
+        ct_syn_timeout_s=ct_syn_timeout_s,
+        ct_other_new_s=ct_other_new_s,
+        ct_other_est_s=ct_other_est_s,
     )
     state = init_state(flow_slots, aff_slots, xp=np if host else jnp)
 
@@ -343,7 +383,13 @@ def _service_lb(
     frontends resolve to the cluster view (== service index), external
     frontends (LoadBalancer IP / NodePort) to their per-policy shadow view.
 
-    -> (svc_idx, no_ep, dnat_ip_f, dnat_port, snat, learn dict)
+    dsr flags lanes whose program is a DSR delivery program (ref
+    pipeline.go:145 DSRServiceMarkTable): the endpoint is SELECTED (it
+    drives forwarding and policy) but the packet's L3 destination is NOT
+    rewritten and no SNAT applies — dnat_ip/dnat_port then carry the
+    delivery endpoint, with the no-rewrite semantic signaled by the flag.
+
+    -> (svc_idx, no_ep, dnat_ip_f, dnat_port, snat, dsr, learn dict)
     """
     row = jnp.searchsorted(dsvc.uip_f, dst_f, side="left")
     row = jnp.clip(row, 0, dsvc.uip_f.shape[0] - 1)
@@ -387,6 +433,9 @@ def _service_lb(
     # SNAT is a property of the matched FRONTEND entry (NodePort/LB under
     # ETP=Cluster), not of the endpoint program.
     snat = jnp.where(use_ep, dsvc.slot_snat[row, slot_col], 0)
+    # DSR is a property of the PROGRAM (dedicated per-service DSR view),
+    # so fast-path hits can recover it from the cached svc_idx alone.
+    dsr = jnp.where(use_ep, dsvc.prog_dsr[svc_safe], 0)
     learn = {
         "mask": aff_on & ~aff_hit & ~no_ep,
         "aslot": aslot,
@@ -394,10 +443,23 @@ def _service_lb(
         "svc": svc_idx,
         "ep": ep_col + 1,  # stored +1: 0 means empty slot
     }
-    return svc_idx, no_ep, dnat_ip, dnat_port, snat, learn
+    return svc_idx, no_ep, dnat_ip, dnat_port, snat, dsr, learn
 
 
-def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, ct_timeout_s):
+def entry_timeout(conf, proto, timeouts, xp=jnp):
+    """Per-entry idle timeout from the CONFIRMED bit + protocol (scalar or
+    array): the kernel's per-state conntrack lifetime selection.  Single
+    source of truth for step/trace/dump on both datapaths."""
+    t_syn, t_est, t_onew, t_oest = timeouts
+    is_tcp = proto == PROTO_TCP
+    return xp.where(
+        is_tcp,
+        xp.where(conf != 0, t_est, t_syn),
+        xp.where(conf != 0, t_oest, t_onew),
+    )
+
+
+def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta):
     """Shared fast-path flow-cache probe for step and trace (single source of
     truth for the FlowCache row layout).
 
@@ -405,6 +467,10 @@ def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, ct_timeout_
     rows.  rpl flags reply-direction (reverse-tuple) hits: their meta row
     carries the un-DNAT rewrite (original service frontend ip/port) instead
     of a DNAT resolution.
+
+    Freshness is per-state (entry_timeout): half-open TCP and non-TCP
+    entries can carry shorter lifetimes than confirmed connections.  With
+    uniform timeouts (the default) the per-lane selection compiles out.
     """
     kr = flow.keys[slot]  # (B, 4) row gather
     kpg = kr[:, 3]
@@ -415,11 +481,17 @@ def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, ct_timeout_
         & (kr[:, 2] == pp)
         & ((kpg == pg_cur) | (kpg == pg_est) | (kpg == pg_rpl))
     )
-    fresh = (now - flow.ts[slot]) <= ct_timeout_s
+    mr = flow.meta[slot]
+    tmo = meta.timeouts
+    if tmo[0] == tmo[1] == tmo[2] == tmo[3]:
+        timeout = tmo[1]  # uniform: scalar, no per-lane work
+    else:
+        timeout = entry_timeout((mr[:, 3] >> 29) & 1, proto, tmo)
+    fresh = (now - flow.ts[slot]) <= timeout
     hit = key_hit & fresh
     est = hit & ((kpg == pg_est) | (kpg == pg_rpl))
     rpl = hit & (kpg == pg_rpl)
-    return hit, est, rpl, flow.meta[slot]
+    return hit, est, rpl, mr
 
 
 def _pipeline_step(
@@ -457,7 +529,7 @@ def _pipeline_step(
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
     hit, est, rpl, mr = _cache_lookup(
-        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
+        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta
     )
     if valid is not None:
         # Lane mask (SpoofGuard gating, models/forwarding.py): excluded
@@ -493,8 +565,10 @@ def _pipeline_step(
     #   fwd est hit:  partner = reply entry (dnat_ip, src, dnat_port, sport)
     #   reply hit:    partner = fwd entry (dst=client, frontend ip/port)
     p_half = max(1, meta.ct_timeout_s // 2)
-    c_pref = mr[:, 3] & 0x7FFFFFFF  # strip the cached snat bit
-    p_need = est & ((now - c_pref) >= p_half)
+    c_pref = mr[:, 3] & PREF_MASK  # strip the cached snat/dsr bits
+    # Age in mod-2^30 arithmetic: exact whenever the true age < 2^30 s,
+    # which the idle timeout guarantees for any live entry.
+    p_need = est & (((now - c_pref) & PREF_MASK) >= p_half)
 
     def partner_probe(keys, mask):
         """Derive each lane's PARTNER tuple (the other conntrack direction
@@ -526,13 +600,34 @@ def _pipeline_step(
             ts=flow.ts.at[jnp.where(p_live, p_slot, dump)].set(now),
             # Attempt-time update even when the partner is gone, so an
             # evicted partner doesn't drag the walk into every batch.
-            # Preserve the cached snat bit alongside the new pref stamp.
+            # Preserve the cached snat/dsr bits alongside the new stamp.
             meta=flow.meta.at[jnp.where(p_need, slot, dump), 3].set(
-                now | (mr[:, 3] & REPLY_BIT)
+                (now & PREF_MASK) | (mr[:, 3] & ~PREF_MASK)
             ),
         )
 
     flow = jax.lax.cond(p_need.any(), partner_refresh, lambda f: f, flow)
+
+    # SYN_SENT -> ESTABLISHED confirmation (the kernel ct state machine's
+    # two-way-traffic transition): the FIRST reply-direction hit proves the
+    # peer answered; set CONF on the hit entry and its verified partner so
+    # both directions graduate to the confirmed lifetime.  Once per
+    # connection -> under lax.cond, zero steady-state cost.
+    conf_need = rpl & (((mr[:, 3] >> 29) & 1) == 0)
+
+    def confirm(flow):
+        # OR into the CURRENT meta (partner_refresh may have just stamped
+        # pref on this very slot; clobbering it with the start-of-batch
+        # snapshot would diverge from the scalar oracle's pref=now).
+        m = flow.meta
+        tgt0 = jnp.where(conf_need, slot, dump)
+        m = m.at[tgt0, 3].set(m[tgt0, 3] | CONF_BIT)
+        c_slot, c_live = partner_probe(flow.keys, conf_need)
+        tgt = jnp.where(c_live, c_slot, dump)
+        m = m.at[tgt, 3].set(m[tgt, 3] | CONF_BIT)
+        return flow._replace(meta=m)
+
+    flow = jax.lax.cond(conf_need.any(), confirm, lambda f: f, flow)
 
     # TCP connection teardown (conntrack close): a FIN or RST on an
     # established entry removes BOTH tuple directions after this packet's
@@ -571,12 +666,17 @@ def _pipeline_step(
     # hits carry the un-SNAT implicitly via the restored frontend tuple.
     c_snat = (mr[:, 3] >> 31) & 1
     out_snat = outbuf(jnp.where(hit & ~rpl, c_snat, 0))
+    # DSR delivery mark, pinned into the entry at commit time exactly like
+    # the SNAT mark (meta3 bit 30): service updates that renumber LB
+    # programs cannot flip an established connection's delivery mode.
+    c_dsr = (mr[:, 3] >> 30) & 1
+    out_dsr = outbuf(jnp.where(hit & ~rpl, c_dsr, 0))
 
     # ---- slow path: ServiceLB + classify + commit, misses only -------------
     def slow(args):
         flow, aff, outs = args
         (out_code, out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
-         out_rule_out, out_committed, out_snat, n_evict0) = outs
+         out_rule_out, out_committed, out_snat, out_dsr, n_evict0) = outs
         # Batch semantics: affinity LOOKUPS see start-of-batch state even
         # across slow-path rounds; learns land in the carried table.
         aff_snap = aff
@@ -585,7 +685,7 @@ def _pipeline_step(
         def round_body(carry):
             (r, n_evict, flow, aff, out_code, out_svc, out_dnat_ip,
              out_dnat_port, out_rule_in, out_rule_out, out_committed,
-             out_snat) = carry
+             out_snat, out_dsr) = carry
             idx = jax.lax.dynamic_slice(
                 jnp.concatenate([midx, jnp.full((M,), B, jnp.int32)]),
                 (r * M,),
@@ -602,7 +702,7 @@ def _pipeline_step(
             slot_m = slot[safe]
             pp_m = pp[safe]
 
-            svc_idx, no_ep, dnat_ip, dnat_port, snat_m, learn = _service_lb(
+            svc_idx, no_ep, dnat_ip, dnat_port, snat_m, dsr_m, learn = _service_lb(
                 aff_snap, dsvc, h_m, s_f, d_f, p_m, dp_m, now, meta.aff_slots
             )
 
@@ -637,6 +737,7 @@ def _pipeline_step(
             out_rule_out = out_rule_out.at[tgt].set(rule_out)
             out_committed = out_committed.at[tgt].set(committed_m.astype(jnp.int32))
             out_snat = out_snat.at[tgt].set(snat_m)
+            out_dsr = out_dsr.at[tgt].set(dsr_m)
 
             # Insert into the flow cache: ALLOW entries as ETERNAL
             # (conntrack commit), denials tagged with the current gen.
@@ -644,11 +745,13 @@ def _pipeline_step(
             pg_ins = p_m | 0x100 | (egen << 9)
             m1 = _pack_meta1(code, svc_idx, dnat_port)
             rules_p = _pack_rules(rule_in, rule_out)
-            # Column 3 = snat bit | pref (the commit freshens both
-            # directions; the frontend SNAT mark is pinned here for the
-            # connection's lifetime).
-            pref_col = jnp.full((M,), now, jnp.int32)
-            zcol = pref_col | jnp.where(snat_m > 0, REPLY_BIT, 0)
+            # Column 3 = snat(31) | dsr(30) | pref (the commit freshens
+            # both directions; the frontend SNAT mark and the DSR delivery
+            # mark are pinned here for the connection's lifetime).
+            pref_col = jnp.full((M,), now & PREF_MASK, jnp.int32)
+            zcol = (pref_col
+                    | jnp.where(snat_m > 0, REPLY_BIT, 0)
+                    | jnp.where(dsr_m > 0, DSR_BIT, 0))
             key_rows = jnp.stack([s_f, d_f, pp_m, pg_ins], axis=1)
             meta_rows = jnp.stack([dnat_ip, m1, rules_p, zcol], axis=1)
 
@@ -659,7 +762,10 @@ def _pipeline_step(
             # (endpoint -> client), whose meta carries the un-DNAT rewrite —
             # the original frontend (pre-DNAT dst ip/port) the reply's
             # source must be restored to (UnSNAT/EndpointDNAT reverse).
-            rev_ins = ins & committed_m
+            # DSR connections commit NO reply leg: the endpoint answers the
+            # client directly and the reply never re-traverses this node
+            # (ref pipeline.go:698-708 DSR flows bypass the reply path).
+            rev_ins = ins & committed_m & (dsr_m == 0)
             rev_h = hashing.flow_hash(
                 _raw_bits(dnat_ip), _raw_bits(s_f), p_m, dnat_port, sp_m, xp=jnp
             )
@@ -712,7 +818,7 @@ def _pipeline_step(
             )
             return (r + 1, n_evict, flow, aff, out_code, out_svc,
                     out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
-                    out_committed, out_snat)
+                    out_committed, out_snat, out_dsr)
 
         def round_cond(carry):
             r = carry[0]
@@ -720,14 +826,14 @@ def _pipeline_step(
 
         carry = (jnp.int32(0), n_evict0, flow, aff, out_code, out_svc,
                  out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
-                 out_committed, out_snat)
+                 out_committed, out_snat, out_dsr)
         carry = jax.lax.while_loop(round_cond, round_body, carry)
         (_, n_evict, flow, aff, out_code, out_svc, out_dnat_ip,
          out_dnat_port, out_rule_in, out_rule_out, out_committed,
-         out_snat) = carry
+         out_snat, out_dsr) = carry
         return flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
                            out_rule_in, out_rule_out, out_committed,
-                           out_snat, n_evict)
+                           out_snat, out_dsr, n_evict)
 
     def noop(args):
         return args
@@ -738,10 +844,11 @@ def _pipeline_step(
         noop,
         (flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
                      out_rule_in, out_rule_out, out_committed, out_snat,
-                     jnp.int32(0))),
+                     out_dsr, jnp.int32(0))),
     )
     (out_code, out_svc, out_dnat_ip, out_dnat_port,
-     out_rule_in, out_rule_out, out_committed, out_snat, n_evict) = outs
+     out_rule_in, out_rule_out, out_committed, out_snat, out_dsr,
+     n_evict) = outs
 
     final_code = out_code[:B]
     out = {
@@ -763,6 +870,11 @@ def _pipeline_step(
         # SNAT-mark classification (pipeline.go SNATMark analog): external
         # frontend traffic under ETP=Cluster needs masquerade on egress.
         "snat": out_snat[:B],
+        # DSR delivery mark (pipeline.go:145 DSRServiceMarkTable): forward
+        # toward dnat_ip_f (the selected endpoint) but do NOT rewrite the
+        # L3 destination and do NOT SNAT; the endpoint owns the VIP and
+        # replies straight to the client (pipeline.go:698-708).
+        "dsr": out_dsr[:B],
         "n_miss": n_miss,
         # Live entries overwritten by a different tuple this step (the
         # direct-mapped collision cost; weak-#5 measurement surface).
@@ -826,11 +938,11 @@ def _pipeline_trace(
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
     hit, est, rpl, mr = _cache_lookup(
-        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, meta.ct_timeout_s
+        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta
     )
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
 
-    svc_idx, no_ep, dnat_ip, dnat_port, snat, _learn = _service_lb(
+    svc_idx, no_ep, dnat_ip, dnat_port, snat, dsr, _learn = _service_lb(
         aff, dsvc, h, src_f, dst_f, proto, dport, now, meta.aff_slots
     )
     cls = classify_batch(
@@ -844,11 +956,17 @@ def _pipeline_trace(
         "est": est.astype(jnp.int32),
         "reply": rpl.astype(jnp.int32),
         "cached_code": jnp.where(hit, c_code, -1),
+        # Cached DNAT resolution (meta row), so trace consumers can derive
+        # forwarding for hit lanes from the entry the STEP path would use
+        # (service updates after commit may make the fresh walk differ).
+        "cached_dnat_ip_f": mr[:, 0],
+        "cached_dnat_port": c_dport,
         "svc_idx": svc_idx,
         "no_ep": no_ep.astype(jnp.int32),
         "dnat_ip_f": dnat_ip,
         "dnat_port": dnat_port,
         "snat": snat,
+        "dsr": dsr,
         "egress_code": cls["egress_code"],
         "egress_rule": cls["egress_rule"],
         "ingress_code": cls["ingress_code"],

@@ -43,12 +43,15 @@ def collect_agent_info(datapath, node: str, agent=None, now=None) -> dict:
     return info
 
 
-def collect_controller_info(controller, store=None, now=None) -> dict:
+def collect_controller_info(controller, store=None, now=None, status=None) -> dict:
     """AntreaControllerInfo heartbeat (ref pkg/monitor controller side:
     version, connected-agent count, NP/group counts, conditions, service
     CIDR/cluster identity when known).  `controller` is a
     NetworkPolicyController; `store` an optional RamStore whose watcher
-    count is the connected-agent gauge."""
+    count is the connected-agent gauge; `status` an optional
+    StatusAggregator whose per-policy realization phases are summarized
+    (the kubectl-visible NetworkPolicyStatus surface,
+    status_controller.go:281-287)."""
     info = {
         "kind": "AntreaControllerInfo",
         "version": VERSION,
@@ -61,4 +64,21 @@ def collect_controller_info(controller, store=None, now=None) -> dict:
     }
     if store is not None:
         info["connectedAgentNum"] = store.n_watchers
+    if status is not None:
+        statuses = status.all_statuses()
+        info["networkPolicyRealization"] = {
+            "policies": [
+                {
+                    "uid": s.uid,
+                    "phase": s.phase,
+                    "observedGeneration": s.observed_generation,
+                    "currentNodesRealized": s.current_nodes,
+                    "desiredNodesRealized": s.desired_nodes,
+                    "failedNodes": s.failed_nodes,
+                }
+                for s in statuses
+            ],
+            "realized": sum(1 for s in statuses if s.phase == "Realized"),
+            "total": len(statuses),
+        }
     return info

@@ -83,7 +83,11 @@ def _service_matches(svc: Service, pkt: Packet) -> bool:
 
 class Oracle:
     def __init__(self, ps: PolicySet):
-        self.ps = ps
+        from ..compiler.ir import resolve_named_ports
+
+        # Named ports resolve through the SAME pass the compiler uses —
+        # twin parity on named-port semantics by construction.
+        self.ps = resolve_named_ports(ps)
 
     # -- single rule ---------------------------------------------------------
 

@@ -60,6 +60,10 @@ class ScalarOutcome:
     reply: bool = False  # reverse-tuple (reply-direction) conntrack hit
     reject_kind: int = 0  # 0 none / 1 tcp-rst / 2 icmp-port-unreachable
     snat: int = 0  # SNAT mark: external frontend under ETP=Cluster
+    # DSR delivery mark (ref pipeline.go:145 DSRServiceMark): forward toward
+    # the selected endpoint (dnat fields) WITHOUT rewriting the L3 dst and
+    # without SNAT; no reply-direction conntrack leg is committed.
+    dsr: int = 0
     # Lane excluded by the caller's lane modes (SpoofGuard drop or IGMP
     # punt): handled BEFORE the pipeline — no state touched, not a cache
     # miss either.
@@ -78,12 +82,14 @@ def _reject_kind(code: int, proto: int) -> int:
 class _LBProgram:
     """One LB program: an endpoint view + affinity.  The scalar twin of the
     compiler's program rows (compiler/services.py): cluster views occupy
-    indices 0..len(services)-1, ETP=Local shadow views follow; ETP=Cluster
-    external frontends share the cluster program, with SNAT flagged on the
-    FRONTEND entry."""
+    indices 0..len(services)-1, ETP=Local / DSR shadow views follow;
+    ETP=Cluster external frontends share the cluster program, with SNAT
+    flagged on the FRONTEND entry.  dsr marks a DSR delivery program
+    (dedicated per-service view, compiler/services.py prog_dsr)."""
 
     endpoints: list
     affinity_timeout_s: int
+    dsr: bool = False
 
 
 def _build_programs(services, node_ips, node_name):
@@ -116,6 +122,14 @@ def _build_programs(services, node_ips, node_name):
             progs.append(_LBProgram(
                 [e for e in svc.endpoints if e.node == node_name],
                 svc.affinity_timeout_s,
+                dsr=svc.dsr,
+            ))
+        elif svc.dsr:
+            # DSR: dedicated program (full endpoint view) carrying the
+            # per-program mark; no SNAT (compile_services twin).
+            ext, ext_snat = len(progs), 0
+            progs.append(_LBProgram(
+                list(svc.endpoints), svc.affinity_timeout_s, dsr=True,
             ))
         else:
             ext, ext_snat = si, 1
@@ -141,11 +155,22 @@ class PipelineOracle:
         ct_timeout_s: int = 3600,
         node_ips: list[str] | None = None,
         node_name: str = "",
+        ct_syn_timeout_s: int | None = None,
+        ct_other_new_s: int | None = None,
+        ct_other_est_s: int | None = None,
     ):
         self.oracle = Oracle(ps)
         self.flow_slots = flow_slots
         self.aff_slots = aff_slots
         self.ct_timeout_s = ct_timeout_s
+        # Per-state conntrack lifetimes, matching PipelineMeta.timeouts:
+        # (tcp_syn, tcp_est, other_new, other_est); None = uniform.
+        self.ct_timeouts = (
+            ct_syn_timeout_s if ct_syn_timeout_s is not None else ct_timeout_s,
+            ct_timeout_s,
+            ct_other_new_s if ct_other_new_s is not None else ct_timeout_s,
+            ct_other_est_s if ct_other_est_s is not None else ct_timeout_s,
+        )
         self.node_ips = list(node_ips or [])
         self.node_name = node_name
         self._set_services(services)
@@ -211,6 +236,15 @@ class PipelineOracle:
             return slot
         return None
 
+    def timeout_of(self, e: dict, proto: int) -> int:
+        """Per-entry idle timeout (the device twin's entry_timeout): the
+        CONFIRMED state + protocol select the kernel-style lifetime."""
+        t_syn, t_est, t_onew, t_oest = self.ct_timeouts
+        conf = e.get("conf", False)
+        if proto == PROTO_TCP:
+            return t_est if conf else t_syn
+        return t_oest if conf else t_onew
+
     def lookup(self, flow_view: dict, p: Packet, h: int, now: int, gen_w: int):
         """Read-only flow-cache probe -> (slot, entry-or-None)."""
         slot = h & (self.flow_slots - 1)
@@ -219,7 +253,7 @@ class PipelineOracle:
         hit = (
             e is not None
             and e["key"] == key
-            and (now - e["ts"]) <= self.ct_timeout_s
+            and (now - e["ts"]) <= self.timeout_of(e, p.proto)
             and (e["gen"] is None or e["gen"] == gen_w)
         )
         return slot, (e if hit else None)
@@ -278,6 +312,7 @@ class PipelineOracle:
             "dnat_ip": dnat_ip,
             "dnat_port": dnat_port,
             "snat": snat,
+            "dsr": 1 if (prog is not None and not no_ep and prog.dsr) else 0,
             "aff_learn": aff_learn,
             "code": code,
             "ingress_code": int(v.ingress.code),
@@ -309,6 +344,7 @@ class PipelineOracle:
         outs: list[ScalarOutcome] = []
         inserts: list[tuple[int, dict]] = []
         refreshes: list[int] = []
+        confirms: list[int] = []
         pref_updates: list[int] = []
         learns: list[tuple[int, dict]] = []
         teardowns: list[int] = []
@@ -340,16 +376,29 @@ class PipelineOracle:
                 # programs cannot flip an established connection's mark);
                 # reply hits un-SNAT via the restored frontend tuple.
                 snat = 0 if rpl_hit else e.get("snat", 0)
+                # DSR mark was pinned into the entry at commit time, like
+                # snat (the device twin's meta3 bit 30): program
+                # renumbering cannot flip an established connection's
+                # delivery mode.
+                dsr = 0 if rpl_hit else e.get("dsr", 0)
                 outs.append(
                     ScalarOutcome(
                         e["code"], est, e["svc"], e["dnat_ip"], e["dnat_port"],
                         e["rule_out"], e["rule_in"], False, hit=True,
                         reply=rpl_hit,
                         reject_kind=_reject_kind(e["code"], p.proto),
-                        snat=snat,
+                        snat=snat, dsr=dsr,
                     )
                 )
                 refreshes.append(slot)
+                # SYN_SENT -> ESTABLISHED confirmation (device twin: the
+                # CONF_BIT cond in models/pipeline): first reply-direction
+                # hit confirms BOTH tuple directions.
+                if rpl_hit and not e.get("conf", False):
+                    confirms.append(slot)
+                    c_slot = self._partner_live(flow0, e, p)
+                    if c_slot is not None:
+                        confirms.append(c_slot)
                 # TCP FIN/RST on an established entry: tear down BOTH tuple
                 # directions after this packet's verdict (the conntrack
                 # close; conservative vs kernel FIN_WAIT — see the device
@@ -392,7 +441,7 @@ class PipelineOracle:
                 ScalarOutcome(code, False, w["svc_idx"], w["dnat_ip"],
                               w["dnat_port"], rule_out, rule_in, committed,
                               reject_kind=_reject_kind(code, p.proto),
-                              snat=w["snat"])
+                              snat=w["snat"], dsr=w["dsr"])
             )
             if not nc:
                 key = (p.src_ip, p.dst_ip,
@@ -402,18 +451,21 @@ class PipelineOracle:
                         "key": key, "code": code, "svc": w["svc_idx"],
                         "dnat_ip": w["dnat_ip"], "dnat_port": w["dnat_port"],
                         "ts": now, "pref": now, "snat": w["snat"],
+                        "dsr": w["dsr"], "conf": False,
                         "gen": None if committed else gen,
                         "rule_in": rule_in, "rule_out": rule_out,
                         "rpl": False,
                     })
                 )
-            if committed:
+            if committed and not w["dsr"]:
                 # Conntrack commits both directions: the reverse-tuple entry
                 # is keyed on the post-DNAT tuple with ports swapped
                 # (endpoint -> client) and carries the UN-DNAT rewrite (the
                 # original frontend) in its dnat fields.  Insert order (fwd
                 # then rev, per packet) matches the device's interleaved
-                # scatter so eviction races resolve identically.
+                # scatter so eviction races resolve identically.  DSR
+                # connections commit NO reply leg (the reply never
+                # re-traverses this node; pipeline.go:698-708).
                 rev_h = int(
                     hashing.flow_hash(
                         np.uint32(w["dnat_ip"]), np.uint32(p.src_ip),
@@ -429,7 +481,7 @@ class PipelineOracle:
                     (rev_slot, {
                         "key": rev_key, "code": code, "svc": w["svc_idx"],
                         "dnat_ip": p.dst_ip, "dnat_port": p.dst_port,
-                        "ts": now, "pref": now, "gen": None,
+                        "ts": now, "pref": now, "gen": None, "conf": False,
                         "rule_in": rule_in, "rule_out": rule_out,
                         "rpl": True,
                     })
@@ -441,6 +493,11 @@ class PipelineOracle:
         for slot in pref_updates:
             if slot in self.flow:
                 self.flow[slot]["pref"] = now
+        # Confirmations land BEFORE teardowns/inserts (device order: the
+        # CONF meta write precedes key zeroing and slow-path scatters).
+        for slot in confirms:
+            if slot in self.flow:
+                self.flow[slot]["conf"] = True
         # Teardowns BEFORE inserts (the device clears keys before the slow
         # path scatters — a miss lane may legitimately re-occupy the slot).
         for slot in teardowns:

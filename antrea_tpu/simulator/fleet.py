@@ -18,13 +18,18 @@ from ..controller.networkpolicy import WatchEvent
 
 
 class FakeAgent:
-    def __init__(self, store, node: str):
+    def __init__(self, store, node: str, status_reporter=None):
         self.node = node
         self._watcher = store.watch_queue(node)
         self.policies: dict[str, object] = {}
         self.address_groups: dict[str, object] = {}
         self.applied_to_groups: dict[str, object] = {}
         self.events_seen = 0
+        # Realization-status reporting (same callable contract as
+        # AgentPolicyController): a fake agent "realizes" a policy the
+        # moment it lands in its table, so a fleet agent that has NOT been
+        # pumped is exactly a lagging node in the status aggregation.
+        self._status_reporter = status_reporter
 
     def pump(self) -> int:
         """Drain pending events into the local tables; -> events consumed."""
@@ -33,7 +38,15 @@ class FakeAgent:
             self._apply(ev)
             n += 1
         self.events_seen += n
+        if n and self._status_reporter is not None:
+            self._status_reporter(self.node, self.realized_generations())
         return n
+
+    def realized_generations(self) -> dict:
+        return {
+            uid: getattr(p, "generation", 0)
+            for uid, p in self.policies.items()
+        }
 
     def _apply(self, ev: WatchEvent) -> None:
         table = {
@@ -51,8 +64,11 @@ class FakeAgent:
 
 
 class FakeAgentFleet:
-    def __init__(self, store, nodes: list[str]):
-        self.agents = {n: FakeAgent(store, n) for n in nodes}
+    def __init__(self, store, nodes: list[str], status_reporter=None):
+        self.agents = {
+            n: FakeAgent(store, n, status_reporter=status_reporter)
+            for n in nodes
+        }
 
     def pump(self) -> int:
         return sum(a.pump() for a in self.agents.values())

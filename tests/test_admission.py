@@ -1,0 +1,259 @@
+"""Admission webhook tests, mirroring the reference's table-driven cases
+(/root/reference/pkg/controller/networkpolicy/validate.go:307+ per-kind
+validate paths, :995-1012 tier createValidate; mutate.go:109-143).
+
+The invariant under test: an invalid object never reaches group interning /
+dissemination / compile_policy_set — upserts raise AdmissionDenied and
+leave the controller state untouched.
+"""
+
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.crd import (
+    AntreaAppliedTo,
+    AntreaNetworkPolicy,
+    AntreaNPRule,
+    AntreaPeer,
+    ClusterGroup,
+    IPBlock,
+    K8sNetworkPolicy,
+    K8sNPRule,
+    K8sPeer,
+    LabelSelector,
+    PortSpec,
+    Tier,
+)
+from antrea_tpu.controller.admission import (
+    AdmissionDenied,
+    mutate_antrea_policy,
+    validate_cluster_group,
+)
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+
+IN, OUT = cp.Direction.IN, cp.Direction.OUT
+ALLOW, DROP, PASS = cp.RuleAction.ALLOW, cp.RuleAction.DROP, cp.RuleAction.PASS
+AT = AntreaAppliedTo(pod_selector=LabelSelector.make({"app": "web"}))
+PEER = AntreaPeer(pod_selector=LabelSelector.make({"app": "db"}))
+
+
+def _anp(uid="p1", **kw):
+    kw.setdefault("applied_to", [AT])
+    kw.setdefault("rules", [AntreaNPRule(direction=IN, peers=[PEER])])
+    return AntreaNetworkPolicy(uid=uid, name=uid, **kw)
+
+
+def _ctrl():
+    return NetworkPolicyController()
+
+
+# -- tier admission (validate.go:995-1012) -----------------------------------
+
+
+def test_tier_priority_reserved_rejected():
+    c = _ctrl()
+    with pytest.raises(AdmissionDenied, match="reserved"):
+        c.upsert_tier(Tier("mine", 250))
+
+
+def test_tier_priority_overlap_rejected():
+    c = _ctrl()
+    c.upsert_tier(Tier("mine", 42))
+    with pytest.raises(AdmissionDenied, match="overlaps"):
+        c.upsert_tier(Tier("other", 42))
+    # Same-name re-upsert with its own priority is an update, not overlap.
+    c.upsert_tier(Tier("mine", 42))
+    c.upsert_tier(Tier("mine", 43))
+
+
+def test_tier_count_bounded():
+    c = _ctrl()
+    for i in range(14):  # 6 defaults + 14 = 20 == MAX_TIERS
+        c.upsert_tier(Tier(f"t{i}", 1 + i))
+    with pytest.raises(AdmissionDenied, match="maximum number of Tiers"):
+        c.upsert_tier(Tier("overflow", 40))
+
+
+# -- ACNP/ANNP admission (validate.go:525-589) --------------------------------
+
+
+def _denied(c, anp, match):
+    with pytest.raises(AdmissionDenied, match=match):
+        c.upsert_antrea_policy(anp)
+
+
+def test_unknown_tier_rejected_and_leaks_nothing():
+    c = _ctrl()
+    _denied(c, _anp(tier="nope"), "does not exist")
+    assert not c._raw_anps and not c._atgs and not c._ags  # nothing leaked
+    assert c.policy_set().policies == []
+
+
+def test_pass_in_baseline_tier_rejected():
+    c = _ctrl()
+    bad = _anp(tier="baseline", rules=[
+        AntreaNPRule(direction=IN, peers=[PEER], action=PASS)])
+    _denied(c, bad, "Pass")
+    # Numeric-band baseline (programmatic path) is caught too.
+    bad2 = _anp(tier_priority=cp.TIER_BASELINE, rules=[
+        AntreaNPRule(direction=IN, peers=[PEER], action=PASS)])
+    _denied(c, bad2, "Pass")
+    # Pass in a normal tier is fine.
+    c.upsert_antrea_policy(_anp(rules=[
+        AntreaNPRule(direction=IN, peers=[PEER], action=PASS)]))
+
+
+def test_duplicate_rule_names_rejected():
+    c = _ctrl()
+    bad = _anp(rules=[
+        AntreaNPRule(direction=IN, peers=[PEER], name="r"),
+        AntreaNPRule(direction=OUT, peers=[PEER], name="r"),
+    ])
+    _denied(c, bad, "unique")
+
+
+def test_applied_to_spec_xor_rules():
+    c = _ctrl()
+    rule_with_at = AntreaNPRule(direction=IN, peers=[PEER], applied_to=[AT])
+    # Both spec and rules -> rejected.
+    _denied(c, _anp(applied_to=[AT], rules=[rule_with_at]), "both")
+    # Neither -> rejected.
+    _denied(c, _anp(applied_to=[], rules=[
+        AntreaNPRule(direction=IN, peers=[PEER])]), "either")
+    # Some rules but not all -> rejected.
+    _denied(c, _anp(applied_to=[], rules=[
+        rule_with_at, AntreaNPRule(direction=OUT, peers=[PEER])]),
+        "all rules or in none")
+    # All rules -> accepted.
+    c.upsert_antrea_policy(_anp(applied_to=[], rules=[rule_with_at]))
+
+
+def test_peer_forms_mutually_exclusive():
+    c = _ctrl()
+    bad_peer = AntreaPeer(pod_selector=LabelSelector.make({"a": "b"}),
+                          ip_block=IPBlock("10.0.0.0/8"))
+    _denied(c, _anp(rules=[AntreaNPRule(direction=IN, peers=[bad_peer])]),
+            "cannot be set with other peer")
+    bad_group = AntreaPeer(group="g", fqdn="example.com")
+    _denied(c, _anp(rules=[AntreaNPRule(direction=OUT, peers=[bad_group])]),
+            "cannot be set with other peer")
+
+
+def test_unknown_cluster_group_rejected():
+    c = _ctrl()
+    _denied(c, _anp(rules=[AntreaNPRule(
+        direction=IN, peers=[AntreaPeer(group="ghost")])]), "does not exist")
+
+
+def test_fqdn_ingress_rejected():
+    c = _ctrl()
+    _denied(c, _anp(rules=[AntreaNPRule(
+        direction=IN, peers=[AntreaPeer(fqdn="example.com")])]),
+        "egress")
+
+
+def test_invalid_cidr_rejected():
+    c = _ctrl()
+    for cidr in ("300.1.2.3/8", "10.0.0.0/33", "banana"):
+        _denied(c, _anp(rules=[AntreaNPRule(
+            direction=IN, peers=[AntreaPeer(ip_block=IPBlock(cidr))])]),
+            "invalid")
+    # except outside the cidr
+    _denied(c, _anp(rules=[AntreaNPRule(
+        direction=IN,
+        peers=[AntreaPeer(ip_block=IPBlock("10.0.0.0/16",
+                                           excepts=("11.0.0.0/24",)))])]),
+        "within")
+
+
+def test_port_spec_validation():
+    c = _ctrl()
+    mk = lambda p: _anp(rules=[AntreaNPRule(direction=IN, peers=[PEER],
+                                            ports=[p])])
+    _denied(c, mk(PortSpec(port=80, end_port=79)), "smaller")
+    _denied(c, mk(PortSpec(end_port=90)), "without a port")
+    _denied(c, mk(PortSpec(port=70000)), "out of range")
+    c.upsert_antrea_policy(mk(PortSpec(port=80, end_port=90)))
+
+
+def test_l7_requires_allow():
+    c = _ctrl()
+    _denied(c, _anp(rules=[AntreaNPRule(
+        direction=IN, peers=[PEER], action=DROP, l7_protocols=("http",))]),
+        "Allow")
+
+
+def test_k8s_policy_cidr_and_ports_validated():
+    c = _ctrl()
+    bad = K8sNetworkPolicy(
+        uid="k1", namespace="ns", name="np",
+        ingress=[K8sNPRule(peers=[K8sPeer(ip_block=IPBlock("10.0.0.0/40"))])],
+    )
+    with pytest.raises(AdmissionDenied, match="invalid"):
+        c.upsert_k8s_policy(bad)
+    bad2 = K8sNetworkPolicy(
+        uid="k2", namespace="ns", name="np2",
+        ingress=[K8sNPRule(ports=[PortSpec(port=80, end_port=10)])],
+    )
+    with pytest.raises(AdmissionDenied, match="smaller"):
+        c.upsert_k8s_policy(bad2)
+
+
+# -- ClusterGroup admission (validate.go:1051-1133) ---------------------------
+
+
+def test_cluster_group_exactly_one_form():
+    c = _ctrl()
+    with pytest.raises(AdmissionDenied, match="one membership form"):
+        c.upsert_cluster_group(ClusterGroup(name="empty"))
+    with pytest.raises(AdmissionDenied, match="at most one"):
+        c.upsert_cluster_group(ClusterGroup(
+            name="both", pod_selector=LabelSelector.make({"a": "b"}),
+            ip_blocks=[IPBlock("10.0.0.0/8")]))
+    c.upsert_cluster_group(ClusterGroup(
+        name="ok", ip_blocks=[IPBlock("10.0.0.0/8")]))
+
+
+def test_cluster_group_no_deep_nesting():
+    c = _ctrl()
+    c.upsert_cluster_group(ClusterGroup(name="leaf",
+                                        ip_blocks=[IPBlock("10.0.0.0/8")]))
+    c.upsert_cluster_group(ClusterGroup(name="mid", child_groups=["leaf"]))
+    with pytest.raises(AdmissionDenied, match="nesting"):
+        c.upsert_cluster_group(ClusterGroup(name="top", child_groups=["mid"]))
+
+
+def test_cluster_group_invalid_ipblock():
+    existing = {}
+    with pytest.raises(AdmissionDenied, match="invalid"):
+        validate_cluster_group(
+            ClusterGroup(name="bad", ip_blocks=[IPBlock("nope")]), existing)
+
+
+# -- mutation (mutate.go:109-143) ---------------------------------------------
+
+
+def test_mutate_defaults_tier_and_rule_names():
+    anp = _anp(rules=[
+        AntreaNPRule(direction=IN, peers=[PEER]),
+        AntreaNPRule(direction=OUT, peers=[PEER]),
+        AntreaNPRule(direction=IN, peers=[PEER], name="keep"),
+    ])
+    m = mutate_antrea_policy(anp)
+    assert m.tier == "application"
+    names = [r.name for r in m.rules]
+    assert names[2] == "keep"
+    assert names[0].startswith("ingress-allow-") and names[1].startswith("egress-allow-")
+    assert len(set(names)) == 3
+    # Mutation is stable: same input -> same generated names.
+    assert [r.name for r in mutate_antrea_policy(anp).rules] == names
+    # Programmatic band selection is NOT overridden by tier defaulting.
+    prog = _anp(tier_priority=100)
+    assert mutate_antrea_policy(prog).tier == ""
+
+
+def test_mutated_policy_resolves_default_tier():
+    c = _ctrl()
+    c.upsert_antrea_policy(_anp())
+    [p] = c.policy_set().policies
+    assert p.tier_priority == cp.TIER_APPLICATION

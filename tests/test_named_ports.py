@@ -1,0 +1,132 @@
+"""Named-port resolution (ref GroupMember.Ports, types.go:87-88).
+
+The resolution pass (compiler/ir.resolve_named_ports) is shared by the
+compiler and the oracle, so the parity tests here exercise BOTH engines on
+worlds where `port: "http"` resolves to DIFFERENT numeric ports per member.
+"""
+
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.compile import ACT_ALLOW, ACT_DROP, compile_policy_set
+from antrea_tpu.compiler.ir import PolicySet, resolve_named_ports
+from antrea_tpu.ops.match import flip_ips, make_classifier
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+WEB1 = "10.0.0.1"   # exposes http=8080
+WEB2 = "10.0.0.2"   # exposes http=9090
+NOPORT = "10.0.0.3"  # no named ports
+CLIENT = "10.0.1.9"
+
+
+def _member(ip, ports=()):
+    return cp.GroupMember(ip=ip, node="n0", ports=tuple(ports))
+
+
+def _world():
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(name="web", members=[
+        _member(WEB1, [("http", 8080, 6)]),
+        _member(WEB2, [("http", 9090, 6)]),
+        _member(NOPORT),
+    ])
+    ps.address_groups["clients"] = cp.AddressGroup(
+        name="clients", members=[_member(CLIENT)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="np1", name="allow-http", namespace="ns",
+        type=cp.NetworkPolicyType.K8S,
+        applied_to_groups=["web"],
+        policy_types=[cp.Direction.IN],
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(address_groups=["clients"]),
+            services=[cp.Service(protocol=6, port_name="http")],
+        )],
+    ))
+    return ps
+
+
+def test_resolution_pass_shape():
+    rps = resolve_named_ports(_world())
+    [p] = rps.policies
+    # One expanded rule per resolved value (8080, 9090); NOPORT contributes
+    # nothing; the original named service is gone.
+    assert len(p.rules) == 2
+    ports = sorted(s.port for r in p.rules for s in r.services)
+    assert ports == [8080, 9090]
+    assert all(not s.port_name for r in p.rules for s in r.services)
+    for r in p.rules:
+        [key] = r.applied_to_groups
+        g = rps.applied_to_groups[key]
+        port = r.services[0].port
+        assert [m.ip for m in g.members] == [WEB1 if port == 8080 else WEB2]
+    # Idempotent.
+    assert resolve_named_ports(rps) is rps
+
+
+def test_named_port_verdicts_oracle_and_kernel():
+    ps = _world()
+    oracle = Oracle(ps)
+    cps = compile_policy_set(ps)
+    fn, _ = make_classifier(cps)
+
+    cases = [
+        # (dst, dport, expect) — pod isolated in IN by the K8s NP.
+        (WEB1, 8080, ACT_ALLOW),   # resolves http on this member
+        (WEB1, 9090, ACT_DROP),    # the OTHER member's value: no match
+        (WEB2, 9090, ACT_ALLOW),
+        (WEB2, 8080, ACT_DROP),
+        (NOPORT, 8080, ACT_DROP),  # member has no named port: never matches
+    ]
+    pkts = [Packet(src_ip=iputil.ip_to_u32(CLIENT),
+                   dst_ip=iputil.ip_to_u32(d), proto=6,
+                   src_port=40000, dst_port=dp) for d, dp, _ in cases]
+    batch = PacketBatch.from_packets(pkts)
+    out = fn(flip_ips(batch.src_ip), flip_ips(batch.dst_ip),
+             batch.proto.astype(np.int32), batch.dst_port.astype(np.int32))
+    codes = np.asarray(out["code"])
+    for i, (d, dp, expect) in enumerate(cases):
+        o = int(oracle.classify(pkts[i]).code)
+        assert o == expect, (d, dp, "oracle", o)
+        assert int(codes[i]) == expect, (d, dp, "kernel", int(codes[i]))
+
+
+def test_named_port_egress_peer_resolution():
+    """Egress rules resolve the name on the PEER (destination) members."""
+    ps = PolicySet()
+    ps.applied_to_groups["clients"] = cp.AppliedToGroup(
+        name="clients", members=[_member(CLIENT)])
+    ps.address_groups["web"] = cp.AddressGroup(name="web", members=[
+        _member(WEB1, [("http", 8080, 6)]),
+        _member(WEB2, [("http", 9090, 6)]),
+    ])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="acnp1", name="deny-http", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["clients"],
+        tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.OUT,
+            to_peer=cp.NetworkPolicyPeer(address_groups=["web"]),
+            services=[cp.Service(protocol=6, port_name="http")],
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    oracle = Oracle(ps)
+    cps = compile_policy_set(ps)
+    fn, _ = make_classifier(cps)
+    cases = [
+        (WEB1, 8080, ACT_DROP),
+        (WEB1, 9090, ACT_ALLOW),  # 9090 is WEB2's value, not WEB1's
+        (WEB2, 9090, ACT_DROP),
+    ]
+    pkts = [Packet(src_ip=iputil.ip_to_u32(CLIENT),
+                   dst_ip=iputil.ip_to_u32(d), proto=6,
+                   src_port=40000, dst_port=dp) for d, dp, _ in cases]
+    batch = PacketBatch.from_packets(pkts)
+    out = fn(flip_ips(batch.src_ip), flip_ips(batch.dst_ip),
+             batch.proto.astype(np.int32), batch.dst_port.astype(np.int32))
+    for i, (d, dp, expect) in enumerate(cases):
+        assert int(oracle.classify(pkts[i]).code) == expect, (d, dp, "oracle")
+        assert int(np.asarray(out["code"])[i]) == expect, (d, dp, "kernel")

@@ -464,3 +464,148 @@ def test_fixture_snat_mark_pinned_across_service_updates():
         # A fresh ClusterIP flow to B carries no mark.
         r = _probe(dp, CLIENT, "10.96.0.50", 80, now=3)
         assert int(r.snat[0]) == 0, dp.datapath_type
+
+
+def test_fixture_dsr_delivery_both_datapaths():
+    """pipeline.go:145 DSRServiceMarkTable + :698-708 DSR service flows:
+    external-frontend traffic on a DSR service SELECTS an endpoint (dnat
+    fields carry the delivery target for forwarding) but is delivered
+    without L3 rewrite and without SNAT; no reply-direction conntrack leg
+    is committed (the endpoint answers the client directly); fast-path
+    hits recover the mark from the cached program index; the ClusterIP
+    path of the same service stays regular DNAT."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    svc = ServiceEntry(
+        cluster_ip=VIP, port=80, protocol=6, external_ips=[LB_VIP],
+        endpoints=[Endpoint(EP, 8080, node="n1")],
+        dsr=True,
+    )
+    for dp in _mode_dps(_ps([]), [svc]):
+        t = dp.datapath_type
+        # Miss path: endpoint selected, DSR mark on, no SNAT, committed.
+        r = _probe(dp, "10.0.99.7", LB_VIP, 80, now=1)
+        assert int(r.code[0]) == ALLOW, t
+        assert int(r.dsr[0]) == 1, t
+        assert int(r.snat[0]) == 0, t
+        assert int(r.dnat_ip[0]) == iputil.ip_to_u32(EP), t
+        assert int(r.committed[0]) == 1, t
+        # Fast path: established hit keeps the mark (recovered via svc_idx).
+        r = _probe(dp, "10.0.99.7", LB_VIP, 80, now=2)
+        assert int(r.est[0]) == 1 and int(r.dsr[0]) == 1, t
+        assert int(r.snat[0]) == 0, t
+        # No reply-direction leg was committed: the conntrack dump holds no
+        # reply entry, and the endpoint->client tuple is NOT a reply hit
+        # (it classifies fresh — as an ordinary flow it then commits its
+        # OWN pair, which is why the dump check comes first).
+        assert not any(e["reply"] for e in dp.dump_flows(now=2)), t
+        r = _probe(dp, EP, "10.0.99.7", dport=40000, sport=8080, now=3)
+        assert int(r.reply[0]) == 0, t
+        # ClusterIP traffic to the same service: regular DNAT, no DSR mark.
+        r = _probe(dp, CLIENT, VIP, 80, now=4)
+        assert int(r.code[0]) == ALLOW and int(r.dsr[0]) == 0, t
+        assert int(r.dnat_ip[0]) == iputil.ip_to_u32(EP), t
+
+
+def test_fixture_dsr_etp_local_both_datapaths():
+    """DSR composed with externalTrafficPolicy=Local: the local shadow view
+    carries the DSR mark and restricts endpoints to this node."""
+    from antrea_tpu.apis.service import ETP_LOCAL, Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    svc = ServiceEntry(
+        cluster_ip=VIP, port=80, protocol=6, node_port=30080,
+        endpoints=[Endpoint("10.10.0.7", 8080, node="n0"),
+                   Endpoint("10.10.0.33", 8080, node="n1")],
+        external_traffic_policy=ETP_LOCAL, dsr=True,
+    )
+    for dp in _mode_dps(_ps([]), [svc]):
+        t = dp.datapath_type
+        for sport in (40000, 40001, 40002):
+            r = _probe(dp, "10.0.99.7", NODE_IP, 30080, now=1, sport=sport)
+            assert int(r.code[0]) == ALLOW, t
+            assert int(r.dsr[0]) == 1 and int(r.snat[0]) == 0, t
+            assert int(r.dnat_ip[0]) == iputil.ip_to_u32("10.10.0.7"), t
+
+
+def test_fixture_dsr_mark_pinned_across_service_updates():
+    """ct-mark persistence for the DSR delivery mark (meta3 bit 30): a
+    service update that renumbers LB programs — or flips the service's own
+    DSR mode — cannot change an ESTABLISHED connection's delivery mode,
+    exactly like the SNAT mark."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from fixtures_reachability import _ps
+
+    dsr_svc = ServiceEntry(cluster_ip=VIP, port=80, protocol=6,
+                           external_ips=[LB_VIP],
+                           endpoints=[Endpoint(EP, 8080, node="n1")],
+                           dsr=True)
+    other = ServiceEntry(cluster_ip="10.96.0.50", port=80, protocol=6,
+                         endpoints=[Endpoint("10.10.0.33", 8080)])
+    for dp in _mode_dps(_ps([]), [dsr_svc]):
+        t = dp.datapath_type
+        r = _probe(dp, "10.0.99.7", LB_VIP, 80, now=1, sport=40001)
+        assert int(r.dsr[0]) == 1 and int(r.committed[0]) == 1, t
+        # Renumber programs AND turn the service's DSR mode off.
+        from dataclasses import replace
+        dp.install_bundle(services=[other, replace(dsr_svc, dsr=False)])
+        r = _probe(dp, "10.0.99.7", LB_VIP, 80, now=2, sport=40001)
+        assert int(r.est[0]) == 1, t
+        assert int(r.dsr[0]) == 1, t  # pinned at commit
+        # A FRESH connection to the now-regular service has no mark.
+        r = _probe(dp, "10.0.99.7", LB_VIP, 80, now=3, sport=40002)
+        assert int(r.dsr[0]) == 0, t
+
+
+def test_fixture_per_state_conntrack_timeouts_both_datapaths():
+    """Per-state conntrack lifetimes (kernel nf_conntrack_tcp_timeout_*
+    distinctions, polled by the reference's flow exporter via
+    conntrack_linux.go): a half-open TCP connection (no reply seen) times
+    out on the SYN lifetime; once reply traffic confirms it, both tuple
+    directions live on the ESTABLISHED lifetime; non-TCP uses its own
+    (shorter) lifetimes."""
+    from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+    from fixtures_reachability import _ps
+
+    kw = dict(flow_slots=1 << 12, aff_slots=1 << 8,
+              ct_timeout_s=3600, ct_syn_timeout_s=100,
+              ct_other_new_s=50, ct_other_est_s=200)
+    for dp in (TpuflowDatapath(_ps([]), [], miss_chunk=32, **kw),
+               OracleDatapath(_ps([]), [], **kw)):
+        t = dp.datapath_type
+        # Half-open: committed at now=0, never answered.  Within the syn
+        # lifetime it est-bypasses; past it, the entry is dead (re-miss).
+        r = _probe(dp, CLIENT, EP, 80, now=0, sport=41000)
+        assert int(r.committed[0]) == 1, t
+        r = _probe(dp, CLIENT, EP, 80, now=90, sport=41000)
+        assert int(r.est[0]) == 1, t
+        # (the now=90 hit refreshed ts; idle out past syn lifetime again)
+        assert not any(
+            e["sport"] == 41000 and not e["reply"]
+            for e in dp.dump_flows(now=250)
+        ), t  # expired half-open is dead to the conntrack dump too
+        r = _probe(dp, CLIENT, EP, 80, now=300, sport=41000)
+        assert int(r.est[0]) == 0, t  # expired half-open -> reclassified
+
+        # Confirmed: commit at now=0, reply at now=1 confirms BOTH legs;
+        # the forward leg then survives far past the syn lifetime.
+        r = _probe(dp, CLIENT, EP, 80, now=0, sport=41001)
+        assert int(r.committed[0]) == 1, t
+        r = _probe(dp, EP, CLIENT, dport=41001, sport=80, now=1)
+        assert int(r.reply[0]) == 1, t
+        r = _probe(dp, CLIENT, EP, 80, now=1000, sport=41001)
+        assert int(r.est[0]) == 1, t  # established lifetime applies
+
+        # Non-TCP (UDP): unreplied dies at other_new; replied lives to
+        # other_est.
+        r = _probe(dp, CLIENT, EP, 53, now=0, proto=17, sport=41002)
+        assert int(r.committed[0]) == 1, t
+        r = _probe(dp, CLIENT, EP, 53, now=60, proto=17, sport=41002)
+        assert int(r.est[0]) == 0, t  # past other_new: reclassified
+        r = _probe(dp, CLIENT, EP, 53, now=61, proto=17, sport=41003)
+        assert int(r.committed[0]) == 1, t
+        r = _probe(dp, EP, CLIENT, dport=41003, sport=53, now=62, proto=17)
+        assert int(r.reply[0]) == 1, t
+        r = _probe(dp, CLIENT, EP, 53, now=211, proto=17, sport=41003)
+        assert int(r.est[0]) == 1, t  # confirmed UDP: other_est lifetime

@@ -1,0 +1,173 @@
+"""NetworkPolicy realization-status aggregation tests
+(status_controller.go:270 syncHandler semantics; VERDICT round-3 item 4).
+
+The headline scenario: a policy reads partially-realized (Realizing) while
+one fleet agent lags, and Realized once every spanned agent catches up.
+"""
+
+from antrea_tpu.apis import crd
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.controller.status import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_REALIZED,
+    PHASE_REALIZING,
+    StatusAggregator,
+)
+from antrea_tpu.dissemination import RamStore
+from antrea_tpu.simulator.fleet import FakeAgentFleet
+
+N_NODES = 6
+
+
+def _world():
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    agg = StatusAggregator(ctl)
+    nodes = [f"node-{i}" for i in range(N_NODES)]
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    for ni, node in enumerate(nodes):
+        ctl.upsert_pod(crd.Pod(
+            namespace="default", name=f"pod-{ni}", ip=f"10.0.{ni}.1",
+            node=node, labels={"app": "web"},
+        ))
+    return ctl, store, agg, nodes
+
+
+def _policy(uid="p1", prio=1.0):
+    return crd.AntreaNetworkPolicy(
+        uid=uid, name=uid, namespace="", tier_priority=250, priority=prio,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"app": "web"}),
+            ns_selector=crd.LabelSelector.make(),
+        )],
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP)],
+    )
+
+
+def test_realizing_while_one_agent_lags_then_realized():
+    ctl, store, agg, nodes = _world()
+    fleet = FakeAgentFleet(store, nodes,
+                           status_reporter=agg.make_agent_reporter())
+    ctl.upsert_antrea_policy(_policy())
+
+    # Pump every agent EXCEPT the last: the policy spans all 6 nodes but
+    # only 5 have realized the current generation.
+    for node in nodes[:-1]:
+        fleet.agents[node].pump()
+    st = agg.status_of("p1")
+    assert st.phase == PHASE_REALIZING
+    assert st.desired_nodes == N_NODES
+    assert st.current_nodes == N_NODES - 1
+    assert st.observed_generation == 1
+
+    # The laggard catches up -> Realized.
+    fleet.agents[nodes[-1]].pump()
+    st = agg.status_of("p1")
+    assert st.phase == PHASE_REALIZED
+    assert st.current_nodes == st.desired_nodes == N_NODES
+
+
+def test_spec_update_resets_realization():
+    ctl, store, agg, nodes = _world()
+    fleet = FakeAgentFleet(store, nodes,
+                           status_reporter=agg.make_agent_reporter())
+    ctl.upsert_antrea_policy(_policy())
+    fleet.pump()
+    assert agg.status_of("p1").phase == PHASE_REALIZED
+
+    # Spec change bumps the generation: stale node reports no longer count.
+    ctl.upsert_antrea_policy(_policy(prio=2.0))
+    st = agg.status_of("p1")
+    assert st.phase == PHASE_REALIZING
+    assert st.observed_generation == 2
+    assert st.current_nodes == 0
+    fleet.pump()
+    assert agg.status_of("p1").phase == PHASE_REALIZED
+
+
+def test_failure_and_span_shrink_and_delete():
+    ctl, store, agg, nodes = _world()
+    ctl.upsert_antrea_policy(_policy())
+    gen = ctl.np_realization_view()["p1"][0]
+    # All nodes report the current generation; one reports failure.
+    for node in nodes[:-1]:
+        agg.update_status("p1", node, gen)
+    agg.update_status("p1", nodes[-1], gen, failure=True, message="boom")
+    st = agg.status_of("p1")
+    assert st.phase == PHASE_FAILED
+    assert st.failed_nodes == [nodes[-1]]
+    assert st.current_nodes == N_NODES - 1
+
+    # The failing node's pod moves away: span shrinks, status drops, the
+    # policy becomes Realized on the remaining span.
+    ctl.delete_pod(f"default/pod-{N_NODES - 1}")
+    st = agg.status_of("p1")
+    assert st.desired_nodes == N_NODES - 1
+    assert st.phase == PHASE_REALIZED
+
+    # Deletion clears everything.
+    ctl.delete_policy("p1")
+    assert agg.status_of("p1") is None
+    assert agg.all_statuses() == []
+
+
+def test_zero_span_policy_is_pending():
+    ctl = NetworkPolicyController()
+    agg = StatusAggregator(ctl)
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ctl.upsert_antrea_policy(_policy())  # no pods -> empty span
+    st = agg.status_of("p1")
+    assert st.phase == PHASE_PENDING
+    assert st.desired_nodes == 0
+
+
+def test_real_agent_reports_through_sync():
+    """AgentPolicyController (the real agent) reports after a successful
+    datapath apply — wire a store-watched agent with an OracleDatapath."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+    from antrea_tpu.datapath import OracleDatapath
+
+    ctl, store, agg, nodes = _world()
+    agent = AgentPolicyController(
+        nodes[0], OracleDatapath(), store=store,
+        status_reporter=agg.make_agent_reporter(),
+    )
+    ctl.upsert_antrea_policy(_policy())
+    agent.sync()
+    st = agg.status_of("p1")
+    assert st.current_nodes == 1  # this agent realized the current gen
+    assert st.phase == PHASE_REALIZING  # the other 5 span nodes lag
+
+
+def test_subprocess_agent_realization_report():
+    """The report crosses the process boundary: the subprocess agent's sync
+    response carries {uid: generation} and the parent relays it."""
+    from antrea_tpu.dissemination.transport import SubprocessAgent
+
+    ctl, store, agg, nodes = _world()
+    with SubprocessAgent(nodes[0], store) as sub:
+        ctl.upsert_antrea_policy(_policy())
+        sub.pump()
+        resp = sub.sync()
+        agg.update_node_statuses(nodes[0], resp["realized"])
+        st = agg.status_of("p1")
+        assert st.current_nodes == 1
+        assert resp["realized"] == {"p1": 1}
+
+
+def test_controller_info_surfaces_realization():
+    from antrea_tpu.observability.agentinfo import collect_controller_info
+
+    ctl, store, agg, nodes = _world()
+    fleet = FakeAgentFleet(store, nodes,
+                           status_reporter=agg.make_agent_reporter())
+    ctl.upsert_antrea_policy(_policy())
+    fleet.pump()
+    info = collect_controller_info(ctl, store=store, status=agg, now=1)
+    real = info["networkPolicyRealization"]
+    assert real["realized"] == real["total"] == 1
+    assert real["policies"][0]["phase"] == PHASE_REALIZED
