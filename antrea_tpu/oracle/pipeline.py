@@ -195,6 +195,22 @@ class PipelineOracle:
         the stored gen value mismatching."""
         if ps is not None:
             self.oracle = Oracle(ps)
+            # Attribution follows rule IDENTITY across the bundle (the
+            # device twin remaps cached indices by id,
+            # TpuflowDatapath._remap_cached_attribution): cached entries
+            # whose deciding rule no longer exists lose attribution.
+            from ..compiler.ir import rule_id
+
+            live = {
+                rule_id(p, i)
+                for p in self.oracle.ps.policies
+                for i in range(len(p.rules))
+            }
+            for e in self.flow.values():
+                if e.get("rule_in") is not None and e["rule_in"] not in live:
+                    e["rule_in"] = None
+                if e.get("rule_out") is not None and e["rule_out"] not in live:
+                    e["rule_out"] = None
         if services is not None:
             self._set_services(services)
 

@@ -70,7 +70,9 @@ def measure_cold(drs, match_meta, src, dst, proto, dport):
         # Carry-dependent perturbation so XLA cannot hoist the classify out
         # of the loop as loop-invariant.
         dp2 = dp_ ^ (acc[0] & 1)
-        cls = classify_batch(drs_, s_, d_, p_, dp2, meta=match_meta)
+        # fused=True: the pallas consumer path (ops/match cold-path study).
+        cls = classify_batch(drs_, s_, d_, p_, dp2, meta=match_meta,
+                             fused=True)
         acc = acc.at[:1].add(cls["code"].sum(dtype=jnp.int32))
         return (acc, drs_, s_, d_, p_, dp_)
 
@@ -95,7 +97,7 @@ def main():
     dport = jnp.asarray(tr.dst_port)
 
     step, state, (drs, dsvc) = pl.make_pipeline(
-        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=MISS_CHUNK
+        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=MISS_CHUNK, fused=True
     )
     # Warm: cold classify of the whole flow universe, then a cache-warm pass.
     state, out = step(state, drs, dsvc, src, dst, proto, sport, dport,
@@ -121,6 +123,19 @@ def main():
     sec_per_step = device_loop_time(body, carry, k_small=8, k_big=K, repeats=3)
     pps = B / sec_per_step
     cold_pps = measure_cold(drs, step.meta.match, src, dst, proto, dport)
+    _print_and_gate(pps, cold_pps)
+
+
+# Regression floors (round-3 verdict weak #6: a silent 10x perf regression
+# must fail loud).  Set ~30% under the recorded numbers (steady 17.9M, cold
+# 4.6-5.2M) to ride out the tunneled platform's run-to-run jitter (±15%)
+# while catching any real regression.  The JSON line prints BEFORE the
+# gate so the driver always records the measurement.
+STEADY_FLOOR_PPS = 12e6
+COLD_FLOOR_PPS = 3.2e6
+
+
+def _print_and_gate(pps, cold_pps):
     print(json.dumps({
         "metric": f"classified_pkts_per_sec_chip_{N_RULES // 1000}k_rules",
         "value": round(pps, 1),
@@ -135,6 +150,17 @@ def main():
             "n_services": N_SERVICES,
         },
     }))
+    # Explicit raises (not assert): the gate must survive python -O.
+    if pps < STEADY_FLOOR_PPS:
+        raise SystemExit(
+            f"steady throughput regressed: {pps/1e6:.2f}M < floor "
+            f"{STEADY_FLOOR_PPS/1e6:.0f}M pps"
+        )
+    if cold_pps < COLD_FLOOR_PPS:
+        raise SystemExit(
+            f"cold classification regressed: {cold_pps/1e6:.2f}M < floor "
+            f"{COLD_FLOOR_PPS/1e6:.0f}M pps"
+        )
 
 
 if __name__ == "__main__":

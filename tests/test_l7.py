@@ -111,3 +111,72 @@ def test_l7_validation_and_gate():
     # so no group refs or watch events exist for them.
     assert ctl.policy_set().applied_to_groups == {}
     assert gated.policy_set().applied_to_groups == {}
+
+
+def test_l7_attribution_survives_rebundle_both_datapaths():
+    """ADVICE round-3: cached attribution follows rule IDENTITY across a
+    renumbering bundle (TpuflowDatapath._remap_cached_attribution / the
+    oracle's identity filter): an established L7-allowed connection keeps
+    its l7_redirect mark and per-rule stats attribution after an unrelated
+    policy renumbers the rule table; removing the deciding rule drops
+    attribution to none on BOTH datapaths."""
+    from antrea_tpu.features import FeatureGates
+
+    gates = FeatureGates({"L7NetworkPolicy": True, "AntreaPolicy": True,
+                          "NetworkPolicyStats": True, "Traceflow": True})
+    ctl = _controller()
+    ctl.upsert_antrea_policy(_anp_l7())
+    ps1 = copy.deepcopy(ctl.policy_set())
+
+    # A second, earlier-tier policy inserted later renumbers everything.
+    ctl.upsert_antrea_policy(crd.AntreaNetworkPolicy(
+        uid="acnp-front", name="front", namespace="",
+        tier_priority=cp.TIER_SECURITYOPS, priority=1,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"app": "nothing"}),
+            ns_selector=crd.LabelSelector.make(),
+        )],
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP,
+                                peers=[crd.AntreaPeer(
+                                    ip_block=crd.IPBlock("192.0.2.0/24"))])],
+    ))
+    ps2 = copy.deepcopy(ctl.policy_set())
+
+    ctl.delete_policy("acnp-l7")
+    ps3 = copy.deepcopy(ctl.policy_set())
+
+    def probe(dp, now):
+        batch = PacketBatch(
+            src_ip=np.array([iputil.ip_to_u32(CLIENT)], np.uint32),
+            dst_ip=np.array([iputil.ip_to_u32(WEB)], np.uint32),
+            proto=np.array([6], np.int32),
+            src_port=np.array([41000], np.int32),
+            dst_port=np.array([80], np.int32),
+        )
+        return dp.step(batch, now)
+
+    for dp in (TpuflowDatapath(copy.deepcopy(ps1), [], flow_slots=1 << 10,
+                               aff_slots=1 << 6, miss_chunk=16,
+                               feature_gates=gates),
+               OracleDatapath(copy.deepcopy(ps1), [], flow_slots=1 << 10,
+                              aff_slots=1 << 6, feature_gates=gates)):
+        t = dp.datapath_type
+        r = probe(dp, now=1)
+        assert int(r.code[0]) == 0 and int(r.l7_redirect[0]) == 1, t
+        rule_id_before = r.ingress_rule[0]
+        assert rule_id_before is not None, t
+
+        # Renumbering bundle: established hit keeps identity + L7 mark.
+        dp.install_bundle(ps=copy.deepcopy(ps2))
+        r = probe(dp, now=2)
+        assert int(r.est[0]) == 1, t
+        assert r.ingress_rule[0] == rule_id_before, t
+        assert int(r.l7_redirect[0]) == 1, t
+
+        # Deciding rule removed: attribution drops to none, L7 mark off.
+        dp.install_bundle(ps=copy.deepcopy(ps3))
+        r = probe(dp, now=3)
+        assert int(r.est[0]) == 1, t  # connection itself survives
+        assert r.ingress_rule[0] is None, t
+        assert int(r.l7_redirect[0]) == 0, t
