@@ -58,7 +58,11 @@ _ACTION_CODE = {
     RuleAction.PASS: ACT_PASS,
 }
 
-FULL_SPACE = ((0, 1 << 32),)
+# The any/match-all range set spans the COMBINED dual-stack keyspace
+# (utils/ip.py: v4 at [0, 2^32), v6 offset above), so an any-peer matches
+# both families; consumers that are v4-scoped (the svc key space, the
+# introspection tables) clip it harmlessly.
+FULL_SPACE = ((0, iputil.KEYSPACE_END),)
 
 _PORT_PROTOS = (PROTO_TCP, PROTO_UDP, PROTO_SCTP)
 
@@ -142,6 +146,12 @@ def build_group_tables(groups: list) -> tuple[np.ndarray, np.ndarray]:
     pts: set[int] = set()
     for ranges in groups:
         for lo, hi in ranges:
+            # Introspection stays v4-scoped (the kernel's dual-stack tables
+            # are built in ops/match._dim_table_host); v6 boundary points
+            # (combined keyspace >= 2^32, utils/ip.py) are out of range for
+            # this u64 debug table.
+            if lo >= (1 << 32):
+                continue
             pts.add(lo)
             if hi < (1 << 32):
                 pts.add(hi)
@@ -152,6 +162,9 @@ def build_group_tables(groups: list) -> tuple[np.ndarray, np.ndarray]:
     for gid, ranges in enumerate(groups):
         w, b = gid >> 5, np.uint32(1 << (gid & 31))
         for lo, hi in ranges:
+            lo, hi = int(lo), min(int(hi), 1 << 32)  # v4 clip (see above)
+            if lo >= hi:
+                continue
             start = int(np.searchsorted(bounds, lo, side="right"))
             end = int(np.searchsorted(bounds, hi - 1, side="right"))
             bitmap[start : end + 1, w] |= b

@@ -52,19 +52,20 @@ class PolicySet:
         return False
 
     def applied_to_contains(
-        self, policy: NetworkPolicy, rule: NetworkPolicyRule, ip_u32: int
+        self, policy: NetworkPolicy, rule: NetworkPolicyRule, ip_key: int
     ) -> bool:
+        # ip_key is a combined-keyspace address (utils/ip.py — dual-stack).
         groups = rule.applied_to_groups or policy.applied_to_groups
         for gname in groups:
             g = self.applied_to_groups.get(gname)
             if g is None:
                 continue
             for m in g.members:
-                if iputil.ip_to_u32(m.ip) == ip_u32:
+                if iputil.ip_to_key(m.ip) == ip_key:
                     return True
         return False
 
-    def k8s_isolated(self, ip_u32: int, direction: Direction) -> bool:
+    def k8s_isolated(self, ip_key: int, direction: Direction) -> bool:
         """Is the pod at ip isolated (selected by >=1 K8s NP) in direction?"""
         for p in self.policies:
             if not p.is_k8s or direction not in p.policy_types:
@@ -74,7 +75,7 @@ class PolicySet:
                 if g is None:
                     continue
                 for m in g.members:
-                    if iputil.ip_to_u32(m.ip) == ip_u32:
+                    if iputil.ip_to_key(m.ip) == ip_key:
                         return True
         return False
 

@@ -181,8 +181,12 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # interned columns a raw-group delta cannot patch — and whose
         # membership can change even when the raw group's merged ranges do
         # not.  With named ports in play every delta is a full resync (the
-        # OracleDatapath twin applies the same rule).
-        need_recompile = self._has_named_ports
+        # OracleDatapath twin applies the same rule).  v6 members likewise:
+        # DeltaTable rows are v4 i32 ranges (classify_batch lane_ok), so a
+        # v6 membership change folds into a recompile instead.
+        need_recompile = self._has_named_ports or any(
+            iputil.is_v6(ip) for ip in (*added_ips, *removed_ips)
+        )
 
         for ip in added_ips:
             r = iputil.cidr_to_range(ip)

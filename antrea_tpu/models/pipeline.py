@@ -202,6 +202,11 @@ class PipelineMeta(NamedTuple):
     # (ops/match.classify_batch fused=True; single-chip TPU path — ignored
     # when a hit_combine seam is active).
     fused: bool = False
+    # Flow-cache key row width: 4 (v4-only: [src, dst, pp, pg]) or 10
+    # (dual-stack: [s0..s3, d0..d3, pp, pg] — addresses in wide v4-mapped
+    # word form, the xxreg3 analog).  Static, so pure-v4 worlds compile the
+    # narrow fast path unchanged.
+    key_words: int = 4
 
     @property
     def timeouts(self) -> tuple[int, int, int, int]:
@@ -237,13 +242,14 @@ def svc_to_device(st: ServiceTables) -> DeviceServiceTables:
 
 
 def init_state(
-    flow_slots: int = 1 << 20, aff_slots: int = 1 << 18, xp=jnp
+    flow_slots: int = 1 << 20, aff_slots: int = 1 << 18, xp=jnp,
+    key_words: int = 4,
 ) -> PipelineState:
     def zeros(n):
         return xp.zeros(n + 1, dtype=xp.int32)
 
     flow = FlowCache(
-        keys=xp.zeros((flow_slots + 1, 4), dtype=xp.int32),
+        keys=xp.zeros((flow_slots + 1, key_words), dtype=xp.int32),
         meta=xp.zeros((flow_slots + 1, 4), dtype=xp.int32),
         ts=zeros(flow_slots),
     )
@@ -254,6 +260,28 @@ def init_state(
 def _raw_bits(x_f: jax.Array) -> jax.Array:
     """Sign-flipped i32 -> i32 whose u32 reinterpretation is the raw value."""
     return x_f ^ jnp.int32(-(2**31))
+
+
+# RFC 4291 v4-mapped word constants in FLIPPED lane space: flip(0) and
+# flip(0xffff).  Single source of truth with utils/ip.key_to_flipped_words
+# (the oracle-side projection) — parity-critical.
+_MAP0 = -(2**31)
+_MAPF = -(2**31) + 0xFFFF
+
+
+def _wide_words(col_f: jax.Array, w6, is6) -> jax.Array:
+    """(B,) flipped v4 column + optional (B,4) flipped v6 words + family
+    mask -> (B, 4) wide address words (v4 lanes in v4-mapped form).  The
+    ONE device-side implementation of the wide projection; every wide-key
+    construction (fast path, reverse commit, partner probe, trace) must go
+    through here."""
+    m = jnp.stack([
+        jnp.full_like(col_f, _MAP0), jnp.full_like(col_f, _MAP0),
+        jnp.full_like(col_f, _MAPF), col_f,
+    ], axis=1)
+    if w6 is None:
+        return m
+    return jnp.where((is6 != 0)[:, None], w6, m)
 
 
 def _winner_mask(n_slots, slots, mask, dump):
@@ -329,6 +357,7 @@ def make_pipeline(
     ct_other_new_s: Optional[int] = None,
     ct_other_est_s: Optional[int] = None,
     fused: bool = False,
+    dual_stack: bool = False,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -360,12 +389,16 @@ def make_pipeline(
         ct_other_new_s=ct_other_new_s,
         ct_other_est_s=ct_other_est_s,
         fused=fused,
+        key_words=10 if dual_stack else 4,
     )
-    state = init_state(flow_slots, aff_slots, xp=np if host else jnp)
+    state = init_state(flow_slots, aff_slots, xp=np if host else jnp,
+                       key_words=meta.key_words)
 
-    def step(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen):
+    def step(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
+             v6=None):
         return pipeline_step(
-            state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen, meta=meta
+            state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
+            meta=meta, v6=v6,
         )
 
     step.meta = meta  # expose for callers embedding the step in larger jits
@@ -382,6 +415,7 @@ def _service_lb(
     dport: jax.Array,
     now: jax.Array,
     aff_slots: int,
+    lane_ok=None,
 ):
     """ServiceLB + affinity + endpoint choice for a (miss) sub-batch.
 
@@ -404,7 +438,12 @@ def _service_lb(
     slot_eq = dsvc.ppk[row] == key[:, None]  # (M, MAXP)
     slot_found = slot_eq.any(axis=1)
     slot_col = jnp.argmax(slot_eq, axis=1)
-    svc_idx = jnp.where(ip_is_svc & slot_found, dsvc.slot_svc[row, slot_col], MISS)
+    hit_lane = ip_is_svc & slot_found
+    if lane_ok is not None:
+        # Dual-stack: v6 lanes carry a don't-care v4 dst column; service
+        # frontends are v4-only for now (documented gap) — never match.
+        hit_lane = hit_lane & lane_ok
+    svc_idx = jnp.where(hit_lane, dsvc.slot_svc[row, slot_col], MISS)
     is_svc = svc_idx >= 0
     svc_safe = jnp.clip(svc_idx, 0, dsvc.n_ep.shape[0] - 1)
     no_ep = is_svc & (dsvc.has_ep[svc_safe] == 0)
@@ -465,9 +504,13 @@ def entry_timeout(conf, proto, timeouts, xp=jnp):
     )
 
 
-def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta):
+def _cache_lookup(flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta):
     """Shared fast-path flow-cache probe for step and trace (single source of
     truth for the FlowCache row layout).
+
+    addr is the packet's (B, A) address-column matrix — A=2 ([src_f,
+    dst_f]) in v4-only worlds, A=8 (wide word form) in dual-stack worlds;
+    key rows are [addr..., pp, pg].
 
     -> (hit, est, rpl, meta_row (B,4)) where meta_row is the gathered meta
     rows.  rpl flags reply-direction (reverse-tuple) hits: their meta row
@@ -478,13 +521,13 @@ def _cache_lookup(flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta
     entries can carry shorter lifetimes than confirmed connections.  With
     uniform timeouts (the default) the per-lane selection compiles out.
     """
-    kr = flow.keys[slot]  # (B, 4) row gather
-    kpg = kr[:, 3]
+    A = addr.shape[1]
+    kr = flow.keys[slot]  # (B, A+2) row gather
+    kpg = kr[:, A + 1]
     pg_rpl = pg_est | REPLY_BIT
     key_hit = (
-        (kr[:, 0] == src_f)
-        & (kr[:, 1] == dst_f)
-        & (kr[:, 2] == pp)
+        (kr[:, :A] == addr).all(axis=1)
+        & (kr[:, A] == pp)
         & ((kpg == pg_cur) | (kpg == pg_est) | (kpg == pg_rpl))
     )
     mr = flow.meta[slot]
@@ -517,12 +560,14 @@ def _pipeline_step(
     valid=None,
     no_commit=None,
     flags=None,
+    v6=None,
 ):
     flow, aff = state.flow, state.aff
     B = src_f.shape[0]
     N = meta.flow_slots
     M = meta.miss_chunk
     dump = N
+    A = meta.key_words - 2  # address columns: 2 (v4) / 8 (dual-stack wide)
 
     src_raw = _raw_bits(src_f)
     dst_raw = _raw_bits(dst_f)
@@ -530,12 +575,34 @@ def _pipeline_step(
     gen_w = jnp.asarray(gen, jnp.int32) % GEN_ETERNAL  # never == GEN_ETERNAL
 
     # ---- fast path: flow-cache lookup (2 row gathers + 1 column gather) ----
-    h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
+    if A == 2:
+        if v6 is not None:
+            raise ValueError(
+                "v6 lanes require a dual_stack pipeline "
+                "(make_pipeline(dual_stack=True))"
+            )
+        saddr = daddr = is6 = None  # wide-mode-only locals
+        addr = jnp.stack([src_f, dst_f], axis=1)
+        h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
+    else:
+        # Wide (dual-stack) addressing: every lane is a 4-word v4-mapped /
+        # v6 quadruple (sign-flipped per word, utils/ip.key_to_words).
+        if v6 is not None:
+            src6w, dst6w, is6 = v6
+        else:
+            is6 = jnp.zeros_like(src_f)
+            src6w = dst6w = None
+        saddr = _wide_words(src_f, src6w, is6)
+        daddr = _wide_words(dst_f, dst6w, is6)
+        addr = jnp.concatenate([saddr, daddr], axis=1)
+        h = hashing.flow_hash_wide(
+            [addr[:, i] for i in range(8)], proto, sport, dport, xp=jnp
+        )
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
     hit, est, rpl, mr = _cache_lookup(
-        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta
+        flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta
     )
     if valid is not None:
         # Lane mask (SpoofGuard gating, models/forwarding.py): excluded
@@ -580,23 +647,46 @@ def _pipeline_step(
         """Derive each lane's PARTNER tuple (the other conntrack direction
         of its hit entry, un/re-DNAT applied) and key-verify it against
         `keys` — shared by the deferred partner refresh and the FIN/RST
-        teardown so the two can never drift.  -> (p_slot, live_mask)."""
-        p_src = jnp.where(rpl, dst_f, c_dnat_ip)
-        p_dst = jnp.where(rpl, c_dnat_ip, src_f)
+        teardown so the two can never drift.  -> (p_slot, live_mask).
+
+        Dual-stack: v6 connections carry no NAT (service frontends are
+        v4-only), so their partner tuple is the literal address swap; v4
+        partners re/un-apply the cached DNAT resolution."""
         p_sport = jnp.where(rpl, dport, c_dport)
         p_dport = jnp.where(rpl, c_dport, sport)
         p_pg = jnp.where(rpl, pg_est, pg_est | REPLY_BIT)
-        p_h = hashing.flow_hash(
-            _raw_bits(p_src), _raw_bits(p_dst), proto, p_sport, p_dport, xp=jnp
-        )
+        if A == 2:
+            p_src = jnp.where(rpl, dst_f, c_dnat_ip)
+            p_dst = jnp.where(rpl, c_dnat_ip, src_f)
+            p_addr = jnp.stack([p_src, p_dst], axis=1)
+            p_h = hashing.flow_hash(
+                _raw_bits(p_src), _raw_bits(p_dst), proto, p_sport, p_dport,
+                xp=jnp,
+            )
+        else:
+            dn_w = _wide_words(c_dnat_ip, daddr, is6)
+            # the v6 side of the select is daddr — for v6, dnat == dst, so
+            # that is exactly the no-NAT identity; v4 lanes map the cached
+            # DNAT resolution.
+            p_srcw = jnp.where((rpl != 0)[:, None], daddr,
+                               dn_w)
+            p_dstw = jnp.where((rpl != 0)[:, None], dn_w, saddr)
+            # rpl v6: partner dst = this packet's src (literal swap); rpl
+            # v4: the cached frontend.  dn_w already encodes both.
+            p_dstw = jnp.where(((rpl != 0) & (is6 != 0))[:, None],
+                               saddr, p_dstw)
+            p_addr = jnp.concatenate([p_srcw, p_dstw], axis=1)
+            p_h = hashing.flow_hash_wide(
+                [p_addr[:, i] for i in range(8)], proto, p_sport, p_dport,
+                xp=jnp,
+            )
         p_slot = (p_h & jnp.uint32(N - 1)).astype(jnp.int32)
         pkr = keys[p_slot]
         live = (
             mask
-            & (pkr[:, 0] == p_src)
-            & (pkr[:, 1] == p_dst)
-            & (pkr[:, 2] == ((p_sport << 16) | p_dport))
-            & (pkr[:, 3] == p_pg)
+            & (pkr[:, :A] == p_addr).all(axis=1)
+            & (pkr[:, A] == ((p_sport << 16) | p_dport))
+            & (pkr[:, A + 1] == p_pg)
         )
         return p_slot, live
 
@@ -707,15 +797,28 @@ def _pipeline_step(
             h_m = h[safe]
             slot_m = slot[safe]
             pp_m = pp[safe]
+            if A == 8:
+                saddr_m = saddr[safe]
+                daddr_m = daddr[safe]
+                is6_m = is6[safe]
+                v6_m = (saddr_m, daddr_m, is6_m)
+            else:
+                is6_m = None
+                v6_m = None
 
             svc_idx, no_ep, dnat_ip, dnat_port, snat_m, dsr_m, learn = _service_lb(
-                aff_snap, dsvc, h_m, s_f, d_f, p_m, dp_m, now, meta.aff_slots
+                aff_snap, dsvc, h_m, s_f, d_f, p_m, dp_m, now, meta.aff_slots,
+                lane_ok=None if is6_m is None else (is6_m == 0),
             )
 
+            # v6 lanes classify on their own (un-NATed) tuple; their wide
+            # words double as the classifier's v6 lanes (same flipped-word
+            # layout the interval tables expect).
             cls = classify_batch(
                 drs, s_f, dnat_ip, p_m, dnat_port,
                 meta=meta.match, hit_combine=hit_combine,
                 fused=meta.fused and hit_combine is None,
+                v6=v6_m,
             )
             code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
             # SvcReject happens in EndpointDNAT, BEFORE the policy tables
@@ -759,7 +862,13 @@ def _pipeline_step(
             zcol = (pref_col
                     | jnp.where(snat_m > 0, REPLY_BIT, 0)
                     | jnp.where(dsr_m > 0, DSR_BIT, 0))
-            key_rows = jnp.stack([s_f, d_f, pp_m, pg_ins], axis=1)
+            if A == 2:
+                addr_m = jnp.stack([s_f, d_f], axis=1)
+            else:
+                addr_m = jnp.concatenate([saddr_m, daddr_m], axis=1)
+            key_rows = jnp.concatenate(
+                [addr_m, pp_m[:, None], pg_ins[:, None]], axis=1
+            )
             meta_rows = jnp.stack([dnat_ip, m1, rules_p, zcol], axis=1)
 
             # Conntrack commits BOTH directions (ref ConntrackCommit +
@@ -773,13 +882,27 @@ def _pipeline_step(
             # client directly and the reply never re-traverses this node
             # (ref pipeline.go:698-708 DSR flows bypass the reply path).
             rev_ins = ins & committed_m & (dsr_m == 0)
-            rev_h = hashing.flow_hash(
-                _raw_bits(dnat_ip), _raw_bits(s_f), p_m, dnat_port, sp_m, xp=jnp
-            )
+            if A == 2:
+                rev_h = hashing.flow_hash(
+                    _raw_bits(dnat_ip), _raw_bits(s_f), p_m, dnat_port, sp_m,
+                    xp=jnp,
+                )
+                rev_addr = jnp.stack([dnat_ip, s_f], axis=1)
+            else:
+                # Reverse tuple in wide form: v4 endpoints take the mapped
+                # word quadruple of the DNAT resolution; v6 connections are
+                # NAT-free, so the reverse is the literal word swap.
+                rev_srcw = _wide_words(dnat_ip, daddr_m, is6_m)
+                rev_addr = jnp.concatenate([rev_srcw, saddr_m], axis=1)
+                rev_h = hashing.flow_hash_wide(
+                    [rev_addr[:, i] for i in range(8)], p_m, dnat_port, sp_m,
+                    xp=jnp,
+                )
             rev_slot = (rev_h & jnp.uint32(N - 1)).astype(jnp.int32)
             rev_pg = p_m | 0x100 | (GEN_ETERNAL << 9) | REPLY_BIT
-            rev_keys = jnp.stack(
-                [dnat_ip, s_f, (dnat_port << 16) | sp_m, rev_pg], axis=1
+            rev_keys = jnp.concatenate(
+                [rev_addr, ((dnat_port << 16) | sp_m)[:, None],
+                 rev_pg[:, None]], axis=1
             )
             rev_meta = jnp.stack(
                 [d_f, _pack_meta1(code, svc_idx, dp_m), rules_p, pref_col],
@@ -790,7 +913,8 @@ def _pipeline_step(
             # collisions resolve in the same order as the oracle's
             # per-packet insert sequence (parity on eviction races).
             slot2 = jnp.stack([slot_m, rev_slot], axis=1).reshape(2 * M)
-            keys2 = jnp.stack([key_rows, rev_keys], axis=1).reshape(2 * M, 4)
+            keys2 = jnp.stack([key_rows, rev_keys], axis=1).reshape(
+                2 * M, A + 2)
             meta2 = jnp.stack([meta_rows, rev_meta], axis=1).reshape(2 * M, 4)
             ins2 = jnp.stack([ins, rev_ins], axis=1).reshape(2 * M)
 
@@ -801,13 +925,11 @@ def _pipeline_step(
             okr = flow.keys[jnp.where(ins2, slot2, dump)]
             id3 = 0xFF | REPLY_BIT
             tuple_differs = (
-                (okr[:, 0] != keys2[:, 0])
-                | (okr[:, 1] != keys2[:, 1])
-                | (okr[:, 2] != keys2[:, 2])
-                | ((okr[:, 3] & id3) != (keys2[:, 3] & id3))
+                (okr[:, : A + 1] != keys2[:, : A + 1]).any(axis=1)
+                | ((okr[:, A + 1] & id3) != (keys2[:, A + 1] & id3))
             )
             n_evict = n_evict + (
-                ins2 & (okr[:, 3] != 0) & tuple_differs
+                ins2 & (okr[:, A + 1] != 0) & tuple_differs
             ).sum(dtype=jnp.int32)
 
             flow = FlowCache(
@@ -897,7 +1019,7 @@ pipeline_step = jax.jit(_pipeline_step, static_argnames=("meta", "hit_combine"))
 def _cache_stats(state: PipelineState):
     """On-demand flow-cache census (full scan — not for the per-step path):
     occupancy, committed (eternal-gen, incl. reply) and denial entries."""
-    kpg = state.flow.keys[:-1, 3]  # exclude the write-dump row
+    kpg = state.flow.keys[:-1, -1]  # pg is the LAST key column (any width)
     valid = kpg != 0
     gen = (kpg >> 9) & GEN_ETERNAL
     est = valid & (gen == GEN_ETERNAL)
@@ -926,6 +1048,7 @@ def _pipeline_trace(
     *,
     meta: PipelineMeta,
     hit_combine=None,
+    v6=None,
 ):
     """Read-only per-packet stage trace (the Traceflow analog,
     ref framework.go:328-338): every packet is walked through ServiceLB and
@@ -935,26 +1058,48 @@ def _pipeline_trace(
     """
     flow, aff = state.flow, state.aff
     N = meta.flow_slots
+    A = meta.key_words - 2
     src_raw = _raw_bits(src_f)
     dst_raw = _raw_bits(dst_f)
     pp = (sport << 16) | dport
     gen_w = jnp.asarray(gen, jnp.int32) % GEN_ETERNAL
 
-    h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
+    if A == 2:
+        if v6 is not None:
+            raise ValueError(
+                "v6 lanes require a dual_stack pipeline "
+                "(make_pipeline(dual_stack=True))"
+            )
+        is6 = None
+        addr = jnp.stack([src_f, dst_f], axis=1)
+        h = hashing.flow_hash(src_raw, dst_raw, proto, sport, dport, xp=jnp)
+    else:
+        if v6 is not None:
+            src6w, dst6w, is6 = v6
+        else:
+            is6 = jnp.zeros_like(src_f)
+            src6w = dst6w = None
+        addr = jnp.concatenate([
+            _wide_words(src_f, src6w, is6), _wide_words(dst_f, dst6w, is6),
+        ], axis=1)
+        h = hashing.flow_hash_wide(
+            [addr[:, i] for i in range(8)], proto, sport, dport, xp=jnp
+        )
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
     hit, est, rpl, mr = _cache_lookup(
-        flow, slot, src_f, dst_f, pp, pg_cur, pg_est, now, proto, meta
+        flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta
     )
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
 
     svc_idx, no_ep, dnat_ip, dnat_port, snat, dsr, _learn = _service_lb(
-        aff, dsvc, h, src_f, dst_f, proto, dport, now, meta.aff_slots
+        aff, dsvc, h, src_f, dst_f, proto, dport, now, meta.aff_slots,
+        lane_ok=None if is6 is None else (is6 == 0),
     )
     cls = classify_batch(
         drs, src_f, dnat_ip, proto, dnat_port,
-        meta=meta.match, hit_combine=hit_combine,
+        meta=meta.match, hit_combine=hit_combine, v6=v6,
     )
     fresh_code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
     code = jnp.where(hit, c_code, fresh_code)

@@ -41,3 +41,17 @@ def flow_hash(src, dst, proto, sport, dport, salt=0x5CA1AB1E, xp=np):
          xp.asarray(dport).astype(xp.uint32) ^ xp.uint32(salt)],
         xp=xp,
     )
+
+
+def flow_hash_wide(addr_cols, proto, sport, dport, salt=0x5CA1AB1E, xp=np):
+    """Dual-stack 5-tuple hash: 8 address words (both endpoints in wide,
+    v4-mapped word form — see utils/ip.key_to_words) + ports/proto.
+    addr_cols is a sequence of 8 (B,)-shaped word arrays (sign-flipped i32
+    is fine: the u32 view is hashed, identically on both twins)."""
+    return fnv_mix(
+        [*addr_cols,
+         (xp.asarray(proto).astype(xp.uint32) << xp.uint32(16))
+         ^ xp.asarray(sport).astype(xp.uint32),
+         xp.asarray(dport).astype(xp.uint32) ^ xp.uint32(salt)],
+        xp=xp,
+    )

@@ -65,10 +65,21 @@ _ALL1 = 0xFFFFFFFF
 
 
 class DimTable(NamedTuple):
-    """One match dimension: interval bounds + rule-incidence rows."""
+    """One match dimension: interval bounds + rule-incidence rows.
 
-    bounds: jax.Array  # (NB,) i32 ascending (sign-flipped for IP dims)
-    inc: jax.Array  # (NB+1, W) u32 — rule bitmap per elementary interval
+    Dual-stack (ref pipeline.go IPv6 table, fields.go:184-185 xxreg3): the
+    incidence rows concatenate the v4 interval space (rows 0..NB4) and the
+    v6 interval space (rows NB4+1..NB4+1+NB6) — v6 boundaries live in a
+    separate 4-word lexicographic table, and once a packet resolves to an
+    interval INDEX everything downstream is family-blind.  bounds6 always
+    exists (possibly 0 rows; the v6 space then has the single whole-space
+    interval, still painted by family-spanning groups like any-peer)."""
+
+    bounds: jax.Array  # (NB4,) i32 ascending (sign-flipped for IP dims)
+    # (NB6, 4) i32 — v6 boundaries as per-word sign-flipped u32 quadruples,
+    # ascending lexicographically.  Empty (0, 4) for the svc dimension.
+    bounds6: jax.Array
+    inc: jax.Array  # (NB4+1+NB6+1, W) u32 — rule bitmap per interval
 
 
 class DeviceDirection(NamedTuple):
@@ -86,10 +97,12 @@ class DeviceDirection(NamedTuple):
 
 
 class IsoTable(NamedTuple):
-    """K8s default-deny isolation membership (one bit per packet)."""
+    """K8s default-deny isolation membership (one bit per packet);
+    dual-stack like DimTable (val rows = K4+1+K6+1)."""
 
-    bounds: jax.Array  # (K,) i32 sign-flipped
-    val: jax.Array  # (K+1,) i32 0/1
+    bounds: jax.Array  # (K4,) i32 sign-flipped
+    bounds6: jax.Array  # (K6, 4) i32 per-word sign-flipped
+    val: jax.Array  # (K4+1+K6+1,) i32 0/1
 
 
 class DeltaTable(NamedTuple):
@@ -179,38 +192,88 @@ def _inc_mask(rule_idx: np.ndarray, w: int) -> np.ndarray:
     return inc
 
 
-def _span(bounds_u: np.ndarray, lo: int, hi: int) -> tuple[int, int]:
-    """[lo, hi) range -> inclusive interval-row span [a, b].
+_V6_OFF = iputil.V6_OFF
+_V6_END = 1 << 128  # exclusive end of the v6-relative space
 
-    Mirrors the interval convention of compiler/compile._GroupSpace
-    build_group_tables: row i covers (bounds[i-1], bounds[i]] in searchsorted-
-     'right' index space.
-    """
-    a = int(np.searchsorted(bounds_u, lo, side="right"))
-    b = int(np.searchsorted(bounds_u, hi - 1, side="right"))
+
+def _span_list(bounds: list, lo: int, hi: int) -> tuple[int, int]:
+    """[lo, hi) range -> inclusive interval-row span [a, b] over a SORTED
+    python-int bounds list (bisect 'right' index space, row i covering
+    (bounds[i-1], bounds[i]])."""
+    import bisect
+
+    a = bisect.bisect_right(bounds, lo)
+    b = bisect.bisect_right(bounds, hi - 1)
     return a, b
 
 
-def _dim_bounds(by: dict[int, np.ndarray], groups: list) -> np.ndarray:
-    pts: set[int] = set()
-    for g in by:
-        for lo, hi in groups[g]:
-            pts.add(int(lo))
-            if hi < (1 << 32):
-                pts.add(int(hi))
-    return np.array(sorted(pts), dtype=np.uint64)
+def _family_split(lo: int, hi: int):
+    """Combined-keyspace [lo, hi) -> (v4 part or None, v6-relative part or
+    None); family-spanning ranges (any-peer) contribute to both."""
+    v4 = v6 = None
+    if lo < (1 << 32):
+        v4 = (lo, min(hi, 1 << 32))
+    if hi > _V6_OFF:
+        v6 = (max(lo, _V6_OFF) - _V6_OFF, hi - _V6_OFF)
+    return v4, v6
+
+
+def _dual_bounds(range_lists) -> tuple[list, list]:
+    """Boundary points of both families from combined ranges."""
+    p4: set[int] = set()
+    p6: set[int] = set()
+    for ranges in range_lists:
+        for lo, hi in ranges:
+            r4, r6 = _family_split(int(lo), int(hi))
+            if r4 is not None:
+                p4.add(r4[0])
+                if r4[1] < (1 << 32):
+                    p4.add(r4[1])
+            if r6 is not None:
+                p6.add(r6[0])
+                if r6[1] < _V6_END:
+                    p6.add(r6[1])
+    return sorted(p4), sorted(p6)
+
+
+def _v6_words(vals: list) -> np.ndarray:
+    """Sorted v6-relative ints -> (N, 4) sign-flipped i32 word quadruples
+    (lexicographic order preserved word-wise)."""
+    out = np.zeros((len(vals), 4), dtype=np.uint32)
+    for i, v in enumerate(vals):
+        out[i] = [(v >> 96) & 0xFFFFFFFF, (v >> 64) & 0xFFFFFFFF,
+                  (v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF]
+    return iputil.flip_u32(out)
+
+
+def _paint(b4: list, b6: list, lo: int, hi: int, write) -> None:
+    """Paint combined range [lo, hi) into the dual interval row space via
+    the write(row_a, row_b) callback: v4 rows [0..len(b4)], v6 rows
+    [len(b4)+1 ..]."""
+    r4, r6 = _family_split(int(lo), int(hi))
+    if r4 is not None and r4[0] < r4[1]:
+        a, b = _span_list(b4, *r4)
+        write(a, b)
+    if r6 is not None and r6[0] < r6[1]:
+        a, b = _span_list(b6, *r6)
+        off = len(b4) + 1
+        write(off + a, off + b)
 
 
 def _dim_table_host(gids: np.ndarray, groups: list, w: int, ip_dim: bool) -> DimTable:
-    """Build one dimension's (bounds, incidence) pair.
+    """Build one dimension's (bounds, bounds6, incidence) triple.
 
     Only the groups this dimension actually uses contribute boundary points,
     so each dimension's interval table stays as small as its own address
     structure (the appliedTo dimension is typically far coarser than peer).
     """
     by = _rules_by_gid(gids)
-    bounds_u = _dim_bounds(by, groups)
-    inc = np.zeros((len(bounds_u) + 1, w), dtype=np.uint32)
+    b4, b6 = _dual_bounds(groups[g] for g in by)
+    if not ip_dim:
+        # svc keys live entirely below 2^32; no v6 sub-space.
+        b6 = []
+    n_rows = len(b4) + 1 + (len(b6) + 1 if ip_dim else 0)
+    inc = np.zeros((n_rows, w), dtype=np.uint32)
     for g, rr in by.items():
         ranges = groups[g]
         if not ranges or rr.size == 0:
@@ -218,29 +281,40 @@ def _dim_table_host(gids: np.ndarray, groups: list, w: int, ip_dim: bool) -> Dim
         gmask = _inc_mask(rr, w)
         nzw = np.nonzero(gmask)[0]
         vals = gmask[nzw]
+
+        def write(a, b):
+            inc[a : b + 1][:, nzw] |= vals
+
         for lo, hi in ranges:
-            a, b = _span(bounds_u, lo, hi)
-            inc[a : b + 1, nzw] |= vals
+            if ip_dim:
+                _paint(b4, b6, lo, hi, write)
+            else:
+                a, b = _span_list(b4, int(lo), int(hi))
+                write(a, b)
     if ip_dim:
-        bounds = iputil.flip_u32(bounds_u.astype(np.uint32))
+        bounds = iputil.flip_u32(np.array(b4, dtype=np.uint64).astype(np.uint32))
+        bounds6 = _v6_words(b6)
     else:
-        bounds = bounds_u.astype(np.int32)
-    return DimTable(bounds=bounds, inc=inc)
+        bounds = np.array(b4, dtype=np.int64).astype(np.int32)
+        bounds6 = np.zeros((0, 4), dtype=np.int32)
+    return DimTable(bounds=bounds, bounds6=bounds6, inc=inc)
 
 
 def _iso_host(gid: int, groups: list) -> IsoTable:
     ranges = groups[gid]
-    pts: set[int] = set()
-    for lo, hi in ranges:
-        pts.add(int(lo))
-        if hi < (1 << 32):
-            pts.add(int(hi))
-    bounds_u = np.array(sorted(pts), dtype=np.uint64)
-    val = np.zeros(len(bounds_u) + 1, dtype=np.int32)
-    for lo, hi in ranges:
-        a, b = _span(bounds_u, lo, hi)
+    b4, b6 = _dual_bounds([ranges])
+    val = np.zeros(len(b4) + 1 + len(b6) + 1, dtype=np.int32)
+
+    def write(a, b):
         val[a : b + 1] = 1
-    return IsoTable(bounds=iputil.flip_u32(bounds_u.astype(np.uint32)), val=val)
+
+    for lo, hi in ranges:
+        _paint(b4, b6, lo, hi, write)
+    return IsoTable(
+        bounds=iputil.flip_u32(np.array(b4, dtype=np.uint64).astype(np.uint32)),
+        bounds6=_v6_words(b6),
+        val=val,
+    )
 
 
 def _direction_host(
@@ -314,11 +388,15 @@ def to_device(
 # ---------------------------------------------------------------------------
 
 
-def _patch_rows(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks) -> jax.Array:
-    """Apply the active delta slots to gathered incidence rows (B, W)."""
+def _patch_rows(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks,
+                lane_ok=None) -> jax.Array:
+    """Apply the active delta slots to gathered incidence rows (B, W).
+    lane_ok masks lanes the (v4-only) delta ranges may touch at all."""
 
     def body(i, rows):
         m = (ip_f >= dt.lo_f[i]) & (ip_f <= dt.hi_f[i])
+        if lane_ok is not None:
+            m = m & lane_ok
         mask = masks[i][None, :]
         s = dt.sign[i]
         rows = jnp.where((m & (s > 0))[:, None], rows | mask, rows)
@@ -328,13 +406,16 @@ def _patch_rows(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks) -> jax.
     return jax.lax.fori_loop(0, dt.n, body, rows)
 
 
-def _patch_iso(bit: jax.Array, ip_f: jax.Array, dt: DeltaTable, which: int) -> jax.Array:
+def _patch_iso(bit: jax.Array, ip_f: jax.Array, dt: DeltaTable, which: int,
+               lane_ok=None) -> jax.Array:
     def body(i, bit):
         m = (
             (ip_f >= dt.lo_f[i])
             & (ip_f <= dt.hi_f[i])
             & (((dt.iso[i] >> which) & 1) == 1)
         )
+        if lane_ok is not None:
+            m = m & lane_ok
         s = dt.sign[i]
         bit = jnp.where(m & (s > 0), 1, bit)
         bit = jnp.where(m & (s < 0), 0, bit)
@@ -503,6 +584,26 @@ def _searchsorted_right(bounds: jax.Array, x: jax.Array) -> jax.Array:
     return blk_c * K + inblock
 
 
+def _searchsorted6(bounds6: jax.Array, xw: jax.Array) -> jax.Array:
+    """Lexicographic searchsorted(side='right') over 4-word v6 boundaries.
+
+    bounds6 (N, 4) and xw (B, 4) are per-word sign-flipped i32, so word-wise
+    signed compares give unsigned lexicographic order.  v6 boundary tables
+    are small (group CIDR endpoints), so all-pairs compare-count is the
+    right TPU shape (see _searchsorted_right's rationale).
+    """
+    n = bounds6.shape[0]
+    if n == 0:
+        return jnp.zeros(xw.shape[0], dtype=jnp.int32)
+    b = bounds6[None, :, :]  # (1, N, 4)
+    k = xw[:, None, :]  # (B, 1, 4)
+    lt = b < k
+    eq = b == k
+    leq = lt[..., 0] | (eq[..., 0] & (lt[..., 1] | (eq[..., 1] & (
+        lt[..., 2] | (eq[..., 2] & (lt[..., 3] | eq[..., 3]))))))
+    return leq.sum(axis=1, dtype=jnp.int32)
+
+
 def classify_batch(
     drs: DeviceRuleSet,
     src_ip_f: jax.Array,  # (B,) sign-flipped i32
@@ -513,6 +614,7 @@ def classify_batch(
     meta: StaticMeta,
     hit_combine=None,
     fused: bool = False,
+    v6=None,
 ):
     """-> dict with final/egress/ingress codes and deciding rule indices.
 
@@ -525,6 +627,13 @@ def classify_batch(
     match is an all-reduce over ICI (the TPU analog of OVS evaluating one
     shared table).
 
+    v6, if given, is the dual-stack lane extension (ref pipeline.go IPv6
+    table): a (src6w_f (B,4), dst6w_f (B,4), is6 (B,)) tuple of per-word
+    sign-flipped v6 addresses plus the family mask.  v6 lanes resolve in
+    each dimension's v6 interval sub-space; their v4-lane inputs are
+    ignored.  None = pure-v4 batch (zero extra work — the v4 interval rows
+    come first, so indices need no adjustment).
+
     fused=True consumes the gathered rows through the pallas consumer
     kernel (one read per gathered byte; see the cold-path study above).
     Single-chip only: the kernel derives global rule indices from lane
@@ -535,33 +644,52 @@ def classify_batch(
     """
     ing, eg = drs.ingress, drs.egress
     svc_key = (proto << 16) | dst_port
+    if v6 is not None:
+        src6w, dst6w, is6 = v6
 
-    def dim_row(tab: DimTable, x: jax.Array) -> jax.Array:
-        return tab.inc[_searchsorted_right(tab.bounds, x)]
+    def dim_idx(tab, x, x6w):
+        i4 = _searchsorted_right(tab.bounds, x)
+        if v6 is None:
+            return i4
+        i6 = tab.bounds.shape[0] + 1 + _searchsorted6(tab.bounds6, x6w)
+        return jnp.where(is6 != 0, i6, i4)
 
-    def iso_bit(tab: IsoTable, x: jax.Array) -> jax.Array:
-        return tab.val[_searchsorted_right(tab.bounds, x)]
+    def dim_row(tab: DimTable, x: jax.Array, x6w=None) -> jax.Array:
+        if x6w is None:
+            # svc dimension: the (proto<<16|port) key space is shared by
+            # both families — no v6 sub-space.
+            return tab.inc[_searchsorted_right(tab.bounds, x)]
+        return tab.inc[dim_idx(tab, x, x6w)]
+
+    def iso_bit(tab: IsoTable, x: jax.Array, x6w=None) -> jax.Array:
+        return tab.val[dim_idx(tab, x, x6w)]
 
     # Ingress: pod = dst, peer = src.  Egress: pod = src, peer = dst.
-    in_at = dim_row(ing.at, dst_ip_f)
-    in_peer = dim_row(ing.peer, src_ip_f)
+    s6 = src6w if v6 is not None else None
+    d6 = dst6w if v6 is not None else None
+    in_at = dim_row(ing.at, dst_ip_f, d6)
+    in_peer = dim_row(ing.peer, src_ip_f, s6)
     in_svc = dim_row(ing.svc, svc_key)
-    out_at = dim_row(eg.at, src_ip_f)
-    out_peer = dim_row(eg.peer, dst_ip_f)
+    out_at = dim_row(eg.at, src_ip_f, s6)
+    out_peer = dim_row(eg.peer, dst_ip_f, d6)
     out_svc = dim_row(eg.svc, svc_key)
-    iso_in = iso_bit(drs.iso_in, dst_ip_f)
-    iso_out = iso_bit(drs.iso_out, src_ip_f)
+    iso_in = iso_bit(drs.iso_in, dst_ip_f, d6)
+    iso_out = iso_bit(drs.iso_out, src_ip_f, s6)
 
     if meta.delta_slots > 0:
         # Incremental membership deltas patch the gathered rows, so peer/
         # appliedTo/isolation consumers all see post-delta membership.
+        # Delta slots carry v4 ranges only (v6 membership changes force a
+        # recompile, datapath/tpuflow.py) — v6 lanes must not false-match
+        # a v4 range on their don't-care v4 lane.
         d = drs.ip_delta
-        in_at = _patch_rows(in_at, dst_ip_f, d, d.at_in)
-        in_peer = _patch_rows(in_peer, src_ip_f, d, d.peer_in)
-        out_at = _patch_rows(out_at, src_ip_f, d, d.at_out)
-        out_peer = _patch_rows(out_peer, dst_ip_f, d, d.peer_out)
-        iso_in = _patch_iso(iso_in, dst_ip_f, d, 0)
-        iso_out = _patch_iso(iso_out, src_ip_f, d, 1)
+        ok = None if v6 is None else (is6 == 0)
+        in_at = _patch_rows(in_at, dst_ip_f, d, d.at_in, ok)
+        in_peer = _patch_rows(in_peer, src_ip_f, d, d.peer_in, ok)
+        out_at = _patch_rows(out_at, src_ip_f, d, d.at_out, ok)
+        out_peer = _patch_rows(out_peer, dst_ip_f, d, d.peer_out, ok)
+        iso_in = _patch_iso(iso_in, dst_ip_f, d, 0, ok)
+        iso_out = _patch_iso(iso_out, src_ip_f, d, 1, ok)
 
     if fused and hit_combine is None:
         in_hits, out_hits = _fused_hits(
@@ -708,10 +836,11 @@ _classify_jit = jax.jit(
 
 
 def make_classifier(cps: CompiledPolicySet):
-    """-> (fn(src_f, dst_f, proto, dport) -> verdict dict, DeviceRuleSet)."""
+    """-> (fn(src_f, dst_f, proto, dport, v6=None) -> verdict dict, DRS)."""
     drs, meta = to_device(cps)
 
-    def fn(src_f, dst_f, proto, dport):
-        return _classify_jit(drs, src_f, dst_f, proto, dport, meta=meta)
+    def fn(src_f, dst_f, proto, dport, v6=None):
+        return _classify_jit(drs, src_f, dst_f, proto, dport, meta=meta,
+                             v6=v6)
 
     return fn, drs

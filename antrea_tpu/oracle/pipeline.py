@@ -158,7 +158,12 @@ class PipelineOracle:
         ct_syn_timeout_s: int | None = None,
         ct_other_new_s: int | None = None,
         ct_other_est_s: int | None = None,
+        dual_stack: bool = False,
     ):
+        # Dual-stack mode mirrors the device's wide (10-column) flow-cache
+        # keys: addresses hash/compare as 4-word v4-mapped quadruples and
+        # v4-mapped v6 twins collapse onto their v4 host (canon_key).
+        self.dual_stack = dual_stack
         self.oracle = Oracle(ps)
         self.flow_slots = flow_slots
         self.aff_slots = aff_slots
@@ -214,12 +219,26 @@ class PipelineOracle:
         if services is not None:
             self._set_services(services)
 
+    def _k(self, key: int) -> int:
+        """Address canonicalization for flow keys: identity in v4-only
+        mode; in dual-stack mode the device's wide word form makes a
+        v4-mapped v6 address and its v4 host the same key (canon_key)."""
+        return iputil.canon_key(key) if self.dual_stack else key
+
     def _flow_hash(self, p: Packet) -> int:
-        return int(
-            hashing.flow_hash(
-                np.uint32(p.src_ip), np.uint32(p.dst_ip), p.proto, p.src_port, p.dst_port
-            )
-        )
+        return int(self._hash5(p.src_ip, p.dst_ip, p.proto,
+                               p.src_port, p.dst_port))
+
+    def _hash5(self, src: int, dst: int, proto: int, sport: int,
+               dport: int) -> int:
+        if self.dual_stack:
+            cols = [np.uint32(w & 0xFFFFFFFF)
+                    for w in (*iputil.key_to_flipped_words(src),
+                              *iputil.key_to_flipped_words(dst))]
+            return int(hashing.flow_hash_wide(cols, proto, sport, dport))
+        return int(hashing.flow_hash(
+            np.uint32(src), np.uint32(dst), proto, sport, dport
+        ))
 
     def _partner_of(self, e: dict, p: Packet):
         """Partner-direction tuple of a hit entry (the device twin is
@@ -230,12 +249,11 @@ class PipelineOracle:
         t_dst = e["dnat_ip"] if rpl else p.src_ip
         t_sport = p.dst_port if rpl else e["dnat_port"]
         t_dport = e["dnat_port"] if rpl else p.src_port
-        t_h = int(hashing.flow_hash(
-            np.uint32(t_src), np.uint32(t_dst), p.proto, t_sport, t_dport,
-        ))
+        t_h = self._hash5(t_src, t_dst, p.proto, t_sport, t_dport)
         return (
             t_h & (self.flow_slots - 1),
-            (t_src, t_dst, (t_sport << 16) | t_dport, p.proto),
+            (self._k(t_src), self._k(t_dst),
+             (t_sport << 16) | t_dport, p.proto),
             not rpl,
         )
 
@@ -265,7 +283,8 @@ class PipelineOracle:
         """Read-only flow-cache probe -> (slot, entry-or-None)."""
         slot = h & (self.flow_slots - 1)
         e = flow_view.get(slot)
-        key = (p.src_ip, p.dst_ip, (p.src_port << 16) | p.dst_port, p.proto)
+        key = (self._k(p.src_ip), self._k(p.dst_ip),
+               (p.src_port << 16) | p.dst_port, p.proto)
         hit = (
             e is not None
             and e["key"] == key
@@ -460,7 +479,7 @@ class PipelineOracle:
                               snat=w["snat"], dsr=w["dsr"])
             )
             if not nc:
-                key = (p.src_ip, p.dst_ip,
+                key = (self._k(p.src_ip), self._k(p.dst_ip),
                        (p.src_port << 16) | p.dst_port, p.proto)
                 inserts.append(
                     (slot, {
@@ -482,15 +501,13 @@ class PipelineOracle:
                 # scatter so eviction races resolve identically.  DSR
                 # connections commit NO reply leg (the reply never
                 # re-traverses this node; pipeline.go:698-708).
-                rev_h = int(
-                    hashing.flow_hash(
-                        np.uint32(w["dnat_ip"]), np.uint32(p.src_ip),
-                        p.proto, w["dnat_port"], p.src_port,
-                    )
+                rev_h = self._hash5(
+                    w["dnat_ip"], p.src_ip, p.proto,
+                    w["dnat_port"], p.src_port,
                 )
                 rev_slot = rev_h & (self.flow_slots - 1)
                 rev_key = (
-                    w["dnat_ip"], p.src_ip,
+                    self._k(w["dnat_ip"]), self._k(p.src_ip),
                     (w["dnat_port"] << 16) | p.src_port, p.proto,
                 )
                 inserts.append(

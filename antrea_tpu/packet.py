@@ -17,13 +17,25 @@ import numpy as np
 
 @dataclass(frozen=True)
 class Packet:
-    """Scalar 5-tuple for the reference interpreter."""
+    """Scalar 5-tuple for the reference interpreter.
 
-    src_ip: int  # u32
-    dst_ip: int  # u32
+    Addresses are COMBINED-keyspace ints (utils/ip.py): plain u32 for v4,
+    2^32 + the 128-bit address for v6 — every scalar membership/range check
+    in the oracle is family-agnostic over this encoding.  A packet's two
+    addresses must share a family (mixed-family packets are not routable
+    and their behavior is undefined)."""
+
+    src_ip: int  # combined keyspace (u32 for v4)
+    dst_ip: int
     proto: int  # 1/6/17/132
     src_port: int = 0  # u16; 0 for ICMP
     dst_port: int = 0  # u16
+
+    @property
+    def is6(self) -> bool:
+        from .utils import ip as iputil
+
+        return iputil.key_is_v6(self.src_ip) or iputil.key_is_v6(self.dst_ip)
 
 
 @dataclass
@@ -48,10 +60,21 @@ class PacketBatch:
     # SYN 0x02, RST 0x04, ACK 0x10); consumed by the conntrack teardown
     # path (models/pipeline.py).  None == all 0 (no teardown signals).
     tcp_flags: np.ndarray = None
+    # Dual-stack lane extension (the xxreg3 wide-register analog,
+    # fields.go:184-185): (B, 4) u32 per-address word quadruples + the
+    # family mask.  None == pure-v4 batch; for v6 lanes the 32-bit
+    # src_ip/dst_ip columns are don't-care (callers conventionally 0).
+    src_ip6: np.ndarray = None  # (B, 4) u32
+    dst_ip6: np.ndarray = None  # (B, 4) u32
+    is6: np.ndarray = None  # (B,) i32 0/1
 
     @property
     def size(self) -> int:
         return int(self.src_ip.shape[0])
+
+    @property
+    def has_v6(self) -> bool:
+        return self.is6 is not None and bool(np.any(self.is6))
 
     def in_ports(self) -> np.ndarray:
         """in_port column, defaulting to -1 (non-pod ingress)."""
@@ -67,15 +90,57 @@ class PacketBatch:
 
     @staticmethod
     def from_packets(packets: list[Packet]) -> "PacketBatch":
+        from .utils import ip as iputil
+
+        any6 = any(p.is6 for p in packets)
+        kw = {}
+        if any6:
+            def words(key):
+                # v4 addresses in a v6 lane take the RFC 4291 mapped form
+                # so packet() can round-trip them (mixed-family packets are
+                # undefined; this just keeps reconstruction lossless).
+                return iputil.key_to_words(key)
+
+            kw = dict(
+                src_ip6=np.array([words(p.src_ip) for p in packets],
+                                 dtype=np.uint32),
+                dst_ip6=np.array([words(p.dst_ip) for p in packets],
+                                 dtype=np.uint32),
+                is6=np.array([1 if p.is6 else 0 for p in packets],
+                             dtype=np.int32),
+            )
         return PacketBatch(
-            src_ip=np.array([p.src_ip for p in packets], dtype=np.uint32),
-            dst_ip=np.array([p.dst_ip for p in packets], dtype=np.uint32),
+            src_ip=np.array(
+                [0 if p.is6 else p.src_ip for p in packets], dtype=np.uint32
+            ),
+            dst_ip=np.array(
+                [0 if p.is6 else p.dst_ip for p in packets], dtype=np.uint32
+            ),
             proto=np.array([p.proto for p in packets], dtype=np.int32),
             src_port=np.array([p.src_port for p in packets], dtype=np.int32),
             dst_port=np.array([p.dst_port for p in packets], dtype=np.int32),
+            **kw,
         )
 
     def packet(self, i: int) -> Packet:
+        from .utils import ip as iputil
+
+        if self.is6 is not None and int(self.is6[i]):
+            def key(wrow):
+                w = [int(x) for x in wrow]
+                if w[0] == 0 and w[1] == 0 and w[2] == 0xFFFF:
+                    return w[3]  # v4-mapped form round-trips to v4
+                return iputil.V6_OFF + (
+                    (w[0] << 96) | (w[1] << 64) | (w[2] << 32) | w[3]
+                )
+
+            return Packet(
+                src_ip=key(self.src_ip6[i]),
+                dst_ip=key(self.dst_ip6[i]),
+                proto=int(self.proto[i]),
+                src_port=int(self.src_port[i]),
+                dst_port=int(self.dst_port[i]),
+            )
         return Packet(
             src_ip=int(self.src_ip[i]),
             dst_ip=int(self.dst_ip[i]),

@@ -21,9 +21,25 @@ equivalent scale axes map onto a 2-D `jax.sharding.Mesh`:
 
 The interval bounds / iso / service tables are replicated (they are the
 small, read-mostly side), the incidence words are sharded (they are the
-memory that grows with rule count) — at 100k+ rules per direction this is
-what lets the rule state exceed a single chip's HBM, the way the reference
-relies on OVS's shared tables + megaflow cache.
+memory that grows with rule count).
+
+HBM capacity math (measured on the 100k-rule bench world, v5e = 16 GB):
+  * incidence tables: 558 MB total = six (NB+1, W) u32 tables; both NB
+    (interval count) and W (rule words) grow ~linearly in rule count, so
+    incidence bytes grow ~QUADRATICALLY: ~5.6 KB/rule at 100k rules,
+    ~56 KB/rule at 1M.  Sharding the word axis divides exactly this term
+    by the rule-axis size R (tests/test_parallel_scale.py asserts the
+    per-shard byte accounting at bench scale).
+  * replicated side: interval bounds+iso ~1.4 MB, service tables ~2 MB at
+    5k services — noise.
+  * per-DATA-shard conntrack state: 36 B/slot (keys 4x4 + meta 4x4 + ts 4)
+    = 151 MB at the bench's 2^22 slots; the data axis divides the slot
+    budget, not the rule state.
+  Single-chip ceiling: ~14 GB of incidence -> ~1.6M rules; an 8-way rule
+  axis lifts that to ~4.5M rules per direction pair (capped earlier by the
+  16-bit attribution packing, models/pipeline.check_rule_capacity) — rule
+  state beyond one chip's HBM is exactly what the axis buys, the way the
+  reference relies on OVS's shared tables + megaflow cache.
 
 State layout under shard_map: conn/aff arrays gain a leading (D,) axis
 sharded over ``data``; shard d sees its (slots+1,) slice.  Verdicts after the
@@ -77,7 +93,9 @@ def make_mesh(n_data: int, n_rule: int, devices=None) -> Mesh:
 
 def _drs_specs() -> m.DeviceRuleSet:
     def dim():
-        return m.DimTable(bounds=P(), inc=P(None, RULE))
+        # Interval bounds (v4 + v6 lexicographic) replicated, incidence
+        # words sharded — bounds are the small side in both families.
+        return m.DimTable(bounds=P(), bounds6=P(), inc=P(None, RULE))
 
     dd = m.DeviceDirection(
         at=dim(),
@@ -87,7 +105,7 @@ def _drs_specs() -> m.DeviceRuleSet:
         l7=P(),  # same discipline as action
         word_idx=P(RULE),
     )
-    iso = m.IsoTable(bounds=P(), val=P())
+    iso = m.IsoTable(bounds=P(), bounds6=P(), val=P())
     return m.DeviceRuleSet(
         ingress=dd,
         egress=dd,

@@ -1,14 +1,23 @@
-"""IPv4 helpers used across the compiler, oracle and kernels.
+"""IP helpers used across the compiler, oracle and kernels — dual-stack.
 
-Everything is u32-based: packets carry IPs as unsigned 32-bit ints, CIDRs are
-(base, prefix_len) pairs, and CIDR sets become half-open [lo, hi) ranges over
-the u32 space so membership reduces to interval lookup (the vectorizable LPM
-strategy; ref: pkg/apis/controlplane/types.go:376 IPBlock, and the CIDR match
-flows built in pkg/agent/openflow/network_policy.go).
+Host-side address arithmetic happens in ONE combined keyspace of plain
+python ints (the reference is dual-stack throughout its pipeline,
+pkg/agent/openflow/pipeline.go IPv6 table / fields.go:184-185 xxreg3):
 
-IPv6 is carried in the reference as 16-byte addresses; this build keeps the
-dataplane IPv4-first (the register-file layout reserves xxreg-style wide slots
-for a later IPv6 column set).
+    IPv4  ->  [0, 2^32)             (the address itself)
+    IPv6  ->  [2^32, 2^32 + 2^128)  (V6_OFF + the 128-bit address)
+
+so CIDR sets of EITHER family become half-open [lo, hi) ranges in the same
+space and every range consumer — merging, ipBlocks, group interning, the
+oracle's membership checks — is family-agnostic for free.  The device side
+splits the combined boundary points back into a u32 interval table (v4)
+and a 4xu32 lexicographic interval table (v6) at compile time
+(ops/match._dim_table_host); packets then resolve to interval INDICES and
+everything downstream is family-blind.
+
+Device lanes are i32; v4 values flip the sign bit so signed compares give
+unsigned order, v6 values flip the sign bit of EACH of their 4 words
+(lexicographic order is preserved word-wise).
 """
 
 from __future__ import annotations
@@ -17,6 +26,10 @@ import ipaddress
 from typing import Iterable
 
 U32_MAX = 0xFFFFFFFF
+# IPv6 offset in the combined keyspace (see module docstring).
+V6_OFF = 1 << 32
+# Exclusive end of the combined keyspace: v4 space + offset v6 space.
+KEYSPACE_END = V6_OFF + (1 << 128)
 
 
 def ip_to_u32(ip: str) -> int:
@@ -28,8 +41,43 @@ def u32_to_ip(v: int) -> str:
     return str(ipaddress.IPv4Address(v & U32_MAX))
 
 
+def is_v6(ip: str) -> bool:
+    return ":" in ip
+
+
+def ip_to_key(ip: str) -> int:
+    """Address of either family -> combined-keyspace int."""
+    if is_v6(ip):
+        return V6_OFF + int(ipaddress.IPv6Address(ip))
+    return int(ipaddress.IPv4Address(ip))
+
+
+def key_is_v6(key: int) -> bool:
+    return key >= V6_OFF
+
+
+def key_to_ip(key: int) -> str:
+    if key >= V6_OFF:
+        return str(ipaddress.IPv6Address(key - V6_OFF))
+    return str(ipaddress.IPv4Address(key))
+
+
+def key_to_words(key: int) -> tuple[int, int, int, int]:
+    """Combined key -> 4 u32 words, v4 in RFC 4291 v4-mapped form
+    (::ffff:a.b.c.d) so a v4 address and its mapped-v6 twin — the same
+    host by definition — share one wide representation, and no other v6
+    address can alias a v4 one."""
+    if key >= V6_OFF:
+        v = key - V6_OFF
+        return ((v >> 96) & U32_MAX, (v >> 64) & U32_MAX,
+                (v >> 32) & U32_MAX, v & U32_MAX)
+    return (0, 0, 0xFFFF, key & U32_MAX)
+
+
 def parse_cidr(cidr: str) -> tuple[int, int]:
-    """'10.0.0.0/8' -> (base_u32, prefix_len). Bare IPs become /32."""
+    """'10.0.0.0/8' -> (base_u32, prefix_len). Bare IPs become /32.
+    IPv4-only callers (service frontends, topology) — policy/range paths
+    go through cidr_to_range, which is dual-stack."""
     if "/" not in cidr:
         return ip_to_u32(cidr), 32
     net = ipaddress.IPv4Network(cidr, strict=False)
@@ -37,7 +85,18 @@ def parse_cidr(cidr: str) -> tuple[int, int]:
 
 
 def cidr_to_range(cidr: str) -> tuple[int, int]:
-    """CIDR -> half-open [lo, hi) u32 range. hi may be 2**32 (whole-space end)."""
+    """CIDR of either family -> half-open [lo, hi) combined-keyspace range.
+    For v4, hi may be 2**32 (whole-v4-space end); for v6, hi may be
+    KEYSPACE_END."""
+    if is_v6(cidr):
+        if "/" not in cidr:
+            base, plen = V6_OFF + int(ipaddress.IPv6Address(cidr)), 128
+        else:
+            net = ipaddress.IPv6Network(cidr, strict=False)
+            base, plen = V6_OFF + int(net.network_address), net.prefixlen
+        size = 1 << (128 - plen)
+        lo = V6_OFF + ((base - V6_OFF) & ~(size - 1))
+        return lo, lo + size
     base, plen = parse_cidr(cidr)
     size = 1 << (32 - plen)
     lo = base & ~(size - 1) & U32_MAX
@@ -104,3 +163,25 @@ def unflip_u32(v) -> int:
     """Scalar inverse of flip_u32 (plain-int space, numpy-2 safe): the
     stored sign-flipped i32 value back to its u32 address."""
     return (int(v) ^ 0x80000000) & 0xFFFFFFFF
+
+
+def key_to_flipped_words(key: int) -> tuple[int, int, int, int]:
+    """key_to_words with each word sign-flipped — the exact i32 lane values
+    the device stores, for host/oracle twins that must hash or compare the
+    same bits (returned as SIGNED i32-range ints)."""
+    return tuple(
+        ((w ^ 0x80000000) & U32_MAX) - (1 << 32)
+        if (w ^ 0x80000000) & 0x80000000 else (w ^ 0x80000000)
+        for w in key_to_words(key)
+    )
+
+
+def canon_key(key: int) -> int:
+    """Collapse a v4-mapped v6 address (::ffff:a.b.c.d) to its v4 int —
+    the combined-keyspace equivalence the wide word form induces (they are
+    the same host, RFC 4291); all other keys unchanged."""
+    if key >= V6_OFF:
+        v = key - V6_OFF
+        if (v >> 32) == 0xFFFF:
+            return v & U32_MAX
+    return key

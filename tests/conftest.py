@@ -21,3 +21,12 @@ def cpu_devices():
     import jax
 
     return jax.devices("cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: datapath-compile / scale / process-boundary tests (minutes). "
+        "Quick developer loop: pytest -m 'not slow' (< 2 min); CI and the "
+        "driver run everything.",
+    )

@@ -9,6 +9,8 @@ from urllib.request import urlopen
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu import antctl
 from antrea_tpu.agent.apiserver import AgentApiServer
 from antrea_tpu.agent.memberlist import MemberlistCluster

@@ -4,6 +4,8 @@ reference semantics cited per module."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.packetcapture import (
     CaptureSpec,
     PacketCaptureController,

@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis.controlplane import Direction, RuleAction
 from antrea_tpu.apis.crd import (
     AntreaAppliedTo,

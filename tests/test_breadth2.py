@@ -4,6 +4,8 @@ ownership, flow export/aggregation."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.fqdn import FqdnController, fqdn_matches
 from antrea_tpu.agent.memberlist import ConsistentHash, MemberlistCluster
 from antrea_tpu.apis.controlplane import Direction, RuleAction

@@ -4,6 +4,8 @@ NodeLatencyMonitor — reference semantics cited in each module."""
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.bgp import BgpController, BgpPeer, BgpPolicy
 from antrea_tpu.agent.memberlist import MemberlistCluster
 from antrea_tpu.agent.monitortool import NodeLatencyMonitor

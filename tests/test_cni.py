@@ -4,6 +4,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.cni import CniServer, HostLocalIPAM, IPAMError
 from antrea_tpu.apis.crd import (
     K8sNetworkPolicy,

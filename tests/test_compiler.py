@@ -11,7 +11,9 @@ from antrea_tpu.utils import ip as iputil
 
 
 def test_svc_key_ranges_any():
-    assert _svc_key_ranges([]) == ((0, 1 << 32),)
+    # FULL_SPACE spans the combined dual-stack keyspace; the svc key space
+    # only occupies its low 2^24, which the range trivially covers.
+    assert _svc_key_ranges([]) == ((0, iputil.KEYSPACE_END),)
 
 
 def test_svc_key_ranges_tcp_port():

@@ -13,6 +13,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis.crd import Pod
 from antrea_tpu.controller.networkpolicy import NetworkPolicyController
 

@@ -14,6 +14,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.datapath import (
     DatapathType,
     OracleDatapath,

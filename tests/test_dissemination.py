@@ -8,6 +8,10 @@ from WATCH EVENTS ONLY; correctness = its datapath verdicts match an oracle
 compiled directly from the controller's span-filtered snapshot.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from antrea_tpu.agent import AgentPolicyController

@@ -1,6 +1,10 @@
 """Fake-agent fleet scale test: span-filtered fan-out over many agents
 (the antrea-agent-simulator model, cmd/antrea-agent-simulator)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis import crd
 from antrea_tpu.apis import controlplane as cp
 from antrea_tpu.controller.networkpolicy import NetworkPolicyController

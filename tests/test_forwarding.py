@@ -13,6 +13,8 @@ import copy
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.compiler.topology import (
     FWD_DROP_SPOOF,
     FWD_DROP_UNKNOWN,

@@ -5,6 +5,10 @@ Runs in pallas interpret mode on CPU (tests/conftest.py pins JAX_PLATFORMS
 =cpu); the same code compiles on TPU where the bench uses it.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from antrea_tpu.compiler.compile import compile_policy_set

@@ -1,5 +1,9 @@
 """Host route/iptables program renderer, IP assigner, antctl check."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.ipassigner import ANNOUNCE_REPEATS, IPAssigner
 from antrea_tpu.agent.nodeportlocal import NplController
 from antrea_tpu.agent.route import GW_DEV, render_program

@@ -8,6 +8,10 @@ rate-limited queues).  Differential discipline: both datapaths behind the
 Datapath boundary.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from antrea_tpu.agent.multicast import (

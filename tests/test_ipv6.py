@@ -1,0 +1,409 @@
+"""Dual-stack (IPv6) truth tables for the classification engines.
+
+Hand-authored expectations from the reference's dual-stack semantics
+(pipeline.go IPv6 table; fields.go:184-185 xxreg3; IPBlock v6 CIDRs in
+types.go:376), run on BOTH engines — the scalar oracle over the combined
+keyspace and the TPU kernel over the dual interval tables.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.compile import (
+    ACT_ALLOW,
+    ACT_DROP,
+    compile_policy_set,
+)
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.ops.match import flip_ips, make_classifier
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+WEB6 = "2001:db8:0:1::10"
+CLIENT6 = "2001:db8:0:2::7"
+OTHER6 = "2001:db8:ffff::9"
+WEB4 = "10.0.0.10"
+CLIENT4 = "10.0.1.7"
+
+
+def _pkt(src, dst, dport=80, proto=6, sport=40000):
+    return Packet(
+        src_ip=iputil.ip_to_key(src), dst_ip=iputil.ip_to_key(dst),
+        proto=proto, src_port=sport, dst_port=dport,
+    )
+
+
+def _run_both(ps, cases):
+    """cases: [(src, dst, dport, expect)] — assert oracle AND kernel."""
+    oracle = Oracle(ps)
+    cps = compile_policy_set(ps)
+    fn, _ = make_classifier(cps)
+    pkts = [_pkt(s, d, dp) for s, d, dp, _ in cases]
+    batch = PacketBatch.from_packets(pkts)
+    v6 = None
+    if batch.has_v6:
+        v6 = (
+            flip_ips(batch.src_ip6),
+            flip_ips(batch.dst_ip6),
+            batch.is6,
+        )
+    out = fn(flip_ips(batch.src_ip), flip_ips(batch.dst_ip),
+             batch.proto.astype(np.int32), batch.dst_port.astype(np.int32),
+             v6=v6)
+    codes = np.asarray(out["code"])
+    for i, (s, d, dp, expect) in enumerate(cases):
+        o = int(oracle.classify(pkts[i]).code)
+        assert o == expect, (s, d, dp, "oracle", o, "want", expect)
+        assert int(codes[i]) == expect, (s, d, dp, "kernel", int(codes[i]),
+                                         "want", expect)
+
+
+def _member(ip):
+    return cp.GroupMember(ip=ip, node="n0")
+
+
+def test_v6_only_acnp_cidr_peer():
+    """ACNP drop from a v6 CIDR onto a v6 pod; unlisted v6 sources allowed;
+    v4 traffic unaffected (family separation)."""
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[_member(WEB6)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="p", name="p", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["web"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(
+                ip_blocks=[cp.IPBlock("2001:db8:0:2::/64")]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    _run_both(ps, [
+        (CLIENT6, WEB6, 80, ACT_DROP),     # in the denied /64
+        (OTHER6, WEB6, 80, ACT_ALLOW),     # different v6 prefix
+        (CLIENT4, WEB4, 80, ACT_ALLOW),    # v4 never matches v6 appliedTo
+    ])
+
+
+def test_dual_stack_k8s_isolation():
+    """A K8s NP isolating a dual-stack group: BOTH families of the pod set
+    are default-denied; the allow rule's v6 ipBlock admits only v6 clients
+    in range, and the v4 twin pod stays isolated for v4 clients."""
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[_member(WEB6), _member(WEB4)])
+    ps.address_groups["cli6"] = cp.AddressGroup(
+        name="cli6", ip_blocks=[cp.IPBlock("2001:db8:0:2::/64")])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="k", name="k", namespace="ns", type=cp.NetworkPolicyType.K8S,
+        applied_to_groups=["web"], policy_types=[cp.Direction.IN],
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(address_groups=["cli6"]),
+        )],
+    ))
+    _run_both(ps, [
+        (CLIENT6, WEB6, 80, ACT_ALLOW),   # allowed by the v6 block
+        (OTHER6, WEB6, 80, ACT_DROP),     # isolated, no rule matches
+        (CLIENT4, WEB4, 80, ACT_DROP),    # v4 twin isolated too
+        (CLIENT4, "10.0.0.99", 80, ACT_ALLOW),  # non-selected pod: default
+    ])
+
+
+def test_any_peer_spans_both_families():
+    """An any-peer allow (empty peer) matches v6 AND v4 sources — the
+    FULL_SPACE group covers the combined keyspace."""
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[_member(WEB6), _member(WEB4)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="k", name="k", namespace="ns", type=cp.NetworkPolicyType.K8S,
+        applied_to_groups=["web"], policy_types=[cp.Direction.IN],
+        rules=[cp.NetworkPolicyRule(direction=cp.Direction.IN)],  # any
+    ))
+    _run_both(ps, [
+        (OTHER6, WEB6, 80, ACT_ALLOW),
+        (CLIENT4, WEB4, 80, ACT_ALLOW),
+    ])
+
+
+def test_v6_member_peers_and_egress():
+    """v6 group members as egress peers + tier precedence across families:
+    an app-tier v6 drop is overridden by an earlier-tier allow."""
+    ps = PolicySet()
+    ps.applied_to_groups["cli"] = cp.AppliedToGroup(
+        name="cli", members=[_member(CLIENT6)])
+    ps.address_groups["dst"] = cp.AddressGroup(
+        name="dst", members=[_member(WEB6)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="drop", name="drop", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["cli"], tier_priority=250, priority=5.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.OUT,
+            to_peer=cp.NetworkPolicyPeer(address_groups=["dst"]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    ps.policies.append(cp.NetworkPolicy(
+        uid="allow", name="allow", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["cli"], tier_priority=100, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.OUT,
+            to_peer=cp.NetworkPolicyPeer(address_groups=["dst"]),
+            services=[cp.Service(protocol=6, port=443)],
+            action=cp.RuleAction.ALLOW, priority=0,
+        )],
+    ))
+    _run_both(ps, [
+        (CLIENT6, WEB6, 443, ACT_ALLOW),  # securityops tier wins
+        (CLIENT6, WEB6, 80, ACT_DROP),    # app-tier drop
+        (CLIENT6, OTHER6, 80, ACT_ALLOW),  # not the peer
+    ])
+
+
+def test_v6_excepts_and_mixed_batch():
+    """v6 IPBlock with excepts; a single batch carries both families."""
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[_member(WEB6), _member(WEB4)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="p", name="p", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["web"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(ip_blocks=[
+                cp.IPBlock("2001:db8::/32",
+                           excepts=("2001:db8:0:2::/64",)),
+                cp.IPBlock("10.0.1.0/24"),
+            ]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    _run_both(ps, [
+        (OTHER6, WEB6, 80, ACT_DROP),     # inside /32
+        (CLIENT6, WEB6, 80, ACT_ALLOW),   # carved out by except
+        (CLIENT4, WEB4, 80, ACT_DROP),    # the v4 block, same rule
+        ("10.0.2.7", WEB4, 80, ACT_ALLOW),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-level dual-stack: wide (10-column) flow-cache keys, conntrack
+# commit/est/reply/teardown for v6 flows, mixed-family batches — device
+# kernel (make_pipeline dual_stack=True) vs scalar spec (PipelineOracle
+# dual_stack=True) differential.
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.models import pipeline as pl
+from antrea_tpu.oracle.pipeline import PipelineOracle
+
+
+def _mk_dual(ps, services=()):
+    cps = compile_policy_set(ps)
+    svc = compile_services(list(services))
+    step, state, (drs, dsvc) = pl.make_pipeline(
+        cps, svc, flow_slots=1 << 10, aff_slots=1 << 6, miss_chunk=16,
+        dual_stack=True,
+    )
+    po = PipelineOracle(ps, list(services), flow_slots=1 << 10,
+                        aff_slots=1 << 6, dual_stack=True)
+    return step, state, drs, dsvc, po
+
+
+def _step_both(step, state, drs, dsvc, po, pkts, now, gen=0):
+    batch = PacketBatch.from_packets(pkts)
+    v6 = None
+    if batch.is6 is not None:
+        v6 = (jnp.asarray(flip_ips(batch.src_ip6)),
+              jnp.asarray(flip_ips(batch.dst_ip6)),
+              jnp.asarray(batch.is6))
+    state, out = pl.pipeline_step(
+        state, drs, dsvc,
+        jnp.asarray(flip_ips(batch.src_ip)),
+        jnp.asarray(flip_ips(batch.dst_ip)),
+        jnp.asarray(batch.proto.astype(np.int32)),
+        jnp.asarray(batch.src_port.astype(np.int32)),
+        jnp.asarray(batch.dst_port.astype(np.int32)),
+        jnp.int32(now), jnp.int32(gen), meta=step.meta, v6=v6,
+    )
+    outs = po.step(batch, now, gen=gen)
+    dev = {k: np.asarray(v) for k, v in out.items()}
+    for i, o in enumerate(outs):
+        assert int(dev["code"][i]) == o.code, (i, "code")
+        assert int(dev["est"][i]) == int(o.est), (i, "est")
+        assert int(dev["reply"][i]) == int(o.reply), (i, "reply")
+        assert int(dev["committed"][i]) == int(o.committed), (i, "committed")
+        assert int(dev["svc_idx"][i]) == o.svc_idx, (i, "svc")
+    return state, dev, outs
+
+
+def _dual_ps():
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[_member(WEB6), _member(WEB4)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="p", name="p", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["web"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(
+                ip_blocks=[cp.IPBlock("2001:db8:0:2::/64"),
+                           cp.IPBlock("10.0.1.0/24")]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    return ps
+
+
+def test_dual_stack_pipeline_conntrack_parity():
+    """v6 flows commit/est/reply through the wide flow cache identically on
+    device and oracle; denied v6 flows cache denials; mixed batches work."""
+    step, state, drs, dsvc, po = _mk_dual(_dual_ps())
+
+    # Mixed batch: allowed v6, denied v6, allowed v4, denied v4.
+    pkts = [
+        _pkt(OTHER6, WEB6, sport=41000),
+        _pkt(CLIENT6, WEB6, sport=41001),
+        _pkt("10.9.9.9", WEB4, sport=41002),
+        _pkt("10.0.1.7", WEB4, sport=41003),
+    ]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert [o.code for o in outs] == [0, 1, 0, 1]
+    assert [int(x) for x in dev["committed"]] == [1, 0, 1, 0]
+
+    # Same batch again: allowed flows est-hit; denials hit their cached
+    # denial entries (same generation).
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=2)
+    assert [int(x) for x in dev["est"]] == [1, 0, 1, 0]
+    assert all(o.hit for o in outs)
+
+    # Reply direction of the allowed v6 flow: reverse-tuple est hit.
+    rev = [Packet(src_ip=iputil.ip_to_key(WEB6),
+                  dst_ip=iputil.ip_to_key(OTHER6),
+                  proto=6, src_port=80, dst_port=41000)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, rev, now=3)
+    assert int(dev["reply"][0]) == 1 and int(dev["est"][0]) == 1
+
+
+def test_dual_stack_gen_invalidation_and_teardown():
+    """Generation bump revalidates cached v6 denials; FIN teardown removes
+    both tuple directions of a v6 connection — on both engines."""
+    from antrea_tpu.models.pipeline import TCP_FIN
+
+    step, state, drs, dsvc, po = _mk_dual(_dual_ps())
+    deny = [_pkt(CLIENT6, WEB6, sport=42000)]
+    ok = [_pkt(OTHER6, WEB6, sport=42001)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, deny + ok, now=1)
+    assert [o.code for o in outs] == [1, 0]
+
+    # Bundle commit (gen 1): denial must re-classify (still denied, not a
+    # cache hit); the established v6 connection bypasses.
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, deny + ok,
+                                  now=2, gen=1)
+    assert not outs[0].hit and outs[0].code == 1
+    assert outs[1].hit and outs[1].est
+
+    # FIN on the established flow tears down both directions.
+    batch = PacketBatch.from_packets(ok)
+    batch.tcp_flags = np.array([TCP_FIN], np.int32)
+    v6 = (jnp.asarray(flip_ips(batch.src_ip6)),
+          jnp.asarray(flip_ips(batch.dst_ip6)),
+          jnp.asarray(batch.is6))
+    state, out = pl.pipeline_step(
+        state, drs, dsvc,
+        jnp.asarray(flip_ips(batch.src_ip)),
+        jnp.asarray(flip_ips(batch.dst_ip)),
+        jnp.asarray(batch.proto.astype(np.int32)),
+        jnp.asarray(batch.src_port.astype(np.int32)),
+        jnp.asarray(batch.dst_port.astype(np.int32)),
+        jnp.int32(3), jnp.int32(1), meta=step.meta, v6=v6,
+        flags=jnp.asarray(batch.flags()),
+    )
+    po.step(batch, 3, gen=1, flags=batch.flags())
+    # Next same-tuple packet is a fresh classification on both sides.
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, ok, now=4, gen=1)
+    assert not outs[0].hit
+    assert int(dev["est"][0]) == 0
+
+
+def test_dual_stack_v4_service_still_works():
+    """In a dual-stack world, v4 service traffic keeps full ServiceLB/DNAT
+    (wide keys change the cache layout, not the NAT semantics); v6 traffic
+    to the same frontend value cannot match a v4 frontend."""
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+
+    svc = ServiceEntry(cluster_ip="10.96.0.10", port=80, protocol=6,
+                       endpoints=[Endpoint(WEB4, 8080)])
+    step, state, drs, dsvc, po = _mk_dual(PolicySet(), [svc])
+    pkts = [_pkt(CLIENT4, "10.96.0.10", 80, sport=43000)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert outs[0].svc_idx == 0 and outs[0].code == 0
+    assert outs[0].dnat_ip == iputil.ip_to_u32(WEB4)
+    assert int(dev["dnat_port"][0]) == 8080
+    # Established + reply un-DNAT still work over wide keys.
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=2)
+    assert int(dev["est"][0]) == 1
+    rev = [Packet(src_ip=iputil.ip_to_u32(WEB4),
+                  dst_ip=iputil.ip_to_u32(CLIENT4),
+                  proto=6, src_port=8080, dst_port=43000)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, rev, now=3)
+    assert int(dev["reply"][0]) == 1
+    assert int(dev["dnat_port"][0]) == 80  # un-DNAT to the frontend
+
+
+def test_v6_group_delta_forces_recompile_both_datapaths():
+    """DeltaTable rows are v4-only, so a v6 membership delta must fold into
+    a full recompile (never an OverflowError or a silently-wrapped v4
+    patch) — and the recompiled tables must reflect the new member."""
+    from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+    from antrea_tpu.ops.match import classify_batch
+
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[_member(WEB6)])
+    ps.address_groups["bad"] = cp.AddressGroup(
+        name="bad", members=[cp.GroupMember(ip=CLIENT6)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="p", name="p", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["web"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(address_groups=["bad"]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    import copy
+
+    for dp_cls in (TpuflowDatapath, OracleDatapath):
+        kw = {"miss_chunk": 16} if dp_cls is TpuflowDatapath else {}
+        dp = dp_cls(copy.deepcopy(ps), [], flow_slots=1 << 8,
+                    aff_slots=1 << 4, **kw)
+        g0 = dp.generation
+        gen = dp.apply_group_delta("bad", [OTHER6], [])
+        assert gen == g0 + 1, dp.datapath_type
+
+    # The tpuflow recompile reflects the added v6 member (white-box: the
+    # Datapath packet boundary is v4; classify directly on its tables).
+    dp = TpuflowDatapath(copy.deepcopy(ps), [], flow_slots=1 << 8,
+                         aff_slots=1 << 4, miss_chunk=16)
+    dp.apply_group_delta("bad", [OTHER6], [])
+    pkts = [_pkt(OTHER6, WEB6)]
+    b = PacketBatch.from_packets(pkts)
+    out = classify_batch(
+        dp._drs,
+        jnp.asarray(flip_ips(b.src_ip)), jnp.asarray(flip_ips(b.dst_ip)),
+        jnp.asarray(b.proto.astype(np.int32)),
+        jnp.asarray(b.dst_port.astype(np.int32)),
+        meta=dp._meta.match,
+        v6=(jnp.asarray(flip_ips(b.src_ip6)), jnp.asarray(flip_ips(b.dst_ip6)),
+            jnp.asarray(b.is6)),
+    )
+    assert int(np.asarray(out["code"])[0]) == ACT_DROP  # new member matches

@@ -5,6 +5,8 @@ bit-for-bit — the TPU-build analog of the reference's OVS differential tests
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.compiler.compile import compile_policy_set
 from antrea_tpu.ops.match import flip_ips, make_classifier
 from antrea_tpu.oracle import Oracle

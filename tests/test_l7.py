@@ -11,6 +11,8 @@ import copy
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis import controlplane as cp
 from antrea_tpu.apis import crd
 from antrea_tpu.controller.networkpolicy import NetworkPolicyController

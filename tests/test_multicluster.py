@@ -3,6 +3,10 @@ label identities — driven end-to-end into per-cluster datapaths (the
 BASELINE config-5 'multicluster' scenario; cross-cluster reachability
 rides DNAT to remote pod IPs, the Geneve-tunnel analog)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from antrea_tpu.apis.controlplane import Direction, RuleAction

@@ -6,6 +6,8 @@ import struct
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.native import ConfigStore, native_available
 
 

@@ -7,6 +7,8 @@ import copy
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.nodeportlocal import (
     DEFAULT_PORT_RANGE,
     NplController,

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.compiler.compile import compile_policy_set
 from antrea_tpu.compiler.services import compile_services
 from antrea_tpu.models.pipeline import make_pipeline

@@ -10,6 +10,8 @@ AgentPolicyController and demands identical verdicts post-restart.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.controller import AgentPolicyController
 from antrea_tpu.controller.networkpolicy import NetworkPolicyController
 from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath

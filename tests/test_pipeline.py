@@ -4,6 +4,8 @@ DNAT, session affinity — device pipeline vs scalar pipeline oracle."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis import controlplane as cp
 from antrea_tpu.apis.service import Endpoint, ServiceEntry
 from antrea_tpu.compiler.compile import compile_policy_set

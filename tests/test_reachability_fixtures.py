@@ -9,6 +9,8 @@ triangle (reference docs -> fixtures <- oracle <- kernel).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis.service import Endpoint, ServiceEntry
 from antrea_tpu.compiler.compile import compile_policy_set
 from antrea_tpu.compiler.services import compile_services

@@ -7,6 +7,10 @@ packet re-classifies under the CURRENT policy — a closed connection can
 never est-bypass a deny installed after it closed.  Closing segments that
 MISS the cache classify but never establish."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import copy
 
 import numpy as np

@@ -12,6 +12,8 @@ controller.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.apis.controlplane import Direction, RuleAction
 from antrea_tpu.apis.crd import (
     K8sNetworkPolicy,

@@ -6,6 +6,8 @@ import tarfile
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from antrea_tpu.agent.ipsec import IpsecCertificateController
 from antrea_tpu.controller.certificates import (
     SIGNER_IPSEC,
