@@ -106,10 +106,10 @@ class PacketCaptureController:
         # Half-open [lo, hi) narrowed via inclusive hi-1 — hi itself can be
         # 2**32 (e.g. a /0 or the top /32), which overflows uint32.
         if spec.src_cidr:
-            lo, hi = iputil.cidr_to_range(spec.src_cidr)
+            lo, hi = iputil.cidr_to_range_v4(spec.src_cidr)
             m &= (batch.src_ip >= np.uint32(lo)) & (batch.src_ip <= np.uint32(hi - 1))
         if spec.dst_cidr:
-            lo, hi = iputil.cidr_to_range(spec.dst_cidr)
+            lo, hi = iputil.cidr_to_range_v4(spec.dst_cidr)
             m &= (batch.dst_ip >= np.uint32(lo)) & (batch.dst_ip <= np.uint32(hi - 1))
         if spec.protocol is not None:
             m &= batch.proto == spec.protocol

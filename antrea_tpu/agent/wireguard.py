@@ -112,7 +112,7 @@ class WireGuardClient:
         best_len = -1
         for p in self.peers():
             for cidr in p.allowed_ips:
-                lo, hi = iputil.cidr_to_range(cidr)
+                lo, hi = iputil.cidr_to_range_v4(cidr)
                 if lo <= ip_u32 < hi:
                     plen = 32 - (hi - lo).bit_length() + 1
                     if plen > best_len:

@@ -54,6 +54,12 @@ FWD_DROP_UNKNOWN = 4  # dst in the LOCAL podCIDR but no such pod -> drop
 FWD_MCAST = 5  # dst is a joined multicast group -> replicate (MulticastOutput)
 FWD_DROP_MCAST = 6  # multicast dst with no receivers -> drop (MulticastRouting miss)
 FWD_PUNT = 7  # punted to the controller (IGMP packet-in, packetin.go:44)
+FWD_ARP_REPLY = 8  # ARP request we answer (ARPResponder) -> reply out in_port
+FWD_ARP_FLOOD = 9  # ARP we don't answer -> normal L2 flood (OFPP_NORMAL)
+
+# ARP opcodes carried in PacketBatch.arp_op (0 = not ARP).
+ARP_OP_REQUEST = 1
+ARP_OP_REPLY = 2
 
 # Pseudo-port for multicast replication (the consumer resolves the actual
 # port list via Datapath.mcast_group(mcast_idx)).
@@ -146,6 +152,10 @@ class ForwardingTables(NamedTuple):
     local_range_f: np.ndarray  # (2,) i32 [lo_f, hi_f] of the local podCIDR
     mc_ip_f: np.ndarray  # (Mcap,) i32 sorted flipped joined group IPs
     n_mc: np.ndarray  # (1,) i32
+    # ARP responder table (pipeline.go ARPResponder): every address this
+    # node answers ARP for — gateway IP, local pod IPs, remote node IPs.
+    arp_ip_f: np.ndarray  # (Acap,) i32 sorted flipped
+    n_arp: np.ndarray  # (1,) i32
 
 
 def _cap(n: int, floor: int = 8) -> int:
@@ -221,7 +231,7 @@ def compile_topology(topo: Topology) -> ForwardingTables:
     # Remote node podCIDR intervals, sorted by lo; must be disjoint.
     ranges = []
     for nr in topo.remote_nodes:
-        lo, hi = iputil.cidr_to_range(nr.pod_cidr)  # [lo, hi) raw u32
+        lo, hi = iputil.cidr_to_range_v4(nr.pod_cidr)  # [lo, hi) raw u32
         ranges.append((lo, hi, iputil.ip_to_u32(nr.node_ip), nr.name))
     ranges.sort()
     for a, b in zip(ranges, ranges[1:]):
@@ -243,7 +253,7 @@ def compile_topology(topo: Topology) -> ForwardingTables:
         rn_peer_f[i] = _flip(peer)
 
     if topo.pod_cidr:
-        llo, lhi = iputil.cidr_to_range(topo.pod_cidr)
+        llo, lhi = iputil.cidr_to_range_v4(topo.pod_cidr)
         local_range = np.array([_flip(llo), _flip(lhi - 1)], np.int32)
     else:
         local_range = np.array([_I32_MAX, _I32_MIN], np.int32)  # empty
@@ -261,6 +271,19 @@ def compile_topology(topo: Topology) -> ForwardingTables:
     mc_ip_f = np.full(Mcap, _I32_MAX, np.int32)
     mc_ip_f[:M] = np.array(mg, np.int32) if M else mc_ip_f[:0]
 
+    # ARP responder set (pipeline.go ARPResponder): gateway + local pods +
+    # remote node IPs — the addresses arp_respond (the scalar spec) answers.
+    arp_set = {u for u in pods}
+    if topo.gateway_ip:
+        arp_set.add(iputil.ip_to_u32(topo.gateway_ip))
+    for nr in topo.remote_nodes:
+        arp_set.add(iputil.ip_to_u32(nr.node_ip))
+    as_f = sorted(_flip(u) for u in arp_set)
+    A = len(as_f)
+    Acap = _cap(A)
+    arp_ip_f = np.full(Acap, _I32_MAX, np.int32)
+    arp_ip_f[:A] = np.array(as_f, np.int32) if A else arp_ip_f[:0]
+
     return ForwardingTables(
         lp_ip_f=lp_ip_f, lp_port=lp_port,
         lp_tc_in=lp_tc_in, lp_tc_eg=lp_tc_eg,
@@ -270,6 +293,8 @@ def compile_topology(topo: Topology) -> ForwardingTables:
         local_range_f=local_range,
         mc_ip_f=mc_ip_f,
         n_mc=np.array([M], np.int32),
+        arp_ip_f=arp_ip_f,
+        n_arp=np.array([A], np.int32),
     )
 
 
@@ -325,12 +350,13 @@ class ResolvedTopology:
     mcast: list = field(default_factory=list)  # [McastGroup], table order
     mcast_idx: dict = field(default_factory=dict)  # group u32 -> idx
     node_ip_by_name: dict = field(default_factory=dict)  # remote node -> u32
+    arp_u32: set = field(default_factory=set)  # ARP-answerable addresses
 
 
 def resolve_topology(topo: Topology) -> ResolvedTopology:
     pod_by_u32 = {iputil.ip_to_u32(ip): port for ip, port in topo.local_pods}
     remote = sorted(
-        iputil.cidr_to_range(nr.pod_cidr) + (iputil.ip_to_u32(nr.node_ip),)
+        iputil.cidr_to_range_v4(nr.pod_cidr) + (iputil.ip_to_u32(nr.node_ip),)
         for nr in topo.remote_nodes
     )
     mg = sorted(
@@ -340,12 +366,17 @@ def resolve_topology(topo: Topology) -> ResolvedTopology:
         pod_by_u32=pod_by_u32,
         pod_by_port={p: u for u, p in pod_by_u32.items()},
         remote=remote,
-        local=iputil.cidr_to_range(topo.pod_cidr) if topo.pod_cidr else None,
+        local=iputil.cidr_to_range_v4(topo.pod_cidr) if topo.pod_cidr else None,
         mcast=[g for _u, g in mg],
         mcast_idx={u: i for i, (u, _g) in enumerate(mg)},
         node_ip_by_name={
             nr.name: iputil.ip_to_u32(nr.node_ip) for nr in topo.remote_nodes
         },
+        arp_u32=(
+            set(pod_by_u32)
+            | ({iputil.ip_to_u32(topo.gateway_ip)} if topo.gateway_ip else set())
+            | {iputil.ip_to_u32(nr.node_ip) for nr in topo.remote_nodes}
+        ),
     )
 
 

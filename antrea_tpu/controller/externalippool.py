@@ -31,7 +31,7 @@ class IPRange:
         reference's ipAllocator does the same, and the agent-side owner
         could not ARP-answer either address anyway."""
         if self.cidr:
-            lo, hi = iputil.cidr_to_range(self.cidr)  # [lo, hi)
+            lo, hi = iputil.cidr_to_range_v4(self.cidr)  # [lo, hi)
             if hi - lo > 2:
                 return lo + 1, hi - 2
             return lo, hi - 1

@@ -19,6 +19,9 @@ from collections import Counter
 from ..apis.controlplane import GroupMember
 from ..compiler.ir import PolicySet
 from ..compiler.topology import (
+    ARP_OP_REQUEST,
+    FWD_ARP_FLOOD,
+    FWD_ARP_REPLY,
     FWD_DROP_SPOOF,
     FWD_LOCAL,
     FWD_GATEWAY,
@@ -311,12 +314,17 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
 
         in_ports = batch.in_ports()
         flags = batch.flags()
+        arp_ops = batch.arp_ops()
         O = self._oracle
         lane_modes = []
         no_commit = []
         for i in range(batch.size):
             if oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i])):
                 lane_modes.append(O.LANE_SPOOF)
+            elif int(arp_ops[i]) > 0:
+                # ARP lanes bypass the IP pipeline (handled in forwarding);
+                # code ALLOW, nothing committed — the punt-lane treatment.
+                lane_modes.append(O.LANE_PUNT)
             elif int(batch.proto[i]) == PROTO_IGMP:
                 lane_modes.append(O.LANE_PUNT)
             else:
@@ -333,7 +341,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             batch, now, gen=self._gen, lane_modes=lane_modes,
             no_commit=no_commit, flags=flags,
         )
-        fwd = self._forward_fields(batch, outs, in_ports, lane_modes)
+        fwd = self._forward_fields(batch, outs, in_ports, lane_modes,
+                                   arp_ops)
         if not self._gates.enabled("NetworkPolicyStats"):
             return self._to_result(outs, fwd)
         for o in outs:
@@ -351,7 +360,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         return self._to_result(outs, fwd)
 
     def _forward_fields(
-        self, batch: PacketBatch, outs, in_ports, lane_modes
+        self, batch: PacketBatch, outs, in_ports, lane_modes, arp_ops=None
     ) -> list[dict]:
         """Per-lane forwarding decision via the scalar spec
         (compiler/topology.oracle_forward + TC resolution), mirroring
@@ -364,6 +373,22 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                              "fwd_kind": FWD_DROP_SPOOF,
                              "out_port": -1, "peer_ip": 0, "dec_ttl": 0,
                              "tc_act": 0, "tc_port": 0, "mcast_idx": -1})
+                continue
+            if arp_ops is not None and int(arp_ops[i]) > 0:
+                # ARPResponder (scalar spec = ResolvedTopology.arp_u32):
+                # answered requests reply out the ingress port; the rest
+                # floods (OFPP_NORMAL).  Spoofed ARP was caught above.
+                answer = (
+                    int(arp_ops[i]) == ARP_OP_REQUEST
+                    and int(batch.dst_ip[i]) in self._rt.arp_u32
+                )
+                rows.append({
+                    "spoofed": 0, "punt": 0,  # answered in the dataplane
+                    "fwd_kind": FWD_ARP_REPLY if answer else FWD_ARP_FLOOD,
+                    "out_port": int(in_ports[i]) if answer else -1,
+                    "peer_ip": 0, "dec_ttl": 0,
+                    "tc_act": 0, "tc_port": 0, "mcast_idx": -1,
+                })
                 continue
             if lane_modes[i] == O.LANE_PUNT:
                 rows.append({"spoofed": 0, "punt": 1, "fwd_kind": FWD_PUNT,

@@ -274,6 +274,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             jnp.int32(now),
             jnp.int32(self._gen),
             jnp.asarray(batch.flags()),
+            # Only materialize the ARP lane when the batch carries ARP —
+            # pure-IP batches keep the round-3 compiled program.
+            jnp.asarray(batch.arp_ops()) if batch.arp_op is not None else None,
             meta=self._meta,
         )
         self._state = state

@@ -1,0 +1,328 @@
+"""Network dissemination wire: the controller<->agent channel over REAL
+mutual-TLS TCP sockets.
+
+The reference's control plane is a protobuf watch over HTTPS with CA-signed
+certificates on both ends (/root/reference/pkg/apiserver/apiserver.go:97-99,
+pkg/apiserver/certificate/; agents authenticate and verify the server).
+This module materializes that wire for the TPU build:
+
+  * X.509 PKI (make_ca / issue_cert, real certificates via `cryptography`)
+    — the wire-level counterpart of the semantic CSR flow in
+    controller/certificates.py;
+  * DisseminationServer: accepts mTLS connections (client certs REQUIRED
+    and verified against the CA), registers a queued span watcher per
+    agent and streams serde-encoded WatchEvents (newline-JSON — the
+    protobuf-role codec of dissemination/serde.py);
+  * the SAME connection carries the agent's realization-status reports
+    upstream ({"status": {...}} frames -> StatusAggregator), the
+    UpdateStatus RPC of status_controller.go:140;
+  * NetAgent: the agent-side client feeding an AgentPolicyController.
+
+Delivery is explicitly pumped (server.pump() / agent.pump()) so tests are
+deterministic; the sockets, handshakes and certificate verification are
+real.  A client without a CA-signed certificate cannot connect; an agent
+refusing the server certificate cannot be fed.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import json
+import os
+import select
+import socket
+import ssl
+import threading
+from typing import Optional
+
+from . import serde
+from .store import RamStore, Watcher
+
+
+# -- PKI ---------------------------------------------------------------------
+
+
+def _write(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def make_ca(dirpath: str, cn: str = "antrea-tpu-ca") -> None:
+    """Create ca.crt/ca.key under dirpath (idempotent)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(dirpath, exist_ok=True)
+    if os.path.exists(os.path.join(dirpath, "ca.crt")):
+        return
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    _write(os.path.join(dirpath, "ca.key"), key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    _write(os.path.join(dirpath, "ca.crt"),
+           cert.public_bytes(serialization.Encoding.PEM))
+
+
+def issue_cert(dirpath: str, cn: str, *, server: bool = False) -> tuple[str, str]:
+    """CA-sign a cert for cn -> (cert path, key path).  Server certs get
+    the 127.0.0.1/localhost SANs the client verifies against."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    with open(os.path.join(dirpath, "ca.key"), "rb") as f:
+        ca_key = serialization.load_pem_private_key(f.read(), None)
+    with open(os.path.join(dirpath, "ca.crt"), "rb") as f:
+        ca_cert = x509.load_pem_x509_certificate(f.read())
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=30))
+    )
+    if server:
+        b = b.add_extension(x509.SubjectAlternativeName([
+            x509.DNSName("localhost"),
+            x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+        ]), critical=False)
+    cert = b.sign(ca_key, hashes.SHA256())
+    cp = os.path.join(dirpath, f"{cn}.crt")
+    kp = os.path.join(dirpath, f"{cn}.key")
+    _write(kp, key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    _write(cp, cert.public_bytes(serialization.Encoding.PEM))
+    return cp, kp
+
+
+# -- framing -----------------------------------------------------------------
+
+
+class _LineConn:
+    """Newline-JSON framing over a (TLS) socket, nonblocking reads."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self._buf = b""
+
+    def send(self, obj: dict) -> None:
+        self.sock.sendall(
+            (json.dumps(obj, separators=(",", ":")) + "\n").encode())
+
+    def recv_ready(self, first_wait: float = 0.0) -> list[dict]:
+        """Drain whatever is available -> decoded frames.  first_wait
+        bounds the wait for the FIRST chunk (loopback TLS records can land
+        an instant after the peer's sendall returns); subsequent reads
+        never block."""
+        out = []
+        wait = first_wait
+        while True:
+            r, _, _ = select.select([self.sock], [], [], wait)
+            wait = 0.0
+            if not r:
+                # TLS may hold decrypted bytes even when the raw socket is
+                # quiet; poll the SSL buffer too.
+                if getattr(self.sock, "pending", lambda: 0)() == 0:
+                    break
+            try:
+                chunk = self.sock.recv(65536)
+            except ssl.SSLWantReadError:
+                break
+            if not chunk:
+                break  # peer closed
+            self._buf += chunk
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            if line:
+                out.append(json.loads(line.decode()))
+        return out
+
+
+# -- server ------------------------------------------------------------------
+
+
+class DisseminationServer:
+    """mTLS dissemination endpoint in front of a RamStore."""
+
+    def __init__(self, store: RamStore, certdir: str, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 status_aggregator=None):
+        self._store = store
+        self._status = status_aggregator
+        cert, key = issue_cert(certdir, "controller", server=True)
+        self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self._ctx.load_cert_chain(cert, key)
+        self._ctx.load_verify_locations(os.path.join(certdir, "ca.crt"))
+        self._ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+        self._lsock = socket.create_server((host, port))
+        self.address = self._lsock.getsockname()
+        # node -> (conn, watcher); handshakes land here from the acceptor.
+        self._conns: dict[str, tuple[_LineConn, Watcher]] = {}
+        self._lock = threading.Lock()
+        self._closing = False
+        # TLS handshakes are inherently concurrent with the client's
+        # connect, so accept+handshake+hello run on a daemon thread (the
+        # reference's apiserver accepts concurrently too); event delivery
+        # and status consumption stay on the explicit pump() for
+        # deterministic tests.
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          daemon=True)
+        self._acceptor.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                raw, _ = self._lsock.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                raw.settimeout(5.0)
+                tls = self._ctx.wrap_socket(raw, server_side=True)
+            except (ssl.SSLError, OSError):
+                raw.close()  # unauthenticated peer: handshake rejected
+                continue
+            try:
+                buf = b""
+                while b"\n" not in buf:
+                    chunk = tls.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                if not buf:
+                    tls.close()
+                    continue
+                line, rest = buf.split(b"\n", 1)
+                hello = json.loads(line.decode())
+                node = hello["hello"]
+            except (ssl.SSLError, OSError, ValueError, KeyError):
+                # Malformed/stalled hello: close the HANDSHAKEN socket (its
+                # fd moved out of `raw` at wrap time).
+                tls.close()
+                continue
+            tls.settimeout(None)
+            tls.setblocking(False)
+            conn = _LineConn(tls)
+            # Frames coalesced into the hello's TLS record (e.g. an eager
+            # status report) must not be dropped.
+            conn._buf = rest
+            with self._lock:
+                self._conns[node] = (conn, self._store.watch_queue(node))
+
+    def wait_connected(self, n: int, timeout: float = 5.0) -> None:
+        """Block until n agents have completed handshake+hello (the
+        acceptor thread registers them asynchronously)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._conns) >= n:
+                    return
+            time.sleep(0.01)
+        raise TimeoutError(f"{n} agents not connected within {timeout}s")
+
+    def pump(self) -> int:
+        """Stream queued events, consume status reports -> events shipped."""
+        shipped = 0
+        with self._lock:
+            conns = list(self._conns.items())
+        for node, (conn, watcher) in conns:
+            conn.sock.setblocking(True)
+            for ev in watcher.drain():
+                conn.send({"ev": serde.encode_event(ev)})
+                shipped += 1
+            conn.sock.setblocking(False)
+        # ONE bounded select across every agent socket (not 50ms per idle
+        # connection serially), then drain only the ready/buffered ones.
+        if conns:
+            ready, _, _ = select.select([c.sock for _n, (c, _w) in conns],
+                                        [], [], 0.05)
+            ready_ids = {id(s) for s in ready}
+            for node, (conn, _w) in conns:
+                if (id(conn.sock) in ready_ids or conn._buf
+                        or conn.sock.pending()):
+                    for frame in conn.recv_ready():
+                        if "status" in frame and self._status is not None:
+                            self._status.update_node_statuses(
+                                node, frame["status"])
+        return shipped
+
+    def close(self) -> None:
+        self._closing = True
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn, watcher in conns:
+            watcher.stop()
+            conn.sock.close()
+        self._lsock.close()
+        self._acceptor.join(timeout=2)
+
+
+# -- agent client ------------------------------------------------------------
+
+
+class NetAgent:
+    """Agent-side client: TLS-verified event stream into an
+    AgentPolicyController + upstream realization reports."""
+
+    def __init__(self, node: str, address, certdir: str, datapath,
+                 client_cn: Optional[str] = None):
+        from ..agent.controller import AgentPolicyController
+
+        cert, key = issue_cert(certdir, client_cn or f"agent-{node}")
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(cert, key)
+        ctx.load_verify_locations(os.path.join(certdir, "ca.crt"))
+        raw = socket.create_connection(tuple(address))
+        self._sock = ctx.wrap_socket(raw, server_hostname="localhost")
+        self._conn = _LineConn(self._sock)
+        self._conn.send({"hello": node})
+        self._sock.setblocking(False)
+        self.node = node
+        self.agent = AgentPolicyController(node, datapath)
+
+    def pump(self, wait: float = 0.5) -> int:
+        n = 0
+        for frame in self._conn.recv_ready(first_wait=wait):
+            if "ev" in frame:
+                self.agent.handle_event(serde.decode_event(frame["ev"]))
+                n += 1
+        return n
+
+    def sync_and_report(self) -> dict:
+        """Reconcile into the datapath, then send the realization report
+        upstream (the UpdateStatus RPC over the same mTLS channel)."""
+        self.agent.sync()
+        realized = self.agent.realized_generations()
+        self._sock.setblocking(True)
+        self._conn.send({"status": realized})
+        self._sock.setblocking(False)
+        return realized
+
+    def close(self) -> None:
+        self._sock.close()

@@ -26,7 +26,10 @@ import jax.numpy as jnp
 
 from ..compiler.compile import ACT_ALLOW, ACT_DROP
 from ..compiler.topology import (
+    ARP_OP_REQUEST,
     FIRST_POD_OFPORT,
+    FWD_ARP_FLOOD,
+    FWD_ARP_REPLY,
     FWD_DROP_MCAST,
     FWD_DROP_SPOOF,
     FWD_DROP_UNKNOWN,
@@ -60,6 +63,8 @@ class DeviceForwardingTables(NamedTuple):
     local_range_f: jax.Array
     mc_ip_f: jax.Array
     n_mc: jax.Array
+    arp_ip_f: jax.Array
+    n_arp: jax.Array
 
 
 def fwd_to_device(ft: ForwardingTables) -> DeviceForwardingTables:
@@ -187,18 +192,29 @@ def _pipeline_step_full(
     now: jax.Array,
     gen: jax.Array,
     flags: jax.Array = None,
+    arp_op: jax.Array = None,
     *,
     meta: pl.PipelineMeta,
     hit_combine=None,
 ):
-    """Full per-packet walk: SpoofGuard -> (IGMP punt) -> policy/service
-    pipeline -> forwarding -> Output; one jit, one dispatch."""
+    """Full per-packet walk: SpoofGuard/ARP -> (IGMP punt) -> policy/
+    service pipeline -> forwarding -> Output; one jit, one dispatch.
+
+    arp_op lanes (ref pipeline.go ARPSpoofGuard/ARPResponder, :114-195):
+    ARP is handled BEFORE the IP pipeline — sender-IP spoof gating via the
+    same port binding, then the responder answers requests for addresses
+    this node owns (gateway/local pods/remote node IPs) back out the
+    ingress port; everything else floods (OFPP_NORMAL).  ARP lanes touch
+    no conntrack/policy state."""
     spoof = spoof_lookup(dft, src_f, in_port)
     # IGMP membership traffic is punted to the controller, never forwarded
     # (ref packetin.go PacketInCategoryIGMP; pkg/agent/multicast snooping):
     # excluded from the policy pipeline like spoofed lanes so reports
     # neither commit conntrack state nor count as policy verdicts.
+    is_arp = (arp_op > 0) if arp_op is not None else None
     igmp = ~spoof & (proto == PROTO_IGMP)
+    if is_arp is not None:
+        igmp = igmp & ~is_arp
     # Multicast data traffic bypasses conntrack (multicast.go): classified
     # every step, never cached.
     is_mc = (dst_f >= MCAST_LO_F) & (dst_f <= MCAST_HI_F)
@@ -210,9 +226,12 @@ def _pipeline_step_full(
         no_commit = no_commit | (
             (proto == pl.PROTO_TCP) & ((flags & pl._TEARDOWN_FLAGS) != 0)
         )
+    valid = ~spoof & ~igmp
+    if is_arp is not None:
+        valid = valid & ~is_arp
     state, out = pl._pipeline_step(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
-        meta=meta, hit_combine=hit_combine, valid=~spoof & ~igmp,
+        meta=meta, hit_combine=hit_combine, valid=valid,
         no_commit=no_commit, flags=flags,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
@@ -224,6 +243,22 @@ def _pipeline_step_full(
     kind = jnp.where(
         spoof, FWD_DROP_SPOOF, jnp.where(igmp, FWD_PUNT, fwd["kind"])
     ).astype(jnp.int32)
+    if is_arp is not None:
+        # ARPResponder: answered requests reply out the ingress port;
+        # unanswered (or reply-opcode) ARP floods.  ARPSpoofGuard already
+        # resolved in `spoof` (sender IP vs port binding).
+        acap = dft.arp_ip_f.shape[0]
+        arow = jnp.clip(jnp.searchsorted(dft.arp_ip_f, dst_f), 0, acap - 1)
+        answer = (
+            is_arp & ~spoof
+            & (arow < dft.n_arp[0]) & (dft.arp_ip_f[arow] == dst_f)
+            & (arp_op == ARP_OP_REQUEST)
+        )
+        kind = jnp.where(
+            is_arp & ~spoof,
+            jnp.where(answer, FWD_ARP_REPLY, FWD_ARP_FLOOD),
+            kind,
+        ).astype(jnp.int32)
     deliverable = (code == ACT_ALLOW) & (
         (kind == FWD_LOCAL) | (kind == FWD_TUNNEL) | (kind == FWD_GATEWAY)
         | (kind == FWD_MCAST)
@@ -235,6 +270,8 @@ def _pipeline_step_full(
     tc_act = tc_w & 3
     tc_port = tc_w >> 2
     out_port = jnp.where(deliverable, fwd["out_port"], -1)
+    if is_arp is not None:
+        out_port = jnp.where(kind == FWD_ARP_REPLY, in_port, out_port)
     # Redirect replaces the output port (ref TrafficControl redirect action:
     # the packet leaves via the target device instead of its computed port).
     out_port = jnp.where(tc_act == TC_REDIRECT, tc_port, out_port)

@@ -60,6 +60,11 @@ class PacketBatch:
     # SYN 0x02, RST 0x04, ACK 0x10); consumed by the conntrack teardown
     # path (models/pipeline.py).  None == all 0 (no teardown signals).
     tcp_flags: np.ndarray = None
+    # ARP lanes (ref pipeline.go ARPSpoofGuard/ARPResponder): 0 = not ARP,
+    # 1 = request, 2 = reply.  For ARP lanes src_ip carries the sender
+    # protocol address (SPA) and dst_ip the target (TPA); ports/proto are
+    # ignored.  None == no ARP traffic.
+    arp_op: np.ndarray = None
     # Dual-stack lane extension (the xxreg3 wide-register analog,
     # fields.go:184-185): (B, 4) u32 per-address word quadruples + the
     # family mask.  None == pure-v4 batch; for v6 lanes the 32-bit
@@ -87,6 +92,12 @@ class PacketBatch:
         if self.tcp_flags is None:
             return np.zeros(self.size, np.int32)
         return self.tcp_flags.astype(np.int32)
+
+    def arp_ops(self) -> np.ndarray:
+        """arp_op column, defaulting to 0 (not ARP)."""
+        if self.arp_op is None:
+            return np.zeros(self.size, np.int32)
+        return self.arp_op.astype(np.int32)
 
     @staticmethod
     def from_packets(packets: list[Packet]) -> "PacketBatch":

@@ -103,6 +103,17 @@ def cidr_to_range(cidr: str) -> tuple[int, int]:
     return lo, lo + size
 
 
+def cidr_to_range_v4(cidr: str) -> tuple[int, int]:
+    """cidr_to_range restricted to IPv4, raising a CLEAR error on v6 input
+    — for consumers whose data plane surface is v4-only (topology pod
+    CIDRs, ExternalIPPool allocation, capture filters, wireguard allowed
+    IPs); the policy/range plane uses the dual-stack cidr_to_range."""
+    if is_v6(cidr):
+        raise ValueError(f"IPv6 CIDR {cidr!r} is not supported here "
+                         "(v4-only surface)")
+    return cidr_to_range(cidr)
+
+
 def merge_ranges(ranges: Iterable[tuple[int, int]]) -> list[tuple[int, int]]:
     """Sort + merge half-open ranges; drops empty (lo >= hi) ranges.
 

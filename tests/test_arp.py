@@ -1,0 +1,94 @@
+"""In-kernel ARP pipeline (round-3 verdict missing #9): ARPSpoofGuard +
+ARPResponder run inside the datapath walk on BOTH engines (ref
+pipeline.go:114-195 ARP tables), not host-side only."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.compiler.topology import (
+    ARP_OP_REPLY,
+    ARP_OP_REQUEST,
+    FWD_ARP_FLOOD,
+    FWD_ARP_REPLY,
+    FWD_DROP_SPOOF,
+    NodeRoute,
+    Topology,
+    arp_respond,
+    mac_of_ip,
+)
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+GW = "10.10.1.1"
+POD = "10.10.1.5"
+POD2 = "10.10.1.6"
+REMOTE_NODE = "172.18.0.9"
+
+
+def _topo():
+    return Topology(
+        node_name="n1", gateway_ip=GW, pod_cidr="10.10.1.0/24",
+        local_pods=[(POD, 3), (POD2, 4)],
+        remote_nodes=[NodeRoute(name="n2", node_ip=REMOTE_NODE,
+                                pod_cidr="10.10.2.0/24")],
+    )
+
+
+def _arp(dp, sender, target, op=ARP_OP_REQUEST, in_port=3, now=1):
+    batch = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(sender)], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(target)], np.uint32),
+        proto=np.array([0], np.int32),
+        src_port=np.array([0], np.int32),
+        dst_port=np.array([0], np.int32),
+        in_port=np.array([in_port], np.int32),
+        arp_op=np.array([op], np.int32),
+    )
+    return dp.step(batch, now)
+
+
+@pytest.mark.parametrize("dp_cls", [TpuflowDatapath, OracleDatapath])
+def test_arp_responder_and_spoofguard(dp_cls):
+    kw = {"miss_chunk": 16} if dp_cls is TpuflowDatapath else {}
+    dp = dp_cls(PolicySet(), [], flow_slots=1 << 8, aff_slots=1 << 4,
+                topology=_topo(), **kw)
+    t = dp.datapath_type
+
+    # Request for the gateway from the pod's own port: answered out in_port.
+    r = _arp(dp, POD, GW)
+    assert int(r.fwd_kind[0]) == FWD_ARP_REPLY, t
+    assert int(r.out_port[0]) == 3, t
+    assert int(r.punt[0]) == 0 and int(r.code[0]) == 0, t
+
+    # Remote node IPs and local pods are answerable too.
+    assert int(_arp(dp, POD, REMOTE_NODE, now=2).fwd_kind[0]) == FWD_ARP_REPLY, t
+    assert int(_arp(dp, POD, POD2, now=3).fwd_kind[0]) == FWD_ARP_REPLY, t
+
+    # Unknown target: flood (OFPP_NORMAL), no reply port.
+    r = _arp(dp, POD, "10.10.1.99", now=4)
+    assert int(r.fwd_kind[0]) == FWD_ARP_FLOOD and int(r.out_port[0]) == -1, t
+
+    # Reply opcode is never answered by the responder.
+    assert int(_arp(dp, POD, GW, op=ARP_OP_REPLY, now=5).fwd_kind[0]) \
+        == FWD_ARP_FLOOD, t
+
+    # ARPSpoofGuard: sender IP not bound to the ingress port -> drop.
+    r = _arp(dp, POD2, GW, in_port=3, now=6)
+    assert int(r.fwd_kind[0]) == FWD_DROP_SPOOF, t
+    assert int(r.spoofed[0]) == 1, t
+
+    # ARP lanes touch no conntrack state.
+    assert dp.cache_stats()["occupied"] == 0, t
+
+
+def test_arp_mac_resolution_matches_spec():
+    """The reply MAC comes from the deterministic scheme both datapaths and
+    restarted agents share (mac_of_ip); arp_respond is the host surface."""
+    topo = _topo()
+    assert arp_respond(topo, GW) == mac_of_ip(GW)
+    assert arp_respond(topo, REMOTE_NODE) == mac_of_ip(REMOTE_NODE)
+    assert arp_respond(topo, "10.10.1.99") is None

@@ -72,7 +72,7 @@ def test_named_tiers_resolve_and_order():
     ctl.upsert_antrea_policy(_anp("drop-urgent", tier="urgent"))
     assert _probe(ctl) == 1
     # Unknown tier is a config error.
-    with pytest.raises(ValueError, match="unknown tier"):
+    with pytest.raises(ValueError, match="does not exist"):
         ctl.upsert_antrea_policy(_anp("x", tier="nope"))
     # A referenced tier refuses deletion; a tier priority change re-sorts.
     with pytest.raises(ValueError, match="referenced"):
@@ -99,7 +99,7 @@ def test_cluster_groups_resolve_union_and_update():
     assert _probe(ctl, src="10.0.0.99") == 0  # not in the union
 
     # Unknown group is an error; deletion of a referenced group refuses.
-    with pytest.raises(ValueError, match="unknown ClusterGroup"):
+    with pytest.raises(ValueError, match="does not exist"):
         ctl.upsert_antrea_policy(_anp("y", peer=AntreaPeer(group="ghost")))
     with pytest.raises(ValueError, match="referenced"):
         ctl.delete_cluster_group("clients")
