@@ -71,11 +71,20 @@ def _cmd_get(args) -> int:
                 "antctl: services is snapshot-only (--state); the live "
                 "agent serves the installed frontends via ovsflows/cache"
             )
+        # policystatus/controllerinfo are served by the CONTROLLER api
+        # server (controller/apiserver.py) — same fetch path, the kind is
+        # simply a controller route (realization phases per policy,
+        # status_controller.go analog).
         print(json.dumps(json.loads(_fetch(args.server, "/" + args.kind)),
                          indent=2))
         return 0
     if args.state is None:
         raise SystemExit("antctl: get needs --state or --server")
+    if args.kind in ("policystatus", "controllerinfo"):
+        raise SystemExit(
+            f"antctl: {args.kind} is only served live by the controller "
+            "api server (--server)"
+        )
     if args.kind not in (
         "networkpolicies", "addressgroups", "appliedtogroups", "services"
     ):
@@ -267,6 +276,8 @@ def main(argv=None) -> int:
         "networkpolicies", "addressgroups", "appliedtogroups", "services",
         "podinterfaces", "ovsflows", "memberlist", "featuregates",
         "agentinfo", "cache",
+        # live-CONTROLLER kinds (--server points at a ControllerApiServer):
+        "policystatus", "controllerinfo",
     ])
     g.add_argument("--state", help="datapath persist dir")
     g.add_argument("--server", help="live agent API base URL")

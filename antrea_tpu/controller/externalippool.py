@@ -26,16 +26,19 @@ class IPRange:
     end: str = ""
 
     def bounds(self) -> tuple[int, int]:
-        """-> [lo, hi] inclusive u32 bounds.  CIDR form excludes the network
-        and broadcast addresses (for prefixes shorter than /31) — the
-        reference's ipAllocator does the same, and the agent-side owner
-        could not ARP-answer either address anyway."""
+        """-> [lo, hi] inclusive COMBINED-keyspace bounds (utils/ip.py —
+        pools are dual-stack like the reference's ipAllocator).  v4 CIDRs
+        exclude the network and broadcast addresses (prefixes < /31); v6
+        CIDRs exclude the network (subnet-router anycast) address only —
+        IPv6 has no broadcast."""
         if self.cidr:
-            lo, hi = iputil.cidr_to_range_v4(self.cidr)  # [lo, hi)
+            lo, hi = iputil.cidr_to_range(self.cidr)  # [lo, hi)
+            if iputil.is_v6(self.cidr):
+                return (lo + 1, hi - 1) if hi - lo > 1 else (lo, hi - 1)
             if hi - lo > 2:
                 return lo + 1, hi - 2
             return lo, hi - 1
-        lo, hi = iputil.ip_to_u32(self.start), iputil.ip_to_u32(self.end)
+        lo, hi = iputil.ip_to_key(self.start), iputil.ip_to_key(self.end)
         if hi < lo:
             raise ValueError(f"range end {self.end} before start {self.start}")
         return lo, hi
@@ -57,9 +60,9 @@ class PoolExhaustedError(Exception):
 class ExternalIPPoolController:
     def __init__(self):
         self._pools: dict[str, ExternalIPPool] = {}
-        # pool -> {ip_u32 -> owner}
+        # pool -> {ip key -> owner} (combined keyspace int)
         self._alloc: dict[str, dict[int, str]] = {}
-        # pool -> rolling next-candidate u32 (O(1) amortized sequential
+        # pool -> rolling next-candidate position (O(1) amortized sequential
         # allocation — the same wrap-around-cursor discipline as
         # agent/cni.HostLocalIPAM; exhaustion is a count check, never a
         # range scan).
@@ -76,15 +79,15 @@ class ExternalIPPoolController:
             if b[0] <= a[1]:
                 raise ValueError(
                     f"pool {pool.name}: overlapping ipRanges "
-                    f"{iputil.u32_to_ip(a[0])}-{iputil.u32_to_ip(a[1])} and "
-                    f"{iputil.u32_to_ip(b[0])}-{iputil.u32_to_ip(b[1])}"
+                    f"{iputil.key_to_ip(a[0])}-{iputil.key_to_ip(a[1])} and "
+                    f"{iputil.key_to_ip(b[0])}-{iputil.key_to_ip(b[1])}"
                 )
         used = self._alloc.get(pool.name, {})
         for ip in used:
             if not any(lo <= ip <= hi for lo, hi in bounds):
                 raise ValueError(
                     f"pool {pool.name}: range update strands allocated "
-                    f"{iputil.u32_to_ip(ip)}"
+                    f"{iputil.key_to_ip(ip)}"
                 )
         self._pools[pool.name] = pool
         self._alloc.setdefault(pool.name, {})
@@ -105,13 +108,13 @@ class ExternalIPPoolController:
         table = self._alloc[pool_name]
         held = next((u for u, o in table.items() if o == owner), None)
         if held is not None:
-            if ip is not None and iputil.ip_to_u32(ip) != held:
+            if ip is not None and iputil.ip_to_key(ip) != held:
                 raise ValueError(
-                    f"{owner} already holds {iputil.u32_to_ip(held)}"
+                    f"{owner} already holds {iputil.key_to_ip(held)}"
                 )
-            return iputil.u32_to_ip(held)
+            return iputil.key_to_ip(held)
         if ip is not None:
-            u = iputil.ip_to_u32(ip)
+            u = iputil.ip_to_key(ip)
             if not any(lo <= u <= hi for lo, hi in
                        (r.bounds() for r in pool.ip_ranges)):
                 raise ValueError(f"{ip} outside pool {pool_name}")
@@ -127,15 +130,15 @@ class ExternalIPPoolController:
         # walk terminates after skipping the (bounded) allocated run.
         flat_pos = self._cursor.get(pool_name, 0) % total
         while True:
-            u = self._flat_to_u32(bounds, flat_pos)
+            u = self._flat_to_key(bounds, flat_pos)
             flat_pos = (flat_pos + 1) % total
             if u not in table:
                 table[u] = owner
                 self._cursor[pool_name] = flat_pos
-                return iputil.u32_to_ip(u)
+                return iputil.key_to_ip(u)
 
     @staticmethod
-    def _flat_to_u32(bounds: list, pos: int) -> int:
+    def _flat_to_key(bounds: list, pos: int) -> int:
         for lo, hi in bounds:
             n = hi - lo + 1
             if pos < n:
@@ -148,7 +151,7 @@ class ExternalIPPoolController:
         for u, o in list(table.items()):
             if o == owner:
                 del table[u]
-                return iputil.u32_to_ip(u)
+                return iputil.key_to_ip(u)
         return None
 
     def usage(self, pool_name: str) -> dict:

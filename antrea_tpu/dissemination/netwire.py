@@ -219,6 +219,18 @@ class DisseminationServer:
                 line, rest = buf.split(b"\n", 1)
                 hello = json.loads(line.decode())
                 node = hello["hello"]
+                # Bind the VERIFIED certificate identity to the claimed
+                # node: a CA-signed cert for agent-X must not register as
+                # node Y (the mutual-TLS authentication contract — antrea's
+                # apiserver authenticates agents by identity, not just by
+                # holding any cluster cert).
+                cert = tls.getpeercert()
+                cns = [v for rdn in cert.get("subject", ())
+                       for k, v in rdn if k == "commonName"]
+                if cns != [f"agent-{node}"]:
+                    raise ValueError(
+                        f"cert identity {cns} does not match node {node!r}"
+                    )
             except (ssl.SSLError, OSError, ValueError, KeyError):
                 # Malformed/stalled hello: close the HANDSHAKEN socket (its
                 # fd moved out of `raw` at wrap time).
@@ -231,7 +243,16 @@ class DisseminationServer:
             # status report) must not be dropped.
             conn._buf = rest
             with self._lock:
+                old = self._conns.pop(node, None)
                 self._conns[node] = (conn, self._store.watch_queue(node))
+            if old is not None:
+                # Reconnect: retire the previous registration — an
+                # un-stopped watcher would buffer events forever.
+                old[1].stop()
+                try:
+                    old[0].sock.close()
+                except OSError:
+                    pass
 
     def wait_connected(self, n: int, timeout: float = 5.0) -> None:
         """Block until n agents have completed handshake+hello (the
@@ -251,25 +272,49 @@ class DisseminationServer:
         shipped = 0
         with self._lock:
             conns = list(self._conns.items())
+        dead: list[str] = []
+        live = []
         for node, (conn, watcher) in conns:
-            conn.sock.setblocking(True)
-            for ev in watcher.drain():
-                conn.send({"ev": serde.encode_event(ev)})
-                shipped += 1
-            conn.sock.setblocking(False)
+            try:
+                conn.sock.setblocking(True)
+                for ev in watcher.drain():
+                    conn.send({"ev": serde.encode_event(ev)})
+                    shipped += 1
+                conn.sock.setblocking(False)
+                live.append((node, conn))
+            except (OSError, ssl.SSLError, ValueError):
+                # One dead agent must not halt dissemination to the rest:
+                # prune it (its events stay in the STORE's history; a
+                # reconnect replays).
+                dead.append(node)
         # ONE bounded select across every agent socket (not 50ms per idle
         # connection serially), then drain only the ready/buffered ones.
-        if conns:
-            ready, _, _ = select.select([c.sock for _n, (c, _w) in conns],
-                                        [], [], 0.05)
+        if live:
+            try:
+                ready, _, _ = select.select([c.sock for _n, c in live],
+                                            [], [], 0.05)
+            except (OSError, ValueError):
+                ready = [c.sock for _n, c in live]  # sort out per-conn below
             ready_ids = {id(s) for s in ready}
-            for node, (conn, _w) in conns:
-                if (id(conn.sock) in ready_ids or conn._buf
-                        or conn.sock.pending()):
-                    for frame in conn.recv_ready():
-                        if "status" in frame and self._status is not None:
-                            self._status.update_node_statuses(
-                                node, frame["status"])
+            for node, conn in live:
+                try:
+                    if (id(conn.sock) in ready_ids or conn._buf
+                            or conn.sock.pending()):
+                        for frame in conn.recv_ready():
+                            if "status" in frame and self._status is not None:
+                                self._status.update_node_statuses(
+                                    node, frame["status"])
+                except (OSError, ssl.SSLError, ValueError):
+                    dead.append(node)
+        for node in dead:
+            with self._lock:
+                entry = self._conns.pop(node, None)
+            if entry is not None:
+                entry[1].stop()
+                try:
+                    entry[0].sock.close()
+                except OSError:
+                    pass
         return shipped
 
     def close(self) -> None:

@@ -247,18 +247,19 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
         )
     else:
         def body(state, drs, dsvc, dft, src_f, dst_f, proto, sport,
-                 dport, in_port, flags, now, gen):
+                 dport, in_port, flags, arp_op, now, gen):
             local = jax.tree.map(lambda x: x[0], state)
             local, out = fw._pipeline_step_full(
                 local, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
-                in_port, now, gen, flags, meta=meta, hit_combine=_pmin_rule,
+                in_port, now, gen, flags, arp_op,
+                meta=meta, hit_combine=_pmin_rule,
             )
             return finish(local, out)
 
         in_specs = (
             _state_specs(), _drs_specs(), _svc_specs(), _fwd_specs(),
             P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA),
-            P(), P(),
+            P(DATA), P(), P(),
         )
 
     step = jax.jit(jax.shard_map(
@@ -313,7 +314,9 @@ def make_sharded_pipeline_full(
     over (data, rule) — the production multi-chip step.
 
     -> (step, state, (drs, dsvc, dft)); step(state, drs, dsvc, dft, src_f,
-    dst_f, proto, sport, dport, in_port, now, gen) -> (state', out).
+    dst_f, proto, sport, dport, in_port, flags, arp_op, now, gen) ->
+    (state', out) — flags/arp_op are the TCP-teardown and ARP lane columns
+    (zeros when absent), sharded over data like the rest of the batch.
     Forwarding is stateless per-packet, so it shards trivially over the
     data axis with replicated topology tables; the rule axis participates
     only in the classification pmin, exactly as in make_sharded_pipeline.

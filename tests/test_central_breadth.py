@@ -258,3 +258,32 @@ def test_node_latency_monitor():
     assert by["node-c"]["lastMeasuredRTT"] is None
     mon.delete_peer("node-c")
     assert len(mon.report()["peerNodeLatencyStats"]) == 1
+
+
+def test_externalippool_ipv6_ranges():
+    """Dual-stack ExternalIPPool (the reference's ipAllocator handles v6
+    ranges): allocation, pinning, release and usage over a v6 CIDR; the
+    network (anycast) address is excluded; v4 pools unchanged."""
+    from antrea_tpu.controller.externalippool import (
+        ExternalIPPool, ExternalIPPoolController, IPRange,
+    )
+
+    c = ExternalIPPoolController()
+    c.upsert(ExternalIPPool(name="p6", ip_ranges=[
+        IPRange(cidr="2001:db8:ee::/126"),
+        IPRange(start="2001:db8:ff::10", end="2001:db8:ff::11"),
+    ]))
+    got = [c.allocate("p6", f"o{i}") for i in range(5)]
+    assert got == [
+        "2001:db8:ee::1", "2001:db8:ee::2", "2001:db8:ee::3",
+        "2001:db8:ff::10", "2001:db8:ff::11",
+    ]
+    import pytest as _pytest
+    from antrea_tpu.controller.externalippool import PoolExhaustedError
+
+    with _pytest.raises(PoolExhaustedError):
+        c.allocate("p6", "overflow")
+    assert c.usage("p6") == {"total": 5, "used": 5}
+    assert c.release("p6", "o0") == "2001:db8:ee::1"
+    # Pinned v6 allocation.
+    assert c.allocate("p6", "pin", ip="2001:db8:ee::1") == "2001:db8:ee::1"

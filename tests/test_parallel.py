@@ -161,7 +161,8 @@ def test_sharded_full_walk_matches_single(cluster):
         )
         stN, outN = stepN(
             stN, drsN, dsvcN, dftN, src_f, dst_f, proto, sport, dport,
-            in_port, flags, jnp.int32(1000 + t), jnp.int32(0),
+            in_port, flags, np.zeros_like(flags), jnp.int32(1000 + t),
+            jnp.int32(0),
         )
         for k in ("code", "est", "spoofed", "fwd_kind", "out_port",
                   "peer_f", "dec_ttl", "mcast_idx", "dnat_ip_f"):
