@@ -407,3 +407,70 @@ def test_v6_group_delta_forces_recompile_both_datapaths():
             jnp.asarray(b.is6)),
     )
     assert int(np.asarray(out["code"])[0]) == ACT_DROP  # new member matches
+
+
+def test_dual_stack_randomized_differential():
+    """Randomized mixed-family conntrack fuzz: 6 steps of 96-packet batches
+    from a small flow universe (forward + reply + teardown mixes, policy
+    drops, a service, a gen bump mid-run) — device and oracle must agree
+    lane-for-lane on code/est/reply/committed/svc."""
+    import numpy as np
+    from antrea_tpu.apis.service import Endpoint, ServiceEntry
+    from antrea_tpu.models.pipeline import TCP_FIN
+
+    rng = np.random.default_rng(7)
+    v4_hosts = [f"10.7.{i}.{j}" for i in range(2) for j in range(1, 5)]
+    v6_hosts = [f"2001:db8:7:{i}::{j}" for i in range(2) for j in range(1, 5)]
+    svc = ServiceEntry(cluster_ip="10.96.7.1", port=80, protocol=6,
+                       endpoints=[Endpoint(v4_hosts[0], 8080)])
+    ps = _dual_ps()
+    step, state, drs, dsvc, po = _mk_dual(ps, [svc])
+
+    # Flow universe: 24 tuples, both families + some service flows.
+    flows = []
+    for _ in range(24):
+        fam6 = rng.random() < 0.5
+        hosts = v6_hosts if fam6 else v4_hosts
+        s, d = rng.choice(hosts, 2, replace=False)
+        if not fam6 and rng.random() < 0.3:
+            d = "10.96.7.1"  # v4 service frontend
+        flows.append(Packet(
+            src_ip=iputil.ip_to_key(str(s)), dst_ip=iputil.ip_to_key(str(d)),
+            proto=6, src_port=int(rng.integers(40000, 40020)), dst_port=80))
+
+    gen = 0
+    for t in range(6):
+        if t == 3:
+            gen = 1  # bundle commit mid-run: denials revalidate
+        idx = rng.integers(0, len(flows), 96)
+        pkts = []
+        for i in idx:
+            f = flows[i]
+            if rng.random() < 0.3:  # reply direction
+                f = Packet(f.dst_ip, f.src_ip, 6, f.dst_port, f.src_port)
+            pkts.append(f)
+        batch = PacketBatch.from_packets(pkts)
+        batch.tcp_flags = (rng.random(96) < 0.05).astype(np.int32) * TCP_FIN
+        v6 = (jnp.asarray(flip_ips(batch.src_ip6)),
+              jnp.asarray(flip_ips(batch.dst_ip6)),
+              jnp.asarray(batch.is6)) if batch.is6 is not None else None
+        state, out = pl.pipeline_step(
+            state, drs, dsvc,
+            jnp.asarray(flip_ips(batch.src_ip)),
+            jnp.asarray(flip_ips(batch.dst_ip)),
+            jnp.asarray(batch.proto.astype(np.int32)),
+            jnp.asarray(batch.src_port.astype(np.int32)),
+            jnp.asarray(batch.dst_port.astype(np.int32)),
+            jnp.int32(10 + t), jnp.int32(gen), meta=step.meta, v6=v6,
+            flags=jnp.asarray(batch.flags()),
+        )
+        outs = po.step(batch, 10 + t, gen=gen, flags=batch.flags())
+        dev = {k: np.asarray(v) for k, v in out.items()}
+        for i, o in enumerate(outs):
+            ctx = (t, i, iputil.key_to_ip(pkts[i].src_ip),
+                   iputil.key_to_ip(pkts[i].dst_ip))
+            assert int(dev["code"][i]) == o.code, (ctx, "code")
+            assert int(dev["est"][i]) == int(o.est), (ctx, "est")
+            assert int(dev["reply"][i]) == int(o.reply), (ctx, "reply")
+            assert int(dev["committed"][i]) == int(o.committed), (ctx, "com")
+            assert int(dev["svc_idx"][i]) == o.svc_idx, (ctx, "svc")
