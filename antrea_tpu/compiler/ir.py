@@ -80,15 +80,20 @@ class PolicySet:
         return False
 
 
-def _resolve_member(m, service) -> Optional[int]:
-    """Member's numeric port for a named service, or None (no such port —
-    the member cannot match; K8s named-port semantics)."""
-    for name, port, proto in m.ports:
-        if name == service.port_name and (
-            service.protocol is None or proto == service.protocol
-        ):
-            return int(port)
-    return None
+def _resolve_member(m, service) -> list:
+    """All (numeric port, protocol) resolutions of a named service for a
+    member (empty -> no such port — the member cannot match; K8s
+    named-port semantics).  A protocol-less service resolves per
+    (name, protocol) pair: a member exposing e.g. dns/TCP and dns/UDP on
+    different numbers yields both, each expanded into a
+    protocol-narrowed rule (the reference resolves named ports per
+    (name, protocol) pair per member)."""
+    return [
+        (int(port), proto)
+        for name, port, proto in m.ports
+        if name == service.port_name
+        and (service.protocol is None or proto == service.protocol)
+    ]
 
 
 def resolve_named_ports(ps: PolicySet) -> PolicySet:
@@ -130,35 +135,35 @@ def resolve_named_ports(ps: PolicySet) -> PolicySet:
         applied_to_groups=dict(ps.applied_to_groups),
     )
 
-    def narrowed_atg(group_names: list, service, value: int) -> Optional[str]:
+    def narrowed_atg(group_names: list, service, value: int, proto):
         members = [
             m
             for gn in group_names
             for m in (ps.applied_to_groups.get(gn).members
                       if ps.applied_to_groups.get(gn) else [])
-            if _resolve_member(m, service) == value
+            if (value, proto) in _resolve_member(m, service)
         ]
         if not members:
             return None
         key = (f"{'+'.join(group_names)}#np:{service.port_name}"
-               f"/{service.protocol}={value}")
+               f"/{proto}={value}")
         out.applied_to_groups.setdefault(
             key, AppliedToGroup(name=key, members=members)
         )
         return key
 
-    def narrowed_peer(peer: NetworkPolicyPeer, service, value: int):
+    def narrowed_peer(peer: NetworkPolicyPeer, service, value: int, proto):
         members = [
             m
             for gn in peer.address_groups
             for m in (ps.address_groups.get(gn).members
                       if ps.address_groups.get(gn) else [])
-            if _resolve_member(m, service) == value
+            if (value, proto) in _resolve_member(m, service)
         ]
         if not members:
             return None
         key = (f"{'+'.join(peer.address_groups)}#np:{service.port_name}"
-               f"/{service.protocol}={value}")
+               f"/{proto}={value}")
         out.address_groups.setdefault(
             key, AddressGroup(name=key, members=members)
         )
@@ -190,22 +195,23 @@ def resolve_named_ports(ps: PolicySet) -> PolicySet:
                         for m in (ps.address_groups.get(gn).members
                                   if ps.address_groups.get(gn) else [])
                     ]
-                values = sorted({
-                    v for m in src_members
-                    if (v := _resolve_member(m, s)) is not None
-                })
-                for v in values:
-                    resolved = Service(protocol=s.protocol, port=v)
+                values = sorted(
+                    {pair for m in src_members
+                     for pair in _resolve_member(m, s)},
+                    key=lambda vp: (vp[0], str(vp[1])),
+                )
+                for v, proto in values:
+                    resolved = Service(protocol=proto, port=v)
                     if r.direction == Direction.IN:
                         groups = r.applied_to_groups or p.applied_to_groups
-                        key = narrowed_atg(groups, s, v)
+                        key = narrowed_atg(groups, s, v, proto)
                         if key is None:
                             continue
                         new_rules.append(replace_rule(
                             r, services=[resolved], applied_to_groups=[key]
                         ))
                     else:
-                        np_peer = narrowed_peer(r.to_peer, s, v)
+                        np_peer = narrowed_peer(r.to_peer, s, v, proto)
                         if np_peer is None:
                             continue
                         new_rules.append(replace_rule(

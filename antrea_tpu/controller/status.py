@@ -7,7 +7,11 @@ report, per policy, the spec GENERATION they have realized on their node
 against the internal store's current generation + node span (syncHandler,
 :270) into a per-policy status:
 
-    phase                Pending / Realizing / Realized / Failed
+    phase                Realizing / Realized / Failed (Pending is
+                         reserved for a future unprocessed-policy state;
+                         every policy in the realization view is already
+                         processed, and a processed zero-span policy is
+                         Realized — status_controller.go:303-343)
     observed_generation  the spec generation the status describes
     current_nodes        nodes that realized the CURRENT generation
     desired_nodes        the policy's span size
@@ -26,7 +30,7 @@ from dataclasses import dataclass, field
 
 from .networkpolicy import NetworkPolicyController
 
-PHASE_PENDING = "Pending"
+PHASE_PENDING = "Pending"  # reserved: see module docstring
 PHASE_REALIZING = "Realizing"
 PHASE_REALIZED = "Realized"
 PHASE_FAILED = "Failed"
@@ -118,7 +122,11 @@ class StatusAggregator:
                     current += 1
         desired = len(span)
         if desired == 0:
-            phase = PHASE_PENDING
+            # A processed policy with a zero-node span is fully realized
+            # (nothing to install anywhere): syncHandler yields Realized
+            # when currentNodes == desiredNodes == 0 and reserves Pending
+            # for unprocessed policies (status_controller.go:303-343).
+            phase = PHASE_REALIZED
         elif current == desired:
             phase = PHASE_REALIZED
         elif current + len(failed) == desired and failed:

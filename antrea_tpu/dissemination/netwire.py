@@ -181,10 +181,18 @@ class DisseminationServer:
         self._ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
         self._lsock = socket.create_server((host, port))
         self.address = self._lsock.getsockname()
-        # node -> (conn, watcher); handshakes land here from the acceptor.
-        self._conns: dict[str, tuple[_LineConn, Watcher]] = {}
+        # node -> (conn, watcher, seq); handshakes land here from the
+        # acceptor.  seq is the ACCEPT order: concurrent handshake threads
+        # may finish out of order, and a stale connection finishing last
+        # must never evict the agent's newer live one.
+        self._conns: dict[str, tuple[_LineConn, Watcher, int]] = {}
         self._lock = threading.Lock()
         self._closing = False
+        self._accept_seq = 0
+        # Any peer that can reach the listener gets a handshake thread
+        # BEFORE authenticating; bound them so a raw-TCP flood cannot
+        # accumulate threads without limit.
+        self._handshakes = threading.Semaphore(32)
         # TLS handshakes are inherently concurrent with the client's
         # connect, so accept+handshake+hello run on a daemon thread (the
         # reference's apiserver accepts concurrently too); event delivery
@@ -200,59 +208,98 @@ class DisseminationServer:
                 raw, _ = self._lsock.accept()
             except OSError:
                 return  # listener closed
-            try:
-                raw.settimeout(5.0)
-                tls = self._ctx.wrap_socket(raw, server_side=True)
-            except (ssl.SSLError, OSError):
-                raw.close()  # unauthenticated peer: handshake rejected
+            # A slow or malicious peer (even certless) must not stall
+            # registration of every other agent for its 5s timeout:
+            # handshake+hello run on a short-lived per-connection thread;
+            # only registration takes the lock.
+            if not self._handshakes.acquire(blocking=False):
+                raw.close()  # at capacity: shed before spending a thread
                 continue
-            try:
-                buf = b""
-                while b"\n" not in buf:
-                    chunk = tls.recv(4096)
-                    if not chunk:
-                        break
-                    buf += chunk
-                if not buf:
-                    tls.close()
-                    continue
-                line, rest = buf.split(b"\n", 1)
-                hello = json.loads(line.decode())
-                node = hello["hello"]
-                # Bind the VERIFIED certificate identity to the claimed
-                # node: a CA-signed cert for agent-X must not register as
-                # node Y (the mutual-TLS authentication contract — antrea's
-                # apiserver authenticates agents by identity, not just by
-                # holding any cluster cert).
-                cert = tls.getpeercert()
-                cns = [v for rdn in cert.get("subject", ())
-                       for k, v in rdn if k == "commonName"]
-                if cns != [f"agent-{node}"]:
-                    raise ValueError(
-                        f"cert identity {cns} does not match node {node!r}"
-                    )
-            except (ssl.SSLError, OSError, ValueError, KeyError):
-                # Malformed/stalled hello: close the HANDSHAKEN socket (its
-                # fd moved out of `raw` at wrap time).
+            self._accept_seq += 1
+            threading.Thread(
+                target=self._handshake, args=(raw, self._accept_seq),
+                daemon=True,
+            ).start()
+
+    def _handshake(self, raw, seq: int) -> None:
+        try:
+            self._handshake_inner(raw, seq)
+        finally:
+            self._handshakes.release()
+
+    def _handshake_inner(self, raw, seq: int) -> None:
+        try:
+            raw.settimeout(5.0)
+            tls = self._ctx.wrap_socket(raw, server_side=True)
+        except (ssl.SSLError, OSError):
+            raw.close()  # unauthenticated peer: handshake rejected
+            return
+        try:
+            buf = b""
+            while b"\n" not in buf:
+                if len(buf) > 65536:
+                    # A certified peer streaming newline-less bytes must
+                    # not grow the hello buffer without bound (each recv
+                    # resets the per-op timeout): reject.
+                    raise ValueError("hello line exceeds 64KiB")
+                chunk = tls.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            if not buf:
                 tls.close()
-                continue
-            tls.settimeout(None)
-            tls.setblocking(False)
-            conn = _LineConn(tls)
-            # Frames coalesced into the hello's TLS record (e.g. an eager
-            # status report) must not be dropped.
-            conn._buf = rest
-            with self._lock:
-                old = self._conns.pop(node, None)
-                self._conns[node] = (conn, self._store.watch_queue(node))
-            if old is not None:
-                # Reconnect: retire the previous registration — an
-                # un-stopped watcher would buffer events forever.
-                old[1].stop()
-                try:
-                    old[0].sock.close()
-                except OSError:
-                    pass
+                return
+            line, rest = buf.split(b"\n", 1)
+            hello = json.loads(line.decode())
+            node = hello["hello"]
+            # Bind the VERIFIED certificate identity to the claimed
+            # node: a CA-signed cert for agent-X must not register as
+            # node Y (the mutual-TLS authentication contract — antrea's
+            # apiserver authenticates agents by identity, not just by
+            # holding any cluster cert).
+            cert = tls.getpeercert()
+            cns = [v for rdn in cert.get("subject", ())
+                   for k, v in rdn if k == "commonName"]
+            if cns != [f"agent-{node}"]:
+                raise ValueError(
+                    f"cert identity {cns} does not match node {node!r}"
+                )
+        except (ssl.SSLError, OSError, ValueError, KeyError, TypeError):
+            # Malformed/stalled hello (TypeError: valid JSON that is not
+            # an object): close the HANDSHAKEN socket (its fd moved out
+            # of `raw` at wrap time).
+            tls.close()
+            return
+        tls.settimeout(None)
+        tls.setblocking(False)
+        conn = _LineConn(tls)
+        # Frames coalesced into the hello's TLS record (e.g. an eager
+        # status report) must not be dropped.
+        conn._buf = rest
+        with self._lock:
+            if self._closing:
+                # close() already snapshotted _conns: registering now
+                # would leak an un-stopped watcher that buffers store
+                # events forever plus an open TLS socket.
+                tls.close()
+                return
+            old = self._conns.get(node)
+            if old is not None and old[2] > seq:
+                # A NEWER connection for this node already registered
+                # (this thread's hello was slower): this one is stale —
+                # evicting the live registration would stream to a socket
+                # the agent abandoned.
+                tls.close()
+                return
+            self._conns[node] = (conn, self._store.watch_queue(node), seq)
+        if old is not None:
+            # Reconnect: retire the previous registration — an
+            # un-stopped watcher would buffer events forever.
+            old[1].stop()
+            try:
+                old[0].sock.close()
+            except OSError:
+                pass
 
     def wait_connected(self, n: int, timeout: float = 5.0) -> None:
         """Block until n agents have completed handshake+hello (the
@@ -272,11 +319,14 @@ class DisseminationServer:
         shipped = 0
         with self._lock:
             conns = list(self._conns.items())
-        dead: list[str] = []
+        dead: list[tuple[str, _LineConn]] = []
         live = []
-        for node, (conn, watcher) in conns:
+        for node, (conn, watcher, _seq) in conns:
             try:
-                conn.sock.setblocking(True)
+                # Bounded send: an agent that stopped reading (full TCP
+                # buffer) must not block the pump forever — a timed-out
+                # sendall raises and the agent is pruned as dead.
+                conn.sock.settimeout(2.0)
                 for ev in watcher.drain():
                     conn.send({"ev": serde.encode_event(ev)})
                     shipped += 1
@@ -286,7 +336,7 @@ class DisseminationServer:
                 # One dead agent must not halt dissemination to the rest:
                 # prune it (its events stay in the STORE's history; a
                 # reconnect replays).
-                dead.append(node)
+                dead.append((node, conn))
         # ONE bounded select across every agent socket (not 50ms per idle
         # connection serially), then drain only the ready/buffered ones.
         if live:
@@ -305,23 +355,39 @@ class DisseminationServer:
                                 self._status.update_node_statuses(
                                     node, frame["status"])
                 except (OSError, ssl.SSLError, ValueError):
-                    dead.append(node)
-        for node in dead:
+                    dead.append((node, conn))
+        for node, failed_conn in dead:
             with self._lock:
-                entry = self._conns.pop(node, None)
+                entry = self._conns.get(node)
+                # Identity-aware prune: if the node RECONNECTED between
+                # our snapshot and now, the registered entry is a fresh
+                # healthy connection — tearing it down by name would
+                # disconnect a live agent.
+                if entry is None or entry[0] is not failed_conn:
+                    entry = None
+                else:
+                    del self._conns[node]
             if entry is not None:
                 entry[1].stop()
                 try:
                     entry[0].sock.close()
                 except OSError:
                     pass
+            else:
+                try:
+                    failed_conn.sock.close()
+                except OSError:
+                    pass
         return shipped
 
     def close(self) -> None:
-        self._closing = True
         with self._lock:
+            # Flag + snapshot under ONE lock hold: any in-flight
+            # _handshake thread either registered before this (and is in
+            # the snapshot) or will observe _closing and self-close.
+            self._closing = True
             conns = list(self._conns.values())
-        for conn, watcher in conns:
+        for conn, watcher, _seq in conns:
             watcher.stop()
             conn.sock.close()
         self._lsock.close()

@@ -639,8 +639,9 @@ def _pipeline_step(
     #   reply hit:    partner = fwd entry (dst=client, frontend ip/port)
     p_half = max(1, meta.ct_timeout_s // 2)
     c_pref = mr[:, 3] & PREF_MASK  # strip the cached snat/dsr bits
-    # Age in mod-2^30 arithmetic: exact whenever the true age < 2^30 s,
-    # which the idle timeout guarantees for any live entry.
+    # Age in mod-2^29 arithmetic (PREF_MASK; bits 0-28 carry pref, bit 29
+    # is CONFIRMED in the meta3 layout): exact whenever the true age
+    # < 2^29 s, which the idle timeout guarantees for any live entry.
     p_need = est & (((now - c_pref) & PREF_MASK) >= p_half)
 
     def partner_probe(keys, mask):

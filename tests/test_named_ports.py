@@ -130,3 +130,32 @@ def test_named_port_egress_peer_resolution():
     for i, (d, dp, expect) in enumerate(cases):
         assert int(oracle.classify(pkts[i]).code) == expect, (d, dp, "oracle")
         assert int(np.asarray(out["code"])[i]) == expect, (d, dp, "kernel")
+
+
+def test_protocolless_named_service_resolves_per_protocol():
+    """A service with port_name and NO protocol resolves per (name,
+    protocol) pair per member: a member exposing dns/TCP=53 and
+    dns/UDP=5353 yields BOTH, each as a protocol-narrowed rule (the
+    reference resolves named ports per pair, not first-match)."""
+    ps = PolicySet()
+    ps.applied_to_groups["dns"] = cp.AppliedToGroup(name="dns", members=[
+        _member(WEB1, [("dns", 53, 6), ("dns", 5353, 17)]),
+    ])
+    ps.address_groups["clients"] = cp.AddressGroup(
+        name="clients", members=[_member(CLIENT)])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="np1", name="allow-dns", namespace="ns",
+        type=cp.NetworkPolicyType.K8S,
+        applied_to_groups=["dns"],
+        policy_types=[cp.Direction.IN],
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(address_groups=["clients"]),
+            services=[cp.Service(protocol=None, port_name="dns")],
+        )],
+    ))
+    rps = resolve_named_ports(ps)
+    [p] = rps.policies
+    resolved = sorted((s.port, s.protocol) for r in p.rules
+                      for s in r.services)
+    assert resolved == [(53, 6), (5353, 17)]

@@ -10,7 +10,6 @@ from antrea_tpu.apis import controlplane as cp
 from antrea_tpu.controller.networkpolicy import NetworkPolicyController
 from antrea_tpu.controller.status import (
     PHASE_FAILED,
-    PHASE_PENDING,
     PHASE_REALIZED,
     PHASE_REALIZING,
     StatusAggregator,
@@ -115,13 +114,16 @@ def test_failure_and_span_shrink_and_delete():
     assert agg.all_statuses() == []
 
 
-def test_zero_span_policy_is_pending():
+def test_zero_span_policy_is_realized():
+    """A processed policy with a zero-node span is Realized, not Pending:
+    syncHandler yields Realized when currentNodes == desiredNodes == 0 and
+    reserves Pending for unprocessed policies (status_controller.go:303-343)."""
     ctl = NetworkPolicyController()
     agg = StatusAggregator(ctl)
     ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
     ctl.upsert_antrea_policy(_policy())  # no pods -> empty span
     st = agg.status_of("p1")
-    assert st.phase == PHASE_PENDING
+    assert st.phase == PHASE_REALIZED
     assert st.desired_nodes == 0
 
 
