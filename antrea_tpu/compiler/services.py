@@ -33,6 +33,17 @@ compile-time hash table — endpoints already use the CSR-style layout.
 Lookup is two-stage exact match (no i64 keys on TPU):
   1. binary search the sorted unique frontend IPs;
   2. compare (proto<<16|port) against that IP's padded slot row.
+
+Dual-stack (ref proxier.go:1379-1465 metaProxier running one proxier per
+family): each ServiceEntry is single-family — its cluster_ip family must
+match its endpoints and external IPs (the reference's per-family proxiers
+see only their family's slices), and NodePort frontends bind only to node
+addresses of the service's family.  v6 frontends land in a SEPARATE
+4-word lexicographic table (uip6_w/ppk6/...), mirroring the policy
+plane's DimTable.bounds6 family split; LB programs and the flat endpoint
+layout are shared — a program is family-pure, and every endpoint row also
+carries its wide (v4-mapped) word form (ep_ipw_f) so v6 lanes can gather
+a 4-word DNAT resolution from the same flat index.
 """
 
 from __future__ import annotations
@@ -72,6 +83,15 @@ class ServiceTables:
     # (models/pipeline.py meta3 bit 30) like the SNAT mark, so established
     # connections keep their delivery mode across program renumbering.
     prog_dsr: np.ndarray
+    # v6 frontend sub-table (empty (0, 4)/(0, 1) in pure-v4 sets — the
+    # dual-stack pipeline statically compiles the v6 probe out then).
+    uip6_w: np.ndarray  # (NU6, 4) i32 per-word sign-flipped, sorted lex
+    ppk6: np.ndarray  # (NU6, MAXP6) i32 (proto<<16|port), -1 empty
+    slot_svc6: np.ndarray  # (NU6, MAXP6) i32 LB-program index, -1 empty
+    slot_snat6: np.ndarray  # (NU6, MAXP6) i32 0/1 per frontend
+    # (E, 4) wide flipped word form of EVERY flat endpoint (v4 rows in
+    # v4-mapped form) — the 4-word DNAT resolution v6 lanes gather.
+    ep_ipw_f: np.ndarray
     names: list[str]
 
     @property
@@ -86,26 +106,49 @@ def compile_services(
     node_name: str = "",
 ) -> ServiceTables:
     """node_ips: this node's addresses — every (node_ip, proto, node_port)
-    becomes a frontend for NodePort services.  node_name: identity used by
-    externalTrafficPolicy=Local endpoint filtering."""
+    becomes a frontend for NodePort services, bound per the service's
+    family.  node_name: identity used by externalTrafficPolicy=Local
+    endpoint filtering."""
     node_ips = list(node_ips or [])
+    node_ips4 = [ip for ip in node_ips if not iputil.is_v6(ip)]
+    node_ips6 = [ip for ip in node_ips if iputil.is_v6(ip)]
 
     # Build programs: cluster views first (index == service index), then
     # local shadow views for ETP=Local services with external frontends.
     progs: list[dict] = []
     for si, svc in enumerate(services):
+        # Family purity (metaProxier model, proxier.go:1379-1465): a
+        # ServiceEntry is one family's slice of a (possibly dual-stack)
+        # Service — mixed-family endpoints or external IPs are a config
+        # error, never a silent partial match.
+        fam6 = iputil.is_v6(svc.cluster_ip)
+        svc_name = f"{svc.namespace}/{svc.name}" if svc.name else f"svc-{si}"
+        for e in svc.endpoints:
+            if iputil.is_v6(e.ip) != fam6:
+                raise ValueError(
+                    f"service {svc_name}: endpoint {e.ip} family differs "
+                    f"from cluster IP {svc.cluster_ip} (one ServiceEntry "
+                    f"per family, like the reference's per-family proxiers)"
+                )
+        for ip in svc.external_ips:
+            if iputil.is_v6(ip) != fam6:
+                raise ValueError(
+                    f"service {svc_name}: external IP {ip} family differs "
+                    f"from cluster IP {svc.cluster_ip}"
+                )
         progs.append({
             "eps": list(svc.endpoints),
             "aff": svc.affinity_timeout_s,
-            "name": f"{svc.namespace}/{svc.name}" if svc.name else f"svc-{si}",
+            "name": svc_name,
             "dsr": False,  # the ClusterIP path is always regular DNAT
         })
-    frontends: list[tuple[int, int, int, int]] = []  # (ip_u, key, prog, snat)
+    frontends: list[tuple[int, int, int, int]] = []  # (ip_key, key, prog, snat)
     for si, svc in enumerate(services):
         key = (svc.protocol << 16) + svc.port
-        frontends.append((iputil.ip_to_u32(svc.cluster_ip), key, si, 0))
+        frontends.append((iputil.ip_to_key(svc.cluster_ip), key, si, 0))
+        my_node_ips = node_ips6 if iputil.is_v6(svc.cluster_ip) else node_ips4
         has_external = bool(svc.external_ips) or (
-            svc.node_port > 0 and node_ips
+            svc.node_port > 0 and my_node_ips
         )
         if not has_external:
             continue
@@ -134,12 +177,12 @@ def compile_services(
             # program; the SNAT mark lives on the frontend entry.
             ext_prog, ext_snat = si, 1
         for ip in svc.external_ips:
-            frontends.append((iputil.ip_to_u32(ip), key, ext_prog, ext_snat))
+            frontends.append((iputil.ip_to_key(ip), key, ext_prog, ext_snat))
         if svc.node_port > 0:
             np_key = (svc.protocol << 16) + svc.node_port
-            for nip in node_ips:
+            for nip in my_node_ips:
                 frontends.append(
-                    (iputil.ip_to_u32(nip), np_key, ext_prog, ext_snat)
+                    (iputil.ip_to_key(nip), np_key, ext_prog, ext_snat)
                 )
 
     P = max(1, len(progs))
@@ -156,7 +199,8 @@ def compile_services(
     prog_dsr = np.zeros(P, dtype=np.int32)
     ep_base = np.zeros(P, dtype=np.int32)
     names: list[str] = [""] * P
-    flat_ip: list[int] = []
+    flat_ip: list[int] = []  # narrow u32 (0 for v6 rows — v4 lanes only)
+    flat_w: list[tuple] = []  # wide flipped words, every row
     flat_port: list[int] = []
     for pi, pr in enumerate(progs):
         eps = pr["eps"]
@@ -167,35 +211,58 @@ def compile_services(
         prog_dsr[pi] = 1 if pr.get("dsr") else 0
         names[pi] = pr["name"]
         for ep in eps:
-            flat_ip.append(iputil.ip_to_u32(ep.ip))
+            k = iputil.ip_to_key(ep.ip)
+            flat_ip.append(0 if iputil.key_is_v6(k) else k)
+            flat_w.append(iputil.key_to_flipped_words(k))
             flat_port.append(ep.port)
     if not flat_ip:  # keep gathers in-bounds for endpoint-less sets
         flat_ip, flat_port = [0], [0]
+        flat_w = [iputil.key_to_flipped_words(0)]
 
     by_ip: dict[int, list[tuple[int, int, int]]] = {}
     seen_keys: dict[int, set] = {}
-    for ip_u, key, prog, fsnat in frontends:
-        keys = seen_keys.setdefault(ip_u, set())
+    for ip_k, key, prog, fsnat in frontends:
+        keys = seen_keys.setdefault(ip_k, set())
         if key in keys:
             raise ValueError(
-                f"duplicate frontend {iputil.u32_to_ip(ip_u)} "
+                f"duplicate frontend {iputil.key_to_ip(ip_k)} "
                 f"proto/port key {key:#x}"
             )
         keys.add(key)
-        by_ip.setdefault(ip_u, []).append((key, prog, fsnat))
+        by_ip.setdefault(ip_k, []).append((key, prog, fsnat))
 
-    NU = max(1, len(by_ip))
-    maxp = max(1, max((len(v) for v in by_ip.values()), default=1))
+    by_ip4 = {k: v for k, v in by_ip.items() if not iputil.key_is_v6(k)}
+    by_ip6 = {k: v for k, v in by_ip.items() if iputil.key_is_v6(k)}
+
+    NU = max(1, len(by_ip4))
+    maxp = max(1, max((len(v) for v in by_ip4.values()), default=1))
     uips = np.zeros(NU, dtype=np.uint32)
     ppk = np.full((NU, maxp), -1, dtype=np.int32)
     slot_svc = np.full((NU, maxp), -1, dtype=np.int32)
     slot_snat = np.zeros((NU, maxp), dtype=np.int32)
-    for row, ip_u in enumerate(sorted(by_ip)):
+    for row, ip_u in enumerate(sorted(by_ip4)):
         uips[row] = ip_u
-        for col, (key, prog, fsnat) in enumerate(by_ip[ip_u]):
+        for col, (key, prog, fsnat) in enumerate(by_ip4[ip_u]):
             ppk[row, col] = key
             slot_svc[row, col] = prog
             slot_snat[row, col] = fsnat
+
+    # v6 frontend rows, sorted by combined key (== word-lexicographic
+    # order, the contract _searchsorted6-style probes rely on).  Truly
+    # empty ((0, ...)) when no v6 frontends exist, so the pipeline's v6
+    # probe compiles out statically in pure-v4 worlds.
+    NU6 = len(by_ip6)
+    maxp6 = max(1, max((len(v) for v in by_ip6.values()), default=1))
+    uip6_w = np.zeros((NU6, 4), dtype=np.int32)
+    ppk6 = np.full((NU6, maxp6), -1, dtype=np.int32)
+    slot_svc6 = np.full((NU6, maxp6), -1, dtype=np.int32)
+    slot_snat6 = np.zeros((NU6, maxp6), dtype=np.int32)
+    for row, ip_k in enumerate(sorted(by_ip6)):
+        uip6_w[row] = iputil.key_to_flipped_words(ip_k)
+        for col, (key, prog, fsnat) in enumerate(by_ip6[ip_k]):
+            ppk6[row, col] = key
+            slot_svc6[row, col] = prog
+            slot_snat6[row, col] = fsnat
 
     # Sort rows by flipped key so device-side searchsorted over i32 works.
     uip_f = _flip(uips)
@@ -212,5 +279,10 @@ def compile_services(
         ep_port=np.asarray(flat_port, dtype=np.int32),
         slot_snat=slot_snat[order],
         prog_dsr=prog_dsr,
+        uip6_w=uip6_w,
+        ppk6=ppk6,
+        slot_svc6=slot_svc6,
+        slot_snat6=slot_snat6,
+        ep_ipw_f=np.asarray(flat_w, dtype=np.int32),
         names=names,
     )

@@ -97,6 +97,12 @@ class StepResult:
     # owns the VIP and replies directly to the client, so no reply-direction
     # conntrack leg exists on this node.
     dsr: np.ndarray = None
+    # Dual-stack views (populated only by dual_stack datapaths): per-lane
+    # COMBINED-keyspace ints (utils/ip.py — v4 lanes carry their plain u32
+    # value, so these are strict supersets of dnat_ip/peer_ip).  Python
+    # lists because v6 addresses exceed any numpy integer lane width.
+    dnat_key: list = None  # post-DNAT dst (reply lanes: un-DNAT rewrite)
+    peer_key: list = None  # tunnel peer (FWD_TUNNEL lanes; else 0)
 
 
 class Datapath(ABC):

@@ -41,6 +41,7 @@ from ..compiler.topology import (
 )
 from ..compiler.compile import ACT_ALLOW
 from ..oracle.pipeline import PipelineOracle, _reject_kind
+from ..utils import ip as iputil
 from ..packet import PacketBatch
 from . import persist
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
@@ -74,10 +75,12 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         persist_dir: Optional[str] = None,
         feature_gates=None,
         topology: Optional[Topology] = None,
+        dual_stack: bool = False,
     ):
         from ..features import DEFAULT_GATES
 
         self._gates = feature_gates or DEFAULT_GATES
+        self._dual_stack = dual_stack
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
         self._topo = topology
@@ -93,6 +96,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             ct_syn_timeout_s=ct_syn_timeout_s,
             ct_other_new_s=ct_other_new_s, ct_other_est_s=ct_other_est_s,
             node_ips=list(node_ips or []), node_name=node_name,
+            dual_stack=dual_stack,
         )
         self._stats_in: Counter = Counter()
         self._stats_out: Counter = Counter()
@@ -215,8 +219,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 continue  # stale-generation denial: dead to lookups
             src, dst, pp, proto = e["key"]
             out.append({
-                "src": iputil.u32_to_ip(src),
-                "dst": iputil.u32_to_ip(dst),
+                "src": iputil.key_to_ip(src),
+                "dst": iputil.key_to_ip(dst),
                 "sport": (pp >> 16) & 0xFFFF,
                 "dport": pp & 0xFFFF,
                 "proto": proto,
@@ -224,7 +228,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 "committed": e["gen"] is None,
                 "code": e["code"],
                 "svc_idx": e["svc"],
-                "dnat_ip": iputil.u32_to_ip(e["dnat_ip"]),
+                "dnat_ip": iputil.key_to_ip(e["dnat_ip"]),
                 "dnat_port": e["dnat_port"],
                 "ingress_rule": e["rule_in"],
                 "egress_rule": e["rule_out"],
@@ -316,10 +320,15 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         flags = batch.flags()
         arp_ops = batch.arp_ops()
         O = self._oracle
+        if batch.has_v6 and not self._dual_stack:
+            raise ValueError(
+                "batch carries v6 lanes but this datapath is v4-only; "
+                "construct it with dual_stack=True"
+            )
         lane_modes = []
         no_commit = []
         for i in range(batch.size):
-            if oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i])):
+            if oracle_spoof(self._rt, batch.src_key(i), int(in_ports[i])):
                 lane_modes.append(O.LANE_SPOOF)
             elif int(arp_ops[i]) > 0:
                 # ARP lanes bypass the IP pipeline (handled in forwarding);
@@ -333,7 +342,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             # never establishes (the closing-segment rule — same gating as
             # models/forwarding._pipeline_step_full).
             no_commit.append(
-                is_mcast_u32(int(batch.dst_ip[i]))
+                is_mcast_u32(batch.dst_key(i))
                 or (int(batch.proto[i]) == PROTO_TCP
                     and (int(flags[i]) & _TEARDOWN_FLAGS) != 0)
             )
@@ -378,9 +387,14 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 # ARPResponder (scalar spec = ResolvedTopology.arp_u32):
                 # answered requests reply out the ingress port; the rest
                 # floods (OFPP_NORMAL).  Spoofed ARP was caught above.
+                # v6 lanes model Neighbor Discovery (NS answers from the
+                # nd set — the NDP twin, route_linux.go v6 neighbors).
+                tgt = batch.dst_key(i)
                 answer = (
                     int(arp_ops[i]) == ARP_OP_REQUEST
-                    and int(batch.dst_ip[i]) in self._rt.arp_u32
+                    and (tgt in self._rt.nd_keys
+                         if iputil.key_is_v6(tgt)
+                         else tgt in self._rt.arp_u32)
                 )
                 rows.append({
                     "spoofed": 0, "punt": 0,  # answered in the dataplane
@@ -397,7 +411,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 continue
             # Replies forward to their literal dst (the client); their dnat
             # fields carry the source un-rewrite.
-            eff_dst = int(batch.dst_ip[i]) if o.reply else o.dnat_ip
+            eff_dst = batch.dst_key(i) if o.reply else o.dnat_ip
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             deliverable = o.code == ACT_ALLOW and f["kind"] in (
                 FWD_LOCAL, FWD_TUNNEL, FWD_GATEWAY, FWD_MCAST
@@ -405,7 +419,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             uni_deliverable = deliverable and f["kind"] != FWD_MCAST
             if uni_deliverable:
                 tc_act, tc_port = _tc_from_tables(
-                    self._ft, int(batch.src_ip[i]), eff_dst
+                    self._ft, batch.src_key(i), eff_dst
                 )
             else:
                 tc_act, tc_port = 0, 0
@@ -429,11 +443,16 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         def col(key, dtype=np.int32):
             return np.array([r[key] for r in fwd], dtype)
 
+        def narrow(v):
+            # v6 combined keys don't fit the u32 lane; the dual-stack view
+            # is dnat_key/peer_key (interface.py).
+            return v if v < (1 << 32) else 0
+
         return StepResult(
             code=np.array([o.code for o in outs], np.int32),
             est=np.array([int(o.est) for o in outs], np.int32),
             svc_idx=np.array([o.svc_idx for o in outs], np.int32),
-            dnat_ip=np.array([o.dnat_ip for o in outs], np.uint32),
+            dnat_ip=np.array([narrow(o.dnat_ip) for o in outs], np.uint32),
             dnat_port=np.array([o.dnat_port for o in outs], np.int32),
             ingress_rule=[o.ingress_rule for o in outs],
             egress_rule=[o.egress_rule for o in outs],
@@ -455,8 +474,12 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             ], np.int32),
             fwd_kind=col("fwd_kind"),
             out_port=col("out_port"),
-            peer_ip=col("peer_ip", np.uint32),
+            peer_ip=np.array([narrow(r["peer_ip"]) for r in fwd], np.uint32),
             dec_ttl=col("dec_ttl"),
             tc_act=col("tc_act"),
             tc_port=col("tc_port"),
+            dnat_key=([o.dnat_ip for o in outs]
+                      if self._dual_stack else None),
+            peer_key=([r["peer_ip"] for r in fwd]
+                      if self._dual_stack else None),
         )

@@ -70,10 +70,17 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         persist_dir: Optional[str] = None,
         feature_gates=None,
         topology: Optional[Topology] = None,
+        dual_stack: bool = False,
     ):
         from ..features import DEFAULT_GATES
 
         self._gates = feature_gates or DEFAULT_GATES
+        # Dual-stack switches the flow cache to wide (10-column) keys and
+        # enables v6 service frontends / forwarding tables (the reference
+        # is dual-stack when both families are configured,
+        # proxier.go:1379-1465 / route_linux.go).  Static per instance:
+        # pure-v4 nodes keep the narrow fast path compiled unchanged.
+        self._dual_stack = dual_stack
         # Node identity: NodePort frontends bind to these addresses and
         # externalTrafficPolicy=Local filters endpoints to this node
         # (ref proxier.go nodePortAddresses / externalPolicyLocal).
@@ -101,7 +108,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # snapshot and resume with a MONOTONIC generation; flow-cache state
         # is dropped (re-classifies, never re-verdicts differently).
         self._init_persist(persist_dir, ps, services)
-        self._state = pl.init_state(flow_slots, aff_slots)
+        self._state = pl.init_state(flow_slots, aff_slots,
+                                    key_words=10 if dual_stack else 4)
         # Per-rule packet counters (IngressMetric/EgressMetric analog),
         # keyed by stable rule id so they survive bundle renumbering.
         self._stats_in: Counter = Counter()
@@ -162,11 +170,12 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         r_in = jnp.asarray(remap_arr(old_in, new_in))
         r_out = jnp.asarray(remap_arr(old_out, new_out))
         meta = self._state.flow.meta
-        rp = meta[:, 2]
+        _, _, RC, _ = pl._meta_cols(self._meta.key_words - 2)
+        rp = meta[:, RC]
         vi = jnp.clip(rp & 0xFFFF, 0, r_in.shape[0] - 1)
         vo = jnp.clip((rp >> 16) & 0xFFFF, 0, r_out.shape[0] - 1)
         self._state = self._state._replace(flow=self._state.flow._replace(
-            meta=meta.at[:, 2].set(r_in[vi] | (r_out[vo] << 16))
+            meta=meta.at[:, RC].set(r_in[vi] | (r_out[vo] << 16))
         ))
 
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
@@ -259,6 +268,27 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._dft = fwd.fwd_to_device(ft)
         self._persist_topology()
 
+    def _v6_lanes(self, batch: PacketBatch):
+        """Batch -> the pipeline's v6 lane tuple (or None).  Dual-stack
+        instances ALWAYS materialize the wide lanes (the key layout is
+        static); narrow instances reject v6-carrying batches loudly."""
+        if not self._dual_stack:
+            if batch.has_v6:
+                raise ValueError(
+                    "batch carries v6 lanes but this datapath is v4-only; "
+                    "construct it with dual_stack=True"
+                )
+            return None
+        B = batch.size
+        if batch.src_ip6 is None:
+            z = np.zeros((B, 4), np.uint32)
+            return (jnp.asarray(iputil.flip_u32(z)),
+                    jnp.asarray(iputil.flip_u32(z)),
+                    jnp.zeros(B, jnp.int32))
+        return (jnp.asarray(iputil.flip_u32(batch.src_ip6)),
+                jnp.asarray(iputil.flip_u32(batch.dst_ip6)),
+                jnp.asarray(batch.is6))
+
     def step(self, batch: PacketBatch, now: int) -> StepResult:
         state, out = fwd.pipeline_step_full(
             self._state,
@@ -278,6 +308,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             # pure-IP batches keep the round-3 compiled program.
             jnp.asarray(batch.arp_ops()) if batch.arp_op is not None else None,
             meta=self._meta,
+            v6=self._v6_lanes(batch),
         )
         self._state = state
         o = {k: np.asarray(v) for k, v in out.items()}
@@ -288,6 +319,35 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
 
         def unflip(col):
             return (col.astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32)
+
+        def keys_of(wide_col):
+            """(B, 4) flipped word rows -> per-lane combined keys.
+            Vectorized for the common case: v4-mapped rows (word 3 IS the
+            key) take one numpy pass; Python big-int math runs only for
+            lanes carrying a real v6 address."""
+            words = unflip(wide_col).astype(np.int64)
+            mapped = ((words[:, 0] == 0) & (words[:, 1] == 0)
+                      & (words[:, 2] == 0xFFFF))
+            keys = words[:, 3].tolist()
+            for i in np.nonzero(~mapped)[0]:
+                w = words[i]
+                keys[i] = iputil.V6_OFF + (
+                    (int(w[0]) << 96) | (int(w[1]) << 64)
+                    | (int(w[2]) << 32) | int(w[3])
+                )
+            return keys
+
+        dnat_key = peer_key = None
+        if self._dual_stack:
+            dnat_key = keys_of(o["dnat_w_f"])
+            peer_key = keys_of(o["peer_w"])
+            # Non-tunnel lanes' peer words are zero; report 0, not the
+            # mapped-zero key.
+            peer_key = [
+                k if (kind == FWD_TUNNEL and port != -1) else 0
+                for k, kind, port in zip(peer_key, o["fwd_kind"],
+                                         o["out_port"])
+            ]
 
         return StepResult(
             code=o["code"],
@@ -324,6 +384,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             dec_ttl=o["dec_ttl"],
             tc_act=o["tc_act"],
             tc_port=o["tc_port"],
+            dnat_key=dnat_key,
+            peer_key=peer_key,
         )
 
     def stats(self) -> DatapathStats:
@@ -344,7 +406,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         keys = np.asarray(flow.keys)[:-1].astype(np.int64)
         meta = np.asarray(flow.meta)[:-1].astype(np.int64)
         ts = np.asarray(flow.ts)[:-1]
-        kpg = keys[:, 3]
+        A = self._meta.key_words - 2
+        DC, M1C, RC, ZC = pl._meta_cols(A)
+        kpg = keys[:, A + 1]
         # Live = occupied, within idle timeout, AND valid under the current
         # generation: stale-gen denial entries survive in the table after a
         # bundle but are dead to lookups — dumping them would resolve their
@@ -355,7 +419,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # lookup path: a half-open TCP entry past its syn lifetime is dead
         # to lookups and must not appear in the conntrack dump either.
         tmo = pl.entry_timeout(
-            (meta[:, 3] >> 29) & 1, kpg & 0xFF, self._meta.timeouts, xp=np
+            (meta[:, ZC] >> 29) & 1, kpg & 0xFF, self._meta.timeouts, xp=np
         )
         live = (
             (kpg != 0)
@@ -367,6 +431,14 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         def unflip_ip(v: int) -> str:
             return iputil.u32_to_ip(iputil.unflip_u32(v))
 
+        def wide_ip(row) -> str:
+            """4 flipped word lanes -> address string (mapped form = v4)."""
+            w = [iputil.unflip_u32(int(x)) for x in row]
+            v = (w[0] << 96) | (w[1] << 64) | (w[2] << 32) | w[3]
+            if (v >> 32) == 0xFFFF:
+                return iputil.u32_to_ip(v & 0xFFFFFFFF)
+            return iputil.key_to_ip(iputil.V6_OFF + v)
+
         def rid(ids: list, idx: int):
             return ids[idx] if 0 <= idx < len(ids) and ids[idx] else None
 
@@ -374,20 +446,26 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             pg = int(kpg[i])
             gen = (pg >> 9) & pl.GEN_ETERNAL
             # Shared bit-layout decoders (single source of truth with the
-            # kernel's row packing).
-            code, svc_idx, dnat_port = pl._unpack_meta1(int(meta[i, 1]))
-            rule_in, rule_out = pl._unpack_rules(int(meta[i, 2]))
+            # kernel's row packing); wide worlds decode word quadruples.
+            code, svc_idx, dnat_port = pl._unpack_meta1(int(meta[i, M1C]))
+            rule_in, rule_out = pl._unpack_rules(int(meta[i, RC]))
+            if A == 2:
+                src, dst = unflip_ip(keys[i, 0]), unflip_ip(keys[i, 1])
+                dnat = unflip_ip(meta[i, DC])
+            else:
+                src, dst = wide_ip(keys[i, 0:4]), wide_ip(keys[i, 4:8])
+                dnat = wide_ip(meta[i, 0:4])
             out.append({
-                "src": unflip_ip(keys[i, 0]),
-                "dst": unflip_ip(keys[i, 1]),
-                "sport": (int(keys[i, 2]) >> 16) & 0xFFFF,
-                "dport": int(keys[i, 2]) & 0xFFFF,
+                "src": src,
+                "dst": dst,
+                "sport": (int(keys[i, A]) >> 16) & 0xFFFF,
+                "dport": int(keys[i, A]) & 0xFFFF,
                 "proto": pg & 0xFF,
                 "reply": bool(pg & (1 << 31)),
                 "committed": gen == pl.GEN_ETERNAL,
                 "code": code,
                 "svc_idx": svc_idx,
-                "dnat_ip": unflip_ip(meta[i, 0]),
+                "dnat_ip": dnat,
                 "dnat_port": dnat_port,
                 "ingress_rule": rid(self._cps.ingress.rule_ids, rule_in),
                 "egress_rule": rid(self._cps.egress.rule_ids, rule_out),
@@ -430,6 +508,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             jnp.int32(now),
             jnp.int32(self._gen),
             meta=self._meta,
+            v6=self._v6_lanes(batch),
         )
         o = {k: np.asarray(v) for k, v in o.items()}
         in_ids = self._cps.ingress.rule_ids
@@ -438,6 +517,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         def rid(ids, i):
             return ids[i] if 0 <= i < len(ids) and ids[i] else None
 
+        def wide_key(row) -> int:
+            w = [iputil.unflip_u32(int(x)) for x in row]
+            v = (w[0] << 96) | (w[1] << 64) | (w[2] << 32) | w[3]
+            return v & 0xFFFFFFFF if (v >> 32) == 0xFFFF else iputil.V6_OFF + v
+
         from ..compiler.topology import oracle_forward, oracle_spoof
 
         in_ports = batch.in_ports()
@@ -445,19 +529,26 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         for i in range(batch.size):
             # Forwarding observations via the scalar spec (read-only slow
             # path; identical semantics to the fused kernel — test-enforced
-            # via the step() parity suite).
-            dnat_u = iputil.unflip_u32(o["dnat_ip_f"][i])
+            # via the step() parity suite).  Addresses flow as combined
+            # keys (family-agnostic spec).
+            p = batch.packet(i)
+            if self._dual_stack:
+                dnat_u = wide_key(o["dnat_w_f"][i])
+                cached_dnat = wide_key(o["cached_dnat_w_f"][i])
+            else:
+                dnat_u = iputil.unflip_u32(o["dnat_ip_f"][i])
+                cached_dnat = iputil.unflip_u32(o["cached_dnat_ip_f"][i])
             # Forward-leg destination mirrors step(): non-reply cache hits
             # route by the CACHED entry's DNAT resolution (service updates
             # after commit must not flip the reported forwarding); replies
             # go to their literal dst; misses use the fresh walk.
             if o["reply"][i]:
-                eff_dst = int(batch.dst_ip[i])
+                eff_dst = p.dst_ip
             elif o["cache_hit"][i]:
-                eff_dst = iputil.unflip_u32(o["cached_dnat_ip_f"][i])
+                eff_dst = cached_dnat
             else:
                 eff_dst = dnat_u
-            spoofed = oracle_spoof(self._rt, int(batch.src_ip[i]), int(in_ports[i]))
+            spoofed = oracle_spoof(self._rt, p.src_ip, int(in_ports[i]))
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
             out.append({
                 "cache_hit": bool(o["cache_hit"][i]),
@@ -533,6 +624,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             ct_other_new_s=self._pipe_kw["ct_other_new_s"],
             ct_other_est_s=self._pipe_kw["ct_other_est_s"],
             fused=self._pipe_kw["fused"],
+            key_words=10 if self._dual_stack else 4,
         )
         # Reset incremental bookkeeping: the compile folded all prior deltas.
         D = self._delta_slots

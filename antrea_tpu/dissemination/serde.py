@@ -212,6 +212,10 @@ def encode_service_entry(s: ServiceEntry) -> dict:
         "externalIPs": list(s.external_ips),
         "nodePort": s.node_port,
         "externalTrafficPolicy": s.external_traffic_policy,
+        # service.antrea.io/load-balancer-mode analog: without this a
+        # persisted DSR service would silently revert to regular DNAT
+        # (and SNAT) after an agent restart.
+        "loadBalancerModeDSR": s.dsr,
     }
 
 
@@ -230,6 +234,7 @@ def decode_service_entry(d: dict) -> ServiceEntry:
         external_ips=list(d.get("externalIPs", ())),
         node_port=d.get("nodePort", 0),
         external_traffic_policy=d.get("externalTrafficPolicy", "Cluster"),
+        dsr=d.get("loadBalancerModeDSR", False),
     )
 
 
@@ -240,7 +245,9 @@ def encode_topology(t) -> dict:
     return {
         "node": t.node_name,
         "gatewayIP": t.gateway_ip,
+        "gatewayIPv6": t.gateway_ip6,
         "podCIDR": t.pod_cidr,
+        "podCIDRv6": t.pod_cidr6,
         "localPods": [[ip, port] for ip, port in t.local_pods],
         "remoteNodes": [
             {"name": n.name, "nodeIP": n.node_ip, "podCIDR": n.pod_cidr}
@@ -267,6 +274,8 @@ def decode_topology(d: dict):
     return Topology(
         node_name=d.get("node", ""),
         gateway_ip=d.get("gatewayIP", ""),
+        gateway_ip6=d.get("gatewayIPv6", ""),
+        pod_cidr6=d.get("podCIDRv6", ""),
         pod_cidr=d.get("podCIDR", ""),
         local_pods=[(ip, port) for ip, port in d.get("localPods", ())],
         remote_nodes=[

@@ -65,10 +65,32 @@ class DeviceForwardingTables(NamedTuple):
     n_mc: jax.Array
     arp_ip_f: jax.Array
     n_arp: jax.Array
+    lp6_ipw: jax.Array
+    lp6_port: jax.Array
+    lp6_tc_in: jax.Array
+    lp6_tc_eg: jax.Array
+    n_lp6: jax.Array
+    rn6_lo_w: jax.Array
+    rn6_hi_w: jax.Array
+    rn6_peer_w: jax.Array
+    n_rn6: jax.Array
+    local_range6_w: jax.Array
+    nd_ipw: jax.Array
+    n_nd: jax.Array
 
 
 def fwd_to_device(ft: ForwardingTables) -> DeviceForwardingTables:
     return DeviceForwardingTables(*[jnp.asarray(c) for c in ft])
+
+
+def _lex_le(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a <= b over the trailing 4-word axis (per-word
+    sign-flipped i32, so signed compares give unsigned order — the same
+    contract as ops/match._searchsorted6)."""
+    lt = a < b
+    eq = a == b
+    return lt[..., 0] | (eq[..., 0] & (lt[..., 1] | (eq[..., 1] & (
+        lt[..., 2] | (eq[..., 2] & (lt[..., 3] | eq[..., 3]))))))
 
 
 def _lp_row(dft: DeviceForwardingTables, ip_f: jax.Array):
@@ -79,13 +101,31 @@ def _lp_row(dft: DeviceForwardingTables, ip_f: jax.Array):
     return row, known
 
 
-def spoof_lookup(dft: DeviceForwardingTables, src_f: jax.Array, in_port: jax.Array):
+def _row_eq_wide(table: jax.Array, n: jax.Array, xw: jax.Array):
+    """-> (row, known) exact 4-word row match (all-pairs — per-node v6
+    tables are small; same shape rationale as ops/match._searchsorted6)."""
+    cap = table.shape[0]
+    eq = (table[None, :, :] == xw[:, None, :]).all(axis=2)  # (B, cap)
+    eq = eq & (jnp.arange(cap, dtype=jnp.int32) < n[0])[None, :]
+    known = eq.any(axis=1)
+    row = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    return row, known
+
+
+def spoof_lookup(dft: DeviceForwardingTables, src_f: jax.Array, in_port: jax.Array,
+                 src_w=None, is6=None):
     """SpoofGuard (ref pipeline.go SpoofGuard): packets entering on a pod
-    ofport must source the IP bound to that port.  Resolves the pod by
-    source IP (the table is an ip<->ofport bijection, enforced at compile)."""
+    ofport must source an IP bound to that port.  Resolves the pod by
+    source IP (the table is a per-family ip<->ofport bijection, enforced
+    at compile); v6 lanes resolve in the lexicographic sub-table."""
     row, known = _lp_row(dft, src_f)
     pod_in = in_port >= FIRST_POD_OFPORT
-    return pod_in & (~known | (dft.lp_port[row] != in_port))
+    spoof4 = pod_in & (~known | (dft.lp_port[row] != in_port))
+    if src_w is None:
+        return spoof4
+    row6, known6 = _row_eq_wide(dft.lp6_ipw, dft.n_lp6, src_w)
+    spoof6 = pod_in & (~known6 | (dft.lp6_port[row6] != in_port))
+    return jnp.where(is6 != 0, spoof6, spoof4)
 
 
 def forwarding_lookup(
@@ -164,6 +204,61 @@ def forwarding_lookup(
     }
 
 
+def forwarding_lookup6(
+    dft: DeviceForwardingTables, dst_w: jax.Array, in_port: jax.Array
+):
+    """The v6 leg of L2ForwardingCalc + L3Forwarding + L3DecTTL (ref
+    route_linux.go v6 routes): exact local-pod match in the lexicographic
+    table, inclusive [lo, hi] word-interval match for remote v6 podCIDRs,
+    local-CIDR unknown-pod drop, gateway default.  No v6 multicast table
+    (ff00::/8 replication is not modeled — those lanes take the gateway
+    default).  -> same dict shape as forwarding_lookup, with peer_w
+    ((B, 4)) instead of peer_f."""
+    row, is_local = _row_eq_wide(dft.lp6_ipw, dft.n_lp6, dst_w)
+    rcap = dft.rn6_lo_w.shape[0]
+    ge_lo = _lex_le(dft.rn6_lo_w[None, :, :], dst_w[:, None, :])
+    le_hi = _lex_le(dst_w[:, None, :], dft.rn6_hi_w[None, :, :])
+    in_row = ge_lo & le_hi & (
+        jnp.arange(rcap, dtype=jnp.int32) < dft.n_rn6[0])[None, :]
+    in_rn = in_row.any(axis=1)
+    r = jnp.argmax(in_row, axis=1).astype(jnp.int32)
+    in_local_cidr = (
+        _lex_le(dft.local_range6_w[0][None, :], dst_w)
+        & _lex_le(dst_w, dft.local_range6_w[1][None, :])
+    )
+    kind = jnp.where(
+        is_local,
+        FWD_LOCAL,
+        jnp.where(
+            in_rn,
+            FWD_TUNNEL,
+            jnp.where(in_local_cidr, FWD_DROP_UNKNOWN, FWD_GATEWAY),
+        ),
+    ).astype(jnp.int32)
+    out_port = jnp.where(
+        is_local,
+        dft.lp6_port[row],
+        jnp.where(
+            in_rn,
+            OFPORT_TUNNEL,
+            jnp.where(in_local_cidr, -1, OFPORT_GATEWAY),
+        ),
+    ).astype(jnp.int32)
+    peer_w = jnp.where((in_rn & ~is_local)[:, None], dft.rn6_peer_w[r], 0)
+    routed_in = (in_port == OFPORT_TUNNEL) | (in_port == OFPORT_GATEWAY)
+    dec_ttl = jnp.where(
+        is_local, routed_in, in_rn | (kind == FWD_GATEWAY)
+    ).astype(jnp.int32)
+    return {
+        "kind": kind,
+        "out_port": out_port,
+        "peer_w": peer_w,
+        "dec_ttl": dec_ttl,
+        "lp_row": row,
+        "is_local": is_local,
+    }
+
+
 def tc_lookup(
     dft: DeviceForwardingTables,
     src_f: jax.Array,
@@ -175,6 +270,19 @@ def tc_lookup(
     srow, sknown = _lp_row(dft, src_f)
     w_in = jnp.where(dst_is_local, dft.lp_tc_in[dst_row], 0)
     w_eg = jnp.where(sknown, dft.lp_tc_eg[srow], 0)
+    return jnp.where(w_in != 0, w_in, w_eg)
+
+
+def tc_lookup6(
+    dft: DeviceForwardingTables,
+    src_w: jax.Array,
+    dst_row6: jax.Array,
+    dst_is_local6: jax.Array,
+):
+    """tc_lookup's v6 leg over the lexicographic pod table."""
+    srow, sknown = _row_eq_wide(dft.lp6_ipw, dft.n_lp6, src_w)
+    w_in = jnp.where(dst_is_local6, dft.lp6_tc_in[dst_row6], 0)
+    w_eg = jnp.where(sknown, dft.lp6_tc_eg[srow], 0)
     return jnp.where(w_in != 0, w_in, w_eg)
 
 
@@ -196,6 +304,7 @@ def _pipeline_step_full(
     *,
     meta: pl.PipelineMeta,
     hit_combine=None,
+    v6=None,
 ):
     """Full per-packet walk: SpoofGuard/ARP -> (IGMP punt) -> policy/
     service pipeline -> forwarding -> Output; one jit, one dispatch.
@@ -205,8 +314,21 @@ def _pipeline_step_full(
     same port binding, then the responder answers requests for addresses
     this node owns (gateway/local pods/remote node IPs) back out the
     ingress port; everything else floods (OFPP_NORMAL).  ARP lanes touch
-    no conntrack/policy state."""
-    spoof = spoof_lookup(dft, src_f, in_port)
+    no conntrack/policy state.
+
+    v6 (dual-stack pipelines): the (src6w_f, dst6w_f, is6) lane extension.
+    v6 lanes spoof-guard / forward / TC through the lexicographic
+    sub-tables; arp_op on a v6 lane models Neighbor Discovery (NS=1 answers
+    from the nd table, the ARPResponder twin)."""
+    if v6 is not None:
+        src6w, dst6w, is6 = v6
+        saddr_w = pl._wide_words(src_f, src6w, is6)
+        daddr_w = pl._wide_words(dst_f, dst6w, is6)
+        m6 = is6 != 0
+        spoof = spoof_lookup(dft, src_f, in_port, src_w=saddr_w, is6=is6)
+    else:
+        is6 = None
+        spoof = spoof_lookup(dft, src_f, in_port)
     # IGMP membership traffic is punted to the controller, never forwarded
     # (ref packetin.go PacketInCategoryIGMP; pkg/agent/multicast snooping):
     # excluded from the policy pipeline like spoofed lanes so reports
@@ -216,8 +338,11 @@ def _pipeline_step_full(
     if is_arp is not None:
         igmp = igmp & ~is_arp
     # Multicast data traffic bypasses conntrack (multicast.go): classified
-    # every step, never cached.
+    # every step, never cached.  The 224/4 window is a v4 range — v6 lanes
+    # carry a don't-care narrow dst and must not alias into it.
     is_mc = (dst_f >= MCAST_LO_F) & (dst_f <= MCAST_HI_F)
+    if is6 is not None:
+        is_mc = is_mc & ~m6
     no_commit = is_mc
     if flags is not None:
         # A FIN/RST-flagged TCP miss classifies but never ESTABLISHES a
@@ -232,7 +357,7 @@ def _pipeline_step_full(
     state, out = pl._pipeline_step(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
         meta=meta, hit_combine=hit_combine, valid=valid,
-        no_commit=no_commit, flags=flags,
+        no_commit=no_commit, flags=flags, v6=v6,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
     # Forward toward the packet's effective destination: the DNAT-resolved
@@ -240,13 +365,39 @@ def _pipeline_step_full(
     # SOURCE un-rewrite; a reply forwards to its literal dst (the client).
     eff_dst = jnp.where(out["reply"] == 1, dst_f, out["dnat_ip_f"])
     fwd = forwarding_lookup(dft, eff_dst, in_port)
+    peer_w = None
+    if is6 is not None:
+        # v6 lanes forward by their wide effective destination through the
+        # lexicographic tables; merge per family.
+        eff_dst_w = jnp.where((out["reply"] == 1)[:, None], daddr_w,
+                              out["dnat_w_f"])
+        fwd6 = forwarding_lookup6(dft, eff_dst_w, in_port)
+        fwd = {
+            "kind": jnp.where(m6, fwd6["kind"], fwd["kind"]),
+            "out_port": jnp.where(m6, fwd6["out_port"], fwd["out_port"]),
+            "peer_f": jnp.where(m6, 0, fwd["peer_f"]),
+            "dec_ttl": jnp.where(m6, fwd6["dec_ttl"], fwd["dec_ttl"]),
+            "lp_row": fwd["lp_row"],
+            "is_local": jnp.where(m6, fwd6["is_local"], fwd["is_local"]),
+            "is_mc": fwd["is_mc"] & ~m6,
+            "mcast_idx": jnp.where(m6, -1, fwd["mcast_idx"]),
+            "lp_row6": fwd6["lp_row"],
+            "is_local6": fwd6["is_local"] & m6,
+        }
+        # Wide peer view: v4 tunnel peers in mapped form, v6 peers native.
+        peer_w = jnp.where(
+            m6[:, None], fwd6["peer_w"],
+            pl._wide_words(fwd["peer_f"], None, None),
+        )
     kind = jnp.where(
         spoof, FWD_DROP_SPOOF, jnp.where(igmp, FWD_PUNT, fwd["kind"])
     ).astype(jnp.int32)
     if is_arp is not None:
         # ARPResponder: answered requests reply out the ingress port;
         # unanswered (or reply-opcode) ARP floods.  ARPSpoofGuard already
-        # resolved in `spoof` (sender IP vs port binding).
+        # resolved in `spoof` (sender IP vs port binding).  v6 lanes model
+        # Neighbor Discovery: NS (op 1) answers from the nd table — the
+        # NDP twin of the responder (route_linux.go v6 neighbors).
         acap = dft.arp_ip_f.shape[0]
         arow = jnp.clip(jnp.searchsorted(dft.arp_ip_f, dst_f), 0, acap - 1)
         answer = (
@@ -254,6 +405,12 @@ def _pipeline_step_full(
             & (arow < dft.n_arp[0]) & (dft.arp_ip_f[arow] == dst_f)
             & (arp_op == ARP_OP_REQUEST)
         )
+        if is6 is not None:
+            _ndrow, nd_known = _row_eq_wide(dft.nd_ipw, dft.n_nd, daddr_w)
+            answer6 = (
+                is_arp & ~spoof & nd_known & (arp_op == ARP_OP_REQUEST)
+            )
+            answer = jnp.where(m6, answer6, answer)
         kind = jnp.where(
             is_arp & ~spoof,
             jnp.where(answer, FWD_ARP_REPLY, FWD_ARP_FLOOD),
@@ -264,9 +421,14 @@ def _pipeline_step_full(
         | (kind == FWD_MCAST)
     )
     uni_deliverable = deliverable & (kind != FWD_MCAST)
-    tc_w = jnp.where(
-        uni_deliverable, tc_lookup(dft, src_f, fwd["lp_row"], fwd["is_local"]), 0
-    )
+    tc_base = tc_lookup(dft, src_f, fwd["lp_row"], fwd["is_local"])
+    if is6 is not None:
+        tc_base = jnp.where(
+            m6,
+            tc_lookup6(dft, saddr_w, fwd["lp_row6"], fwd["is_local6"]),
+            tc_base,
+        )
+    tc_w = jnp.where(uni_deliverable, tc_base, 0)
     tc_act = tc_w & 3
     tc_port = tc_w >> 2
     out_port = jnp.where(deliverable, fwd["out_port"], -1)
@@ -306,6 +468,10 @@ def _pipeline_step_full(
         tc_port=tc_port,
         mcast_idx=jnp.where(deliverable, fwd["mcast_idx"], -1),
     )
+    if peer_w is not None:
+        # Wide tunnel-peer view (v6 podCIDR rows may tunnel over either
+        # family); zeroed like peer_f for non-deliverable lanes.
+        out["peer_w"] = jnp.where(uni_deliverable[:, None], peer_w, 0)
     return state, out
 
 

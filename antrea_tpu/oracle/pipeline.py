@@ -93,28 +93,53 @@ class _LBProgram:
 
 
 def _build_programs(services, node_ips, node_name):
-    """-> (programs, frontends {(ip_u, proto, port) -> (prog idx, snat)})."""
+    """-> (programs, frontends {(ip_key, proto, port) -> (prog idx, snat)}).
+
+    Dual-stack: frontends key on COMBINED-keyspace ints (utils/ip.py), so
+    v4 and v6 frontends live in one family-agnostic map — the scalar twin
+    of the compiler's narrow + lexicographic table split.  Family-purity
+    validation mirrors compile_services exactly (metaProxier model)."""
     from ..apis.service import ETP_LOCAL
 
+    node_ips4 = [ip for ip in node_ips if not iputil.is_v6(ip)]
+    node_ips6 = [ip for ip in node_ips if iputil.is_v6(ip)]
     progs = [
         _LBProgram(list(s.endpoints), s.affinity_timeout_s) for s in services
     ]
     fronts: dict[tuple[int, int, int], tuple[int, int]] = {}
 
-    def add_front(ip_u: int, proto: int, port: int, prog: int, snat: int) -> None:
-        key = (ip_u, proto, port)
+    def add_front(ip_k: int, proto: int, port: int, prog: int, snat: int) -> None:
+        key = (ip_k, proto, port)
         if key in fronts:
             # Same observable rule as compile_services: duplicate frontends
             # are a config error, never silent last-writer-wins.
             raise ValueError(
-                f"duplicate frontend {iputil.u32_to_ip(ip_u)} "
+                f"duplicate frontend {iputil.key_to_ip(ip_k)} "
                 f"proto {proto} port {port}"
             )
         fronts[key] = (prog, snat)
 
     for si, svc in enumerate(services):
-        add_front(iputil.ip_to_u32(svc.cluster_ip), svc.protocol, svc.port, si, 0)
-        has_external = bool(svc.external_ips) or (svc.node_port > 0 and node_ips)
+        fam6 = iputil.is_v6(svc.cluster_ip)
+        svc_name = f"{svc.namespace}/{svc.name}" if svc.name else f"svc-{si}"
+        for e in svc.endpoints:
+            if iputil.is_v6(e.ip) != fam6:
+                raise ValueError(
+                    f"service {svc_name}: endpoint {e.ip} family differs "
+                    f"from cluster IP {svc.cluster_ip} (one ServiceEntry "
+                    f"per family, like the reference's per-family proxiers)"
+                )
+        for ip in svc.external_ips:
+            if iputil.is_v6(ip) != fam6:
+                raise ValueError(
+                    f"service {svc_name}: external IP {ip} family differs "
+                    f"from cluster IP {svc.cluster_ip}"
+                )
+        add_front(iputil.ip_to_key(svc.cluster_ip), svc.protocol, svc.port, si, 0)
+        my_node_ips = node_ips6 if fam6 else node_ips4
+        has_external = bool(svc.external_ips) or (
+            svc.node_port > 0 and my_node_ips
+        )
         if not has_external:
             continue
         if svc.external_traffic_policy == ETP_LOCAL:
@@ -134,11 +159,11 @@ def _build_programs(services, node_ips, node_name):
         else:
             ext, ext_snat = si, 1
         for ip in svc.external_ips:
-            add_front(iputil.ip_to_u32(ip), svc.protocol, svc.port, ext, ext_snat)
+            add_front(iputil.ip_to_key(ip), svc.protocol, svc.port, ext, ext_snat)
         if svc.node_port > 0:
-            for nip in node_ips:
+            for nip in my_node_ips:
                 add_front(
-                    iputil.ip_to_u32(nip), svc.protocol, svc.node_port,
+                    iputil.ip_to_key(nip), svc.protocol, svc.node_port,
                     ext, ext_snat,
                 )
     return progs, fronts
@@ -315,25 +340,37 @@ class PipelineOracle:
             n_ep = len(prog.endpoints)
             ep_col = (h & 0x7FFFFFFF) % max(1, n_ep)
             if prog.affinity_timeout_s > 0:
-                ah = int(hashing.fnv_mix([np.uint32(p.src_ip), np.uint32(svc_idx)]))
+                if self.dual_stack:
+                    # Wide client hash: 4 words + program, the device's
+                    # dual-stack formula (_service_lb) word for word.
+                    ah = int(hashing.fnv_mix(
+                        [np.uint32(w) for w in iputil.key_to_words(p.src_ip)]
+                        + [np.uint32(svc_idx)]
+                    ))
+                else:
+                    ah = int(hashing.fnv_mix(
+                        [np.uint32(p.src_ip), np.uint32(svc_idx)]))
                 aslot = ah & (self.aff_slots - 1)
                 ae = aff_view.get(aslot)
                 # ae["ep"] >= n_ep means the endpoint list shrank since the
                 # learn: stale — fall through to hash re-select (matches the
-                # device's aff_hit staleness guard).
+                # device's aff_hit staleness guard).  Client identity in
+                # canon space: the device compares wide words, under which
+                # a v4-mapped v6 client and its v4 host are the same.
                 if (
                     ae is not None
-                    and ae["client"] == p.src_ip
+                    and ae["client"] == self._k(p.src_ip)
                     and ae["svc"] == svc_idx
                     and ae["ep"] < n_ep
                     and (now - ae["ts"]) <= prog.affinity_timeout_s
                 ):
                     ep_col = ae["ep"]
                 else:
-                    aff_learn = (aslot, {"client": p.src_ip, "svc": svc_idx,
+                    aff_learn = (aslot, {"client": self._k(p.src_ip),
+                                         "svc": svc_idx,
                                          "ep": ep_col, "ts": now})
             ep = prog.endpoints[ep_col]
-            dnat_ip, dnat_port = iputil.ip_to_u32(ep.ip), ep.port
+            dnat_ip, dnat_port = iputil.ip_to_key(ep.ip), ep.port
             snat = front_snat
 
         v = self.oracle.classify(

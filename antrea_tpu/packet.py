@@ -133,6 +133,14 @@ class PacketBatch:
             **kw,
         )
 
+    def src_key(self, i: int) -> int:
+        """Lane i's source address as a combined-keyspace int (family-
+        agnostic — the scalar-spec working currency)."""
+        return self.packet(i).src_ip
+
+    def dst_key(self, i: int) -> int:
+        return self.packet(i).dst_ip
+
     def packet(self, i: int) -> Packet:
         from .utils import ip as iputil
 

@@ -1,0 +1,289 @@
+"""IPv6 service plane (ServiceLB/DNAT/affinity/DSR) — dual-stack proxy.
+
+Hand-authored expectations from the reference's dual-stack proxy
+(/root/reference/pkg/agent/proxy/proxier.go:1379-1465 metaProxier: one
+proxier per family, each seeing only its family's ClusterIPs/endpoints/
+node addresses), asserted as a device-kernel vs scalar-oracle differential
+over the wide (10-column) flow cache: v6 ClusterIP DNAT, v6 reply un-DNAT,
+v6 ClientIP affinity, v6 NodePort SNAT marks, v6 DSR delivery, no-endpoint
+reject, and family-purity validation.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import jax.numpy as jnp
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.service import ETP_LOCAL, Endpoint, ServiceEntry
+from antrea_tpu.compiler.compile import compile_policy_set
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.models import pipeline as pl
+from antrea_tpu.ops.match import flip_ips
+from antrea_tpu.oracle.pipeline import PipelineOracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+VIP6 = "fd00:96::10"
+EP6A = "2001:db8:0:1::10"
+EP6B = "2001:db8:0:1::11"
+CLIENT6 = "2001:db8:0:2::7"
+CLIENT6B = "2001:db8:0:2::8"
+NODE6 = "2001:db8:ffff::1"
+NODE4 = "192.168.0.1"
+EXT6 = "fd00:ee::5"
+
+VIP4 = "10.96.0.10"
+EP4 = "10.0.0.10"
+CLIENT4 = "10.0.1.7"
+
+
+def _pkt(src, dst, dport=80, proto=6, sport=40000):
+    return Packet(
+        src_ip=iputil.ip_to_key(src), dst_ip=iputil.ip_to_key(dst),
+        proto=proto, src_port=sport, dst_port=dport,
+    )
+
+
+def _mk(services, node_ips=(), node_name="n0", ps=None):
+    ps = ps if ps is not None else PolicySet()
+    cps = compile_policy_set(ps)
+    svc = compile_services(list(services), node_ips=list(node_ips),
+                           node_name=node_name)
+    step, state, (drs, dsvc) = pl.make_pipeline(
+        cps, svc, flow_slots=1 << 10, aff_slots=1 << 6, miss_chunk=16,
+        dual_stack=True,
+    )
+    po = PipelineOracle(ps, list(services), flow_slots=1 << 10,
+                        aff_slots=1 << 6, node_ips=list(node_ips),
+                        node_name=node_name, dual_stack=True)
+    return step, state, drs, dsvc, po
+
+
+def _step_both(step, state, drs, dsvc, po, pkts, now, gen=0):
+    batch = PacketBatch.from_packets(pkts)
+    v6 = None
+    if batch.is6 is not None:
+        v6 = (jnp.asarray(flip_ips(batch.src_ip6)),
+              jnp.asarray(flip_ips(batch.dst_ip6)),
+              jnp.asarray(batch.is6))
+    state, out = pl.pipeline_step(
+        state, drs, dsvc,
+        jnp.asarray(flip_ips(batch.src_ip)),
+        jnp.asarray(flip_ips(batch.dst_ip)),
+        jnp.asarray(batch.proto.astype(np.int32)),
+        jnp.asarray(batch.src_port.astype(np.int32)),
+        jnp.asarray(batch.dst_port.astype(np.int32)),
+        jnp.int32(now), jnp.int32(gen), meta=step.meta, v6=v6,
+    )
+    outs = po.step(batch, now, gen=gen)
+    dev = {k: np.asarray(v) for k, v in out.items()}
+    for i, o in enumerate(outs):
+        assert int(dev["code"][i]) == o.code, (i, "code")
+        assert int(dev["est"][i]) == int(o.est), (i, "est")
+        assert int(dev["reply"][i]) == int(o.reply), (i, "reply")
+        assert int(dev["committed"][i]) == int(o.committed), (i, "committed")
+        assert int(dev["svc_idx"][i]) == o.svc_idx, (i, "svc")
+        assert int(dev["snat"][i]) == int(o.snat), (i, "snat")
+        assert int(dev["dsr"][i]) == int(o.dsr), (i, "dsr")
+        assert int(dev["dnat_port"][i]) == o.dnat_port, (i, "dnat_port")
+    return state, dev, outs
+
+
+def _dev_dnat_key(dev, i) -> int:
+    """Device wide DNAT words -> combined-keyspace int (oracle space)."""
+    words = [iputil.unflip_u32(int(w)) for w in dev["dnat_w_f"][i]]
+    v = (words[0] << 96) | (words[1] << 64) | (words[2] << 32) | words[3]
+    if (v >> 32) == 0xFFFF:  # v4-mapped
+        return v & 0xFFFFFFFF
+    return iputil.V6_OFF + v
+
+
+def test_v6_clusterip_dnat_and_reply_unnat():
+    """A v6 ClusterIP DNATs to its v6 endpoint (proxier.go ipv6 proxier
+    serviceMap path); the reply leg un-DNATs back to the frontend."""
+    svc = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                       endpoints=[Endpoint(EP6A, 8080)])
+    step, state, drs, dsvc, po = _mk([svc])
+    pkts = [_pkt(CLIENT6, VIP6, 80, sport=43000)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert outs[0].svc_idx == 0 and outs[0].code == 0
+    assert outs[0].dnat_ip == iputil.ip_to_key(EP6A)
+    assert _dev_dnat_key(dev, 0) == iputil.ip_to_key(EP6A)
+    assert int(dev["dnat_port"][0]) == 8080
+
+    # Established hit keeps the cached wide resolution.
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=2)
+    assert int(dev["est"][0]) == 1
+    assert _dev_dnat_key(dev, 0) == iputil.ip_to_key(EP6A)
+
+    # Reply (endpoint -> client): reverse-tuple est hit carrying the
+    # un-DNAT rewrite back to the v6 frontend.
+    rev = [Packet(src_ip=iputil.ip_to_key(EP6A),
+                  dst_ip=iputil.ip_to_key(CLIENT6),
+                  proto=6, src_port=8080, dst_port=43000)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, rev, now=3)
+    assert int(dev["reply"][0]) == 1 and int(dev["est"][0]) == 1
+    assert _dev_dnat_key(dev, 0) == iputil.ip_to_key(VIP6)
+    assert int(dev["dnat_port"][0]) == 80
+
+
+def test_v6_clientip_affinity_sticks():
+    """ClientIP affinity keys on the full 128-bit client address: the same
+    v6 client re-selects its learned endpoint across NEW connections;
+    a different client may hash elsewhere (serviceLearnFlow analog)."""
+    svc = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                       endpoints=[Endpoint(EP6A, 8080), Endpoint(EP6B, 8080)],
+                       affinity_timeout_s=300)
+    step, state, drs, dsvc, po = _mk([svc])
+    # Distinct source ports = distinct connections; affinity (not the flow
+    # cache) must make them agree.
+    first = None
+    for sport in (43100, 43101, 43102):
+        pkts = [_pkt(CLIENT6, VIP6, 80, sport=sport)]
+        state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts,
+                                      now=sport - 43090)
+        got = _dev_dnat_key(dev, 0)
+        assert got == outs[0].dnat_ip  # device == oracle per-lane
+        if first is None:
+            first = got
+        assert got == first, "affinity must pin the endpoint"
+    assert first in (iputil.ip_to_key(EP6A), iputil.ip_to_key(EP6B))
+
+
+def test_v6_no_endpoint_reject():
+    """A v6 service with no endpoints rejects (SvcReject before the policy
+    tables, EndpointDNAT order) — reject kind derives from proto."""
+    svc = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6, endpoints=[])
+    step, state, drs, dsvc, po = _mk([svc])
+    state, dev, outs = _step_both(
+        step, state, drs, dsvc, po, [_pkt(CLIENT6, VIP6, 80)], now=1)
+    assert outs[0].code == 2  # REJECT
+    assert int(dev["reject_kind"][0]) == int(pl.REJECT_TCP_RST)
+
+
+def test_v6_nodeport_binds_v6_node_ips_only():
+    """NodePort frontends bind per family (metaProxier: the v6 proxier sees
+    only v6 node addresses): the v6 service answers on the v6 node IP with
+    the ETP=Cluster SNAT mark; the v4 node IP does NOT expose it."""
+    svc = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                       endpoints=[Endpoint(EP6A, 8080)], node_port=30080)
+    step, state, drs, dsvc, po = _mk([svc], node_ips=[NODE4, NODE6])
+    pkts = [
+        _pkt(CLIENT6, NODE6, 30080, sport=43200),  # v6 NodePort: hit + SNAT
+        _pkt(CLIENT4, NODE4, 30080, sport=43201),  # v4 node IP: no frontend
+    ]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert outs[0].svc_idx == 0
+    assert int(dev["snat"][0]) == 1
+    assert _dev_dnat_key(dev, 0) == iputil.ip_to_key(EP6A)
+    assert outs[1].svc_idx == -1  # the v4 family never exposes a v6 service
+
+
+def test_v6_external_ip_dsr():
+    """A v6 external IP under DSR: endpoint selected (drives forwarding),
+    destination NOT rewritten... is signaled via dsr=1 with snat=0 and no
+    reply conntrack leg (pipeline.go:698-708)."""
+    svc = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                       endpoints=[Endpoint(EP6A, 80)],
+                       external_ips=[EXT6], dsr=True)
+    step, state, drs, dsvc, po = _mk([svc])
+    pkts = [_pkt(CLIENT6, EXT6, 80, sport=43300)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert int(dev["dsr"][0]) == 1 and int(dev["snat"][0]) == 0
+    assert _dev_dnat_key(dev, 0) == iputil.ip_to_key(EP6A)
+    # DSR commits no reply leg: the endpoint->client tuple misses.
+    rev = [Packet(src_ip=iputil.ip_to_key(EP6A),
+                  dst_ip=iputil.ip_to_key(CLIENT6),
+                  proto=6, src_port=80, dst_port=43300)]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, rev, now=2)
+    assert int(dev["reply"][0]) == 0 and not outs[0].hit
+
+
+def test_v6_etp_local_filters_endpoints():
+    """externalTrafficPolicy=Local on a v6 service: external-frontend
+    traffic only selects endpoints on this node; with none local, the
+    no-endpoint treatment applies (proxier.go externalPolicyLocal)."""
+    svc = ServiceEntry(
+        cluster_ip=VIP6, port=80, protocol=6,
+        endpoints=[Endpoint(EP6A, 8080, node="other")],
+        external_ips=[EXT6], external_traffic_policy=ETP_LOCAL,
+    )
+    step, state, drs, dsvc, po = _mk([svc], node_name="n0")
+    pkts = [
+        _pkt(CLIENT6, EXT6, 80, sport=43400),  # external: no LOCAL ep -> reject
+        _pkt(CLIENT6, VIP6, 80, sport=43401),  # cluster view still serves
+    ]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert outs[0].code == 2
+    assert outs[1].code == 0
+    assert _dev_dnat_key(dev, 1) == iputil.ip_to_key(EP6A)
+
+
+def test_dual_stack_twin_services_coexist():
+    """A dual-stack Service is TWO ServiceEntry rows (one per family, the
+    metaProxier split): both families LB to their own endpoints in one
+    mixed batch, and the policy plane sees post-DNAT tuples."""
+    svc6 = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                        endpoints=[Endpoint(EP6A, 8080)])
+    svc4 = ServiceEntry(cluster_ip=VIP4, port=80, protocol=6,
+                        endpoints=[Endpoint(EP4, 8080)])
+    step, state, drs, dsvc, po = _mk([svc6, svc4])
+    pkts = [
+        _pkt(CLIENT6, VIP6, 80, sport=43500),
+        _pkt(CLIENT4, VIP4, 80, sport=43501),
+    ]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert outs[0].svc_idx == 0 and outs[1].svc_idx == 1
+    assert _dev_dnat_key(dev, 0) == iputil.ip_to_key(EP6A)
+    assert _dev_dnat_key(dev, 1) == iputil.ip_to_key(EP4)
+    assert int(dev["dnat_ip_f"][1]) == int(
+        flip_ips(np.array([iputil.ip_to_u32(EP4)], np.uint32))[0]
+    )
+
+
+def test_family_mismatch_raises_on_both_compilers():
+    """Mixed-family endpoints or external IPs are a config error on BOTH
+    engines (family purity, one ServiceEntry per family)."""
+    bad_ep = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                          endpoints=[Endpoint(EP4, 8080)])
+    bad_ext = ServiceEntry(cluster_ip=VIP4, port=80, protocol=6,
+                           endpoints=[Endpoint(EP4, 8080)],
+                           external_ips=[EXT6])
+    for bad in (bad_ep, bad_ext):
+        with pytest.raises(ValueError):
+            compile_services([bad])
+        with pytest.raises(ValueError):
+            PipelineOracle(PolicySet(), [bad], dual_stack=True)
+
+
+def test_v6_service_with_policy_on_post_dnat_tuple():
+    """Policy evaluates the POST-DNAT tuple (EndpointDNAT before the
+    policy tables): a drop rule on the v6 ENDPOINT fires for ClusterIP
+    traffic that DNATs onto it."""
+    ps = PolicySet()
+    ps.applied_to_groups["web"] = cp.AppliedToGroup(
+        name="web", members=[cp.GroupMember(ip=EP6A, node="n0")])
+    ps.policies.append(cp.NetworkPolicy(
+        uid="p", name="p", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["web"], tier_priority=250, priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(
+                ip_blocks=[cp.IPBlock("2001:db8:0:2::/64")]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    svc = ServiceEntry(cluster_ip=VIP6, port=80, protocol=6,
+                       endpoints=[Endpoint(EP6A, 8080)])
+    step, state, drs, dsvc, po = _mk([svc], ps=ps)
+    pkts = [
+        _pkt(CLIENT6, VIP6, 80, sport=43600),   # DNAT -> EP6A -> dropped
+        _pkt("2001:db8:ffff::9", VIP6, 80, sport=43601),  # other src: allowed
+    ]
+    state, dev, outs = _step_both(step, state, drs, dsvc, po, pkts, now=1)
+    assert outs[0].code == 1
+    assert outs[1].code == 0
