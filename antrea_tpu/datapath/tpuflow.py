@@ -190,12 +190,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # interned columns a raw-group delta cannot patch — and whose
         # membership can change even when the raw group's merged ranges do
         # not.  With named ports in play every delta is a full resync (the
-        # OracleDatapath twin applies the same rule).  v6 members likewise:
-        # DeltaTable rows are v4 i32 ranges (classify_batch lane_ok), so a
-        # v6 membership change folds into a recompile instead.
-        need_recompile = self._has_named_ports or any(
-            iputil.is_v6(ip) for ip in (*added_ips, *removed_ips)
-        )
+        # OracleDatapath twin applies the same rule).  v6 members take the
+        # SAME O(1) slot path as v4: DeltaTable carries a family-tagged
+        # lexicographic lane (ops/match.DeltaTable.fam/lo6_w/hi6_w), so v6
+        # pod churn never forces a recompile.
+        need_recompile = self._has_named_ports
 
         for ip in added_ips:
             r = iputil.cidr_to_range(ip)
@@ -638,6 +637,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             "peer_in": np.zeros((D, match_meta.w_in), np.uint32),
             "at_out": np.zeros((D, match_meta.w_out), np.uint32),
             "peer_out": np.zeros((D, match_meta.w_out), np.uint32),
+            "fam": np.zeros(D, np.int32),
+            "lo6_w": np.full((D, 4), 2**31 - 1, np.int32),
+            "hi6_w": np.full((D, 4), -(2**31), np.int32),
         }
         self._name_gids: dict[str, list[int]] = {}
         self._gid_ident = dict(cps.gid_ident)
@@ -727,8 +729,16 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         mm = self._meta.match
         for (lo, hi), gid, sign in rows:
             i = self._n_deltas
-            h["lo_f"][i] = iputil.flip_u32(np.uint32(lo))
-            h["hi_f"][i] = iputil.flip_u32(np.uint32(hi - 1))  # inclusive
+            if lo >= iputil.V6_OFF:
+                # v6 slot: lexicographic word bounds, family-tagged
+                # (cidr_to_range never spans families).
+                h["fam"][i] = 1
+                h["lo6_w"][i] = iputil.key_to_flipped_words(lo)
+                h["hi6_w"][i] = iputil.key_to_flipped_words(hi - 1)
+            else:
+                h["fam"][i] = 0
+                h["lo_f"][i] = iputil.flip_u32(np.uint32(lo))
+                h["hi_f"][i] = iputil.flip_u32(np.uint32(hi - 1))  # inclusive
             h["sign"][i] = sign
             h["at_in"][i] = self._rule_mask(cps.ingress.at_gid, gid, mm.w_in)
             h["peer_in"][i] = self._rule_mask(cps.ingress.peer_gid, gid, mm.w_in)
@@ -748,6 +758,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             at_out=jnp.asarray(h["at_out"]),
             peer_out=jnp.asarray(h["peer_out"]),
             n=jnp.int32(self._n_deltas),
+            fam=jnp.asarray(h["fam"]),
+            lo6_w=jnp.asarray(h["lo6_w"]),
+            hi6_w=jnp.asarray(h["hi6_w"]),
         ))
 
     def _sync_ps_members(self, name: str) -> None:

@@ -206,8 +206,8 @@ class PipelineMeta(NamedTuple):
     ct_other_new_s: Optional[int] = None
     ct_other_est_s: Optional[int] = None
     # Classify cache misses through the fused pallas consumer
-    # (ops/match.classify_batch fused=True; single-chip TPU path — ignored
-    # when a hit_combine seam is active).
+    # (ops/match.classify_batch fused=True) — shard-aware: composes with
+    # the rule-axis hit_combine seam via global word offsets.
     fused: bool = False
     # Flow-cache key row width: 4 (v4-only: [src, dst, pp, pg]) or 10
     # (dual-stack: [s0..s3, d0..d3, pp, pg] — addresses in wide v4-mapped
@@ -910,7 +910,9 @@ def _pipeline_step(
             cls = classify_batch(
                 drs, s_f, dnat_ip, p_m, dnat_port,
                 meta=meta.match, hit_combine=hit_combine,
-                fused=meta.fused and hit_combine is None,
+                # The fused consumer is shard-aware (global word offsets
+                # from word_idx), so it composes with hit_combine.
+                fused=meta.fused,
                 v6=None if wide_m is None else (saddr_m, dnat_w, is6_m),
             )
             code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)

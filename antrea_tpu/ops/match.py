@@ -121,9 +121,14 @@ class DeltaTable(NamedTuple):
     Slots apply in append order inside a dynamic-trip-count loop (`n`), so
     zero pending deltas cost zero iterations and a later delta for the same
     rule bit wins.  Empty slots: sign == 0.
+
+    Dual-stack: a slot is single-family (`fam`) — v4 slots compare the
+    narrow range, v6 slots the 4-word lexicographic one (same pre-resolved
+    masks either way), so v6 pod churn stays O(1) instead of forcing a
+    recompile.
     """
 
-    lo_f: jax.Array  # (D,) sign-flipped i32, inclusive
+    lo_f: jax.Array  # (D,) sign-flipped i32, inclusive (v4 slots)
     hi_f: jax.Array  # (D,) sign-flipped i32, inclusive
     sign: jax.Array  # (D,) i32 — +1 set, -1 clear, 0 empty
     iso: jax.Array  # (D,) i32 — bit0: patches iso_in, bit1: patches iso_out
@@ -132,6 +137,9 @@ class DeltaTable(NamedTuple):
     at_out: jax.Array  # (D, W_out)
     peer_out: jax.Array  # (D, W_out)
     n: jax.Array  # () i32 — active slots
+    fam: jax.Array  # (D,) i32 — 0: v4 slot, 1: v6 slot
+    lo6_w: jax.Array  # (D, 4) per-word flipped, inclusive (v6 slots)
+    hi6_w: jax.Array  # (D, 4)
 
 
 class DeviceRuleSet(NamedTuple):
@@ -153,6 +161,11 @@ class StaticMeta(NamedTuple):
     w_in: int  # ingress rule words (incl. shard padding)
     w_out: int
     delta_slots: int = 0
+    # Fused-consumer interpret override: None = infer from the DEFAULT
+    # platform.  The sharded builders set this from the MESH's platform —
+    # a CPU mesh on a TPU-default host (the virtual-device dryrun) must
+    # interpret, and vice versa.
+    fused_interpret: "bool | None" = None
 
 
 def empty_delta(slots: int, w_in: int, w_out: int, xp=jnp) -> DeltaTable:
@@ -166,6 +179,9 @@ def empty_delta(slots: int, w_in: int, w_out: int, xp=jnp) -> DeltaTable:
         at_out=xp.zeros((slots, w_out), dtype=xp.uint32),
         peer_out=xp.zeros((slots, w_out), dtype=xp.uint32),
         n=xp.zeros((), dtype=xp.int32),
+        fam=xp.zeros((slots,), dtype=xp.int32),
+        lo6_w=xp.full((slots, 4), 2**31 - 1, dtype=xp.int32),
+        hi6_w=xp.full((slots, 4), -(2**31), dtype=xp.int32),
     )
 
 
@@ -388,15 +404,40 @@ def to_device(
 # ---------------------------------------------------------------------------
 
 
+def _lex_le4(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a <= b over a trailing 4-word axis (per-word flipped
+    i32 — the same compare _searchsorted6 builds from)."""
+    lt = a < b
+    eq = a == b
+    return lt[..., 0] | (eq[..., 0] & (lt[..., 1] | (eq[..., 1] & (
+        lt[..., 2] | (eq[..., 2] & (lt[..., 3] | eq[..., 3]))))))
+
+
+def _delta_lane_match(ip_f, dt: DeltaTable, i, wide):
+    """Lanes slot i's range covers: v4 slots compare the narrow column of
+    v4 lanes; v6 slots the wide words of v6 lanes (family-pure slots —
+    the dual-stack membership test, shared by rows and iso)."""
+    m4 = (ip_f >= dt.lo_f[i]) & (ip_f <= dt.hi_f[i])
+    if wide is None:
+        return m4
+    xw, is6 = wide
+    m4 = m4 & (is6 == 0) & (dt.fam[i] == 0)
+    m6 = (
+        (is6 != 0) & (dt.fam[i] == 1)
+        & _lex_le4(dt.lo6_w[i][None, :], xw)
+        & _lex_le4(xw, dt.hi6_w[i][None, :])
+    )
+    return m4 | m6
+
+
 def _patch_rows(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks,
-                lane_ok=None) -> jax.Array:
+                wide=None) -> jax.Array:
     """Apply the active delta slots to gathered incidence rows (B, W).
-    lane_ok masks lanes the (v4-only) delta ranges may touch at all."""
+    wide = (xw (B,4), is6) in dual-stack worlds — the dimension's lane
+    words, so v6 slots patch v6 lanes."""
 
     def body(i, rows):
-        m = (ip_f >= dt.lo_f[i]) & (ip_f <= dt.hi_f[i])
-        if lane_ok is not None:
-            m = m & lane_ok
+        m = _delta_lane_match(ip_f, dt, i, wide)
         mask = masks[i][None, :]
         s = dt.sign[i]
         rows = jnp.where((m & (s > 0))[:, None], rows | mask, rows)
@@ -407,15 +448,12 @@ def _patch_rows(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks,
 
 
 def _patch_iso(bit: jax.Array, ip_f: jax.Array, dt: DeltaTable, which: int,
-               lane_ok=None) -> jax.Array:
+               wide=None) -> jax.Array:
     def body(i, bit):
         m = (
-            (ip_f >= dt.lo_f[i])
-            & (ip_f <= dt.hi_f[i])
+            _delta_lane_match(ip_f, dt, i, wide)
             & (((dt.iso[i] >> which) & 1) == 1)
         )
-        if lane_ok is not None:
-            m = m & lane_ok
         s = dt.sign[i]
         bit = jnp.where(m & (s > 0), 1, bit)
         bit = jnp.where(m & (s < 0), 0, bit)
@@ -595,12 +633,7 @@ def _searchsorted6(bounds6: jax.Array, xw: jax.Array) -> jax.Array:
     n = bounds6.shape[0]
     if n == 0:
         return jnp.zeros(xw.shape[0], dtype=jnp.int32)
-    b = bounds6[None, :, :]  # (1, N, 4)
-    k = xw[:, None, :]  # (B, 1, 4)
-    lt = b < k
-    eq = b == k
-    leq = lt[..., 0] | (eq[..., 0] & (lt[..., 1] | (eq[..., 1] & (
-        lt[..., 2] | (eq[..., 2] & (lt[..., 3] | eq[..., 3]))))))
+    leq = _lex_le4(bounds6[None, :, :], xw[:, None, :])  # (B, N)
     return leq.sum(axis=1, dtype=jnp.int32)
 
 
@@ -636,11 +669,13 @@ def classify_batch(
 
     fused=True consumes the gathered rows through the pallas consumer
     kernel (one read per gathered byte; see the cold-path study above).
-    Single-chip only: the kernel derives global rule indices from lane
-    position, which is wrong under hit_combine's rule-axis sharding, so a
-    non-None hit_combine keeps the XLA scan.  Delta patching composes (it
-    runs on the gathered rows before the consumer).  Off-TPU the kernel
-    runs in interpret mode (slow; parity tests only).
+    Composes with hit_combine's rule-axis sharding: each shard's kernel
+    receives its global word offset (word_idx[0], carried as data for
+    exactly this) and emits GLOBAL rule indices, so the pmin all-reduce
+    combines them like the XLA-scan path — the sharded walk keeps the
+    fused cold-path win.  Delta patching composes (it runs on the
+    gathered rows before the consumer).  Off-TPU the kernel runs in
+    interpret mode (slow; parity tests only).
     """
     ing, eg = drs.ingress, drs.egress
     svc_key = (proto << 16) | dst_port
@@ -679,21 +714,25 @@ def classify_batch(
     if meta.delta_slots > 0:
         # Incremental membership deltas patch the gathered rows, so peer/
         # appliedTo/isolation consumers all see post-delta membership.
-        # Delta slots carry v4 ranges only (v6 membership changes force a
-        # recompile, datapath/tpuflow.py) — v6 lanes must not false-match
-        # a v4 range on their don't-care v4 lane.
+        # Slots are family-pure: v4 slots patch v4 lanes on the narrow
+        # column, v6 slots patch v6 lanes on their wide words — v6 pod
+        # churn stays O(1), no recompile (DeltaTable docstring).
         d = drs.ip_delta
-        ok = None if v6 is None else (is6 == 0)
-        in_at = _patch_rows(in_at, dst_ip_f, d, d.at_in, ok)
-        in_peer = _patch_rows(in_peer, src_ip_f, d, d.peer_in, ok)
-        out_at = _patch_rows(out_at, src_ip_f, d, d.at_out, ok)
-        out_peer = _patch_rows(out_peer, dst_ip_f, d, d.peer_out, ok)
-        iso_in = _patch_iso(iso_in, dst_ip_f, d, 0, ok)
-        iso_out = _patch_iso(iso_out, src_ip_f, d, 1, ok)
+        wide_d = None if v6 is None else (d6, is6)
+        wide_s = None if v6 is None else (s6, is6)
+        in_at = _patch_rows(in_at, dst_ip_f, d, d.at_in, wide_d)
+        in_peer = _patch_rows(in_peer, src_ip_f, d, d.peer_in, wide_s)
+        out_at = _patch_rows(out_at, src_ip_f, d, d.at_out, wide_s)
+        out_peer = _patch_rows(out_peer, dst_ip_f, d, d.peer_out, wide_d)
+        iso_in = _patch_iso(iso_in, dst_ip_f, d, 0, wide_d)
+        iso_out = _patch_iso(iso_out, src_ip_f, d, 1, wide_s)
 
-    if fused and hit_combine is None:
+    if fused:
+        shard = hit_combine is not None
         in_hits, out_hits = _fused_hits(
-            (in_at, in_peer, in_svc), (out_at, out_peer, out_svc), meta
+            (in_at, in_peer, in_svc), (out_at, out_peer, out_svc), meta,
+            w0_in=ing.word_idx[0] if shard else None,
+            w0_out=eg.word_idx[0] if shard else None,
         )
     else:
         in_hits = _phase_hits(
@@ -778,48 +817,127 @@ def _phase_scan_tile(m, w, phases):
     )
 
 
-@lru_cache(maxsize=32)
-def _consumer_call(b, w_in, w_out, in_phases, out_phases, interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+def _phase_scan_tile_dyn(m, w, phases, w0):
+    """_phase_scan_tile with a DYNAMIC global word offset (the rule-axis
+    shard seam): this tile's words are global words [w0, w0+w), so phase
+    boundaries cannot be static slices — each phase masks the full width
+    by its global-rule window instead (the _phase_hits mask discipline,
+    inside VMEM).  w0 is a traced scalar from word_idx, NOT a python int."""
+    mu = m.astype(jnp.uint32)
+    base = (jax.lax.broadcasted_iota(jnp.int32, (m.shape[0], w), 1)
+            + w0) * 32
 
-    def kernel(ia, ip_, is_, oa, op_, os_, o_ref):
-        i0, ik, ib = _phase_scan_tile(ia[:] & ip_[:] & is_[:], w_in, in_phases)
-        o0, ok_, ob = _phase_scan_tile(oa[:] & op_[:] & os_[:], w_out, out_phases)
-        o_ref[:] = jnp.stack(
-            [i0, ik, ib, o0, ok_, ob, jnp.zeros_like(i0), jnp.zeros_like(i0)],
-            axis=1,
+    def first_bounded(lo_rule, hi_rule):
+        k_lo = jnp.clip(lo_rule - base, 0, 32)
+        k_hi = jnp.clip(hi_rule - base, 0, 32)
+        mask_lo = jnp.where(
+            k_lo <= 0,
+            jnp.uint32(_ALL1),
+            ~((jnp.uint32(1) << jnp.minimum(k_lo, 31).astype(jnp.uint32))
+              - jnp.uint32(1)),
         )
+        mask_lo = jnp.where(k_lo >= 32, jnp.uint32(0), mask_lo)
+        mask_hi = jnp.where(
+            k_hi >= 32,
+            jnp.uint32(_ALL1),
+            (jnp.uint32(1) << jnp.clip(k_hi, 0, 31).astype(jnp.uint32))
+            - jnp.uint32(1),
+        )
+        mw = mu & mask_lo & mask_hi
+        lsb = mw & (jnp.uint32(0) - mw)
+        tz = jax.lax.population_count(lsb - jnp.uint32(1))
+        v = jnp.where(mw == jnp.uint32(0), BIG, base + tz.astype(jnp.int32))
+        return jnp.min(v, axis=1)
+
+    n0, nk, _nb = phases
+    # Baseline phase upper bound: unbounded (padding words carry zero bits).
+    return (
+        first_bounded(0, n0),
+        first_bounded(n0, n0 + nk),
+        first_bounded(n0 + nk, 1 << 30),
+    )
+
+
+@lru_cache(maxsize=32)
+def _consumer_call(b, w_in, w_out, in_phases, out_phases, interpret,
+                   sharded):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     tb = _FUSE_TB
+    if sharded:
+        # Shard-aware variant: two SMEM scalars carry each direction's
+        # global word offset (word_idx[0] — data, so the SAME compiled
+        # kernel serves every rule shard under shard_map).
+        def kernel(ia, ip_, is_, oa, op_, os_, w0i, w0o, o_ref):
+            i0, ik, ib = _phase_scan_tile_dyn(
+                ia[:] & ip_[:] & is_[:], w_in, in_phases, w0i[0, 0])
+            o0, ok_, ob = _phase_scan_tile_dyn(
+                oa[:] & op_[:] & os_[:], w_out, out_phases, w0o[0, 0])
+            o_ref[:] = jnp.stack(
+                [i0, ik, ib, o0, ok_, ob,
+                 jnp.zeros_like(i0), jnp.zeros_like(i0)], axis=1,
+            )
+
+        extra = [pl.BlockSpec((1, 1), lambda i: (0, 0),
+                              memory_space=pltpu.SMEM)] * 2
+    else:
+        def kernel(ia, ip_, is_, oa, op_, os_, o_ref):
+            i0, ik, ib = _phase_scan_tile(
+                ia[:] & ip_[:] & is_[:], w_in, in_phases)
+            o0, ok_, ob = _phase_scan_tile(
+                oa[:] & op_[:] & os_[:], w_out, out_phases)
+            o_ref[:] = jnp.stack(
+                [i0, ik, ib, o0, ok_, ob,
+                 jnp.zeros_like(i0), jnp.zeros_like(i0)], axis=1,
+            )
+
+        extra = []
+
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, 8), jnp.int32),
         grid=(b // tb,),
         in_specs=[pl.BlockSpec((tb, w), lambda i: (i, 0))
-                  for w in (w_in, w_in, w_in, w_out, w_out, w_out)],
+                  for w in (w_in, w_in, w_in, w_out, w_out, w_out)] + extra,
         out_specs=pl.BlockSpec((tb, 8), lambda i: (i, 0)),
         interpret=interpret,
     )
 
 
-def _fused_hits(rows_in, rows_out, meta: StaticMeta):
+def _fused_hits(rows_in, rows_out, meta: StaticMeta, w0_in=None, w0_out=None):
     """6 gathered row sets -> (in_hits, out_hits) via the fused consumer.
 
     Pads the batch to the tile multiple (tiny worlds / odd slow-path
     chunks); interpret mode keeps the kernel testable off-TPU.
+
+    w0_in/w0_out (traced scalars): each direction's global word offset —
+    pass word_idx[0] under rule-axis shard_map so the kernel emits GLOBAL
+    rule indices that compose with the hit_combine pmin (the shard seam;
+    None = single-chip, offsets statically zero).  Widths come from the
+    rows themselves (per-shard width != meta.w_* under sharding).
     """
     b = rows_in[0].shape[0]
+    w_in = rows_in[0].shape[1]
+    w_out = rows_out[0].shape[1]
     pad = (-b) % _FUSE_TB
     if pad:
         rows_in = tuple(jnp.pad(r, ((0, pad), (0, 0))) for r in rows_in)
         rows_out = tuple(jnp.pad(r, ((0, pad), (0, 0))) for r in rows_out)
-    interpret = jax.devices()[0].platform == "cpu"
+    if meta.fused_interpret is not None:
+        interpret = meta.fused_interpret
+    else:
+        interpret = jax.devices()[0].platform == "cpu"
+    sharded = w0_in is not None
     call = _consumer_call(
-        b + pad, meta.w_in, meta.w_out, meta.in_phases, meta.out_phases,
-        interpret,
+        b + pad, w_in, w_out, meta.in_phases, meta.out_phases,
+        interpret, sharded,
     )
-    hits = call(*rows_in, *rows_out)[:b]
+    if sharded:
+        scal = lambda x: jnp.asarray(x, jnp.int32).reshape(1, 1)  # noqa: E731
+        hits = call(*rows_in, *rows_out, scal(w0_in), scal(w0_out))[:b]
+    else:
+        hits = call(*rows_in, *rows_out)[:b]
     return (hits[:, 0], hits[:, 1], hits[:, 2]), (hits[:, 3], hits[:, 4], hits[:, 5])
 
 

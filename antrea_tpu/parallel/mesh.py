@@ -123,6 +123,9 @@ def _drs_specs() -> m.DeviceRuleSet:
             at_out=P(None, RULE),
             peer_out=P(None, RULE),
             n=P(),
+            fam=P(),
+            lo6_w=P(),
+            hi6_w=P(),
         ),
     )
 
@@ -141,6 +144,11 @@ def shard_rule_set(cps: CompiledPolicySet, mesh: Mesh):
     """Compile + place rule tensors on the mesh -> (drs, StaticMeta)."""
     n_rule = mesh.shape[RULE]
     drs, meta = m.to_device(cps, word_multiple=n_rule)
+    # The fused consumer must interpret iff the MESH's backend is CPU —
+    # the default platform can differ (virtual-CPU dryrun on a TPU host).
+    meta = meta._replace(
+        fused_interpret=(mesh.devices.flat[0].platform == "cpu")
+    )
     specs = _drs_specs()
     drs = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), drs, specs
@@ -200,7 +208,7 @@ def _fwd_specs() -> fw.DeviceForwardingTables:
 
 
 def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
-                        ct_timeout_s, miss_chunk):
+                        ct_timeout_s, miss_chunk, fused=False):
     """Shared builder behind make_sharded_pipeline[_full] — one place for
     the capacity check, placement, meta/state construction and shard_map
     scaffolding so the two public variants can never drift."""
@@ -221,6 +229,9 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
         aff_slots=aff_slots,
         ct_timeout_s=ct_timeout_s,
         miss_chunk=miss_chunk,
+        # The fused consumer is shard-aware (global word offsets ride
+        # word_idx), so the sharded walk keeps the cold-path win.
+        fused=fused,
     )
     state = shard_state(pl.init_state(flow_slots, aff_slots), mesh)
 
@@ -283,6 +294,7 @@ def make_sharded_pipeline(
     aff_slots: int = 1 << 18,
     ct_timeout_s: int = 3600,
     miss_chunk: int = 4096,
+    fused: bool = False,
 ):
     """Full stateful datapath step, SPMD over (data, rule).
 
@@ -293,7 +305,8 @@ def make_sharded_pipeline(
     only when ITS slice of the batch has cache misses.
     """
     step, state, drs, dsvc, _dft = _build_sharded_step(
-        cps, svc, mesh, None, flow_slots, aff_slots, ct_timeout_s, miss_chunk
+        cps, svc, mesh, None, flow_slots, aff_slots, ct_timeout_s,
+        miss_chunk, fused=fused,
     )
     return step, state, (drs, dsvc)
 
@@ -308,6 +321,7 @@ def make_sharded_pipeline_full(
     aff_slots: int = 1 << 18,
     ct_timeout_s: int = 3600,
     miss_chunk: int = 4096,
+    fused: bool = False,
 ):
     """The FULL per-packet walk (SpoofGuard -> policy/service pipeline ->
     L2/L3 forward -> Output, models/forwarding._pipeline_step_full), SPMD
@@ -322,6 +336,7 @@ def make_sharded_pipeline_full(
     only in the classification pmin, exactly as in make_sharded_pipeline.
     """
     step, state, drs, dsvc, dft = _build_sharded_step(
-        cps, svc, mesh, ft, flow_slots, aff_slots, ct_timeout_s, miss_chunk
+        cps, svc, mesh, ft, flow_slots, aff_slots, ct_timeout_s,
+        miss_chunk, fused=fused,
     )
     return step, state, (drs, dsvc, dft)

@@ -359,10 +359,10 @@ def test_dual_stack_v4_service_still_works():
     assert int(dev["dnat_port"][0]) == 80  # un-DNAT to the frontend
 
 
-def test_v6_group_delta_forces_recompile_both_datapaths():
-    """DeltaTable rows are v4-only, so a v6 membership delta must fold into
-    a full recompile (never an OverflowError or a silently-wrapped v4
-    patch) — and the recompiled tables must reflect the new member."""
+def test_v6_group_delta_is_incremental_both_datapaths():
+    """v6 membership deltas take the O(1) slot path (DeltaTable's
+    family-tagged lexicographic lane, ops/match.DeltaTable) — no recompile
+    — and classification reflects the new member on both engines."""
     from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
     from antrea_tpu.ops.match import classify_batch
 
@@ -390,11 +390,13 @@ def test_v6_group_delta_forces_recompile_both_datapaths():
         gen = dp.apply_group_delta("bad", [OTHER6], [])
         assert gen == g0 + 1, dp.datapath_type
 
-    # The tpuflow recompile reflects the added v6 member (white-box: the
-    # Datapath packet boundary is v4; classify directly on its tables).
+    # The tpuflow DELTA SLOT (no recompile) reflects the added v6 member
+    # (white-box: classify directly on its tables with v6 lanes).
     dp = TpuflowDatapath(copy.deepcopy(ps), [], flow_slots=1 << 8,
                          aff_slots=1 << 4, miss_chunk=16)
     dp.apply_group_delta("bad", [OTHER6], [])
+    assert dp._n_deltas == 1, "v6 delta must use a slot, not a recompile"
+    # Slot removal clears it again without recompile.
     pkts = [_pkt(OTHER6, WEB6)]
     b = PacketBatch.from_packets(pkts)
     out = classify_batch(
@@ -407,6 +409,18 @@ def test_v6_group_delta_forces_recompile_both_datapaths():
             jnp.asarray(b.is6)),
     )
     assert int(np.asarray(out["code"])[0]) == ACT_DROP  # new member matches
+    dp.apply_group_delta("bad", [], [OTHER6])
+    assert dp._n_deltas == 2  # a clear slot appended, still incremental
+    out = classify_batch(
+        dp._drs,
+        jnp.asarray(flip_ips(b.src_ip)), jnp.asarray(flip_ips(b.dst_ip)),
+        jnp.asarray(b.proto.astype(np.int32)),
+        jnp.asarray(b.dst_port.astype(np.int32)),
+        meta=dp._meta.match,
+        v6=(jnp.asarray(flip_ips(b.src_ip6)), jnp.asarray(flip_ips(b.dst_ip6)),
+            jnp.asarray(b.is6)),
+    )
+    assert int(np.asarray(out["code"])[0]) == ACT_ALLOW  # member removed
 
 
 def test_dual_stack_randomized_differential():

@@ -175,3 +175,34 @@ def test_sharded_full_walk_matches_single(cluster):
     from antrea_tpu.compiler.topology import FWD_TUNNEL
     assert int((np.asarray(outN["fwd_kind"]) == FWD_TUNNEL).sum()) > 0
     assert int(np.asarray(outN["spoofed"]).sum()) > 0
+
+
+def test_sharded_fused_consumer_matches_single(cluster, batch):
+    """fused=True composes with the rule-axis shard seam: each shard's
+    pallas consumer receives its global word offset (word_idx[0]) and
+    emits GLOBAL rule indices, so the pmin-combined verdicts are
+    bit-identical to the single-chip fused path — the sharded walk keeps
+    the cold-path win (round-4 weak #4)."""
+    cps = compile_policy_set(cluster.ps)
+    svc = compile_services(gen_services(16, cluster.pod_ips, seed=13))
+    src_f, dst_f, proto, sport, dport = _cols(batch)
+
+    step1, st1, (drs1, dsvc1) = make_pipeline(
+        cps, svc, flow_slots=1 << 14, aff_slots=1 << 12, fused=True
+    )
+    mesh = _mesh(2, 4)
+    stepN, stN, (drsN, dsvcN) = make_sharded_pipeline(
+        cps, svc, mesh, flow_slots=1 << 14, aff_slots=1 << 12, fused=True
+    )
+    for t in range(2):
+        st1, out1 = step1(st1, drs1, dsvc1, src_f, dst_f, proto, sport,
+                          dport, jnp.int32(1000 + t), jnp.int32(0))
+        stN, outN = stepN(stN, drsN, dsvcN, src_f, dst_f, proto, sport,
+                          dport, jnp.int32(1000 + t), jnp.int32(0))
+        for k in ("code", "est", "svc_idx", "dnat_ip_f", "dnat_port",
+                  "ingress_rule", "egress_rule"):
+            np.testing.assert_array_equal(
+                np.asarray(outN[k]), np.asarray(out1[k]),
+                err_msg=f"step{t}:{k}",
+            )
+    assert int(np.asarray(outN["est"]).sum()) > 0
