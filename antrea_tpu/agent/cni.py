@@ -210,3 +210,160 @@ class CniServer:
 
     def cmd_check(self, container_id: str) -> bool:
         return self.ifaces.get(container_id) is not None
+
+
+# -- unix-socket wire ---------------------------------------------------------
+
+
+CNI_WIRE_VERSION = "1.0"
+
+
+class CniSocketServer:
+    """The kubelet->agent seam over a unix-domain socket — the transport
+    shape of the reference's Cni gRPC service
+    (/root/reference/pkg/apis/cni/v1beta1/cni.proto:67-75; server
+    pkg/agent/cniserver/server.go:430 listening on a unix socket).
+
+    Framing: newline-delimited JSON requests
+    {"version": "1.0", "cmd": "add"|"del"|"check", ...} with one JSON
+    response line each.  Concurrent clients each get a handler thread
+    (kubelet issues parallel CNI calls for distinct sandboxes); a
+    version the server doesn't speak gets a structured error, the
+    versioned-request contract of the proto."""
+
+    def __init__(self, server: CniServer, sock_path: str):
+        import os as _os
+        import socket as _socket
+        import threading as _threading
+
+        self._server = server
+        self.sock_path = sock_path
+        try:
+            _os.unlink(sock_path)
+        except FileNotFoundError:
+            pass
+        self._lsock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        self._lsock.bind(sock_path)
+        self._lsock.listen(16)
+        self._closing = False
+        # CmdAdd/CmdDel mutate IPAM + interface store: serialize them (the
+        # reference's server also serializes per-container operations).
+        self._mu = _threading.Lock()
+        self._acceptor = _threading.Thread(target=self._accept_loop,
+                                           daemon=True)
+        self._acceptor.start()
+
+    def _accept_loop(self) -> None:
+        import threading as _threading
+        import time as _time
+
+        while not self._closing:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                if self._closing:
+                    return
+                # Transient accept errors (ECONNABORTED, EMFILE pressure)
+                # must not kill a live server; back off and keep serving.
+                _time.sleep(0.05)
+                continue
+            _threading.Thread(target=self._serve, args=(conn,),
+                              daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        from ..dissemination.netwire import iter_json_lines
+
+        try:
+            try:
+                for req in iter_json_lines(conn):
+                    resp = self._handle(req)
+                    conn.sendall(json.dumps(resp).encode() + b"\n")
+            except ValueError as e:
+                # Malformed JSON / oversized frame: one structured error,
+                # then drop the (unrecoverable) stream.
+                conn.sendall(json.dumps(
+                    {"ok": False, "error": f"malformed request: {e}"}
+                ).encode() + b"\n")
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req) -> dict:
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be a JSON object"}
+        if req.get("version") != CNI_WIRE_VERSION:
+            return {"ok": False,
+                    "error": f"unsupported version {req.get('version')!r}"}
+        cmd = req.get("cmd")
+        try:
+            with self._mu:
+                if cmd == "add":
+                    ic = self._server.cmd_add(
+                        req["containerId"], req.get("podNamespace", ""),
+                        req.get("podName", ""), req.get("labels") or {},
+                    )
+                    return {"ok": True, "ip": ic.ip, "ofport": ic.ofport,
+                            "gateway": self._server.ipam.gateway}
+                if cmd == "del":
+                    return {"ok": True,
+                            "released": self._server.cmd_del(
+                                req["containerId"])}
+                if cmd == "check":
+                    return {"ok": True,
+                            "exists": self._server.cmd_check(
+                                req["containerId"])}
+        except Exception as e:  # noqa: BLE001 — handler boundary: the
+            # kubelet gets a structured error, never a dead socket.
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    def close(self) -> None:
+        import os as _os
+
+        self._closing = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        try:
+            _os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+
+class CniClient:
+    """Framed unix-socket client (the kubelet side of the seam)."""
+
+    def __init__(self, sock_path: str):
+        import socket as _socket
+
+        self._sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        self._sock.connect(sock_path)
+        self._buf = b""
+
+    def _rpc(self, body: dict) -> dict:
+        from ..dissemination.netwire import recv_one_json
+
+        body.setdefault("version", CNI_WIRE_VERSION)
+        self._sock.sendall(json.dumps(body).encode() + b"\n")
+        obj, self._buf = recv_one_json(self._sock, self._buf)
+        return obj
+
+    def add(self, container_id: str, pod_namespace: str = "",
+            pod_name: str = "", labels=None) -> dict:
+        return self._rpc({"cmd": "add", "containerId": container_id,
+                          "podNamespace": pod_namespace,
+                          "podName": pod_name, "labels": labels or {}})
+
+    def delete(self, container_id: str) -> dict:
+        return self._rpc({"cmd": "del", "containerId": container_id})
+
+    def check(self, container_id: str) -> dict:
+        return self._rpc({"cmd": "check", "containerId": container_id})
+
+    def close(self) -> None:
+        self._sock.close()

@@ -8,9 +8,13 @@ ALIVE node owns a given egress IP is a pure function of the alive set and
 the key, so every agent independently elects the same owner and ownership
 moves deterministically when membership changes.
 
-The gossip transport is out of scope here (membership arrives via
-join/leave calls — the dissemination plane or an operator drives them);
-the consistent hash ring IS the load-bearing semantics and is reproduced:
+The gossip transport lives in agent/gossip.py (SWIM over UDP: probe,
+indirect probe, suspect/dead, piggybacked membership — cluster.go:180
+memberlist.Create / :227 Join): a SwimNode feeds this cluster's
+join/leave on DETECTED transitions, so Egress/ServiceExternalIP/
+MC-gateway failover triggers on real death, not an operator's leave()
+call (tests/test_gossip.py kills a process and observes re-election).
+The consistent hash ring here is the load-bearing election semantics:
 virtual nodes on a ring, owner = first node clockwise of the key's hash
 (ref consistenthash.New/Get).
 """

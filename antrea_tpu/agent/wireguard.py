@@ -10,19 +10,24 @@ remote node — endpoint = node IP:port, allowedIPs = that node's pod CIDR(s)
 The cipher itself is the kernel's WireGuard implementation even in the
 reference (the agent only drives wgctrl netlink); what the agent owns —
 and what this module rebuilds — is key lifecycle + the peer/allowed-IP
-reconciliation.  Key material here is 32 random bytes; the public half is
-derived by a tagged one-way digest standing in for X25519 scalar-mult
-(no curve library in this image; the derivation is irrelevant to the
-reconciliation semantics under test, and real key math would ride the
-kernel exactly as in the reference)."""
+reconciliation.  Key material is REAL X25519 (wgtypes.GeneratePrivateKey
+analog): the private key is a curve scalar, the public half is X25519
+scalar-mult via `cryptography`, and shared_secret() computes the
+Diffie-Hellman both peers agree on — the primitive the kernel's Noise
+handshake consumes."""
 
 from __future__ import annotations
 
 import base64
-import hashlib
 import os
 from dataclasses import dataclass
 from typing import Optional
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
 
 DEFAULT_PORT = 51820  # ref: pkg/agent/config WireGuardListenPort default
 
@@ -30,11 +35,24 @@ _KEY_ROW = "wireguard/private_key"
 
 
 def _derive_public(private_b64: str) -> str:
-    """Placeholder for X25519 pub-key derivation (see module docstring):
-    deterministic one-way digest tagged so it can never be mistaken for a
-    real curve point."""
-    d = hashlib.sha256(b"antrea-tpu-wg-pub:" + private_b64.encode()).digest()
-    return base64.b64encode(d).decode()
+    """X25519 public key of a base64 private scalar (wgtypes
+    Key.PublicKey) — interop-checked against RFC 7748 vectors in
+    tests/test_aux_agents.py."""
+    priv = X25519PrivateKey.from_private_bytes(
+        base64.b64decode(private_b64))
+    return base64.b64encode(priv.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )).decode()
+
+
+def shared_secret(private_b64: str, peer_public_b64: str) -> str:
+    """X25519 DH: both directions derive the same 32-byte secret — the
+    handshake primitive (kernel Noise IK consumes exactly this)."""
+    priv = X25519PrivateKey.from_private_bytes(
+        base64.b64decode(private_b64))
+    pub = X25519PublicKey.from_public_bytes(
+        base64.b64decode(peer_public_b64))
+    return base64.b64encode(priv.exchange(pub)).decode()
 
 
 @dataclass
@@ -72,6 +90,11 @@ class WireGuardClient:
     @property
     def listen_port(self) -> int:
         return self._port
+
+    def shared_with(self, peer_public_b64: str) -> str:
+        """X25519 DH with a peer's published public key — both ends
+        derive the same secret (the handshake-shaped key schedule)."""
+        return shared_secret(self._private, peer_public_b64)
 
     # -- peer reconciliation (client_linux.go UpdatePeer/DeletePeer) ---------
 

@@ -163,6 +163,40 @@ class _LineConn:
         return out
 
 
+def iter_json_lines(sock, max_line: int = 1 << 20):
+    """Yield decoded JSON objects from newline-framed lines on a BLOCKING
+    socket until EOF — the one blocking-side framing loop (the
+    non-blocking twin is _LineConn.recv_ready; the 64KiB hello bound in
+    _handshake_inner is this same discipline).  Malformed JSON yields a
+    ValueError to the caller; an oversized line raises."""
+    buf = b""
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return
+        buf += chunk
+        if len(buf) > max_line:
+            raise ValueError(f"frame exceeds {max_line} bytes")
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line:
+                yield json.loads(line.decode())
+
+
+def recv_one_json(sock, buf: bytes, max_line: int = 1 << 20):
+    """Blocking read of ONE newline-framed JSON object -> (obj, rest) —
+    the client-side half of iter_json_lines' framing."""
+    while b"\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed the socket")
+        buf += chunk
+        if len(buf) > max_line:
+            raise ValueError(f"frame exceeds {max_line} bytes")
+    line, rest = buf.split(b"\n", 1)
+    return json.loads(line.decode()), rest
+
+
 # -- server ------------------------------------------------------------------
 
 
@@ -397,6 +431,24 @@ class DisseminationServer:
 # -- agent client ------------------------------------------------------------
 
 
+def connect_client(node: str, address, certdir: str,
+                   client_cn: Optional[str] = None):
+    """The ONE agent-side mTLS bring-up (cert issue, TLS connect, hello,
+    non-blocking socket) shared by NetAgent and the fleet's watch-only
+    clients — client-side wire changes live here exactly once.
+    -> (tls socket, _LineConn)."""
+    cert, key = issue_cert(certdir, client_cn or f"agent-{node}")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(cert, key)
+    ctx.load_verify_locations(os.path.join(certdir, "ca.crt"))
+    raw = socket.create_connection(tuple(address))
+    sock = ctx.wrap_socket(raw, server_hostname="localhost")
+    conn = _LineConn(sock)
+    conn.send({"hello": node})
+    sock.setblocking(False)
+    return sock, conn
+
+
 class NetAgent:
     """Agent-side client: TLS-verified event stream into an
     AgentPolicyController + upstream realization reports."""
@@ -405,15 +457,8 @@ class NetAgent:
                  client_cn: Optional[str] = None):
         from ..agent.controller import AgentPolicyController
 
-        cert, key = issue_cert(certdir, client_cn or f"agent-{node}")
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-        ctx.load_cert_chain(cert, key)
-        ctx.load_verify_locations(os.path.join(certdir, "ca.crt"))
-        raw = socket.create_connection(tuple(address))
-        self._sock = ctx.wrap_socket(raw, server_hostname="localhost")
-        self._conn = _LineConn(self._sock)
-        self._conn.send({"hello": node})
-        self._sock.setblocking(False)
+        self._sock, self._conn = connect_client(node, address, certdir,
+                                                client_cn)
         self.node = node
         self.agent = AgentPolicyController(node, datapath)
 

@@ -16,6 +16,13 @@ Framing: newline-delimited JSON (serde.event_to_wire).  Event messages are
 are one JSON line each.  Delivery is pumped from a QUEUED store watcher
 (RamStore.watch_queue), so a slow or dead agent never blocks the
 controller — pump() moves whatever is buffered, in order.
+
+NOTE: the PRIMARY dissemination transport is the authenticated mTLS
+network wire (dissemination/netwire.py — the apiserver.go:97-99 analog),
+which the fleet (simulator/fleet.py transport="netwire") and the
+end-to-end reachability tests ride.  This pipe transport remains as a
+FALLBACK harness for subprocess isolation tests where PKI setup would
+add nothing (the framing and serde layers are shared with the wire).
 """
 
 from __future__ import annotations

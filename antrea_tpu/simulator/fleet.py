@@ -6,41 +6,37 @@ agents deployed at scale to stress the controller's dissemination plane —
 they subscribe like real agents, track what they receive, and never touch a
 dataplane.  BASELINE.json names this as the CPU-reference driver.
 
-Each FakeAgent holds a queued watcher on the RamStore under its node name
-and maintains the same local object tables a real AgentPolicyController
-would, so fleet-wide assertions can check span filtering (an agent sees a
-policy iff the policy spans its node) and fan-out cost (events delivered
-vs objects changed)."""
+Each agent maintains the same local object tables a real
+AgentPolicyController would, so fleet-wide assertions can check span
+filtering (an agent sees a policy iff the policy spans its node) and
+fan-out cost (events delivered vs objects changed).
+
+Transports — the reference has exactly ONE dissemination path, the
+authenticated network apiserver (apiserver.go:97-99), and the fleet's
+primary mode mirrors it:
+
+  * ``transport="netwire"`` — each agent is a real mTLS TCP client of a
+    DisseminationServer (dissemination/netwire.py): events arrive over
+    sockets, realization statuses flow back over the same channel.  This
+    is the production-shaped path.
+  * ``transport="inproc"`` — direct RamStore watchers, a fallback for
+    pure fan-out unit tests where socket setup cost would dominate.
+"""
 
 from __future__ import annotations
 
 from ..controller.networkpolicy import WatchEvent
 
 
-class FakeAgent:
-    def __init__(self, store, node: str, status_reporter=None):
-        self.node = node
-        self._watcher = store.watch_queue(node)
+class _AgentTables:
+    """Shared local-object-table logic (the watch-consumer half every
+    agent flavor reuses — one _apply, one realization view)."""
+
+    def _init_tables(self) -> None:
         self.policies: dict[str, object] = {}
         self.address_groups: dict[str, object] = {}
         self.applied_to_groups: dict[str, object] = {}
         self.events_seen = 0
-        # Realization-status reporting (same callable contract as
-        # AgentPolicyController): a fake agent "realizes" a policy the
-        # moment it lands in its table, so a fleet agent that has NOT been
-        # pumped is exactly a lagging node in the status aggregation.
-        self._status_reporter = status_reporter
-
-    def pump(self) -> int:
-        """Drain pending events into the local tables; -> events consumed."""
-        n = 0
-        for ev in self._watcher.drain():
-            self._apply(ev)
-            n += 1
-        self.events_seen += n
-        if n and self._status_reporter is not None:
-            self._status_reporter(self.node, self.realized_generations())
-        return n
 
     def realized_generations(self) -> dict:
         return {
@@ -59,18 +55,130 @@ class FakeAgent:
         else:
             table[ev.name] = ev.obj
 
+
+class FakeAgent(_AgentTables):
+    def __init__(self, store, node: str, status_reporter=None):
+        self.node = node
+        self._watcher = store.watch_queue(node)
+        self._init_tables()
+        # Realization-status reporting (same callable contract as
+        # AgentPolicyController): a fake agent "realizes" a policy the
+        # moment it lands in its table, so a fleet agent that has NOT been
+        # pumped is exactly a lagging node in the status aggregation.
+        self._status_reporter = status_reporter
+
+    def pump(self) -> int:
+        """Drain pending events into the local tables; -> events consumed."""
+        n = 0
+        for ev in self._watcher.drain():
+            self._apply(ev)
+            n += 1
+        self.events_seen += n
+        if n and self._status_reporter is not None:
+            self._status_reporter(self.node, self.realized_generations())
+        return n
+
     def stop(self) -> None:
         self._watcher.stop()
 
 
+class NetFakeAgent(_AgentTables):
+    """Watch-only fake agent over the REAL mTLS wire: a TLS-verified
+    client of DisseminationServer that maintains the same tables and
+    reports realization over the same socket (netwire.NetAgent minus the
+    dataplane — the agent-simulator over the production transport)."""
+
+    def __init__(self, node: str, address, certdir: str):
+        from ..dissemination.netwire import connect_client
+
+        self._sock, self._conn = connect_client(node, address, certdir)
+        self.node = node
+        self._init_tables()
+
+    # Short first-wait: FakeAgentFleet.pump() ships events BEFORE draining
+    # agents, so loopback frames are already buffered — a long per-agent
+    # select would make an idle fleet pump O(agents * wait).
+    def pump(self, wait: float = 0.05) -> int:
+        from ..dissemination import serde
+
+        n = 0
+        for frame in self._conn.recv_ready(first_wait=wait):
+            if "ev" in frame:
+                self._apply(serde.decode_event(frame["ev"]))
+                n += 1
+        self.events_seen += n
+        if n:
+            # Realization report upstream over the SAME TLS channel (the
+            # UpdateStatus RPC analog); the server's next pump() feeds it
+            # into the StatusAggregator.
+            self._sock.setblocking(True)
+            self._conn.send({"status": self.realized_generations()})
+            self._sock.setblocking(False)
+        return n
+
+    def stop(self) -> None:
+        self._sock.close()
+
+
 class FakeAgentFleet:
-    def __init__(self, store, nodes: list[str], status_reporter=None):
-        self.agents = {
-            n: FakeAgent(store, n, status_reporter=status_reporter)
-            for n in nodes
-        }
+    """Fleet over either transport.  netwire mode needs a live
+    DisseminationServer (events + statuses ride its sockets; pass its
+    certdir); inproc mode needs the RamStore."""
+
+    def __init__(self, store, nodes: list[str], status_reporter=None, *,
+                 transport: str = "inproc", server=None, certdir: str = ""):
+        self.transport = transport
+        self._server = server
+        if transport == "netwire":
+            if server is None or not certdir:
+                raise ValueError(
+                    "netwire fleet needs server= (DisseminationServer) "
+                    "and certdir="
+                )
+            if status_reporter is not None:
+                raise ValueError(
+                    "status_reporter is an inproc-transport hook; netwire "
+                    "statuses flow to the server's StatusAggregator over "
+                    "the sockets"
+                )
+            self.agents = {
+                n: NetFakeAgent(n, server.address, certdir) for n in nodes
+            }
+            server.wait_connected(len(nodes))
+        elif transport == "inproc":
+            self.agents = {
+                n: FakeAgent(store, n, status_reporter=status_reporter)
+                for n in nodes
+            }
+        else:
+            raise ValueError(f"unknown fleet transport {transport!r}")
 
     def pump(self) -> int:
+        """One dissemination round; -> events consumed fleet-wide.
+
+        netwire: ship queued events down every socket, then ONE bounded
+        select across the whole fleet picks the agents with data (a
+        serial per-agent wait would make an idle pump O(agents * wait) —
+        the same discipline as DisseminationServer.pump) and only those
+        block-drain; finally consume the statuses they sent back."""
+        if self.transport == "netwire":
+            import select
+
+            self._server.pump()
+            socks = {a._sock: a for a in self.agents.values()}
+            try:
+                ready, _, _ = select.select(list(socks), [], [], 0.2)
+            except (OSError, ValueError):
+                ready = list(socks)
+            n = 0
+            for a in self.agents.values():
+                if (a._sock in ready or a._conn._buf
+                        or getattr(a._sock, "pending", lambda: 0)()):
+                    n += a.pump()
+                else:
+                    n += a.pump(wait=0.0)  # drain-only, never waits
+            self._server.pump()  # consume the freshly-sent status frames
+            return n
         return sum(a.pump() for a in self.agents.values())
 
     def total_events(self) -> int:

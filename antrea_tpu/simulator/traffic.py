@@ -25,6 +25,7 @@ def gen_traffic(
     seed: int = 0,
     services=None,  # optional list[ServiceEntry]; a share of flows target them
     svc_fraction: float = 0.3,
+    one_per_flow: bool = False,  # batch = a PERMUTATION of the universe
 ) -> PacketBatch:
     rng = np.random.default_rng(seed)
     pods = np.asarray(pod_ips, dtype=np.uint32)
@@ -59,8 +60,16 @@ def gen_traffic(
         f_dport = np.where(to_svc, svc_port, f_dport)
         f_proto = np.where(to_svc, svc_proto, f_proto)
 
-    # Zipf draw over flows -> batch indices.
-    idx = (rng.zipf(zipf_a, size=batch) - 1) % n_flows
+    if one_per_flow:
+        # Exactly one packet per universe flow, shuffled — the churn-pool
+        # shape (flow ARRIVALS: every window is genuinely fresh flows,
+        # no zipf head re-hitting the cache).
+        if batch != n_flows:
+            raise ValueError("one_per_flow requires batch == n_flows")
+        idx = rng.permutation(n_flows)
+    else:
+        # Zipf draw over flows -> batch indices.
+        idx = (rng.zipf(zipf_a, size=batch) - 1) % n_flows
 
     return PacketBatch(
         src_ip=f_src[idx],

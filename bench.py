@@ -105,9 +105,12 @@ def measure_churn(cps, svc, pod_ips, services):
 def _measure_churn(cps, svc, pod_ips, services):
     hot = gen_traffic(pod_ips, B, n_flows=1 << 15, seed=31,
                       services=services, svc_fraction=0.3)
-    # The churn pool: one packet per universe flow, drawn without repeats.
+    # The churn pool: one packet per universe flow, drawn without repeats
+    # (a zipf draw would re-hit its head flows in every window and
+    # under-state the miss fraction).
     pool = gen_traffic(pod_ips, CHURN_POOL, n_flows=CHURN_POOL, seed=32,
-                       services=services, svc_fraction=0.3)
+                       services=services, svc_fraction=0.3,
+                       one_per_flow=True)
     n_new = B // CHURN_DIV  # fresh flows per batch
 
     def col(hot_c, pool_c):
@@ -292,10 +295,11 @@ def main():
 # gate so the driver always records the measurement.
 STEADY_FLOOR_PPS = 12e6
 COLD_FLOOR_PPS = 3.2e6
-# Churn-regime floor: calibrated from the round-5 measurement (12.58M pps
-# @ universe=slots=2^22, 1/8 fresh) with the same ~30%-under-jitter margin
-# as the others.
-CHURN_FLOOR_PPS = 8.5e6
+# Churn-regime floor: calibrated from the round-5 measurement (5.14M pps
+# @ universe=slots=2^22, 1/8 genuinely-fresh flows per batch — the
+# permutation pool; a zipf pool re-hits its head and inflated this to
+# 12.6M) with the same ~30%-under-jitter margin as the others.
+CHURN_FLOOR_PPS = 3.5e6
 
 
 def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
@@ -347,7 +351,7 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
     if churn_pps is not None and churn_pps < CHURN_FLOOR_PPS:
         raise SystemExit(
             f"churn-regime throughput regressed: {churn_pps/1e6:.2f}M < "
-            f"floor {CHURN_FLOOR_PPS/1e6:.0f}M pps"
+            f"floor {CHURN_FLOOR_PPS/1e6:.1f}M pps"
         )
 
 

@@ -212,3 +212,40 @@ def test_externalnode_policies_reach_vm_agent():
                             interface_ips=[], labels=en.labels))
     assert npc.policy_set_for_node("vm-1").policies == []
     assert enc.delete("vms/vm-1") == 0
+
+
+def test_wireguard_x25519_known_answer_and_dh():
+    """Real X25519 key math (wgtypes.GeneratePrivateKey analog): RFC 7748
+    section 5.2 test vector for the scalar-mult base-point derivation, and
+    both peers of a DH agreeing on the shared secret (the Noise handshake
+    primitive)."""
+    import base64
+
+    from antrea_tpu.agent.wireguard import _derive_public, shared_secret
+
+    # RFC 7748 / NaCl known-answer: Alice's private scalar -> public key.
+    alice_priv = base64.b64encode(bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )).decode()
+    alice_pub_expect = bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert base64.b64decode(_derive_public(alice_priv)) == alice_pub_expect
+
+    bob_priv = base64.b64encode(bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )).decode()
+    bob_pub = _derive_public(bob_priv)
+    # RFC 7748 shared secret K.
+    k_expect = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    s1 = shared_secret(alice_priv, bob_pub)
+    s2 = shared_secret(bob_priv, _derive_public(alice_priv))
+    assert s1 == s2
+    assert base64.b64decode(s1) == k_expect
+
+    # Client-level: two nodes exchange published keys and agree.
+    a = WireGuardClient("n1")
+    b = WireGuardClient("n2")
+    assert a.shared_with(b.public_key) == b.shared_with(a.public_key)

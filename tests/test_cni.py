@@ -158,3 +158,67 @@ def test_stale_del_keeps_recreated_pod(tmp_path):
     # The FINAL del does remove it.
     srv.cmd_del("cid-new")
     assert ctl.policy_set_for_node("n0").policies == []
+
+
+def test_cni_socket_wire_from_separate_process(tmp_path):
+    """CNI add/del/check round-trip over a unix-domain socket from a REAL
+    separate process (the kubelet seam: cni.proto:67-75 — a gRPC service
+    on a unix socket; here framed JSON with the same versioned
+    request/response shape), plus in-process concurrent clients and the
+    unsupported-version error path."""
+    import json as _json
+    import subprocess
+    import sys
+
+    from antrea_tpu.agent.cni import CNI_WIRE_VERSION, CniClient, CniSocketServer
+    from antrea_tpu.native import ConfigStore
+
+    store = ConfigStore(str(tmp_path / "conf.db"))
+    srv = CniSocketServer(
+        CniServer("n0", "10.10.0.0/24", store), str(tmp_path / "cni.sock"))
+    try:
+        # Cross-process: the client lives in its own python process.
+        script = f"""
+import json, socket, sys
+s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+s.connect({str(tmp_path / 'cni.sock')!r})
+def rpc(body):
+    s.sendall(json.dumps(body).encode() + b"\\n")
+    buf = b""
+    while b"\\n" not in buf:
+        buf += s.recv(65536)
+    return json.loads(buf.split(b"\\n", 1)[0])
+add = rpc({{"version": {CNI_WIRE_VERSION!r}, "cmd": "add",
+           "containerId": "c-远1", "podNamespace": "default",
+           "podName": "p1", "labels": {{"app": "web"}}}})
+chk = rpc({{"version": {CNI_WIRE_VERSION!r}, "cmd": "check",
+           "containerId": "c-远1"}})
+dele = rpc({{"version": {CNI_WIRE_VERSION!r}, "cmd": "del",
+            "containerId": "c-远1"}})
+chk2 = rpc({{"version": {CNI_WIRE_VERSION!r}, "cmd": "check",
+            "containerId": "c-远1"}})
+bad = rpc({{"version": "0.9", "cmd": "add", "containerId": "x"}})
+print(json.dumps([add, chk, dele, chk2, bad]))
+"""
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=60,
+                             check=True, cwd="/root/repo")
+        add, chk, dele, chk2, bad = _json.loads(out.stdout)
+        assert add["ok"] and add["ip"].startswith("10.10.0.")
+        assert add["gateway"] == "10.10.0.1"
+        assert chk == {"ok": True, "exists": True}
+        assert dele == {"ok": True, "released": True}
+        assert chk2 == {"ok": True, "exists": False}
+        assert not bad["ok"] and "version" in bad["error"]
+
+        # Concurrent clients allocate distinct addresses (the kubelet's
+        # parallel sandbox adds).
+        c1, c2 = CniClient(srv.sock_path), CniClient(srv.sock_path)
+        a1 = c1.add("c-a", "default", "pa")
+        a2 = c2.add("c-b", "default", "pb")
+        assert a1["ip"] != a2["ip"]
+        # Idempotent re-ADD over the wire returns the same address.
+        assert c2.add("c-a", "default", "pa")["ip"] == a1["ip"]
+        c1.close(); c2.close()
+    finally:
+        srv.close()
