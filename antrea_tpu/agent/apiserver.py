@@ -135,9 +135,25 @@ class AgentApiServer:
             if ps is None:
                 return []
             if route == "/networkpolicies":
+                # Per-policy traffic volumes: sum this policy's rule
+                # counters from the datapath stats (the NetworkPolicyStats
+                # API shape, pkg/apis/stats — rule ids embed the policy
+                # uid, compiler/ir.rule_id).
+                st = self._dp.stats()
+                # One pass per table (rule ids are "{uid}/dir/idx",
+                # compiler/ir.rule_id), not a per-policy scan.
+                pk, by = {}, {}
+                for table, acc in ((st.ingress, pk), (st.egress, pk),
+                                   (st.ingress_bytes, by),
+                                   (st.egress_bytes, by)):
+                    for k, v in (table or {}).items():
+                        uid = k.split("/", 1)[0]
+                        acc[uid] = acc.get(uid, 0) + v
                 return [
                     {"uid": p.uid, "name": p.name, "namespace": p.namespace,
-                     "type": p.type.value, "rules": len(p.rules)}
+                     "type": p.type.value, "rules": len(p.rules),
+                     "packets": pk.get(p.uid, 0),
+                     "bytes": by.get(p.uid, 0)}
                     for p in ps.policies
                 ]
             table = (

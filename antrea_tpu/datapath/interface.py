@@ -178,5 +178,10 @@ class DatapathStats:
 
     ingress: dict
     egress: dict
+    # Per-rule BYTE volumes (PacketBatch.pkt_len sums; the NetworkPolicy
+    # stats bytes counters, ref pkg/apis/stats) — empty when batches carry
+    # no lengths.
+    ingress_bytes: dict = None
+    egress_bytes: dict = None
     default_allow: int = 0
     default_deny: int = 0

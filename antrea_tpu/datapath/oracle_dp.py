@@ -81,6 +81,7 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
 
         self._gates = feature_gates or DEFAULT_GATES
         self._dual_stack = dual_stack
+        self._flow_stats = self._gates.enabled("FlowExporter")
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
         self._topo = topology
@@ -97,9 +98,12 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             ct_other_new_s=ct_other_new_s, ct_other_est_s=ct_other_est_s,
             node_ips=list(node_ips or []), node_name=node_name,
             dual_stack=dual_stack,
+            count_flow_stats=self._gates.enabled("FlowExporter"),
         )
         self._stats_in: Counter = Counter()
         self._stats_out: Counter = Counter()
+        self._bytes_in: Counter = Counter()
+        self._bytes_out: Counter = Counter()
         self._default_allow = 0
         self._default_deny = 0
         self._rebuild_l7_ids()
@@ -200,6 +204,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         return DatapathStats(
             ingress=dict(self._stats_in),
             egress=dict(self._stats_out),
+            ingress_bytes=dict(self._bytes_in),
+            egress_bytes=dict(self._bytes_out),
             default_allow=self._default_allow,
             default_deny=self._default_deny,
         )
@@ -233,6 +239,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 "ingress_rule": e["rule_in"],
                 "egress_rule": e["rule_out"],
                 "last_seen": e["ts"],
+                "packets": e.get("pkts", 0),
+                "bytes": e.get("octets", 0),
             })
         return out
 
@@ -346,21 +354,28 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                 or (int(batch.proto[i]) == PROTO_TCP
                     and (int(flags[i]) & _TEARDOWN_FLAGS) != 0)
             )
+        lens = np.maximum(batch.lens(), 0)
         outs = self._oracle.step(
             batch, now, gen=self._gen, lane_modes=lane_modes,
             no_commit=no_commit, flags=flags,
+            lens=lens if self._flow_stats else None,
         )
         fwd = self._forward_fields(batch, outs, in_ports, lane_modes,
                                    arp_ops)
         if not self._gates.enabled("NetworkPolicyStats"):
             return self._to_result(outs, fwd)
-        for o in outs:
+        for i, o in enumerate(outs):
             if o.skipped:
                 continue  # SpoofGuard drop: before the policy tables
+            ln = int(lens[i])
             if o.ingress_rule is not None:
                 self._stats_in[o.ingress_rule] += 1
+                if ln:
+                    self._bytes_in[o.ingress_rule] += ln
             if o.egress_rule is not None:
                 self._stats_out[o.egress_rule] += 1
+                if ln:
+                    self._bytes_out[o.egress_rule] += ln
             if o.ingress_rule is None and o.egress_rule is None:
                 if o.code == 0:
                     self._default_allow += 1

@@ -75,6 +75,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         from ..features import DEFAULT_GATES
 
         self._gates = feature_gates or DEFAULT_GATES
+        # Per-entry traffic counters ride the FlowExporter gate: volumes
+        # cost a hit-path column gather+scatter, paid only when the
+        # observability plane consumes them (flowexporter/types.go:59).
+        self._flow_stats = self._gates.enabled("FlowExporter")
         # Dual-stack switches the flow cache to wide (10-column) keys and
         # enables v6 service frontends / forwarding tables (the reference
         # is dual-stack when both families are configured,
@@ -114,6 +118,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # keyed by stable rule id so they survive bundle renumbering.
         self._stats_in: Counter = Counter()
         self._stats_out: Counter = Counter()
+        self._bytes_in: Counter = Counter()
+        self._bytes_out: Counter = Counter()
         self._default_allow = 0
         self._default_deny = 0
         self._evictions = 0
@@ -289,6 +295,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 jnp.asarray(batch.is6))
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
+        # One materialization of the per-lane byte lengths, clamped
+        # (negative pkt_len must never decrement a monotonic counter).
+        lens = np.maximum(batch.lens(), 0)
         state, out = fwd.pipeline_step_full(
             self._state,
             self._drs,
@@ -306,6 +315,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             # Only materialize the ARP lane when the batch carries ARP —
             # pure-IP batches keep the round-3 compiled program.
             jnp.asarray(batch.arp_ops()) if batch.arp_op is not None else None,
+            jnp.asarray(lens) if self._flow_stats else None,
             meta=self._meta,
             v6=self._v6_lanes(batch),
         )
@@ -314,7 +324,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._evictions += int(o["n_evict"])
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
-        self._count_metrics(o, in_ids, out_ids)
+        self._count_metrics(o, in_ids, out_ids, lens)
 
         def unflip(col):
             return (col.astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32)
@@ -391,6 +401,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         return DatapathStats(
             ingress=dict(self._stats_in),
             egress=dict(self._stats_out),
+            ingress_bytes=dict(self._bytes_in),
+            egress_bytes=dict(self._bytes_out),
             default_allow=self._default_allow,
             default_deny=self._default_deny,
         )
@@ -405,6 +417,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         keys = np.asarray(flow.keys)[:-1].astype(np.int64)
         meta = np.asarray(flow.meta)[:-1].astype(np.int64)
         ts = np.asarray(flow.ts)[:-1]
+        pkts = np.asarray(flow.pkts)[:-1]
+        octets = np.asarray(flow.octets)[:-1]
         A = self._meta.key_words - 2
         DC, M1C, RC, ZC = pl._meta_cols(A)
         kpg = keys[:, A + 1]
@@ -469,6 +483,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 "ingress_rule": rid(self._cps.ingress.rule_ids, rule_in),
                 "egress_rule": rid(self._cps.egress.rule_ids, rule_out),
                 "last_seen": int(ts[i]),
+                # Per-direction traffic volumes (OriginalPackets/
+                # OriginalBytes analog); zeros when the FlowExporter gate
+                # is off (counting disabled).
+                "packets": int(pkts[i]),
+                "bytes": int(octets[i]),
             })
         return out
 
@@ -574,7 +593,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
 
     # -- internals -----------------------------------------------------------
 
-    def _count_metrics(self, o: dict, in_ids: list, out_ids: list) -> None:
+    def _count_metrics(self, o: dict, in_ids: list, out_ids: list,
+                       lens=None) -> None:
         if not self._gates.enabled("NetworkPolicyStats"):
             return
         # SpoofGuard drops and IGMP punts happen BEFORE the policy tables
@@ -584,19 +604,27 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         punt = o.get("punt")
         if punt is not None and not_spoofed is not None:
             not_spoofed = not_spoofed & (punt == 0)
-        for key, ids, ctr in (
-            ("ingress_rule", in_ids, self._stats_in),
-            ("egress_rule", out_ids, self._stats_out),
+        for key, ids, ctr, bctr in (
+            ("ingress_rule", in_ids, self._stats_in, self._bytes_in),
+            ("egress_rule", out_ids, self._stats_out, self._bytes_out),
         ):
             idx = o[key]
             # Cached entries can carry attribution indices from an older
             # generation (ct_label semantics); clamp to the current table.
-            vals = idx[(idx >= 0) & (idx < len(ids))]
+            ok = (idx >= 0) & (idx < len(ids))
+            vals = idx[ok]
             if vals.size:
                 bc = np.bincount(vals, minlength=len(ids))
+                # Byte volumes ride the same attribution (pkg/apis/stats
+                # bytes counters): weighted bincount over packet lengths.
+                bb = (np.bincount(vals, weights=lens[ok],
+                                  minlength=len(ids))
+                      if lens is not None else None)
                 for r in np.nonzero(bc)[0]:
                     if ids[r]:
                         ctr[ids[r]] += int(bc[r])
+                        if bb is not None and bb[r]:
+                            bctr[ids[r]] += int(bb[r])
         none_mask = (o["ingress_rule"] < 0) & (o["egress_rule"] < 0)
         if not_spoofed is not None:
             none_mask = none_mask & not_spoofed
@@ -624,6 +652,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             ct_other_est_s=self._pipe_kw["ct_other_est_s"],
             fused=self._pipe_kw["fused"],
             key_words=10 if self._dual_stack else 4,
+            count_flow_stats=self._flow_stats,
         )
         # Reset incremental bookkeeping: the compile folded all prior deltas.
         D = self._delta_slots

@@ -301,6 +301,7 @@ def _pipeline_step_full(
     gen: jax.Array,
     flags: jax.Array = None,
     arp_op: jax.Array = None,
+    lens: jax.Array = None,
     *,
     meta: pl.PipelineMeta,
     hit_combine=None,
@@ -357,7 +358,7 @@ def _pipeline_step_full(
     state, out = pl._pipeline_step(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
         meta=meta, hit_combine=hit_combine, valid=valid,
-        no_commit=no_commit, flags=flags, v6=v6,
+        no_commit=no_commit, flags=flags, v6=v6, lens=lens,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
     # Forward toward the packet's effective destination: the DNAT-resolved

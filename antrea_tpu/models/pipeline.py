@@ -136,6 +136,12 @@ class FlowCache(NamedTuple):
         persistence analog — both marks live in ct_mark in the reference);
         bit 29 is the conntrack CONFIRMED state (see CONF_BIT)
       ts   (N+1,)  i32: last-seen seconds (refreshed on every hit)
+      pkts/octets (N+1,) i32: per-DIRECTION saturating traffic counters
+        (conntrack OriginalPackets/OriginalBytes,
+        flowexporter/types.go:59) — 1-D columns because the hit path
+        updates them with fast column scatters (the layout rationale
+        above); zero-cost when PipelineMeta.count_flow_stats is off
+        (the update compiles out).
 
     dst in keys is the ORIGINAL (pre-DNAT) dst; dnat_ip_f the resolved one.
 
@@ -153,6 +159,8 @@ class FlowCache(NamedTuple):
     keys: jax.Array
     meta: jax.Array
     ts: jax.Array
+    pkts: jax.Array
+    octets: jax.Array
 
 
 class AffinityTable(NamedTuple):
@@ -209,6 +217,11 @@ class PipelineMeta(NamedTuple):
     # (ops/match.classify_batch fused=True) — shard-aware: composes with
     # the rule-axis hit_combine seam via global word offsets.
     fused: bool = False
+    # Maintain per-entry packet/byte counters (FlowCache.pkts/octets).
+    # Off by default: counting adds a column gather + two scatters to the
+    # hit path, the cost the kernel pays only when the observability
+    # plane (FlowExporter gate) wants volumes.
+    count_flow_stats: bool = False
     # Flow-cache key row width: 4 (v4-only: [src, dst, pp, pg]) or 10
     # (dual-stack: [s0..s3, d0..d3, pp, pg] — addresses in wide v4-mapped
     # word form, the xxreg3 analog).  Static, so pure-v4 worlds compile the
@@ -269,6 +282,8 @@ def init_state(
         # layout documented on FlowCache.
         meta=xp.zeros((flow_slots + 1, 8 if wide else 4), dtype=xp.int32),
         ts=zeros(flow_slots),
+        pkts=zeros(flow_slots),
+        octets=zeros(flow_slots),
     )
     aff = AffinityTable(
         # Wide worlds key affinity on the client's 4-word form (v6
@@ -393,6 +408,7 @@ def make_pipeline(
     ct_other_est_s: Optional[int] = None,
     fused: bool = False,
     dual_stack: bool = False,
+    count_flow_stats: bool = False,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -425,15 +441,16 @@ def make_pipeline(
         ct_other_est_s=ct_other_est_s,
         fused=fused,
         key_words=10 if dual_stack else 4,
+        count_flow_stats=count_flow_stats,
     )
     state = init_state(flow_slots, aff_slots, xp=np if host else jnp,
                        key_words=meta.key_words)
 
     def step(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
-             v6=None):
+             v6=None, lens=None):
         return pipeline_step(
             state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
-            meta=meta, v6=v6,
+            meta=meta, v6=v6, lens=lens,
         )
 
     step.meta = meta  # expose for callers embedding the step in larger jits
@@ -642,6 +659,7 @@ def _pipeline_step(
     no_commit=None,
     flags=None,
     v6=None,
+    lens=None,
 ):
     flow, aff = state.flow, state.aff
     B = src_f.shape[0]
@@ -703,6 +721,25 @@ def _pipeline_step(
 
     # Idle-timeout refresh for hits.
     flow = flow._replace(ts=flow.ts.at[jnp.where(hit, slot, dump)].set(now))
+
+    if meta.count_flow_stats:
+        # Per-direction traffic counters (conntrack OriginalPackets/
+        # OriginalBytes, flowexporter/types.go:59): every hit adds to ITS
+        # entry's columns.  Saturating via per-lane headroom clamp —
+        # exact except when many same-batch duplicates land within one
+        # batch-sum of 2^31, where a slight overshoot can occur (the
+        # reference's u64 counters never reach this boundary in practice).
+        lv = jnp.zeros(B, jnp.int32) if lens is None else lens
+        ctgt = jnp.where(hit, slot, dump)
+
+        def sat_add(col, add):
+            room = jnp.int32(2**31 - 1) - col[ctgt]
+            return col.at[ctgt].add(jnp.minimum(add, jnp.maximum(room, 0)))
+
+        flow = flow._replace(
+            pkts=sat_add(flow.pkts, jnp.ones(B, jnp.int32)),
+            octets=sat_add(flow.octets, jnp.maximum(lv, 0)),
+        )
 
     # Conntrack refreshes BOTH tuple directions on traffic in either
     # direction (one kernel-ct connection == our two cache entries): an
@@ -888,6 +925,9 @@ def _pipeline_step(
             h_m = h[safe]
             slot_m = slot[safe]
             pp_m = pp[safe]
+            if meta.count_flow_stats:
+                lv_m = (jnp.zeros(M, jnp.int32) if lens is None
+                        else jnp.maximum(lens[safe], 0))
             if A == 8:
                 saddr_m = saddr[safe]
                 daddr_m = daddr[safe]
@@ -1046,10 +1086,26 @@ def _pipeline_step(
                 ins2 & (okr[:, A + 1] != 0) & tuple_differs
             ).sum(dtype=jnp.int32)
 
+            if meta.count_flow_stats:
+                # Fresh entries start at this packet's contribution on
+                # the forward leg; the reply leg starts empty (its own
+                # direction's traffic hasn't flowed yet).
+                pk2 = jnp.stack(
+                    [jnp.ones(M, jnp.int32), jnp.zeros(M, jnp.int32)],
+                    axis=1).reshape(2 * M)
+                oc2 = jnp.stack(
+                    [lv_m, jnp.zeros(M, jnp.int32)], axis=1).reshape(2 * M)
+                new_pkts = _scatter_last(flow.pkts, slot2, pk2, ins2, dump)
+                new_octets = _scatter_last(flow.octets, slot2, oc2, ins2,
+                                           dump)
+            else:
+                new_pkts, new_octets = flow.pkts, flow.octets
             flow = FlowCache(
                 keys=_scatter_last_rows(flow.keys, slot2, keys2, ins2, dump),
                 meta=_scatter_last_rows(flow.meta, slot2, meta2, ins2, dump),
                 ts=_scatter_last(flow.ts, slot2, jnp.full((2 * M,), now, jnp.int32), ins2, dump),
+                pkts=new_pkts,
+                octets=new_octets,
             )
             lm = learn["mask"] & valid
             adump = meta.aff_slots

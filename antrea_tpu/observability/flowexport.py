@@ -244,6 +244,16 @@ class FlowAggregator:
                 }
             bf["reply_seen"] = True
             bf["last_seen"] = max(bf["last_seen"], rec["last_seen"])
+            # Reply-direction volumes fold in as the biflow's reverse
+            # counters (the Reverse* IPFIX elements the reference
+            # aggregator emits).  Entry counters are cumulative but RESET
+            # when a cache eviction recreates the entry — fold with max so
+            # aggregated totals never regress (pre-eviction volume is a
+            # floor, not recoverable).
+            bf["reverse_packets"] = max(bf.get("reverse_packets", 0),
+                                        rec.get("packets", 0))
+            bf["reverse_bytes"] = max(bf.get("reverse_bytes", 0),
+                                      rec.get("bytes", 0))
             return
         fkey = (rec["src"], rec["dst"], rec["sport"], rec["dport"], rec["proto"])
         bf = self.biflows.get(fkey)
@@ -252,12 +262,22 @@ class FlowAggregator:
             return
         if bf.pop("_placeholder", None):
             seen_reply = bf.get("reply_seen", False)
+            rev_p = bf.get("reverse_packets")
+            rev_b = bf.get("reverse_bytes")
             last = bf["last_seen"]
             bf.clear()
             bf.update(rec, reply_seen=seen_reply)
+            if rev_p is not None:
+                bf["reverse_packets"], bf["reverse_bytes"] = rev_p, rev_b
             bf["last_seen"] = max(last, rec["last_seen"])
         else:
             bf["last_seen"] = max(bf["last_seen"], rec["last_seen"])
+            # Forward-direction volumes: max-fold (see the reverse-side
+            # comment — an evicted-and-recreated entry restarts its
+            # cumulative counters).
+            if "packets" in rec:
+                bf["packets"] = max(bf.get("packets", 0), rec["packets"])
+                bf["bytes"] = max(bf.get("bytes", 0), rec.get("bytes", 0))
 
     def snapshot(self) -> list[dict]:
         return [dict(v) for _, v in sorted(self.biflows.items())]

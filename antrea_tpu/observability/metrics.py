@@ -51,6 +51,16 @@ def render_metrics(datapath, node: str = "") -> str:
                 f'antrea_tpu_rule_packets_total{{direction="{direction}",'
                 f'rule="{_esc(rule)}"{label_node}}} {count}'
             )
+    by_bytes = (("ingress", stats.ingress_bytes or {}),
+                ("egress", stats.egress_bytes or {}))
+    if any(t for _d, t in by_bytes):
+        lines.append("# TYPE antrea_tpu_rule_bytes_total counter")
+        for direction, table in by_bytes:
+            for rule, count in sorted(table.items()):
+                lines.append(
+                    f'antrea_tpu_rule_bytes_total{{direction="{direction}",'
+                    f'rule="{_esc(rule)}"{label_node}}} {count}'
+                )
     lines += [
         "# TYPE antrea_tpu_default_verdict_packets_total counter",
         f'antrea_tpu_default_verdict_packets_total{{verdict="allow"{label_node}}} '

@@ -184,11 +184,15 @@ class PipelineOracle:
         ct_other_new_s: int | None = None,
         ct_other_est_s: int | None = None,
         dual_stack: bool = False,
+        count_flow_stats: bool = False,
     ):
         # Dual-stack mode mirrors the device's wide (10-column) flow-cache
         # keys: addresses hash/compare as 4-word v4-mapped quadruples and
         # v4-mapped v6 twins collapse onto their v4 host (canon_key).
         self.dual_stack = dual_stack
+        # Per-entry traffic counters (the device twin's
+        # PipelineMeta.count_flow_stats): pkts/octets per direction.
+        self.count_flow_stats = count_flow_stats
         self.oracle = Oracle(ps)
         self.flow_slots = flow_slots
         self.aff_slots = aff_slots
@@ -403,7 +407,7 @@ class PipelineOracle:
 
     def step(
         self, batch: PacketBatch, now: int, gen: int = 0, lane_modes=None,
-        no_commit=None, flags=None,
+        no_commit=None, flags=None, lens=None,
     ) -> list[ScalarOutcome]:
         # The device packs entry generations into GEN_BITS (22) bits, with
         # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
@@ -463,6 +467,14 @@ class PipelineOracle:
                     )
                 )
                 refreshes.append(slot)
+                if self.count_flow_stats:
+                    ln = 0 if lens is None else max(0, int(lens[i]))
+                    live = self.flow.get(slot)
+                    if live is not None:
+                        cap = 2**31 - 1
+                        live["pkts"] = min(live.get("pkts", 0) + 1, cap)
+                        live["octets"] = min(
+                            live.get("octets", 0) + ln, cap)
                 # SYN_SENT -> ESTABLISHED confirmation (device twin: the
                 # CONF_BIT cond in models/pipeline): first reply-direction
                 # hit confirms BOTH tuple directions.
@@ -518,6 +530,7 @@ class PipelineOracle:
             if not nc:
                 key = (self._k(p.src_ip), self._k(p.dst_ip),
                        (p.src_port << 16) | p.dst_port, p.proto)
+                ln = 0 if lens is None else max(0, int(lens[i]))
                 inserts.append(
                     (slot, {
                         "key": key, "code": code, "svc": w["svc_idx"],
@@ -527,6 +540,8 @@ class PipelineOracle:
                         "gen": None if committed else gen,
                         "rule_in": rule_in, "rule_out": rule_out,
                         "rpl": False,
+                        "pkts": 1 if self.count_flow_stats else 0,
+                        "octets": ln if self.count_flow_stats else 0,
                     })
                 )
             if committed and not w["dsr"]:
@@ -554,6 +569,7 @@ class PipelineOracle:
                         "ts": now, "pref": now, "gen": None, "conf": False,
                         "rule_in": rule_in, "rule_out": rule_out,
                         "rpl": True,
+                        "pkts": 0, "octets": 0,
                     })
                 )
             if w["aff_learn"]:

@@ -65,6 +65,10 @@ class PacketBatch:
     # protocol address (SPA) and dst_ip the target (TPA); ports/proto are
     # ignored.  None == no ARP traffic.
     arp_op: np.ndarray = None
+    # L3 payload bytes per packet (drives the per-flow byte counters —
+    # the conntrack OriginalBytes analog, flowexporter/types.go:59).
+    # None == all 0 (volumes count packets only).
+    pkt_len: np.ndarray = None
     # Dual-stack lane extension (the xxreg3 wide-register analog,
     # fields.go:184-185): (B, 4) u32 per-address word quadruples + the
     # family mask.  None == pure-v4 batch; for v6 lanes the 32-bit
@@ -98,6 +102,12 @@ class PacketBatch:
         if self.arp_op is None:
             return np.zeros(self.size, np.int32)
         return self.arp_op.astype(np.int32)
+
+    def lens(self) -> np.ndarray:
+        """pkt_len column, defaulting to 0."""
+        if self.pkt_len is None:
+            return np.zeros(self.size, np.int32)
+        return self.pkt_len.astype(np.int32)
 
     @staticmethod
     def from_packets(packets: list[Packet]) -> "PacketBatch":

@@ -1,0 +1,507 @@
+"""Reference-derived conformance fixtures (round-4 verdict next-round #6).
+
+Each case below is TRANSCRIBED from the reference's e2e Antrea-policy
+suite — /root/reference/test/e2e/antreapolicy_test.go, built on the
+Reachability truth-table harness (test/e2e/utils/reachability.go:209-310)
+— policies AND expected matrices copied from the cited test function, not
+derived from either engine here.  The pod universe is the reference's:
+namespaces x, y, z with pods a, b, c each (9 pods), every pod serving
+TCP 80/81 with named port "serve-81" (the agnhost servers).
+
+Expectations run on BOTH engines (scalar oracle + TPU kernel) over the
+full 9x9 ordered-pair matrix minus self pairs (the reference's harness
+treats self-reachability as loopback, outside policy probes:
+reachability.go ExpectSelf is bookkeeping for the probe matrix).
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import numpy as np
+
+from antrea_tpu.apis.controlplane import (
+    PROTO_TCP,
+    PROTO_UDP,
+    TIER_APPLICATION,
+    TIER_BASELINE,
+    TIER_EMERGENCY,
+    TIER_SECURITYOPS,
+    AddressGroup,
+    AppliedToGroup,
+    Direction,
+    GroupMember,
+    IPBlock,
+    NetworkPolicy,
+    NetworkPolicyPeer,
+    NetworkPolicyRule,
+    NetworkPolicyType,
+    RuleAction,
+    Service,
+)
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.ops.match import flip_ips, make_classifier
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+ALLOW, DROP, REJECT = 0, 1, 2
+NAMESPACES = ("x", "y", "z")
+LETTERS = ("a", "b", "c")
+PODS = [f"{ns}/{p}" for ns in NAMESPACES for p in LETTERS]
+IPS = {f"{ns}/{p}": f"10.{10 + ni}.0.{10 + pi}"
+       for ni, ns in enumerate(NAMESPACES)
+       for pi, p in enumerate(LETTERS)}
+
+
+def member(pod: str) -> GroupMember:
+    # Every e2e pod serves 80 and 81; "serve-81" is the named port the
+    # AllowXBtoYA case resolves (antreapolicy_test.go:509 port81Name).
+    return GroupMember(ip=IPS[pod], node=f"node-{pod[0]}",
+                       ports=(("serve-80", 80, PROTO_TCP),
+                              ("serve-81", 81, PROTO_TCP)))
+
+
+def pods(pred) -> list[str]:
+    return [p for p in PODS if pred(p.split("/")[0], p.split("/")[1])]
+
+
+class World:
+    """PolicySet builder over the x/y/z * a/b/c universe."""
+
+    def __init__(self):
+        self.ps = PolicySet()
+
+    def group(self, name: str, pod_list, ip_blocks=()) -> str:
+        ms = [member(p) for p in pod_list]
+        self.ps.address_groups[name] = AddressGroup(
+            name=name, members=ms, ip_blocks=list(ip_blocks))
+        self.ps.applied_to_groups[name] = AppliedToGroup(
+            name=name, members=ms)
+        return name
+
+    def acnp(self, uid, applied, rules, tier=TIER_APPLICATION, prio=5.0):
+        for i, r in enumerate(rules):
+            if r.priority < 0:
+                r.priority = i
+        self.ps.policies.append(NetworkPolicy(
+            uid=uid, name=uid, type=NetworkPolicyType.ACNP, rules=rules,
+            applied_to_groups=list(applied), tier_priority=tier,
+            priority=prio,
+        ))
+
+    def k8s_default_deny_ingress_everywhere(self):
+        """applyDefaultDenyToAllNamespaces (antreapolicy_test.go:161-173):
+        one K8s NP per namespace selecting all pods, ingress type, no
+        rules — pure isolation."""
+        for ns in NAMESPACES:
+            g = self.group(f"dd-{ns}", pods(lambda n, p, ns=ns: n == ns))
+            self.ps.policies.append(NetworkPolicy(
+                uid=f"default-deny-{ns}", name=f"default-deny-{ns}",
+                namespace=ns, type=NetworkPolicyType.K8S, rules=[],
+                applied_to_groups=[g], policy_types=[Direction.IN],
+            ))
+
+
+def ing(peer, action, services=None, prio=-1):
+    return NetworkPolicyRule(direction=Direction.IN, from_peer=peer,
+                             services=list(services or []), action=action,
+                             priority=prio)
+
+
+def eg(peer, action, services=None, prio=-1):
+    return NetworkPolicyRule(direction=Direction.OUT, to_peer=peer,
+                             services=list(services or []), action=action,
+                             priority=prio)
+
+
+def P(*groups, ip_blocks=()):
+    return NetworkPolicyPeer(address_groups=list(groups),
+                             ip_blocks=list(ip_blocks))
+
+
+TCP80 = [Service(protocol=PROTO_TCP, port=80)]
+TCP81 = [Service(protocol=PROTO_TCP, port=81)]
+NP81 = [Service(protocol=PROTO_TCP, port_name="serve-81")]
+
+
+class Reach:
+    """reachability.go's truth-table API (NewReachability/Expect/...)."""
+
+    def __init__(self, default: int):
+        self.m = {(s, d): default for s in PODS for d in PODS if s != d}
+
+    def expect(self, s, d, v):
+        self.m[(s, d)] = v
+        return self
+
+    def expect_all_ingress(self, d, v):
+        for s in PODS:
+            if s != d:
+                self.m[(s, d)] = v
+
+    def expect_all_egress(self, s, v):
+        for d in PODS:
+            if s != d:
+                self.m[(s, d)] = v
+
+    def expect_egress_to_ns(self, s, ns, v):
+        for d in pods(lambda n, p: n == ns):
+            if s != d:
+                self.m[(s, d)] = v
+
+    def expect_ingress_from_ns(self, d, ns, v):
+        for s in pods(lambda n, p: n == ns):
+            if s != d:
+                self.m[(s, d)] = v
+
+    def expect_ns_ingress_from_ns(self, dns, sns, v):
+        for d in pods(lambda n, p: n == dns):
+            self.expect_ingress_from_ns(d, sns, v)
+
+
+def run_case(world: World, reach: Reach, port=80, proto=PROTO_TCP):
+    """Assert the full matrix on BOTH engines."""
+    oracle = Oracle(world.ps)
+    from antrea_tpu.compiler.compile import compile_policy_set
+
+    fn, _ = make_classifier(compile_policy_set(world.ps))
+    pairs = sorted(reach.m)
+    pkts = [Packet(src_ip=iputil.ip_to_u32(IPS[s]),
+                   dst_ip=iputil.ip_to_u32(IPS[d]),
+                   proto=proto, src_port=40000, dst_port=port)
+            for s, d in pairs]
+    batch = PacketBatch.from_packets(pkts)
+    out = fn(flip_ips(batch.src_ip), flip_ips(batch.dst_ip),
+             batch.proto.astype(np.int32), batch.dst_port.astype(np.int32))
+    codes = np.asarray(out["code"])
+    for i, (s, d) in enumerate(pairs):
+        want = reach.m[(s, d)]
+        got_o = int(oracle.classify(pkts[i]).code)
+        assert got_o == want, (s, d, "oracle", got_o, "want", want)
+        assert int(codes[i]) == want, (s, d, "kernel", int(codes[i]),
+                                       "want", want)
+
+
+# ---------------------------------------------------------------------------
+# Cases.  Each docstring cites the transcribed reference function.
+# ---------------------------------------------------------------------------
+
+
+def test_acnp_allow_xb_to_a():
+    """testACNPAllowXBtoA (antreapolicy_test.go:412): under K8s default
+    deny ingress everywhere, ACNP prio 1 allows TCP/80 from x/b to pods
+    'a' in all namespaces."""
+    w = World()
+    w.k8s_default_deny_ingress_everywhere()
+    a_pods = w.group("all-a", pods(lambda n, p: p == "a"))
+    xb = w.group("xb", ["x/b"])
+    w.acnp("acnp-allow-xb-to-a", [a_pods],
+           [ing(P(xb), RuleAction.ALLOW, TCP80)], prio=1.0)
+    r = Reach(DROP)
+    r.expect("x/b", "x/a", ALLOW)
+    r.expect("x/b", "y/a", ALLOW)
+    r.expect("x/b", "z/a", ALLOW)
+    run_case(w, r, port=80)
+
+
+def test_acnp_allow_xb_to_ya_named_port():
+    """testACNPAllowXBtoYA (antreapolicy_test.go:508): same default-deny
+    world; ACNP prio 2 allows x/b -> y/a on NAMED port serve-81; probes
+    run on port 81."""
+    w = World()
+    w.k8s_default_deny_ingress_everywhere()
+    ya = w.group("ya", ["y/a"])
+    xb = w.group("xb", ["x/b"])
+    w.acnp("acnp-allow-xb-to-ya", [ya],
+           [ing(P(xb), RuleAction.ALLOW, NP81)], prio=2.0)
+    r = Reach(DROP)
+    r.expect("x/b", "y/a", ALLOW)
+    run_case(w, r, port=81)
+
+
+def test_acnp_priority_override_default_deny():
+    """testACNPPriorityOverrideDefaultDeny (antreapolicy_test.go:539):
+    default-deny everywhere + prio-2 allow z->x + prio-1 drop z->x/a:
+    the higher-precedence drop wins on x/a, the allow opens x/b, x/c."""
+    w = World()
+    w.k8s_default_deny_ingress_everywhere()
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    xa = w.group("xa", ["x/a"])
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-priority2", [ns_x],
+           [ing(P(ns_z), RuleAction.ALLOW, TCP80)], prio=2.0)
+    w.acnp("acnp-priority1", [xa],
+           [ing(P(ns_z), RuleAction.DROP, TCP80)], prio=1.0)
+    r = Reach(DROP)
+    for zp in ("z/a", "z/b", "z/c"):
+        r.expect(zp, "x/b", ALLOW)
+        r.expect(zp, "x/c", ALLOW)
+    run_case(w, r, port=80)
+
+
+def test_acnp_allow_no_default_isolation():
+    """testACNPAllowNoDefaultIsolation (antreapolicy_test.go:586): Allow
+    rules create NO isolation — everything stays Connected on port 81."""
+    w = World()
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    ns_y = w.group("ns-y", pods(lambda n, p: n == "y"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-allow-x-ingress-y-egress-z", [ns_x],
+           [ing(P(ns_y), RuleAction.ALLOW, TCP81),
+            eg(P(ns_z), RuleAction.ALLOW, TCP81)], prio=1.1)
+    run_case(w, Reach(ALLOW), port=81)
+
+
+def test_acnp_drop_egress():
+    """testACNPDropEgress (antreapolicy_test.go:621): drop egress TCP/80
+    from all pods 'a' to namespace z."""
+    w = World()
+    a_pods = w.group("all-a", pods(lambda n, p: p == "a"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-deny-a-to-z-egress", [a_pods],
+           [eg(P(ns_z), RuleAction.DROP, TCP80)], prio=1.0)
+    r = Reach(ALLOW)
+    r.expect_egress_to_ns("x/a", "z", DROP)
+    r.expect_egress_to_ns("y/a", "z", DROP)
+    r.expect("z/a", "z/b", DROP)
+    r.expect("z/a", "z/c", DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_drop_ingress_in_selected_namespace():
+    """testACNPDropIngressInSelectedNamespace (antreapolicy_test.go:660):
+    drop-all-ingress rule (no From) applied to namespace x."""
+    w = World()
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    w.acnp("acnp-deny-ingress-to-x", [ns_x],
+           [ing(NetworkPolicyPeer(), RuleAction.DROP, TCP80)], prio=1.0)
+    r = Reach(ALLOW)
+    for d in ("x/a", "x/b", "x/c"):
+        r.expect_all_ingress(d, DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_no_effect_on_other_protocols():
+    """testACNPNoEffectOnOtherProtocols (antreapolicy_test.go:742): a TCP
+    drop (a <- ns z) leaves UDP traffic untouched."""
+    w = World()
+    a_pods = w.group("all-a", pods(lambda n, p: p == "a"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-deny-a-to-z-ingress", [a_pods],
+           [ing(P(ns_z), RuleAction.DROP, TCP80)], prio=1.0)
+    r1 = Reach(ALLOW)
+    for zp in ("z/a", "z/b", "z/c"):
+        for dst in ("x/a", "y/a", "z/a"):
+            if zp != dst:
+                r1.expect(zp, dst, DROP)
+    run_case(w, r1, port=80, proto=PROTO_TCP)
+    run_case(w, Reach(ALLOW), port=80, proto=PROTO_UDP)
+
+
+def test_acnp_priority_override():
+    """testACNPPriorityOverride (antreapolicy_test.go:1800), step 'All
+    three Policies': prio 1.001 drop z/b->x/a beats prio 1.002 allow
+    z->x/a beats prio 1.003 drop z->x."""
+    w = World()
+    xa = w.group("xa", ["x/a"])
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    zb = w.group("zb", ["z/b"])
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-priority1", [xa],
+           [ing(P(zb), RuleAction.DROP, TCP80)], prio=1.001)
+    w.acnp("acnp-priority2", [xa],
+           [ing(P(ns_z), RuleAction.ALLOW, TCP80)], prio=1.002)
+    w.acnp("acnp-priority3", [ns_x],
+           [ing(P(ns_z), RuleAction.DROP, TCP80)], prio=1.003)
+    r = Reach(ALLOW)
+    r.expect("z/a", "x/b", DROP)
+    r.expect("z/a", "x/c", DROP)
+    r.expect("z/b", "x/a", DROP)
+    r.expect("z/b", "x/b", DROP)
+    r.expect("z/b", "x/c", DROP)
+    r.expect("z/c", "x/b", DROP)
+    r.expect("z/c", "x/c", DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_tier_override():
+    """testACNPTierOverride (antreapolicy_test.go:1883), step 'All three
+    Policies in different tiers': emergency drop z/b->x/a beats
+    securityops allow z->x/a beats application drop z->x — the SAME
+    matrix as priority override, driven by tier precedence."""
+    w = World()
+    xa = w.group("xa", ["x/a"])
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    zb = w.group("zb", ["z/b"])
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-tier-emergency", [xa],
+           [ing(P(zb), RuleAction.DROP, TCP80)],
+           tier=TIER_EMERGENCY, prio=100)
+    w.acnp("acnp-tier-securityops", [xa],
+           [ing(P(ns_z), RuleAction.ALLOW, TCP80)],
+           tier=TIER_SECURITYOPS, prio=10)
+    w.acnp("acnp-tier-application", [ns_x],
+           [ing(P(ns_z), RuleAction.DROP, TCP80)],
+           tier=TIER_APPLICATION, prio=1)
+    r = Reach(ALLOW)
+    r.expect("z/a", "x/b", DROP)
+    r.expect("z/a", "x/c", DROP)
+    r.expect("z/b", "x/a", DROP)
+    r.expect("z/b", "x/b", DROP)
+    r.expect("z/b", "x/c", DROP)
+    r.expect("z/c", "x/b", DROP)
+    r.expect("z/c", "x/c", DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_custom_tiers():
+    """testACNPCustomTiers (antreapolicy_test.go:1968): custom tiers at
+    priorities 245/246 — high-priority allow z->x/a over low-priority
+    drop z->x."""
+    w = World()
+    xa = w.group("xa", ["x/a"])
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-tier-high", [xa],
+           [ing(P(ns_z), RuleAction.ALLOW, TCP80)], tier=245, prio=100)
+    w.acnp("acnp-tier-low", [ns_x],
+           [ing(P(ns_z), RuleAction.DROP, TCP80)], tier=246, prio=1)
+    r = Reach(ALLOW)
+    for zp in ("z/a", "z/b", "z/c"):
+        r.expect(zp, "x/b", DROP)
+        r.expect(zp, "x/c", DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_priority_conflicting_rule():
+    """testACNPPriorityConflictingRule (antreapolicy_test.go:2030):
+    identical rules, drop at prio 1 vs allow at prio 2 — the drop
+    prevails for all of z -> x."""
+    w = World()
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-drop", [ns_x],
+           [ing(P(ns_z), RuleAction.DROP, TCP80)], prio=1)
+    w.acnp("acnp-allow", [ns_x],
+           [ing(P(ns_z), RuleAction.ALLOW, TCP80)], prio=2)
+    r = Reach(ALLOW)
+    for zp in ("z/a", "z/b", "z/c"):
+        r.expect_egress_to_ns(zp, "x", DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_rule_priority():
+    """testACNPRulePriority (antreapolicy_test.go:2074): two same-priority
+    ACNPs with conflicting rules — rule order inside acnp-deny puts
+    drop-to-y first, so x->y drops while x->z allows."""
+    w = World()
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    ns_y = w.group("ns-y", pods(lambda n, p: n == "y"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-deny", [ns_x],
+           [eg(P(ns_y), RuleAction.DROP, TCP80, prio=0),
+            eg(P(ns_z), RuleAction.DROP, TCP80, prio=1)], prio=5)
+    w.acnp("acnp-allow", [ns_x],
+           [eg(P(ns_z), RuleAction.ALLOW, TCP80, prio=0),
+            eg(P(ns_y), RuleAction.ALLOW, TCP80, prio=1)], prio=5)
+    r = Reach(ALLOW)
+    for d in ("y/a", "y/b", "y/c"):
+        r.expect_ingress_from_ns(d, "x", DROP)
+    run_case(w, r, port=80)
+
+
+def test_acnp_port_range():
+    """testACNPPortRange (antreapolicy_test.go:2125): drop egress from
+    pods 'a' to ns z on TCP 8080-8082; probes on 8081 (inside the range)
+    and 8083 (outside)."""
+    w = World()
+    a_pods = w.group("all-a", pods(lambda n, p: p == "a"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-deny-a-to-z-egress-port-range", [a_pods],
+           [eg(P(ns_z), RuleAction.DROP,
+               [Service(protocol=PROTO_TCP, port=8080, end_port=8082)])],
+           prio=1.0)
+    r = Reach(ALLOW)
+    r.expect_egress_to_ns("x/a", "z", DROP)
+    r.expect_egress_to_ns("y/a", "z", DROP)
+    r.expect("z/a", "z/b", DROP)
+    r.expect("z/a", "z/c", DROP)
+    run_case(w, r, port=8081)
+    run_case(w, Reach(ALLOW), port=8083)
+
+
+def test_acnp_reject_ingress():
+    """testACNPRejectIngress (antreapolicy_test.go:2190): REJECT (not
+    drop) ingress from namespace z to all pods 'a'."""
+    w = World()
+    a_pods = w.group("all-a", pods(lambda n, p: p == "a"))
+    ns_z = w.group("ns-z", pods(lambda n, p: n == "z"))
+    w.acnp("acnp-reject-a-from-z-ingress", [a_pods],
+           [ing(P(ns_z), RuleAction.REJECT, TCP80)], prio=1.0)
+    r = Reach(ALLOW)
+    r.expect_ingress_from_ns("x/a", "z", REJECT)
+    r.expect_ingress_from_ns("y/a", "z", REJECT)
+    r.expect("z/b", "z/a", REJECT)
+    r.expect("z/c", "z/a", REJECT)
+    run_case(w, r, port=80)
+
+
+def test_baseline_namespace_isolation():
+    """testBaselineNamespaceIsolation (antreapolicy_test.go:1718): a
+    baseline-tier drop of non-x ingress into ns x, then a K8s NP opening
+    y/a -> x/a — the developer policy overrides the baseline AND brings
+    K8s isolation onto x/a (step 'Baseline ACNP with KNP')."""
+    w = World()
+    ns_x = w.group("ns-x", pods(lambda n, p: n == "x"))
+    not_x = w.group("not-x", pods(lambda n, p: n != "x"))
+    w.acnp("acnp-baseline-isolate-ns-x", [ns_x],
+           [ing(P(not_x), RuleAction.DROP, TCP80)],
+           tier=TIER_BASELINE, prio=1.0)
+    # Step 1: baseline alone.
+    r = Reach(ALLOW)
+    r.expect_ns_ingress_from_ns("x", "y", DROP)
+    r.expect_ns_ingress_from_ns("x", "z", DROP)
+    run_case(w, r, port=80)
+
+    # Step 2: + K8s NP allowing y/a -> x/a (isolates x/a in IN).
+    xa = w.group("xa", ["x/a"])
+    ya = w.group("ya", ["y/a"])
+    w.ps.policies.append(NetworkPolicy(
+        uid="allow-y-a-to-x-a", name="allow-y-a-to-x-a", namespace="x",
+        type=NetworkPolicyType.K8S,
+        rules=[ing(P(ya), RuleAction.ALLOW, TCP80)],
+        applied_to_groups=[xa], policy_types=[Direction.IN],
+    ))
+    r2 = Reach(ALLOW)
+    r2.expect("x/b", "x/a", DROP)
+    r2.expect("x/c", "x/a", DROP)
+    r2.expect("y/a", "x/b", DROP)
+    r2.expect("y/a", "x/c", DROP)
+    r2.expect_egress_to_ns("y/b", "x", DROP)
+    r2.expect_egress_to_ns("y/c", "x", DROP)
+    r2.expect_ns_ingress_from_ns("x", "z", DROP)
+    r2.expect("y/a", "x/a", ALLOW)
+    run_case(w, r2, port=80)
+
+
+def test_acnp_namespace_isolation_baseline_self_ns():
+    """testACNPNamespaceIsolation (antreapolicy_test.go:3191), step 1:
+    baseline tier, appliedTo all namespaces, allow same-namespace ingress
+    then drop everything else — only intra-namespace traffic connects.
+    (namespaces:self expands per namespace, exactly what the central
+    controller does with the selfNamespace peer.)"""
+    w = World()
+    for ns in NAMESPACES:
+        g = w.group(f"ns-{ns}", pods(lambda n, p, ns=ns: n == ns))
+        w.acnp(f"ns-isolation-{ns}", [g],
+               [ing(P(g), RuleAction.ALLOW, None, prio=0),
+                ing(NetworkPolicyPeer(), RuleAction.DROP, None, prio=1)],
+               tier=TIER_BASELINE, prio=1.0)
+    r = Reach(DROP)
+    for ns in NAMESPACES:
+        for s in pods(lambda n, p, ns=ns: n == ns):
+            for d in pods(lambda n, p, ns=ns: n == ns):
+                if s != d:
+                    r.expect(s, d, ALLOW)
+    run_case(w, r, port=80)
