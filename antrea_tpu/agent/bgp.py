@@ -10,8 +10,11 @@ The speaker itself is external native code in the reference (gobgp's BGP
 wire implementation); what the controller owns — and what is rebuilt here —
 is the RECONCILIATION: resources -> advertised prefix set per peer, with
 adds/withdraws computed as set deltas (bgp_controller.go reconcile:
-advertisements diffing) and per-peer session state.  The wire protocol is
-behind a `speaker` callable so tests (and a future native speaker) plug in.
+advertisements diffing) and per-peer session state.  The wire protocol
+sits behind a `speaker` callable; agent/bgp_wire.py provides the real
+RFC 4271 speaker (OPEN/KEEPALIVE/UPDATE over TCP — wire_speaker opens a
+session per peer), and tests prove a peer actually receives the routes
+(tests/test_aux_agents.py scripted-peer session).
 """
 
 from __future__ import annotations

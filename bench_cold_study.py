@@ -1,0 +1,111 @@
+"""Cold-path phase-breakdown study (round 5; see the study notes in
+antrea_tpu/ops/match.py).
+
+Measures, at the bench's 100k-rule world and B=32k on the real chip:
+  1. fused end-to-end cold classification (the shipped path);
+  2. the searchsorted phase alone;
+  3. searchsorted + 6 row gathers with a reduction fused into the gather
+     loops (the hard gather bound);
+  4. the AND-in-XLA + 2-input consumer variant (measured dead-end (c)).
+Run directly: python bench_cold_study.py  (several minutes on the
+tunneled platform; numbers jitter ~15% run to run)."""
+import jax, jax.numpy as jnp, numpy as np
+from functools import lru_cache
+from antrea_tpu.compiler.compile import compile_policy_set
+from antrea_tpu.ops import match as m
+from antrea_tpu.simulator.genpolicy import gen_cluster
+from antrea_tpu.simulator.traffic import gen_traffic
+from antrea_tpu.utils import ip as iputil
+from antrea_tpu.utils.timing import device_loop_time
+
+B = 1 << 15
+cluster = gen_cluster(100_000, n_nodes=64, pods_per_node=32, seed=1)
+cps = compile_policy_set(cluster.ps)
+drs, meta = m.to_device(cps)
+tr = gen_traffic(cluster.pod_ips, B, n_flows=1 << 15, seed=3)
+src = jnp.asarray(iputil.flip_u32(tr.src_ip))
+dst = jnp.asarray(iputil.flip_u32(tr.dst_ip))
+proto = jnp.asarray(tr.proto)
+dport = jnp.asarray(tr.dst_port)
+print("w_in", meta.w_in, "w_out", meta.w_out,
+      "NB at", drs.ingress.at.bounds.shape, "peer", drs.ingress.peer.bounds.shape,
+      "svc", drs.ingress.svc.bounds.shape, flush=True)
+
+def timeit(name, body, carry):
+    sec = device_loop_time(body, carry, k_small=8, k_big=64, repeats=3)
+    print(f"{name}: {sec*1e3:.3f} ms/batch -> {B/sec/1e6:.2f}M pps", flush=True)
+    return sec
+
+def perturb(dp_, acc):
+    return dp_ ^ (acc[0] & 1)
+
+# 1) end-to-end fused (baseline)
+def body_full(i, carry):
+    acc, drs_, s_, d_, p_, dp_ = carry
+    cls = m.classify_batch(drs_, s_, d_, p_, perturb(dp_, acc), meta=meta, fused=True)
+    return (acc.at[:1].add(cls["code"].sum(dtype=jnp.int32)), drs_, s_, d_, p_, dp_)
+carry = (jnp.zeros(8, jnp.int32), drs, src, dst, proto, dport)
+t_full = timeit("fused end-to-end", body_full, carry)
+
+# 2) searchsorted phase only (6 dim indices + 2 iso)
+def body_ss(i, carry):
+    acc, drs_, s_, d_, p_, dp_ = carry
+    dp2 = perturb(dp_, acc)
+    svc_key = (p_ << 16) | dp2
+    tot = jnp.int32(0)
+    for tab, x in ((drs_.ingress.at, d_), (drs_.ingress.peer, s_),
+                   (drs_.ingress.svc, svc_key), (drs_.egress.at, s_),
+                   (drs_.egress.peer, d_), (drs_.egress.svc, svc_key)):
+        tot = tot + m._searchsorted_right(tab.bounds, x).sum()
+    return (acc.at[:1].add(tot), drs_, s_, d_, p_, dp_)
+t_ss = timeit("searchsorted only", body_ss, carry)
+
+# 3) gathers only (no consumer): sum of gathered rows (XLA fuses sum into gather)
+def body_g(i, carry):
+    acc, drs_, s_, d_, p_, dp_ = carry
+    dp2 = perturb(dp_, acc)
+    svc_key = (p_ << 16) | dp2
+    tot = jnp.uint32(0)
+    for tab, x in ((drs_.ingress.at, d_), (drs_.ingress.peer, s_),
+                   (drs_.ingress.svc, svc_key), (drs_.egress.at, s_),
+                   (drs_.egress.peer, d_), (drs_.egress.svc, svc_key)):
+        idx = m._searchsorted_right(tab.bounds, x)
+        tot = tot + tab.inc[idx].sum()
+    return (acc.at[:1].add(tot.astype(jnp.int32)), drs_, s_, d_, p_, dp_)
+t_g = timeit("searchsorted+gathers+reduce (no consumer)", body_g, carry)
+
+# 4) AND-in-XLA + 2-input pallas consumer
+from jax.experimental import pallas as pl
+
+@lru_cache(maxsize=4)
+def consumer2(b, w_in, w_out, in_phases, out_phases):
+    def kernel(mi, mo, o_ref):
+        i0, ik, ib = m._phase_scan_tile(mi[:], w_in, in_phases)
+        o0, ok_, ob = m._phase_scan_tile(mo[:], w_out, out_phases)
+        o_ref[:] = jnp.stack([i0, ik, ib, o0, ok_, ob,
+                              jnp.zeros_like(i0), jnp.zeros_like(i0)], axis=1)
+    tb = m._FUSE_TB
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 8), jnp.int32),
+        grid=(b // tb,),
+        in_specs=[pl.BlockSpec((tb, w), lambda i: (i, 0)) for w in (w_in, w_out)],
+        out_specs=pl.BlockSpec((tb, 8), lambda i: (i, 0)),
+        interpret=jax.devices()[0].platform == "cpu",
+    )
+
+def body_and(i, carry):
+    acc, drs_, s_, d_, p_, dp_ = carry
+    dp2 = perturb(dp_, acc)
+    svc_key = (p_ << 16) | dp2
+    ing, egs = drs_.ingress, drs_.egress
+    mi = (ing.at.inc[m._searchsorted_right(ing.at.bounds, d_)]
+          & ing.peer.inc[m._searchsorted_right(ing.peer.bounds, s_)]
+          & ing.svc.inc[m._searchsorted_right(ing.svc.bounds, svc_key)])
+    mo = (egs.at.inc[m._searchsorted_right(egs.at.bounds, s_)]
+          & egs.peer.inc[m._searchsorted_right(egs.peer.bounds, d_)]
+          & egs.svc.inc[m._searchsorted_right(egs.svc.bounds, svc_key)])
+    hits = consumer2(B, meta.w_in, meta.w_out, meta.in_phases, meta.out_phases)(
+        mi.astype(jnp.int32), mo.astype(jnp.int32))
+    return (acc.at[:1].add(hits[:, 0].sum()), drs_, s_, d_, p_, dp_)
+t_and = timeit("AND-in-XLA + 2-input consumer", body_and, carry)
