@@ -505,3 +505,63 @@ def test_acnp_namespace_isolation_baseline_self_ns():
                 if s != d:
                     r.expect(s, d, ALLOW)
     run_case(w, r, port=80)
+
+
+def test_acnp_applied_to_deny_xb_to_cg_with_ya():
+    """testACNPAppliedToDenyXBtoCGWithYA (antreapolicy_test.go:785): ACNP
+    appliedTo a ClusterGroup selecting y/a; drop from x/b on NAMED port
+    serve-81 — only that one pair drops on port 81."""
+    w = World()
+    cg_ya = w.group("cg-pods-ya", ["y/a"])
+    xb = w.group("xb", ["x/b"])
+    w.acnp("acnp-deny-cg-with-ya-from-xb", [cg_ya],
+           [ing(P(xb), RuleAction.DROP, NP81)], prio=2.0)
+    r = Reach(ALLOW)
+    r.expect("x/b", "y/a", DROP)
+    run_case(w, r, port=81)
+
+
+def test_acnp_ingress_rule_deny_cg_with_xb_to_ya():
+    """testACNPIngressRuleDenyCGWithXBtoYA (antreapolicy_test.go:820): the
+    ClusterGroup sits on the RULE side (from: group cg-pods-xb); drop onto
+    y/a on named port 81."""
+    w = World()
+    cg_xb = w.group("cg-pods-xb", ["x/b"])
+    ya = w.group("ya", ["y/a"])
+    w.acnp("acnp-deny-cg-with-xb-to-ya", [ya],
+           [ing(P(cg_xb), RuleAction.DROP, NP81)], prio=2.0)
+    r = Reach(ALLOW)
+    r.expect("x/b", "y/a", DROP)
+    run_case(w, r, port=81)
+
+
+def test_acnp_strict_namespaces_isolation_pass_to_k8s():
+    """testACNPStrictNamespacesIsolation (antreapolicy_test.go:3244):
+    securityops-tier PASS for same-namespace ingress (delegating
+    intra-namespace control to namespace owners' K8s NPs) + drop from
+    everywhere else.  Step 1: only intra-namespace connects.  Step 2: a
+    K8s default-deny in ns x closes x's intra-namespace traffic too —
+    the PASS hands the verdict to the K8s layer, which isolates."""
+    w = World()
+    for ns in NAMESPACES:
+        g = w.group(f"ns-{ns}", pods(lambda n, p, ns=ns: n == ns))
+        w.acnp(f"strict-ns-{ns}", [g],
+               [ing(P(g), RuleAction.PASS, None, prio=0),
+                ing(NetworkPolicyPeer(), RuleAction.DROP, None, prio=1)],
+               tier=TIER_SECURITYOPS, prio=1.0)
+    r = Reach(DROP)
+    for ns in NAMESPACES:
+        r.expect_ns_ingress_from_ns(ns, ns, ALLOW)
+    run_case(w, r, port=80)
+
+    # Step 2: K8s default-deny-ingress over namespace x.
+    gx = w.group("ddx", pods(lambda n, p: n == "x"))
+    w.ps.policies.append(NetworkPolicy(
+        uid="default-deny-in-namespace-x", name="default-deny-in-namespace-x",
+        namespace="x", type=NetworkPolicyType.K8S, rules=[],
+        applied_to_groups=[gx], policy_types=[Direction.IN],
+    ))
+    r2 = Reach(DROP)
+    for ns in ("y", "z"):
+        r2.expect_ns_ingress_from_ns(ns, ns, ALLOW)
+    run_case(w, r2, port=80)
