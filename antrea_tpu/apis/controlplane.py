@@ -132,12 +132,23 @@ class Service:
     NAMED container port (the IntOrString string form of the reference's
     Service.Port): resolved per destination member by
     compiler/ir.resolve_named_ports before any matching happens.
+
+    icmp_type/icmp_code (ref Service.ICMPType/ICMPCode, types.go:311 —
+    the crd `protocols: icmp:` rule form, e2e testACNPICMPSupport):
+    constrain ICMP lanes.  Datapath convention: an ICMP packet's
+    dst_port column carries (type << 8) | code (the icmp_type/icmp_code
+    flow-match fields ride the same lanes OVS matches them in), so ICMP
+    services compile into the SAME svc-dimension key space as ports —
+    no extra kernel dimension.  icmp_code without icmp_type is invalid
+    (reference validation rejects it too).
     """
 
     protocol: Optional[int] = None
     port: Optional[int] = None
     end_port: Optional[int] = None
     port_name: str = ""
+    icmp_type: Optional[int] = None
+    icmp_code: Optional[int] = None
 
 
 @dataclass

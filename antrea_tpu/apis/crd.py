@@ -137,11 +137,15 @@ class K8sPeer:
 
 @dataclass(frozen=True)
 class PortSpec:
-    """NetworkPolicyPort / Antrea rule port: protocol + port[-end_port]."""
+    """NetworkPolicyPort / Antrea rule port: protocol + port[-end_port],
+    or an ICMP type[/code] constraint (the crd `protocols: icmp:` form,
+    ref crd Rule.Protocols -> controlplane Service ICMPType/ICMPCode)."""
 
     protocol: Optional[int] = 6  # TCP default per K8s API
     port: Optional[int] = None
     end_port: Optional[int] = None
+    icmp_type: Optional[int] = None
+    icmp_code: Optional[int] = None
 
 
 @dataclass

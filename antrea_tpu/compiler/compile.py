@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..apis.controlplane import (
+    PROTO_ICMP,
     PROTO_SCTP,
     PROTO_TCP,
     PROTO_UDP,
@@ -84,7 +85,18 @@ def _svc_key_ranges(services: list[Service]) -> tuple[tuple[int, int], ...]:
     for s in services:
         protos = [s.protocol] if s.protocol is not None else list(range(256))
         for p in protos:
-            if s.port is None or p not in _PORT_PROTOS:
+            if p == PROTO_ICMP and s.icmp_type is not None:
+                # ICMP type/code constraint (Service.ICMPType/ICMPCode,
+                # types.go:311): ICMP lanes carry (type << 8) | code in
+                # the dst_port column, so this is a plain key range.
+                lo = s.icmp_type << 8
+                if s.icmp_code is not None:
+                    lo |= s.icmp_code
+                    hi = lo + 1
+                else:
+                    hi = lo + 256  # any code under this type
+                ranges.append(((p << 16) + lo, (p << 16) + hi))
+            elif s.port is None or p not in _PORT_PROTOS:
                 whole_proto(p)
             else:
                 hi = s.end_port if s.end_port is not None else s.port

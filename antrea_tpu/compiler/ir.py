@@ -113,6 +113,14 @@ def resolve_named_ports(ps: PolicySet) -> PolicySet:
     Consumed by BOTH compile_policy_set and the scalar Oracle — a single
     source of truth, so the twins cannot drift on named-port semantics.
     Idempotent: an already-resolved set has no named services.
+
+    Also the shared SERVICE VALIDATION point (it runs before either
+    engine compiles/matches): ICMP type/code must fit their 8-bit wire
+    fields and icmp_code requires icmp_type — out-of-range values would
+    alias into a NEIGHBOR protocol's key range in the compiled svc
+    dimension while the scalar matcher never fires (twin divergence),
+    and a code without a type silently matches everything (the
+    reference's CRD validation rejects both).
     """
     from ..apis.controlplane import (
         AddressGroup,
@@ -120,6 +128,20 @@ def resolve_named_ports(ps: PolicySet) -> PolicySet:
         Direction,
         NetworkPolicyPeer,
     )
+
+    for p in ps.policies:
+        for r in p.rules:
+            for s in r.services:
+                if s.icmp_code is not None and s.icmp_type is None:
+                    raise ValueError(
+                        f"policy {p.uid}: icmp_code without icmp_type"
+                    )
+                for v, what in ((s.icmp_type, "icmp_type"),
+                                (s.icmp_code, "icmp_code")):
+                    if v is not None and not 0 <= v <= 255:
+                        raise ValueError(
+                            f"policy {p.uid}: {what} {v} outside 0-255"
+                        )
 
     if not any(
         s.port_name

@@ -721,4 +721,5 @@ class NetworkPolicyController:
 
 
 def _port_to_service(p: PortSpec) -> cp.Service:
-    return cp.Service(protocol=p.protocol, port=p.port, end_port=p.end_port)
+    return cp.Service(protocol=p.protocol, port=p.port, end_port=p.end_port,
+                      icmp_type=p.icmp_type, icmp_code=p.icmp_code)

@@ -68,6 +68,10 @@ def _service(s: cp.Service) -> dict:
     out = {"protocol": s.protocol, "port": s.port, "endPort": s.end_port}
     if s.port_name:
         out["portName"] = s.port_name  # IntOrString string form
+    if s.icmp_type is not None:
+        out["icmpType"] = s.icmp_type  # types.go:311 ICMPType/ICMPCode
+        if s.icmp_code is not None:
+            out["icmpCode"] = s.icmp_code
     return out
 
 
@@ -75,6 +79,7 @@ def _service_from(d: dict) -> cp.Service:
     return cp.Service(
         protocol=d.get("protocol"), port=d.get("port"),
         end_port=d.get("endPort"), port_name=d.get("portName", ""),
+        icmp_type=d.get("icmpType"), icmp_code=d.get("icmpCode"),
     )
 
 

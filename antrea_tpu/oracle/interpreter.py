@@ -38,6 +38,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..apis.controlplane import (
+    PROTO_ICMP,
     PROTO_SCTP,
     PROTO_TCP,
     PROTO_UDP,
@@ -77,6 +78,13 @@ def _service_matches(svc: Service, pkt: Packet) -> bool:
     if svc.port is not None and pkt.proto in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
         hi = svc.end_port if svc.end_port is not None else svc.port
         if not (svc.port <= pkt.dst_port <= hi):
+            return False
+    if svc.icmp_type is not None and pkt.proto == PROTO_ICMP:
+        # ICMP lanes carry (type << 8) | code in dst_port (the datapath
+        # convention — Service.ICMPType/ICMPCode, types.go:311).
+        if (pkt.dst_port >> 8) != svc.icmp_type:
+            return False
+        if svc.icmp_code is not None and (pkt.dst_port & 0xFF) != svc.icmp_code:
             return False
     return True
 

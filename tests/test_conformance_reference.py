@@ -565,3 +565,84 @@ def test_acnp_strict_namespaces_isolation_pass_to_k8s():
     for ns in ("y", "z"):
         r2.expect_ns_ingress_from_ns(ns, ns, ALLOW)
     run_case(w, r2, port=80)
+
+
+def test_acnp_icmp_type_code_support():
+    """testACNPICMPSupport (antreapolicy_test.go:3922): egress REJECT of
+    ICMP echo-request (type 8, code 0) from the client to server0, DROP
+    of ALL ICMP to server1; other ICMP types to server0 pass.  ICMP lanes
+    carry (type << 8) | code in the dst_port column (the icmp_type/
+    icmp_code flow-match convention)."""
+    from antrea_tpu.apis.controlplane import PROTO_ICMP
+
+    w = World()
+    client = w.group("client", ["x/a"])
+    server0 = w.group("server0", ["y/a"])
+    server1 = w.group("server1", ["y/b"])
+    w.acnp("test-acnp-icmp", [client],
+           [eg(P(server0), RuleAction.REJECT,
+               [Service(protocol=PROTO_ICMP, icmp_type=8, icmp_code=0)],
+               prio=0),
+            eg(P(server1), RuleAction.DROP,
+               [Service(protocol=PROTO_ICMP)], prio=1)],
+           prio=1.0)
+
+    oracle = Oracle(w.ps)
+    from antrea_tpu.compiler.compile import compile_policy_set
+
+    fn, _ = make_classifier(compile_policy_set(w.ps))
+    cases = [
+        # (src, dst, icmp type, code, want)
+        ("x/a", "y/a", 8, 0, REJECT),   # echo request -> rejected
+        ("x/a", "y/a", 0, 0, ALLOW),    # echo reply: different type
+        ("x/a", "y/a", 8, 1, ALLOW),    # same type, different code
+        ("x/a", "y/b", 8, 0, DROP),     # any ICMP to server1 drops
+        ("x/a", "y/b", 3, 1, DROP),
+        ("x/c", "y/a", 8, 0, ALLOW),    # other clients unaffected
+    ]
+    pkts = [Packet(src_ip=iputil.ip_to_u32(IPS[s]),
+                   dst_ip=iputil.ip_to_u32(IPS[d]),
+                   proto=PROTO_ICMP, src_port=0,
+                   dst_port=(t << 8) | c)
+            for s, d, t, c, _ in cases]
+    batch = PacketBatch.from_packets(pkts)
+    out = fn(flip_ips(batch.src_ip), flip_ips(batch.dst_ip),
+             batch.proto.astype(np.int32), batch.dst_port.astype(np.int32))
+    codes = np.asarray(out["code"])
+    for i, (s, d, t, c, want) in enumerate(cases):
+        got_o = int(oracle.classify(pkts[i]).code)
+        assert got_o == want, (s, d, t, c, "oracle", got_o)
+        assert int(codes[i]) == want, (s, d, t, c, "kernel", int(codes[i]))
+
+
+def test_icmp_service_validation_and_wire_roundtrip():
+    """ICMP plumbing closes end to end: out-of-range type/code and
+    code-without-type are rejected by the SHARED validation pass (both
+    engines), the wire codec round-trips the fields, and the CRD port
+    form reaches the controlplane Service."""
+    from antrea_tpu.apis import crd
+    from antrea_tpu.apis.controlplane import PROTO_ICMP
+    from antrea_tpu.compiler.ir import resolve_named_ports
+    from antrea_tpu.controller.networkpolicy import _port_to_service
+    from antrea_tpu.dissemination.serde import _service, _service_from
+
+    def ps_with(svc):
+        w = World()
+        g = w.group("g", ["x/a"])
+        w.acnp("p", [g], [ing(P(g), RuleAction.DROP, [svc])], prio=1.0)
+        return w.ps
+
+    for bad in (Service(protocol=PROTO_ICMP, icmp_type=300),
+                Service(protocol=PROTO_ICMP, icmp_type=8, icmp_code=999),
+                Service(protocol=PROTO_ICMP, icmp_code=0)):
+        with pytest.raises(ValueError):
+            resolve_named_ports(ps_with(bad))
+        with pytest.raises(ValueError):
+            Oracle(ps_with(bad))
+
+    s = Service(protocol=PROTO_ICMP, icmp_type=8, icmp_code=0)
+    assert _service_from(_service(s)) == s
+
+    p = crd.PortSpec(protocol=PROTO_ICMP, icmp_type=8, icmp_code=0)
+    out = _port_to_service(p)
+    assert out.icmp_type == 8 and out.icmp_code == 0
