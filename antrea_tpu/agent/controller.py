@@ -25,12 +25,14 @@ from __future__ import annotations
 
 import copy
 import os
+import time
 from typing import Optional
 
 from ..apis import controlplane as cp
 from ..compiler.ir import PolicySet
 from ..controller.networkpolicy import WatchEvent
 from ..datapath.interface import Datapath
+from ..dissemination.netwire import Backoff
 from ..dissemination.store import RamStore
 
 
@@ -43,12 +45,38 @@ class AgentPolicyController:
         *,
         filestore_dir: Optional[str] = None,
         status_reporter=None,
+        retry_backoff_base: float = 0.05,
+        retry_backoff_max: float = 5.0,
+        clock=time.monotonic,
     ):
         self.node = node
         self.datapath = datapath
         self._ps = PolicySet()
         self._rules_dirty = False
         self._deltas: list[tuple[str, list, list]] = []
+        # Datapath install retry (ref: the agent reconciler requeues a
+        # failed rule install instead of dropping it): a raising
+        # install_bundle keeps the dirty flag set, counts into
+        # sync_failures_total, and backs off before the next attempt —
+        # the agent never crashes on a flaky datapath.
+        self.sync_failures_total = 0
+        self.last_sync_error: str = ""
+        # What the datapath actually enforces: refreshed ONLY on a
+        # successful apply, so a failed install can never report upstream
+        # as realized (the status plane would mark a generation Realized
+        # that no flow table holds).
+        self._realized: dict = {}
+        # The ONE backoff discipline (netwire.Backoff, shared with the
+        # wire reconnect path): capped exponential + jitter.
+        self._retry_backoff = Backoff(base=retry_backoff_base,
+                                      cap=retry_backoff_max)
+        self._retry_at = 0.0
+        self._clock = clock
+        # Resync window (reconnect re-list): keys re-listed between
+        # begin_resync()/end_resync(); anything local but absent from the
+        # snapshot is stale and retracted at end_resync.
+        self._in_resync = False
+        self._resync_seen: set[tuple[str, str]] = set()
         # Realization-status reporting (the agent statusManager analog, ref
         # pkg/agent/controller/networkpolicy status reporting feeding
         # controller status_controller.go:140 UpdateStatus): after every
@@ -72,7 +100,42 @@ class AgentPolicyController:
 
     # -- watcher -------------------------------------------------------------
 
+    def begin_resync(self) -> None:
+        """Start of a full re-list from the dissemination plane (server
+        resync after reconnect or watcher overflow): events until
+        end_resync() constitute the complete span-filtered snapshot."""
+        self._in_resync = True
+        self._resync_seen = set()
+
+    def end_resync(self) -> None:
+        """End of the re-list: retract every local object the snapshot did
+        not re-deliver — state that changed while this agent was
+        disconnected (the stale-object half of re-list semantics)."""
+        if not self._in_resync:
+            return
+        seen = self._resync_seen
+        stale_policies = [p for p in self._ps.policies
+                          if ("NetworkPolicy", p.uid) not in seen]
+        if stale_policies:
+            self._ps.policies = [p for p in self._ps.policies
+                                 if ("NetworkPolicy", p.uid) in seen]
+            self._rules_dirty = True
+        for obj_type, table in (("AppliedToGroup", self._ps.applied_to_groups),
+                                ("AddressGroup", self._ps.address_groups)):
+            for name in [n for n in table if (obj_type, n) not in seen]:
+                del table[name]
+                self._rules_dirty = True
+        self._in_resync = False
+        self._resync_seen = set()
+
     def handle_event(self, ev: WatchEvent) -> None:
+        if self._in_resync:
+            if ev.kind == "DELETED":
+                # A delete interleaved into the re-list window un-lists
+                # the object: end_resync must not treat it as re-listed.
+                self._resync_seen.discard((ev.obj_type, ev.name))
+            else:
+                self._resync_seen.add((ev.obj_type, ev.name))
         if ev.obj_type == "NetworkPolicy":
             if ev.kind == "DELETED":
                 self._ps.policies = [p for p in self._ps.policies if p.uid != ev.name]
@@ -115,43 +178,76 @@ class AgentPolicyController:
 
     # -- reconciler ----------------------------------------------------------
 
+    def _install_failed(self, e: Exception) -> None:
+        """Record a failed datapath install: the dirty flag STAYS set (the
+        state is still pending, exactly the reference reconciler's requeue)
+        and the next attempt waits out a capped exponential backoff."""
+        self.sync_failures_total += 1
+        self.last_sync_error = str(e)
+        self._retry_at = self._clock() + self._retry_backoff.next_delay()
+        self._report_status(failure=str(e))
+
     def sync(self) -> None:
         """Apply pending changes to the datapath: one bundle for structural
         changes, or the queued incremental deltas otherwise.  The filestore
         fallback is refreshed only after a SUCCESSFUL apply — it records the
         last state actually pushed to the datapath; idle syncs touch no
-        disk."""
+        disk.
+
+        A raising install does NOT crash the agent: the failure is counted
+        (sync_failures_total), reported upstream as a Failed realization,
+        and retried with backoff on later sync() calls — the dirty state is
+        never dropped."""
         if not self._rules_dirty and not self._deltas:
             return
         if self._rules_dirty:
+            if self._clock() < self._retry_at:
+                return  # backing off a failed install; state stays pending
             # A bundle folds any pending deltas too (membership is already
             # reflected in the local PolicySet).
             try:
                 self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
             except Exception as e:
-                self._report_status(failure=str(e))
-                raise
+                self._install_failed(e)
+                return
+            self._retry_backoff.reset()
+            self._retry_at = 0.0
             self._rules_dirty = False
             self._deltas.clear()
+            self._realized = {p.uid: p.generation for p in self._ps.policies}
             self._save_filestore()
             self._report_status()
             return
-        for name, added, removed in self._deltas:
-            try:
-                self.datapath.apply_group_delta(name, added, removed)
-            except KeyError:
-                # Group unknown to the datapath snapshot (e.g. delta arrived
-                # before any bundle): fall back to a bundle.
-                self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
-                break
+        try:
+            for name, added, removed in self._deltas:
+                try:
+                    self.datapath.apply_group_delta(name, added, removed)
+                except KeyError:
+                    # Group unknown to the datapath snapshot (e.g. delta
+                    # arrived before any bundle): fall back to a bundle.
+                    self.datapath.install_bundle(ps=copy.deepcopy(self._ps))
+                    break
+        except Exception as e:
+            # A failed delta/bundle leaves the datapath on its previous
+            # consistent snapshot; fold the pending membership into a full
+            # bundle retry (the local PolicySet already reflects it).
+            self._deltas.clear()
+            self._rules_dirty = True
+            self._install_failed(e)
+            return
         self._deltas.clear()
+        self._realized = {p.uid: p.generation for p in self._ps.policies}
         self._save_filestore()
         self._report_status()
 
     def realized_generations(self) -> dict:
-        """{policy uid: spec generation} this agent has applied to its
-        datapath — the per-node realization the status plane aggregates."""
-        return {p.uid: p.generation for p in self._ps.policies}
+        """{policy uid: spec generation} this agent has ACTUALLY applied
+        to its datapath — the per-node realization the status plane
+        aggregates.  Tracks successful installs, not the local PolicySet:
+        state received but not yet (or unsuccessfully) installed stays
+        unreported, so the aggregate phase shows Realizing until the
+        datapath really enforces it."""
+        return dict(self._realized)
 
     def _report_status(self, failure: str = "") -> None:
         if self._status_reporter is None:

@@ -23,23 +23,78 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
+# Primary backend: the `cryptography` package.  Fallback: a pure-Python
+# RFC 7748 Montgomery ladder — some deployment images ship without the
+# cryptography wheel (the same gap netwire.py's PKI covers with the
+# openssl CLI), and a missing optional cipher backend must not take the
+# whole agent package down with an ImportError.  Both backends are
+# checked against the RFC 7748 known-answer vectors in
+# tests/test_aux_agents.py.
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+except ImportError:
+    X25519PrivateKey = X25519PublicKey = None
 
 DEFAULT_PORT = 51820  # ref: pkg/agent/config WireGuardListenPort default
 
 _KEY_ROW = "wireguard/private_key"
+
+_P = 2**255 - 19
+_A24 = 121665
+_BASE_U = (9).to_bytes(32, "little")
+
+
+def _x25519(k: bytes, u: bytes) -> bytes:
+    """RFC 7748 §5 X25519(k, u): scalar mult on Curve25519, constant
+    shape (the swap-based Montgomery ladder as specified)."""
+    kb = bytearray(k)
+    kb[0] &= 248
+    kb[31] &= 127
+    kb[31] |= 64
+    scalar = int.from_bytes(kb, "little")
+    ub = bytearray(u)
+    ub[31] &= 127  # mask the unused high bit per RFC 7748 §5
+    x1 = int.from_bytes(ub, "little")
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in reversed(range(255)):
+        kt = (scalar >> t) & 1
+        swap ^= kt
+        if swap:
+            x2, x3, z2, z3 = x3, x2, z3, z2
+        swap = kt
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = z3 * z3 % _P * x1 % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + _A24 * e) % _P
+    if swap:
+        x2, z2 = x3, z3
+    return (x2 * pow(z2, _P - 2, _P) % _P).to_bytes(32, "little")
 
 
 def _derive_public(private_b64: str) -> str:
     """X25519 public key of a base64 private scalar (wgtypes
     Key.PublicKey) — interop-checked against RFC 7748 vectors in
     tests/test_aux_agents.py."""
-    priv = X25519PrivateKey.from_private_bytes(
-        base64.b64decode(private_b64))
+    raw = base64.b64decode(private_b64)
+    if X25519PrivateKey is None:
+        return base64.b64encode(_x25519(raw, _BASE_U)).decode()
+    priv = X25519PrivateKey.from_private_bytes(raw)
     return base64.b64encode(priv.public_key().public_bytes(
         serialization.Encoding.Raw, serialization.PublicFormat.Raw
     )).decode()
@@ -48,10 +103,19 @@ def _derive_public(private_b64: str) -> str:
 def shared_secret(private_b64: str, peer_public_b64: str) -> str:
     """X25519 DH: both directions derive the same 32-byte secret — the
     handshake primitive (kernel Noise IK consumes exactly this)."""
-    priv = X25519PrivateKey.from_private_bytes(
-        base64.b64decode(private_b64))
-    pub = X25519PublicKey.from_public_bytes(
-        base64.b64decode(peer_public_b64))
+    raw_priv = base64.b64decode(private_b64)
+    raw_pub = base64.b64decode(peer_public_b64)
+    if X25519PrivateKey is None:
+        out = _x25519(raw_priv, raw_pub)
+        if not any(out):
+            # Low-order peer point -> null secret: the cryptography
+            # backend raises here (RFC 7748 §6.1 all-zero check); the
+            # fallback must reject identically, not hand an attacker a
+            # forceable key.
+            raise ValueError("low-order peer public key (null shared secret)")
+        return base64.b64encode(out).decode()
+    priv = X25519PrivateKey.from_private_bytes(raw_priv)
+    pub = X25519PublicKey.from_public_bytes(raw_pub)
     return base64.b64encode(priv.exchange(pub)).decode()
 
 

@@ -1,5 +1,11 @@
-"""Dissemination plane (ref: pkg/apiserver RAM store + watch fan-out)."""
+"""Dissemination plane (ref: pkg/apiserver RAM store + watch fan-out).
 
+Failure handling lives beside the happy path: bounded watcher queues in
+store.py (overflow -> resync), reconnect/re-list in netwire.py, typed
+agent-death errors in transport.py, and the deterministic chaos harness
+in faults.py that tests/test_chaos_dissemination.py drives."""
+
+from .faults import FaultPlan
 from .store import RamStore
 
-__all__ = ["RamStore"]
+__all__ = ["FaultPlan", "RamStore"]

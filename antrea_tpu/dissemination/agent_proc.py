@@ -9,6 +9,10 @@ serialization boundary.
 Protocol (newline-delimited JSON on stdin; one-line JSON responses on
 stdout — only control commands respond):
   {"ev": <serde-encoded WatchEvent>}   apply to the local agent controller
+  {"ctl": "resync_begin"/"resync_end"} full re-list window (no response):
+                                       events inside are the complete
+                                       snapshot; stale local state is
+                                       retracted at resync_end
   {"cmd": "sync"}                      reconcile into the datapath
   {"cmd": "step", "now": N, "packets": {...}}  run a batch, return verdicts
   {"cmd": "summary"}                   local PolicySet shape (debugging)
@@ -68,6 +72,14 @@ def main() -> int:
                     f"{type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
+            continue
+        if "ctl" in msg:
+            # Resync markers are stream framing, not RPCs: no response
+            # (responding would desynchronize the request/response pairing).
+            if msg["ctl"] == "resync_begin":
+                agent.begin_resync()
+            elif msg["ctl"] == "resync_end":
+                agent.end_resync()
             continue
         cmd = msg.get("cmd")
         try:

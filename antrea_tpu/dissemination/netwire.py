@@ -30,10 +30,14 @@ import datetime
 import ipaddress
 import json
 import os
+import random
 import select
 import socket
 import ssl
+import subprocess
 import threading
+import time
+from dataclasses import dataclass
 from typing import Optional
 
 from . import serde
@@ -41,6 +45,13 @@ from .store import RamStore, Watcher
 
 
 # -- PKI ---------------------------------------------------------------------
+#
+# Primary backend: the `cryptography` package.  Fallback: the openssl CLI —
+# some deployment images ship libssl (so the stdlib `ssl` module works) but
+# not the Python cryptography wheel; the PKI must not take the whole
+# dissemination plane down with an ImportError there.  Both backends emit
+# the same PEM artifacts, so everything downstream (SSLContext loading,
+# peer-CN verification) is backend-blind.
 
 
 def _write(path: str, data: bytes) -> None:
@@ -48,15 +59,58 @@ def _write(path: str, data: bytes) -> None:
         f.write(data)
 
 
+def _openssl(*args: str, cwd: Optional[str] = None) -> None:
+    subprocess.run(
+        ["openssl", *args], cwd=cwd, check=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+
+
+def _make_ca_openssl(dirpath: str, cn: str) -> None:
+    _openssl("ecparam", "-name", "prime256v1", "-genkey", "-noout",
+             "-out", os.path.join(dirpath, "ca.key"))
+    _openssl("req", "-new", "-x509", "-key", os.path.join(dirpath, "ca.key"),
+             "-out", os.path.join(dirpath, "ca.crt"),
+             "-days", "365", "-subj", f"/CN={cn}")
+
+
+def _issue_cert_openssl(dirpath: str, cn: str, server: bool,
+                        cp: str, kp: str) -> None:
+    csr = os.path.join(dirpath, f"{cn}.csr")
+    ext = os.path.join(dirpath, f"{cn}.ext")
+    try:
+        _openssl("ecparam", "-name", "prime256v1", "-genkey", "-noout",
+                 "-out", kp)
+        _openssl("req", "-new", "-key", kp, "-subj", f"/CN={cn}",
+                 "-out", csr)
+        sign = ["x509", "-req", "-in", csr,
+                "-CA", os.path.join(dirpath, "ca.crt"),
+                "-CAkey", os.path.join(dirpath, "ca.key"),
+                "-CAcreateserial", "-days", "30", "-out", cp]
+        if server:
+            _write(ext, b"subjectAltName=DNS:localhost,IP:127.0.0.1\n")
+            sign += ["-extfile", ext]
+        _openssl(*sign)
+    finally:
+        for p in (csr, ext):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
 def make_ca(dirpath: str, cn: str = "antrea-tpu-ca") -> None:
     """Create ca.crt/ca.key under dirpath (idempotent)."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
-
     os.makedirs(dirpath, exist_ok=True)
     if os.path.exists(os.path.join(dirpath, "ca.crt")):
+        return
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        _make_ca_openssl(dirpath, cn)
         return
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
@@ -80,13 +134,50 @@ def make_ca(dirpath: str, cn: str = "antrea-tpu-ca") -> None:
            cert.public_bytes(serialization.Encoding.PEM))
 
 
+def _cert_usable(cp: str, margin_s: int = 86400) -> bool:
+    """True when the cached cert still outlives the margin.  Leaf certs
+    are valid 30 days: reusing one past expiry would make every reconnect
+    handshake fail forever (the reconnect loop would re-dial an identity
+    the server must reject) — an expiring cert re-mints instead."""
+    try:
+        from cryptography import x509
+    except ImportError:
+        try:
+            _openssl("x509", "-checkend", str(margin_s), "-noout", "-in", cp)
+            return True
+        except (subprocess.SubprocessError, OSError):
+            return False
+    try:
+        with open(cp, "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
+    except (OSError, ValueError):
+        return False
+    exp = getattr(cert, "not_valid_after_utc", None)
+    if exp is None:  # older cryptography: naive UTC datetime
+        exp = cert.not_valid_after.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return exp - now > datetime.timedelta(seconds=margin_s)
+
+
 def issue_cert(dirpath: str, cn: str, *, server: bool = False) -> tuple[str, str]:
     """CA-sign a cert for cn -> (cert path, key path).  Server certs get
-    the 127.0.0.1/localhost SANs the client verifies against."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
+    the 127.0.0.1/localhost SANs the client verifies against.  An already
+    issued, still-valid (cert, key) pair for this CN is reused — a
+    reconnecting agent re-handshakes with its existing identity instead
+    of re-running key generation on every backoff attempt; an expiring
+    one is re-minted (see _cert_usable)."""
+    cp = os.path.join(dirpath, f"{cn}.crt")
+    kp = os.path.join(dirpath, f"{cn}.key")
+    if os.path.exists(cp) and os.path.exists(kp) and _cert_usable(cp):
+        return cp, kp
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        _issue_cert_openssl(dirpath, cn, server, cp, kp)
+        return cp, kp
 
     with open(os.path.join(dirpath, "ca.key"), "rb") as f:
         ca_key = serialization.load_pem_private_key(f.read(), None)
@@ -110,8 +201,6 @@ def issue_cert(dirpath: str, cn: str, *, server: bool = False) -> tuple[str, str
             x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
         ]), critical=False)
     cert = b.sign(ca_key, hashes.SHA256())
-    cp = os.path.join(dirpath, f"{cn}.crt")
-    kp = os.path.join(dirpath, f"{cn}.key")
     _write(kp, key.private_bytes(
         serialization.Encoding.PEM,
         serialization.PrivateFormat.TraditionalOpenSSL,
@@ -123,12 +212,37 @@ def issue_cert(dirpath: str, cn: str, *, server: bool = False) -> tuple[str, str
 # -- framing -----------------------------------------------------------------
 
 
+class Backoff:
+    """Capped exponential backoff with jitter — the reconnect discipline
+    of the reference's client-go watch retry (wait.Backoff).  Jitter keeps
+    a fleet that lost one controller from re-handshaking in lockstep."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0, rng=None):
+        self.base = base
+        self.cap = cap
+        self._rng = rng if rng is not None else random.Random()
+        self.attempt = 0
+
+    def next_delay(self) -> float:
+        # Clamp the exponent: attempt grows without bound across a long
+        # outage, and 2**~1030 overflows float — the cap wins long before.
+        d = min(self.cap, self.base * (2 ** min(self.attempt, 30)))
+        self.attempt += 1
+        return d * (0.5 + 0.5 * self._rng.random())
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+
 class _LineConn:
     """Newline-JSON framing over a (TLS) socket, nonblocking reads."""
 
     def __init__(self, sock):
         self.sock = sock
         self._buf = b""
+        # Orderly EOF observed on recv: the peer is gone — callers use
+        # this to trigger reconnect instead of pumping a dead socket.
+        self.closed = False
 
     def send(self, obj: dict) -> None:
         self.sock.sendall(
@@ -154,7 +268,8 @@ class _LineConn:
             except ssl.SSLWantReadError:
                 break
             if not chunk:
-                break  # peer closed
+                self.closed = True  # peer closed
+                break
             self._buf += chunk
         while b"\n" in self._buf:
             line, self._buf = self._buf.split(b"\n", 1)
@@ -200,14 +315,40 @@ def recv_one_json(sock, buf: bytes, max_line: int = 1 << 20):
 # -- server ------------------------------------------------------------------
 
 
+@dataclass
+class _ConnState:
+    """One registered agent connection.  fresh=True until the first pump
+    ships the initial snapshot (bracketed in resync markers so the agent
+    can retract state a previous connection left behind)."""
+
+    conn: _LineConn
+    watcher: Watcher
+    seq: int
+    fresh: bool = True
+
+
 class DisseminationServer:
-    """mTLS dissemination endpoint in front of a RamStore."""
+    """mTLS dissemination endpoint in front of a RamStore.
+
+    Failure model: an agent connection that dies is pruned (its events
+    stay in the store); on re-handshake the server REPLAYS the node's
+    span-filtered snapshot between {"ctl": "resync_begin"}/{"ctl":
+    "resync_end"} markers — the reference's watch re-list semantics — so
+    the agent can reconcile away anything stale.  Per-agent watcher queues
+    are bounded by watcher_max_pending: a consumer that falls behind the
+    cap costs one full resync, never unbounded controller memory."""
 
     def __init__(self, store: RamStore, certdir: str, *,
                  host: str = "127.0.0.1", port: int = 0,
-                 status_aggregator=None):
+                 status_aggregator=None,
+                 watcher_max_pending: Optional[int] = None):
         self._store = store
         self._status = status_aggregator
+        self._watcher_max_pending = watcher_max_pending
+        # Dissemination-health counters (scraped by
+        # observability.metrics.render_dissemination_metrics).
+        self.resyncs_total = 0      # full snapshots served (incl. hellos)
+        self.reconnects_total = 0   # re-handshakes replacing a live node
         cert, key = issue_cert(certdir, "controller", server=True)
         self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         self._ctx.load_cert_chain(cert, key)
@@ -215,11 +356,11 @@ class DisseminationServer:
         self._ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
         self._lsock = socket.create_server((host, port))
         self.address = self._lsock.getsockname()
-        # node -> (conn, watcher, seq); handshakes land here from the
-        # acceptor.  seq is the ACCEPT order: concurrent handshake threads
-        # may finish out of order, and a stale connection finishing last
-        # must never evict the agent's newer live one.
-        self._conns: dict[str, tuple[_LineConn, Watcher, int]] = {}
+        # node -> _ConnState; handshakes land here from the acceptor.
+        # seq is the ACCEPT order: concurrent handshake threads may finish
+        # out of order, and a stale connection finishing last must never
+        # evict the agent's newer live one.
+        self._conns: dict[str, _ConnState] = {}
         self._lock = threading.Lock()
         self._closing = False
         self._accept_seq = 0
@@ -318,20 +459,31 @@ class DisseminationServer:
                 tls.close()
                 return
             old = self._conns.get(node)
-            if old is not None and old[2] > seq:
+            if old is not None and old.seq > seq:
                 # A NEWER connection for this node already registered
                 # (this thread's hello was slower): this one is stale —
                 # evicting the live registration would stream to a socket
                 # the agent abandoned.
                 tls.close()
                 return
-            self._conns[node] = (conn, self._store.watch_queue(node), seq)
+            self._conns[node] = _ConnState(
+                conn,
+                # replay=False: fresh=True already forces a full resync on
+                # the first pump — buffering the snapshot here would be
+                # discarded work and could spuriously count an overflow.
+                self._store.watch_queue(
+                    node, max_pending=self._watcher_max_pending,
+                    replay=False),
+                seq,
+            )
+            if old is not None:
+                self.reconnects_total += 1
         if old is not None:
             # Reconnect: retire the previous registration — an
             # un-stopped watcher would buffer events forever.
-            old[1].stop()
+            old.watcher.stop()
             try:
-                old[0].sock.close()
+                old.conn.sock.close()
             except OSError:
                 pass
 
@@ -349,21 +501,37 @@ class DisseminationServer:
         raise TimeoutError(f"{n} agents not connected within {timeout}s")
 
     def pump(self) -> int:
-        """Stream queued events, consume status reports -> events shipped."""
+        """Stream queued events, consume status reports -> events shipped.
+
+        A fresh connection (hello or reconnect) and a watcher whose
+        bounded queue overflowed are served a FULL RESYNC: the node's
+        span-filtered snapshot bracketed in resync markers, bypassing the
+        queue (so a snapshot larger than the cap still converges)."""
         shipped = 0
         with self._lock:
             conns = list(self._conns.items())
         dead: list[tuple[str, _LineConn]] = []
         live = []
-        for node, (conn, watcher, _seq) in conns:
+        for node, st in conns:
+            conn = st.conn
             try:
                 # Bounded send: an agent that stopped reading (full TCP
                 # buffer) must not block the pump forever — a timed-out
                 # sendall raises and the agent is pruned as dead.
                 conn.sock.settimeout(2.0)
-                for ev in watcher.drain():
-                    conn.send({"ev": serde.encode_event(ev)})
-                    shipped += 1
+                if st.fresh or st.watcher.needs_resync:
+                    conn.send({"ctl": "resync_begin"})
+                    for ev in self._store.resync(st.watcher):
+                        conn.send({"ev": serde.encode_event(ev)})
+                        shipped += 1
+                    conn.send({"ctl": "resync_end"})
+                    st.fresh = False
+                    with self._lock:
+                        self.resyncs_total += 1
+                else:
+                    for ev in st.watcher.drain():
+                        conn.send({"ev": serde.encode_event(ev)})
+                        shipped += 1
                 conn.sock.setblocking(False)
                 live.append((node, conn))
             except (OSError, ssl.SSLError, ValueError):
@@ -397,14 +565,14 @@ class DisseminationServer:
                 # our snapshot and now, the registered entry is a fresh
                 # healthy connection — tearing it down by name would
                 # disconnect a live agent.
-                if entry is None or entry[0] is not failed_conn:
+                if entry is None or entry.conn is not failed_conn:
                     entry = None
                 else:
                     del self._conns[node]
             if entry is not None:
-                entry[1].stop()
+                entry.watcher.stop()
                 try:
-                    entry[0].sock.close()
+                    entry.conn.sock.close()
                 except OSError:
                     pass
             else:
@@ -414,6 +582,24 @@ class DisseminationServer:
                     pass
         return shipped
 
+    def dissemination_stats(self) -> dict:
+        """Health snapshot for the metrics surface: per-node watcher depth
+        / overflow / resync-pending state plus the server counters."""
+        with self._lock:
+            return {
+                "watchers": {
+                    node: {
+                        "pending": st.watcher.pending(),
+                        "overflows": st.watcher.overflows,
+                        "needs_resync": bool(
+                            st.fresh or st.watcher.needs_resync),
+                    }
+                    for node, st in self._conns.items()
+                },
+                "resyncs_total": self.resyncs_total,
+                "reconnects_total": self.reconnects_total,
+            }
+
     def close(self) -> None:
         with self._lock:
             # Flag + snapshot under ONE lock hold: any in-flight
@@ -421,9 +607,9 @@ class DisseminationServer:
             # the snapshot) or will observe _closing and self-close.
             self._closing = True
             conns = list(self._conns.values())
-        for conn, watcher, _seq in conns:
-            watcher.stop()
-            conn.sock.close()
+        for st in conns:
+            st.watcher.stop()
+            st.conn.sock.close()
         self._lsock.close()
         self._acceptor.join(timeout=2)
 
@@ -449,36 +635,146 @@ def connect_client(node: str, address, certdir: str,
     return sock, conn
 
 
-class NetAgent:
+class ReconnectingClient:
+    """The ONE agent-side wire lifecycle, shared by every client flavor
+    (NetAgent here, the fleet's NetFakeAgent): dial via connect_client,
+    detect a dead socket, re-dial with capped exponential backoff +
+    jitter.  Subclasses call _init_wire() from __init__ and consume
+    self._sock/self._conn; _mark_dead() schedules the backoff,
+    _try_reconnect() honors it.  The FIRST connect still raises to the
+    caller — a misconfigured CA should fail loudly, not spin in
+    backoff."""
+
+    def _init_wire(self, node: str, address, certdir: str, *,
+                   client_cn: Optional[str] = None, reconnect: bool = True,
+                   backoff: Optional[Backoff] = None, clock=time.monotonic,
+                   fault_wrap=None) -> None:
+        self.node = node
+        self._address = tuple(address)
+        self._certdir = certdir
+        self._client_cn = client_cn
+        self._reconnect_enabled = reconnect
+        self._backoff = backoff if backoff is not None else Backoff()
+        self._clock = clock
+        self._fault_wrap = fault_wrap
+        self._next_attempt = 0.0
+        self.reconnects_total = 0
+        self._sock = None
+        self._conn = None
+        self._connect()
+
+    def _connect(self) -> None:
+        sock, conn = connect_client(self.node, self._address, self._certdir,
+                                    self._client_cn)
+        if self._fault_wrap is not None:
+            # Chaos harness hook (dissemination/faults.py): interpose a
+            # fault-injecting wrapper AFTER the authenticated handshake so
+            # injected resets/partial writes exercise the steady state.
+            sock = self._fault_wrap(sock)
+            conn.sock = sock
+        self._sock, self._conn = sock, conn
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _mark_dead(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._conn = None
+        if self._reconnect_enabled:
+            self._next_attempt = self._clock() + self._backoff.next_delay()
+
+    def _try_reconnect(self) -> bool:
+        """One re-dial attempt if the backoff window has elapsed."""
+        if not self._reconnect_enabled:
+            return False
+        if self._clock() < self._next_attempt:
+            return False
+        try:
+            self._connect()
+        except (OSError, ssl.SSLError, ConnectionError):
+            self._next_attempt = self._clock() + self._backoff.next_delay()
+            return False
+        self._backoff.reset()
+        self.reconnects_total += 1
+        return True
+
+    def close(self) -> None:
+        self._reconnect_enabled = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        # Cleared like _mark_dead: a closed client must answer
+        # connected=False and never re-enter a fleet select set.
+        self._sock = None
+        self._conn = None
+
+
+class NetAgent(ReconnectingClient):
     """Agent-side client: TLS-verified event stream into an
-    AgentPolicyController + upstream realization reports."""
+    AgentPolicyController + upstream realization reports.
+
+    Failure model: a dead socket (reset, orderly close, send failure) is
+    detected on the next pump/report, the connection is torn down, and the
+    agent re-dials per ReconnectingClient.  The server replays the node's
+    snapshot on re-handshake between resync markers; the local
+    AgentPolicyController reconciles that snapshot so objects deleted
+    while disconnected are retracted."""
 
     def __init__(self, node: str, address, certdir: str, datapath,
-                 client_cn: Optional[str] = None):
+                 client_cn: Optional[str] = None, *,
+                 reconnect: bool = True, backoff: Optional[Backoff] = None,
+                 clock=time.monotonic, fault_wrap=None):
         from ..agent.controller import AgentPolicyController
 
-        self._sock, self._conn = connect_client(node, address, certdir,
-                                                client_cn)
-        self.node = node
+        self.resyncs_total = 0
         self.agent = AgentPolicyController(node, datapath)
+        self._init_wire(node, address, certdir, client_cn=client_cn,
+                        reconnect=reconnect, backoff=backoff, clock=clock,
+                        fault_wrap=fault_wrap)
 
     def pump(self, wait: float = 0.5) -> int:
+        if self._sock is None and not self._try_reconnect():
+            return 0
         n = 0
-        for frame in self._conn.recv_ready(first_wait=wait):
+        try:
+            frames = self._conn.recv_ready(first_wait=wait)
+        except (OSError, ssl.SSLError, ValueError):
+            self._mark_dead()
+            return 0
+        for frame in frames:
             if "ev" in frame:
                 self.agent.handle_event(serde.decode_event(frame["ev"]))
                 n += 1
+            elif frame.get("ctl") == "resync_begin":
+                self.agent.begin_resync()
+            elif frame.get("ctl") == "resync_end":
+                self.agent.end_resync()
+                self.resyncs_total += 1
+        if self._conn.closed:
+            self._mark_dead()
         return n
 
     def sync_and_report(self) -> dict:
         """Reconcile into the datapath, then send the realization report
-        upstream (the UpdateStatus RPC over the same mTLS channel)."""
+        upstream (the UpdateStatus RPC over the same mTLS channel).  The
+        datapath sync happens regardless of wire health; a failed report
+        send just marks the connection dead for the reconnect path."""
         self.agent.sync()
         realized = self.agent.realized_generations()
-        self._sock.setblocking(True)
-        self._conn.send({"status": realized})
-        self._sock.setblocking(False)
+        if self._sock is None and not self._try_reconnect():
+            return realized
+        try:
+            self._sock.setblocking(True)
+            self._conn.send({"status": realized})
+            self._sock.setblocking(False)
+        except (OSError, ssl.SSLError):
+            self._mark_dead()
         return realized
-
-    def close(self) -> None:
-        self._sock.close()

@@ -19,7 +19,14 @@ Key behavior shared with the reference: a watcher is told about an object
 when the object's span GROWS to include its node (synthesized ADDED), and
 gets a DELETED when the span shrinks away from it — the span diff IS the
 subscription filter.
-"""
+
+Robustness: a queued watcher may carry a depth cap (max_pending).  When a
+consumer falls so far behind that its buffer hits the cap, the buffer is
+DROPPED and the watcher flips to needs_resync — the reference's "watch
+channel full -> client must re-list" semantics (store.go:230 drops the
+watcher; here the transport converts the flag into a full replay via
+RamStore.resync, so a slow agent costs one snapshot, never unbounded
+memory)."""
 
 from __future__ import annotations
 
@@ -40,18 +47,34 @@ class Watcher:
     """One node subscription.  cb-mode delivers inline; queue-mode buffers
     until drain()/pop() — never blocking the store's apply()."""
 
-    def __init__(self, node: str, cb: Optional[Callable[[WatchEvent], None]]):
+    def __init__(self, node: str, cb: Optional[Callable[[WatchEvent], None]],
+                 max_pending: Optional[int] = None):
         self.node = node
         self._cb = cb
         self._queue: deque[WatchEvent] = deque()
         self._known: set = set()
         self._stopped = False
+        # Bounded-queue mode: cap the buffer; overflow invalidates the
+        # stream (needs_resync) instead of growing without bound.
+        self.max_pending = max_pending
+        self.needs_resync = False
+        self.overflows = 0
 
     def _deliver(self, ev: WatchEvent) -> None:
         if self._cb is not None:
             self._cb(ev)
-        else:
-            self._queue.append(ev)
+            return
+        if self.needs_resync:
+            # Stream already invalidated: every buffered/new event is
+            # superseded by the coming full resync — don't re-grow.
+            return
+        if self.max_pending is not None and len(self._queue) >= self.max_pending:
+            self._queue.clear()
+            self._known.clear()
+            self.needs_resync = True
+            self.overflows += 1
+            return
+        self._queue.append(ev)
 
     def pop(self) -> Optional[WatchEvent]:
         return self._queue.popleft() if self._queue else None
@@ -130,14 +153,44 @@ class RamStore:
         self._watchers.append(w)
         return w
 
-    def watch_queue(self, node: str) -> Watcher:
+    def watch_queue(self, node: str, max_pending: Optional[int] = None,
+                    *, replay: bool = True) -> Watcher:
         """Subscribe a node in queued mode: events (including the initial
         replay) buffer in the returned Watcher until drained — the
-        per-watcher channel of the reference's RAM store."""
-        w = Watcher(node, None)
-        self._replay(w)
+        per-watcher channel of the reference's RAM store.  max_pending
+        bounds the buffer (overflow -> needs_resync, see resync()).
+
+        replay=False skips the initial snapshot buffering — for consumers
+        that serve a full resync() on first pump anyway (the netwire
+        server's fresh connections): replaying into a bounded queue there
+        is wasted work and, when the snapshot exceeds the cap, counts a
+        slow-consumer overflow that never happened."""
+        w = Watcher(node, None, max_pending=max_pending)
+        if replay:
+            self._replay(w)
         self._watchers.append(w)
         return w
+
+    def resync(self, w: Watcher) -> list[WatchEvent]:
+        """Full re-list for a queued watcher whose stream was invalidated
+        (overflow or reconnect): rebuilds the watcher's known-set from the
+        CURRENT store state and returns the snapshot as ADDED events —
+        bypassing the bounded queue, so a resync always completes even when
+        the snapshot exceeds max_pending.  The transport brackets these
+        events with resync markers so the consumer can retract anything it
+        holds that is absent from the snapshot (re-list semantics)."""
+        w._queue.clear()
+        w._known.clear()
+        w.needs_resync = False
+        out: list[WatchEvent] = []
+        for (obj_type, name), st in sorted(self._objs.items()):
+            if w.node in st.span:
+                w._known.add((obj_type, name))
+                out.append(WatchEvent(
+                    kind="ADDED", obj_type=obj_type, name=name,
+                    obj=st.obj, span=set(st.span),
+                ))
+        return out
 
     @property
     def n_watchers(self) -> int:

@@ -29,16 +29,43 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import subprocess
 import sys
+import tempfile
+import time
 from typing import Optional
 
 from . import serde
 from .store import RamStore, Watcher
 
 
+class AgentDiedError(RuntimeError):
+    """The agent subprocess is gone (crashed, killed, or wedged past the
+    RPC deadline).  Carries what the operator needs to diagnose it without
+    attaching a debugger: the child's exit code and its stderr tail."""
+
+    def __init__(self, node: str, exit_code: Optional[int],
+                 stderr_tail: str, context: str = ""):
+        self.node = node
+        self.exit_code = exit_code
+        self.stderr_tail = stderr_tail
+        detail = f"agent {node} died (exit code {exit_code})"
+        if context:
+            detail += f" {context}"
+        if stderr_tail:
+            detail += f"; stderr tail:\n{stderr_tail}"
+        super().__init__(detail)
+
+
 class SubprocessAgent:
-    """Parent-side handle: one agent process consuming one node's stream."""
+    """Parent-side handle: one agent process consuming one node's stream.
+
+    Failure model: a dead or wedged child surfaces as AgentDiedError (with
+    exit code + stderr tail) from send_event/pump/_rpc instead of a bare
+    BrokenPipeError or an indefinite readline block; _rpc enforces a read
+    deadline (rpc_timeout) and kills a wedged child rather than hanging
+    the controller."""
 
     def __init__(
         self,
@@ -48,8 +75,15 @@ class SubprocessAgent:
         datapath_type: str = "oracle",
         flow_slots: int = 1 << 12,
         aff_slots: int = 1 << 8,
+        rpc_timeout: float = 60.0,
+        watcher_max_pending: Optional[int] = None,
     ):
         self.node = node
+        self._rpc_timeout = rpc_timeout
+        # The child's stderr lands in a temp file (not a pipe we would
+        # have to drain) so AgentDiedError can carry its tail.
+        self._stderr = tempfile.TemporaryFile()
+        self._rdbuf = b""
         env = dict(os.environ)
         # The child never needs an accelerator; keep it hermetic like the
         # test suite (tests/conftest.py rationale).
@@ -67,43 +101,107 @@ class SubprocessAgent:
             ],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
+            stderr=self._stderr,
             cwd=repo_root,
             env=env,
         )
+        self._store = store
         self._watcher: Optional[Watcher] = None
         if store is not None:
-            self._watcher = store.watch_queue(node)
+            self._watcher = store.watch_queue(
+                node, max_pending=watcher_max_pending)
+
+    # -- death diagnostics ---------------------------------------------------
+
+    def _stderr_tail(self, limit: int = 4096) -> str:
+        try:
+            self._stderr.flush()
+            size = self._stderr.seek(0, os.SEEK_END)
+            self._stderr.seek(max(0, size - limit))
+            return self._stderr.read().decode(errors="replace").strip()
+        except (OSError, ValueError):
+            return ""
+
+    def _died(self, context: str) -> AgentDiedError:
+        """Reap the (dead or dying) child -> typed error with its exit
+        code and stderr tail.  Never blocks long: a pipe already broke or
+        we decided to kill, so the wait is bounded."""
+        if self._proc.poll() is None:
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                try:
+                    self._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        return AgentDiedError(self.node, self._proc.poll(),
+                             self._stderr_tail(), context)
 
     # -- stream pump ---------------------------------------------------------
 
     def pump(self) -> int:
         """Ship everything buffered on the store watcher to the agent;
-        returns the number of events sent."""
+        returns the number of events sent.  A watcher that overflowed its
+        bounded queue is served a full resync bracketed in ctl markers
+        (the same re-list protocol the netwire server speaks)."""
         if self._watcher is None:
             return 0
+        if self._watcher.needs_resync:
+            self._send_frame({"ctl": "resync_begin"})
+            events = self._store.resync(self._watcher)
+            for ev in events:
+                self.send_event(ev)
+            self._send_frame({"ctl": "resync_end"})
+            return len(events)
         events = self._watcher.drain()
         for ev in events:
             self.send_event(ev)
         return len(events)
 
+    def _send_frame(self, frame: dict) -> None:
+        line = json.dumps(frame, separators=(",", ":")) + "\n"
+        try:
+            self._proc.stdin.write(line.encode())
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            # The child died between frames (kill mid-stream): reap it and
+            # raise the typed error instead of a bare BrokenPipeError.
+            raise self._died(f"writing frame: {e}") from e
+
     def send_event(self, ev) -> None:
-        line = json.dumps(
-            {"ev": serde.encode_event(ev)}, separators=(",", ":")
-        ) + "\n"
-        self._proc.stdin.write(line.encode())
-        self._proc.stdin.flush()
+        self._send_frame({"ev": serde.encode_event(ev)})
 
     # -- control RPCs --------------------------------------------------------
 
+    def _read_response_line(self) -> bytes:
+        """One newline-framed response from the child's stdout, under the
+        RPC deadline.  Reads the raw fd (os.read + own buffer — a buffered
+        readline could block past the deadline on a partial line); a
+        wedged child is killed and surfaced as AgentDiedError."""
+        fd = self._proc.stdout.fileno()
+        deadline = time.monotonic() + self._rpc_timeout
+        while b"\n" not in self._rdbuf:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._proc.kill()
+                raise self._died(
+                    f"wedged: no RPC response within {self._rpc_timeout}s")
+            r, _, _ = select.select([fd], [], [], min(remaining, 0.25))
+            if not r:
+                if self._proc.poll() is not None:
+                    raise self._died("while awaiting RPC response")
+                continue
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                raise self._died("stdout closed awaiting RPC response")
+            self._rdbuf += chunk
+        line, self._rdbuf = self._rdbuf.split(b"\n", 1)
+        return line
+
     def _rpc(self, msg: dict) -> dict:
-        self._proc.stdin.write(
-            (json.dumps(msg, separators=(",", ":")) + "\n").encode()
-        )
-        self._proc.stdin.flush()
-        line = self._proc.stdout.readline()
-        if not line:
-            raise RuntimeError(f"agent {self.node} died (no response)")
-        resp = json.loads(line.decode())
+        self._send_frame(msg)
+        resp = json.loads(self._read_response_line().decode())
         if "error" in resp:
             raise RuntimeError(f"agent {self.node}: {resp['error']}")
         return resp
@@ -149,6 +247,18 @@ class SubprocessAgent:
             except subprocess.TimeoutExpired:
                 self._proc.kill()
                 self._proc.wait(timeout=10)
+        # Pipes close even when the child was ALREADY dead (the
+        # AgentDiedError path skips the branch above): a controller
+        # respawning agents must not leak two fds per death.
+        for pipe in (self._proc.stdin, self._proc.stdout):
+            try:
+                pipe.close()
+            except OSError:
+                pass
+        try:
+            self._stderr.close()
+        except OSError:
+            pass
 
     def __enter__(self):
         return self

@@ -1,6 +1,6 @@
 """Observability plane: NP audit logging + metrics surface (SURVEY §5)."""
 
 from .audit import AuditLogger
-from .metrics import render_metrics
+from .metrics import render_dissemination_metrics, render_metrics
 
-__all__ = ["AuditLogger", "render_metrics"]
+__all__ = ["AuditLogger", "render_dissemination_metrics", "render_metrics"]

@@ -38,6 +38,69 @@ def render_controller_metrics(controller, store=None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_dissemination_metrics(server=None, agents=()) -> str:
+    """Dissemination-plane health in Prometheus text — the scrape surface
+    for the failure model (README "Failure model"): per-watcher queue
+    depth/overflow/needs-resync from the server's dissemination_stats(),
+    plus per-agent reconnect/resync counters and the reconciler's
+    sync_failures_total.
+
+    `server` is a DisseminationServer (or None for agent-only scrapes);
+    `agents` is any iterable of NetAgent and/or AgentPolicyController —
+    duck-typed, so a NetAgent contributes wire counters AND its embedded
+    controller's install-failure counter."""
+    lines = []
+    if server is not None:
+        stats = server.dissemination_stats()
+        watchers = sorted(stats["watchers"].items())
+        lines.append("# TYPE antrea_tpu_dissemination_watcher_pending gauge")
+        for node, w in watchers:
+            lines.append(
+                f'antrea_tpu_dissemination_watcher_pending{{node="{_esc(node)}"}} '
+                f'{w["pending"]}'
+            )
+        lines.append(
+            "# TYPE antrea_tpu_dissemination_watcher_overflows_total counter")
+        for node, w in watchers:
+            lines.append(
+                f'antrea_tpu_dissemination_watcher_overflows_total'
+                f'{{node="{_esc(node)}"}} {w["overflows"]}'
+            )
+        lines.append(
+            "# TYPE antrea_tpu_dissemination_watcher_needs_resync gauge")
+        for node, w in watchers:
+            lines.append(
+                f'antrea_tpu_dissemination_watcher_needs_resync'
+                f'{{node="{_esc(node)}"}} {int(w["needs_resync"])}'
+            )
+        lines += [
+            "# TYPE antrea_tpu_dissemination_resyncs_total counter",
+            f"antrea_tpu_dissemination_resyncs_total {stats['resyncs_total']}",
+            "# TYPE antrea_tpu_dissemination_reconnects_total counter",
+            f"antrea_tpu_dissemination_reconnects_total "
+            f"{stats['reconnects_total']}",
+        ]
+    agents = list(agents)
+    for metric, read in (
+        ("antrea_tpu_agent_reconnects_total counter",
+         lambda a: getattr(a, "reconnects_total", None)),
+        ("antrea_tpu_agent_resyncs_total counter",
+         lambda a: getattr(a, "resyncs_total", None)),
+        # A NetAgent embeds its AgentPolicyController as .agent; a bare
+        # controller passed directly carries the counter itself.
+        ("antrea_tpu_agent_sync_failures_total counter",
+         lambda a: getattr(getattr(a, "agent", a),
+                           "sync_failures_total", None)),
+    ):
+        rows = [(a.node, read(a)) for a in agents if read(a) is not None]
+        if rows:
+            name = metric.split(" ")[0]
+            lines.append(f"# TYPE {metric}")
+            for node, val in rows:
+                lines.append(f'{name}{{node="{_esc(node)}"}} {val}')
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(datapath, node: str = "") -> str:
     """One Prometheus-text snapshot of a Datapath's observable state."""
     stats = datapath.stats()

@@ -26,17 +26,22 @@ primary mode mirrors it:
 from __future__ import annotations
 
 from ..controller.networkpolicy import WatchEvent
+from ..dissemination.netwire import ReconnectingClient
 
 
 class _AgentTables:
     """Shared local-object-table logic (the watch-consumer half every
-    agent flavor reuses — one _apply, one realization view)."""
+    agent flavor reuses — one _apply, one realization view, one resync
+    window for the server's re-list protocol)."""
 
     def _init_tables(self) -> None:
         self.policies: dict[str, object] = {}
         self.address_groups: dict[str, object] = {}
         self.applied_to_groups: dict[str, object] = {}
         self.events_seen = 0
+        self.resyncs_seen = 0
+        self._in_resync = False
+        self._resync_seen: set = set()
 
     def realized_generations(self) -> dict:
         return {
@@ -44,22 +49,46 @@ class _AgentTables:
             for uid, p in self.policies.items()
         }
 
+    def _tables(self):
+        return (
+            ("NetworkPolicy", self.policies),
+            ("AddressGroup", self.address_groups),
+            ("AppliedToGroup", self.applied_to_groups),
+        )
+
     def _apply(self, ev: WatchEvent) -> None:
-        table = {
-            "NetworkPolicy": self.policies,
-            "AddressGroup": self.address_groups,
-            "AppliedToGroup": self.applied_to_groups,
-        }[ev.obj_type]
+        table = dict(self._tables())[ev.obj_type]
         if ev.kind == "DELETED":
             table.pop(ev.name, None)
+            if self._in_resync:
+                self._resync_seen.discard((ev.obj_type, ev.name))
         else:
             table[ev.name] = ev.obj
+            if self._in_resync:
+                self._resync_seen.add((ev.obj_type, ev.name))
+
+    def _apply_ctl(self, kind: str) -> None:
+        """Resync markers bracket a full re-list: on resync_end anything
+        not re-delivered inside the window is stale and dropped (state
+        that changed while this agent was disconnected)."""
+        if kind == "resync_begin":
+            self._in_resync = True
+            self._resync_seen = set()
+        elif kind == "resync_end" and self._in_resync:
+            for obj_type, table in self._tables():
+                for name in [n for n in table
+                             if (obj_type, n) not in self._resync_seen]:
+                    del table[name]
+            self._in_resync = False
+            self.resyncs_seen += 1
 
 
 class FakeAgent(_AgentTables):
-    def __init__(self, store, node: str, status_reporter=None):
+    def __init__(self, store, node: str, status_reporter=None, *,
+                 max_pending=None):
         self.node = node
-        self._watcher = store.watch_queue(node)
+        self._store = store
+        self._watcher = store.watch_queue(node, max_pending=max_pending)
         self._init_tables()
         # Realization-status reporting (same callable contract as
         # AgentPolicyController): a fake agent "realizes" a policy the
@@ -68,8 +97,16 @@ class FakeAgent(_AgentTables):
         self._status_reporter = status_reporter
 
     def pump(self) -> int:
-        """Drain pending events into the local tables; -> events consumed."""
+        """Drain pending events into the local tables; -> events consumed.
+        A watcher that overflowed its bounded queue gets the full re-list
+        (store.resync) with the same retract-stale semantics as the wire."""
         n = 0
+        if self._watcher.needs_resync:
+            self._apply_ctl("resync_begin")
+            for ev in self._store.resync(self._watcher):
+                self._apply(ev)
+                n += 1
+            self._apply_ctl("resync_end")
         for ev in self._watcher.drain():
             self._apply(ev)
             n += 1
@@ -82,42 +119,63 @@ class FakeAgent(_AgentTables):
         self._watcher.stop()
 
 
-class NetFakeAgent(_AgentTables):
+class NetFakeAgent(_AgentTables, ReconnectingClient):
     """Watch-only fake agent over the REAL mTLS wire: a TLS-verified
     client of DisseminationServer that maintains the same tables and
     reports realization over the same socket (netwire.NetAgent minus the
-    dataplane — the agent-simulator over the production transport)."""
+    dataplane — the agent-simulator over the production transport).
 
-    def __init__(self, node: str, address, certdir: str):
-        from ..dissemination.netwire import connect_client
+    Same failure model as NetAgent BY CONSTRUCTION: the dial / dead-socket
+    / backoff-reconnect lifecycle is the shared ReconnectingClient; the
+    server's resync markers drive retract-stale reconciliation on
+    re-handshake."""
 
-        self._sock, self._conn = connect_client(node, address, certdir)
-        self.node = node
+    def __init__(self, node: str, address, certdir: str, *,
+                 reconnect: bool = True, backoff=None):
         self._init_tables()
+        self._init_wire(node, address, certdir,
+                        reconnect=reconnect, backoff=backoff)
 
     # Short first-wait: FakeAgentFleet.pump() ships events BEFORE draining
     # agents, so loopback frames are already buffered — a long per-agent
     # select would make an idle fleet pump O(agents * wait).
     def pump(self, wait: float = 0.05) -> int:
+        import ssl
+
         from ..dissemination import serde
 
+        if self._sock is None and not self._try_reconnect():
+            return 0
         n = 0
-        for frame in self._conn.recv_ready(first_wait=wait):
+        try:
+            frames = self._conn.recv_ready(first_wait=wait)
+        except (OSError, ssl.SSLError, ValueError):
+            self._mark_dead()
+            return 0
+        for frame in frames:
             if "ev" in frame:
                 self._apply(serde.decode_event(frame["ev"]))
                 n += 1
+            elif "ctl" in frame:
+                self._apply_ctl(frame["ctl"])
         self.events_seen += n
+        if self._conn.closed:
+            self._mark_dead()
+            return n
         if n:
             # Realization report upstream over the SAME TLS channel (the
             # UpdateStatus RPC analog); the server's next pump() feeds it
             # into the StatusAggregator.
-            self._sock.setblocking(True)
-            self._conn.send({"status": self.realized_generations()})
-            self._sock.setblocking(False)
+            try:
+                self._sock.setblocking(True)
+                self._conn.send({"status": self.realized_generations()})
+                self._sock.setblocking(False)
+            except (OSError, ssl.SSLError):
+                self._mark_dead()
         return n
 
     def stop(self) -> None:
-        self._sock.close()
+        self.close()  # the fleet's uniform agent-stop verb
 
 
 class FakeAgentFleet:
@@ -165,18 +223,23 @@ class FakeAgentFleet:
             import select
 
             self._server.pump()
-            socks = {a._sock: a for a in self.agents.values()}
+            # Disconnected agents (backoff window) have _sock=None: they
+            # must not enter the select set (None is unselectable) — their
+            # pump() below is the re-dial attempt.
+            socks = {a._sock: a for a in self.agents.values()
+                     if a._sock is not None}
             try:
                 ready, _, _ = select.select(list(socks), [], [], 0.2)
             except (OSError, ValueError):
                 ready = list(socks)
             n = 0
             for a in self.agents.values():
-                if (a._sock in ready or a._conn._buf
+                if a._sock is not None and (
+                        a._sock in ready or a._conn._buf
                         or getattr(a._sock, "pending", lambda: 0)()):
                     n += a.pump()
                 else:
-                    n += a.pump(wait=0.0)  # drain-only, never waits
+                    n += a.pump(wait=0.0)  # drain/reconnect-only, no wait
             self._server.pump()  # consume the freshly-sent status frames
             return n
         return sum(a.pump() for a in self.agents.values())

@@ -30,3 +30,11 @@ def pytest_configure(config):
         "Quick developer loop: pytest -m 'not slow' (< 2 min); CI and the "
         "driver run everything.",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tier (tests/test_chaos_dissemination.py): "
+        "scripted connection resets, agent crashes, and install failures "
+        "with convergence-to-oracle-parity assertions.  The single-fault "
+        "smoke rides the tier-1 'not slow' set; the kill/revive soak and "
+        "process-boundary faults are also marked slow.",
+    )

@@ -313,3 +313,37 @@ def test_bgp_session_wire_scripted_peer():
         speaker.close()
         p1.close()
         p2.close()
+
+
+def test_wireguard_x25519_pure_python_fallback(monkeypatch):
+    """The pure-Python RFC 7748 ladder (the backend for images without
+    the cryptography wheel) forced explicitly, so this KAT runs even
+    where the wheel IS installed: same vectors as the primary backend,
+    plus the low-order-point rejection the cryptography backend performs
+    (a null shared secret must raise, never be handed out)."""
+    import base64
+
+    import pytest as _pytest
+
+    from antrea_tpu.agent import wireguard as wg
+
+    monkeypatch.setattr(wg, "X25519PrivateKey", None)
+    monkeypatch.setattr(wg, "X25519PublicKey", None)
+    alice_priv = base64.b64encode(bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )).decode()
+    assert base64.b64decode(wg._derive_public(alice_priv)) == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    bob_priv = base64.b64encode(bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )).decode()
+    shared = wg.shared_secret(alice_priv, wg._derive_public(bob_priv))
+    assert shared == wg.shared_secret(bob_priv, wg._derive_public(alice_priv))
+    assert base64.b64decode(shared) == bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    # Low-order peer point (all-zero u) -> null secret -> must reject.
+    with _pytest.raises(ValueError):
+        wg.shared_secret(alice_priv,
+                         base64.b64encode(b"\x00" * 32).decode())

@@ -1,0 +1,508 @@
+"""Chaos tier for the dissemination plane: scripted faults, provable healing.
+
+The reference's control plane survives real failure — agents that lose the
+apiserver watch reconnect and re-list (ram/store.go:230), and the agent
+reconciler requeues failed installs instead of dropping them.  This tier
+proves the SAME properties of this build under a deterministic FaultPlan
+(dissemination/faults.py): injected connection resets, partial writes,
+agent crashes, and datapath install failures, with one convergence bar —
+after every fault, every node's datapath verdicts return to parity with an
+oracle compiled from the controller's own span-filtered snapshot, and no
+watcher queue ever grows past its configured cap.
+
+The single-fault smoke rides the tier-1 'not slow' set; the kill/revive
+soak and the wire-level overflow test are marked slow.
+"""
+
+import itertools
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from antrea_tpu.agent import AgentPolicyController
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis import crd
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController, WatchEvent
+from antrea_tpu.controller.status import StatusAggregator
+from antrea_tpu.datapath import OracleDatapath
+from antrea_tpu.dissemination import FaultPlan, RamStore
+from antrea_tpu.dissemination.faults import FaultySocket, FlakyDatapath
+from antrea_tpu.dissemination.netwire import (
+    Backoff,
+    DisseminationServer,
+    NetAgent,
+    make_ca,
+)
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.simulator.fleet import FakeAgent, FakeAgentFleet
+from antrea_tpu.utils import ip as iputil
+
+CAP = 16  # watcher_max_pending for every wire test in this tier
+
+# Monotonic packet clock shared by every parity probe: re-stepping a
+# datapath must never reuse a timestamp (flow-cache entries are keyed on
+# real time in production too).
+_NOW = itertools.count(1000)
+
+
+def _policy(uid, cidr="192.0.2.0/24"):
+    return crd.AntreaNetworkPolicy(
+        uid=uid, name=uid, namespace="", tier_priority=250, priority=1,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"app": "web"}),
+            ns_selector=crd.LabelSelector.make())],
+        rules=[crd.AntreaNPRule(direction=cp.Direction.IN,
+                                action=cp.RuleAction.DROP,
+                                peers=[crd.AntreaPeer(
+                                    ip_block=crd.IPBlock(cidr))])],
+    )
+
+
+def _world(tmp_path, nodes, cap=CAP):
+    certdir = str(tmp_path / "pki")
+    make_ca(certdir)
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    agg = StatusAggregator(ctl)
+    srv = DisseminationServer(store, certdir, status_aggregator=agg,
+                              watcher_max_pending=cap)
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    for i, node in enumerate(nodes, 1):
+        ctl.upsert_pod(crd.Pod(namespace="default", name=f"web-{node}",
+                               ip=f"10.0.{i}.1", node=node,
+                               labels={"app": "web"}))
+    return certdir, ctl, store, agg, srv
+
+
+def _agent(node, srv, certdir, plan=None):
+    """NetAgent over an OracleDatapath; with a plan, both the socket
+    (post-handshake) and the datapath are wrapped in fault injectors."""
+    dp = OracleDatapath(flow_slots=1 << 8, aff_slots=1 << 4)
+    fault_wrap = None
+    if plan is not None:
+        dp = FlakyDatapath(dp, plan, node)
+        fault_wrap = lambda sock: FaultySocket(sock, plan, node)
+    return NetAgent(node, srv.address, certdir, dp,
+                    backoff=Backoff(base=0.01, cap=0.05),
+                    fault_wrap=fault_wrap)
+
+
+def _pkts(n_nodes):
+    """Probe matrix: every web-pod IP plus one address inside each deny
+    CIDR this tier uses — covers both verdict flips a policy change can
+    cause."""
+    ips = [f"10.0.{i}.1" for i in range(1, n_nodes + 1)]
+    ips += ["192.0.2.7", "198.51.100.9", "203.0.113.5"]
+    return [(s, d) for s in ips for d in ips if s != d]
+
+
+def _parity(ctl, agents, pairs):
+    # Every probe is a FRESH flow (unique src_port): an allowed flow the
+    # datapath committed earlier is an established connection that
+    # legitimately survives a policy change (conntrack semantics) — the
+    # stateless oracle bar applies to new connections only.
+    now = next(_NOW)
+    pkts = [Packet(src_ip=iputil.ip_to_u32(s), dst_ip=iputil.ip_to_u32(d),
+                   proto=6, src_port=20000 + now % 40000, dst_port=80)
+            for s, d in pairs]
+    batch = PacketBatch.from_packets(pkts)
+    for node, a in agents.items():
+        oracle = Oracle(ctl.policy_set_for_node(node))
+        want = [int(oracle.classify(p).code) for p in pkts]
+        got = [int(x) for x in np.asarray(a.agent.datapath.step(batch, now).code)]
+        if got != want:
+            return False
+    return True
+
+
+def _converge(ctl, srv, agents, pkts, *, cap=CAP, max_cycles=60):
+    """Pump until every node's verdicts match its oracle -> cycles used.
+    Every cycle also asserts the zero-unbounded-growth bar: no server-side
+    watcher queue past the cap."""
+    for cycle in range(max_cycles):
+        srv.pump()
+        for a in agents.values():
+            a.pump(wait=0.02)
+            a.sync_and_report()
+        for node, w in srv.dissemination_stats()["watchers"].items():
+            assert w["pending"] <= cap, (
+                f"watcher for {node} grew to {w['pending']} (cap {cap})")
+        if _parity(ctl, agents, pkts):
+            return cycle + 1
+        time.sleep(0.02)
+    raise AssertionError(
+        f"fleet did not reconverge to oracle parity in {max_cycles} cycles")
+
+
+# -- tier-1 smoke (single fault, fast) ---------------------------------------
+
+
+def test_smoke_reconnect_resync_parity(tmp_path):
+    """ONE injected connection reset while policy churns: the agent must
+    reconnect with backoff, take the server's re-list, retract the stale
+    policy, and return to oracle parity — the minimum healing loop, kept
+    inside the tier-1 'not slow' set."""
+    nodes = ["n1", "n2"]
+    certdir, ctl, store, agg, srv = _world(tmp_path, nodes)
+    plan = FaultPlan(seed=3)
+    try:
+        agents = {"n1": _agent("n1", srv, certdir, plan),
+                  "n2": _agent("n2", srv, certdir)}
+        srv.wait_connected(2)
+        pkts = _pkts(len(nodes))
+        ctl.upsert_antrea_policy(_policy("P1"))
+        _converge(ctl, srv, agents, pkts)
+        assert agents["n1"].resyncs_total == 1  # the hello snapshot
+
+        # Next recv on n1 dies (recv only runs when data arrives, so churn
+        # first): n1 loses the connection mid-update and the rest of the
+        # churn happens while it is down.
+        plan.after("n1.recv", plan.hits("n1.recv"), "reset", times=1)
+        ctl.delete_policy("P1")
+        srv.pump()
+        agents["n1"].pump(wait=0.2)
+        assert plan.count("reset") == 1
+        assert not agents["n1"].connected
+
+        ctl.upsert_antrea_policy(_policy("P2", cidr="198.51.100.0/24"))
+        cycles = _converge(ctl, srv, agents, pkts)
+        assert cycles <= 60
+        a1 = agents["n1"]
+        assert a1.reconnects_total >= 1
+        assert a1.resyncs_total >= 2  # hello + post-reconnect re-list
+        # Re-list retracted the stale policy (deleted while disconnected).
+        assert [p.uid for p in a1.agent.policy_set.policies] == ["P2"]
+        # The undisturbed node never paid a reconnect.
+        assert agents["n2"].reconnects_total == 0
+        # The healing is visible on the live scrape surface.
+        from antrea_tpu.observability import render_dissemination_metrics
+
+        text = render_dissemination_metrics(srv, agents.values())
+        assert 'antrea_tpu_agent_reconnects_total{node="n1"} 1' in text
+        assert 'antrea_tpu_dissemination_watcher_pending{node="n1"} 0' in text
+        assert "antrea_tpu_dissemination_resyncs_total" in text
+        for a in agents.values():
+            a.close()
+    finally:
+        srv.close()
+
+
+def test_install_retry_counts_and_backoff():
+    """install_bundle raising must not crash the agent or drop state: the
+    dirty flag survives, sync_failures_total counts each attempt, retries
+    wait out a capped backoff, and the rules land once the datapath
+    recovers (the reference reconciler's requeue discipline)."""
+    plan = FaultPlan()
+    plan.every("nX.install", 1, "fail", times=2)  # first two installs raise
+    dp = FlakyDatapath(OracleDatapath(flow_slots=1 << 8, aff_slots=1 << 4),
+                       plan, "nX")
+    t = [0.0]
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    agent = AgentPolicyController("nX", dp, store, clock=lambda: t[0])
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="w", ip="10.0.1.1",
+                           node="nX", labels={"app": "web"}))
+    ctl.upsert_antrea_policy(_policy("P1"))
+
+    agent.sync()  # attempt 1: injected failure
+    assert agent.sync_failures_total == 1
+    assert "injected" in agent.last_sync_error
+    assert dp.generation == 0  # nothing installed
+    agent.sync()  # still inside the backoff window: no attempt burned
+    assert agent.sync_failures_total == 1 and plan.count("fail") == 1
+
+    t[0] += 1.0
+    agent.sync()  # attempt 2: injected failure, backoff doubles
+    assert agent.sync_failures_total == 2
+    t[0] += 1.0
+    agent.sync()  # attempt 3: datapath healthy again
+    assert agent.sync_failures_total == 2
+    assert dp.generation == 1
+    # The retried bundle enforces: deny CIDR drops, web peer passes.
+    batch = PacketBatch.from_packets([
+        Packet(src_ip=iputil.ip_to_u32("192.0.2.7"),
+               dst_ip=iputil.ip_to_u32("10.0.1.1"),
+               proto=6, src_port=41000, dst_port=80),
+    ])
+    assert [int(x) for x in np.asarray(dp.step(batch, next(_NOW)).code)] == [
+        int(Oracle(ctl.policy_set_for_node("nX")).classify(p).code)
+        for p in [Packet(src_ip=iputil.ip_to_u32("192.0.2.7"),
+                         dst_ip=iputil.ip_to_u32("10.0.1.1"),
+                         proto=6, src_port=41000, dst_port=80)]
+    ]
+
+
+def test_bounded_watcher_overflow_forces_resync():
+    """A consumer that stops pumping must cost one resync, never unbounded
+    controller memory: the queue caps, overflow flips needs_resync, and
+    the next pump re-lists — including retracting objects deleted during
+    the outage (events the dropped buffer never delivered)."""
+    cap = 8
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    agent = FakeAgent(store, "n1", max_pending=cap)
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="w", ip="10.0.1.1",
+                           node="n1", labels={"app": "web"}))
+    ctl.upsert_k8s_policy(crd.K8sNetworkPolicy(
+        uid="np-web", name="np-web", namespace="default",
+        pod_selector=crd.LabelSelector.make({"app": "web"}),
+        ingress=[crd.K8sNPRule(peers=[crd.K8sPeer(
+            pod_selector=crd.LabelSelector.make({"app": "client"}))])],
+    ))
+    agent.pump()  # tables populated: the outage below has state to stale
+    assert set(agent.policies) == {"np-web"}
+
+    w = agent._watcher
+    # Outage: 20 group-churn events with no pump — more than the cap.
+    for i in range(20):
+        ctl.upsert_pod(crd.Pod(namespace="default", name=f"c{i}",
+                               ip=f"10.0.2.{i + 1}", node="n2",
+                               labels={"app": "client"}))
+        assert w.pending() <= cap  # never grows past the cap
+    assert w.needs_resync and w.overflows == 1
+    assert w.pending() == 0  # overflowed buffer was dropped, not kept
+
+    # Deleted while the stream was invalid: only the re-list can tell.
+    ctl.delete_policy("np-web")
+    agent.pump()
+    assert agent.resyncs_seen == 1
+    assert not w.needs_resync
+    # Tables now mirror the span-filtered snapshot exactly (empty: the
+    # policy and its groups are gone, nothing else spans n1).
+    ps = ctl.policy_set_for_node("n1")
+    assert set(agent.policies) == {p.uid for p in ps.policies} == set()
+    assert set(agent.address_groups) == set(ps.address_groups)
+    assert set(agent.applied_to_groups) == set(ps.applied_to_groups)
+    agent.stop()
+
+
+def test_store_span_shrink_and_stop_interleaving_bounded():
+    """RamStore edge traffic under the bounded-queue path (the round-2
+    watcher-leak area): span shrink still delivers DELETED through a
+    capped queue, overflow + shrink resolves through the re-list (no
+    phantom object, no suppressed re-ADD), and stop() interleaved with
+    producer traffic prunes the watcher without leaking buffered events."""
+    store = RamStore()
+    w1 = store.watch_queue("n1", max_pending=4)
+    w2 = store.watch_queue("n2", max_pending=4)
+
+    def upd(name, span, kind="UPDATED"):
+        store.apply(WatchEvent(kind=kind, obj_type="AddressGroup",
+                               name=name, obj=object(), span=set(span)))
+
+    upd("g1", {"n1", "n2"}, kind="ADDED")
+    assert [e.kind for e in w1.drain()] == ["ADDED"]
+    # Span shrinks away from n1: retraction arrives as DELETED.
+    upd("g1", {"n2"})
+    evs = w1.drain()
+    assert [(e.kind, e.name) for e in evs] == [("DELETED", "g1")]
+
+    # Overflow w1 (cap 4) with unrelated churn, then shrink g2 away while
+    # the stream is invalid: the dropped buffer never says DELETED, the
+    # re-list simply omits g2.
+    upd("g2", {"n1"}, kind="ADDED")
+    for i in range(6):
+        upd(f"x{i}", {"n1"}, kind="ADDED")
+    assert w1.needs_resync and w1.overflows == 1 and w1.pending() == 0
+    upd("g2", set())  # shrink-to-nowhere while overflowed: event dropped
+    snap = {e.name for e in store.resync(w1)}
+    assert "g2" not in snap and {"x0", "x5"} <= snap
+    assert not w1.needs_resync
+    # Known-set was rebuilt by the re-list: a later span GROWTH must
+    # re-deliver ADDED (a stale known-set would suppress it).
+    upd("g2", {"n1"})
+    assert [(e.kind, e.name) for e in w1.drain()] == [("ADDED", "g2")]
+
+    # stop() mid-stream: buffered events are cleared immediately, the
+    # store prunes the watcher on its next apply, and subsequent producer
+    # traffic delivers nowhere — while the surviving watcher still works.
+    upd("g3", {"n1", "n2"}, kind="ADDED")
+    assert w1.pending() > 0
+    before = store.n_watchers
+    w1.stop()
+    assert w1.pending() == 0
+    assert store.n_watchers == before - 1
+    upd("g3", {"n2"})  # shrink away from n1 AFTER the stop: no delivery
+    assert w1.pending() == 0
+    # ...while the surviving watcher (still spanned) got the live stream.
+    assert ("UPDATED", "g3") in [(e.kind, e.name) for e in w2.drain()]
+
+    # stop() while needs_resync is pending must not leave a zombie that
+    # a later resync would resurrect.
+    w3 = store.watch_queue("n1", max_pending=2)
+    for i in range(4):
+        upd(f"y{i}", {"n1"}, kind="ADDED")
+    assert w3.needs_resync
+    w3.stop()
+    upd("y9", {"n1"}, kind="ADDED")
+    assert store.n_watchers == 1  # only w2 remains
+    w2.stop()
+
+
+# -- slow chaos: wire overflow + kill/revive soak ----------------------------
+
+
+@pytest.mark.slow
+def test_wire_overflow_resync_over_mtls(tmp_path):
+    """Server-side bounded watcher over the REAL wire: churn bursts larger
+    than the cap between pumps overflow the queue; the next pump converts
+    that into a bracketed re-list down the socket and the agent converges
+    — one snapshot, never unbounded memory."""
+    nodes = ["n1", "n2"]
+    cap = 4
+    certdir, ctl, store, agg, srv = _world(tmp_path, nodes, cap=cap)
+    try:
+        agents = {n: _agent(n, srv, certdir) for n in nodes}
+        srv.wait_connected(2)
+        pkts = _pkts(len(nodes))
+        ctl.upsert_antrea_policy(_policy("P1"))
+        _converge(ctl, srv, agents, pkts, cap=cap)
+        base = {n: a.resyncs_total for n, a in agents.items()}
+
+        # Burst: each upsert moves both policies' address groups; well
+        # past the cap before any pump runs.
+        ctl.upsert_antrea_policy(_policy("P2", cidr="198.51.100.0/24"))
+        for i in range(12):
+            ctl.upsert_pod(crd.Pod(
+                namespace="default", name=f"w{i}", ip=f"10.9.0.{i + 1}",
+                node=nodes[i % 2], labels={"app": "web"}))
+        stats = srv.dissemination_stats()
+        assert any(w["overflows"] > 0 for w in stats["watchers"].values())
+        assert all(w["pending"] <= cap for w in stats["watchers"].values())
+
+        _converge(ctl, srv, agents, pkts, cap=cap)
+        assert any(a.resyncs_total > base[n] for n, a in agents.items())
+        assert srv.resyncs_total >= 3  # 2 hellos + >=1 overflow re-list
+        for a in agents.values():
+            a.close()
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_fleet_pump_survives_dead_agent_and_reconnects(tmp_path):
+    """FakeAgentFleet.pump with a disconnected member: the dead agent
+    must not crash the fleet-wide select (its socket is None while in
+    backoff) — it re-dials on its own pump slot and reconverges via the
+    server's re-list, while the healthy agent streams on."""
+    nodes = ["n1", "n2"]
+    certdir, ctl, store, agg, srv = _world(tmp_path, nodes)
+    try:
+        fleet = FakeAgentFleet(None, nodes, transport="netwire",
+                               server=srv, certdir=certdir)
+        ctl.upsert_antrea_policy(_policy("P1"))
+        for _ in range(10):
+            fleet.pump()
+            if all(set(a.policies) == {"P1"}
+                   for a in fleet.agents.values()):
+                break
+        a1 = fleet.agents["n1"]
+        assert set(a1.policies) == {"P1"}
+        a1._backoff = Backoff(base=0.01, cap=0.05)
+        a1._sock.close()  # network cut mid-stream
+        ctl.delete_policy("P1")
+        for _ in range(40):
+            fleet.pump()  # must never raise while n1 is down
+            if (a1.reconnects_total >= 1
+                    and all(not a.policies
+                            for a in fleet.agents.values())):
+                break
+            time.sleep(0.02)
+        assert a1.reconnects_total >= 1
+        assert all(not a.policies for a in fleet.agents.values())
+        fleet.stop()
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_chaos_soak_kill_revive_converges(tmp_path):
+    """The full storm, deterministically seeded: probabilistic resets and
+    partial writes on two nodes' sockets, install failures on a third, an
+    agent hard-crash (fresh process, empty datapath) re-handshaking over
+    a live registration, and a mid-stream socket kill — with policy and
+    pod churn between every fault burst.  After EVERY round the fleet
+    must reconverge to oracle parity within the cycle bound, and no
+    watcher queue may ever pass the cap."""
+    nodes = ["n1", "n2", "n3"]
+    certdir, ctl, store, agg, srv = _world(tmp_path, nodes)
+    plan = FaultPlan(seed=11)
+    try:
+        agents = {n: _agent(n, srv, certdir, plan) for n in nodes}
+        srv.wait_connected(3)
+        pkts = _pkts(len(nodes))
+        ctl.upsert_antrea_policy(_policy("P1"))
+        _converge(ctl, srv, agents, pkts)
+
+        # Round 1: wire faults on n1/n2 (bounded so the recovery phase of
+        # each convergence is calm), plus churn racing the resets.
+        plan.prob("n1.send", 0.5, "reset", times=2)
+        plan.prob("n1.recv", 0.5, "reset", times=2)
+        plan.prob("n2.send", 0.5, "partial", times=2)
+        plan.prob("n2.recv", 0.5, "reset", times=2)
+        ctl.upsert_antrea_policy(_policy("P2", cidr="198.51.100.0/24"))
+        ctl.delete_policy("P1")
+        for i in range(6):
+            ctl.upsert_pod(crd.Pod(
+                namespace="default", name=f"s{i}", ip=f"10.8.0.{i + 1}",
+                node=nodes[i % 3], labels={"app": "web"}))
+        _converge(ctl, srv, agents, pkts)
+
+        # Round 2: hard-crash n2 — the process dies, its replacement has
+        # an EMPTY datapath and re-handshakes while the server still holds
+        # the old registration (the stale-conn eviction path).
+        agents["n2"].close()
+        ctl.upsert_antrea_policy(_policy("P1"))  # churn during the outage
+        agents["n2"] = _agent("n2", srv, certdir, plan)
+        _converge(ctl, srv, agents, pkts)
+        assert srv.reconnects_total >= 1  # replaced a live registration
+
+        # Round 3: datapath install failures on n3 while rules change —
+        # the dirty state must survive the failures and land.
+        plan.every("n3.install", 1, "fail", times=3)
+        ctl.delete_policy("P2")
+        _converge(ctl, srv, agents, pkts)
+        assert agents["n3"].agent.sync_failures_total >= 1
+
+        # Round 4: socket killed mid-stream (network cut, not a crash) —
+        # the agent discovers the dead fd and re-dials.  P3's CIDR is
+        # covered by no other policy, so parity genuinely requires the
+        # re-listed P3 on every node.
+        agents["n1"]._sock._sock.close()
+        ctl.upsert_antrea_policy(_policy("P3", cidr="203.0.113.0/24"))
+        _converge(ctl, srv, agents, pkts)
+        assert agents["n1"].reconnects_total >= 1
+
+        # The storm actually happened (a chaos run that injected nothing
+        # proves nothing) and healing is visible in the counters.
+        assert plan.count("reset") >= 1
+        assert plan.count("fail") >= 1
+        assert sum(a.resyncs_total for a in agents.values()) >= 5
+        # Status plane healed too: every node reports the final policies
+        # (the reports ride the same sockets, so give them pump rounds).
+        for _ in range(20):
+            srv.pump()
+            for a in agents.values():
+                a.pump(wait=0.02)
+                a.sync_and_report()
+            srv.pump()
+            if all(agg.status_of(uid).phase == "Realized"
+                   for uid in ("P1", "P3")):
+                break
+            time.sleep(0.02)
+        for uid in ("P1", "P3"):
+            st = agg.status_of(uid)
+            assert st.phase == "Realized", (uid, st)
+        for a in agents.values():
+            a.close()
+    finally:
+        srv.close()

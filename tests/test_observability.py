@@ -190,3 +190,57 @@ def test_metrics_rendering():
     assert 'antrea_tpu_default_verdict_packets_total{verdict="allow",node="n0"} 1' in text
     assert 'antrea_tpu_flow_cache_entries{kind="occupied",node="n0"}' in text
     assert "antrea_tpu_flow_cache_evictions_total" in text
+
+
+def test_dissemination_metrics_rendering():
+    """Scrape format of the dissemination-health surface: per-watcher
+    queue depth/overflow/needs-resync from a server's
+    dissemination_stats(), per-agent reconnect/resync counters, and the
+    reconciler's sync_failures_total — duck-typed exactly as the real
+    DisseminationServer / NetAgent / AgentPolicyController expose them."""
+    from types import SimpleNamespace
+
+    from antrea_tpu.observability import render_dissemination_metrics
+
+    class _Srv:
+        def dissemination_stats(self):
+            return {
+                "watchers": {
+                    "n1": {"pending": 3, "overflows": 1,
+                           "needs_resync": True},
+                    "n2": {"pending": 0, "overflows": 0,
+                           "needs_resync": False},
+                },
+                "resyncs_total": 4,
+                "reconnects_total": 2,
+            }
+
+    agents = [
+        # A NetAgent: wire counters + embedded controller's failure count.
+        SimpleNamespace(node="n1", reconnects_total=2, resyncs_total=3,
+                        agent=SimpleNamespace(sync_failures_total=5)),
+        # A bare AgentPolicyController: only the failure counter.
+        SimpleNamespace(node="n2", sync_failures_total=0),
+    ]
+    text = render_dissemination_metrics(_Srv(), agents)
+    assert text.endswith("\n")
+    assert "# TYPE antrea_tpu_dissemination_watcher_pending gauge" in text
+    assert 'antrea_tpu_dissemination_watcher_pending{node="n1"} 3' in text
+    assert 'antrea_tpu_dissemination_watcher_overflows_total{node="n1"} 1' in text
+    assert 'antrea_tpu_dissemination_watcher_needs_resync{node="n1"} 1' in text
+    assert 'antrea_tpu_dissemination_watcher_needs_resync{node="n2"} 0' in text
+    assert "antrea_tpu_dissemination_resyncs_total 4" in text
+    assert "antrea_tpu_dissemination_reconnects_total 2" in text
+    assert 'antrea_tpu_agent_reconnects_total{node="n1"} 2' in text
+    assert 'antrea_tpu_agent_resyncs_total{node="n1"} 3' in text
+    assert 'antrea_tpu_agent_sync_failures_total{node="n1"} 5' in text
+    assert 'antrea_tpu_agent_sync_failures_total{node="n2"} 0' in text
+    # Every exposed family is TYPEd (scrape-format discipline).
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE "), line
+
+    # Agent-only scrape (no server reachable) still renders.
+    agent_only = render_dissemination_metrics(None, agents)
+    assert "dissemination_watcher_pending" not in agent_only
+    assert 'antrea_tpu_agent_sync_failures_total{node="n2"} 0' in agent_only

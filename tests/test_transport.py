@@ -26,7 +26,7 @@ from antrea_tpu.apis.crd import (
 )
 from antrea_tpu.controller import NetworkPolicyController
 from antrea_tpu.dissemination import RamStore
-from antrea_tpu.dissemination.transport import SubprocessAgent
+from antrea_tpu.dissemination.transport import AgentDiedError, SubprocessAgent
 from antrea_tpu.oracle import Oracle
 from antrea_tpu.packet import Packet, PacketBatch
 from antrea_tpu.utils import ip as iputil
@@ -159,3 +159,126 @@ def test_queued_watcher_does_not_block_and_unsubscribes(wired):
     ctl.upsert_pod(mk_pod("cli9", "10.0.0.99", "nodeB", app="client"))
     assert w.pending() == 0
     del agents["nodeA"]
+
+
+# -- failure model: agent death is typed, diagnosed, and bounded --------------
+
+
+@pytest.mark.chaos
+def test_agent_killed_mid_stream_raises_typed_error(wired):
+    """Kill-mid-stream regression: the child dying between frames must
+    surface as AgentDiedError carrying the node and exit code — never a
+    bare BrokenPipeError from _proc.stdin.write."""
+    ctl, store, agents = wired
+    _pods(ctl)
+    ctl.upsert_k8s_policy(_np_web())
+    a = agents["nodeA"]
+    a.pump(); a.sync()  # healthy first: the stream was live
+
+    a._proc.kill()
+    a._proc.wait(timeout=10)
+    # Churn queues more events; shipping them hits the dead pipe.
+    ctl.upsert_pod(mk_pod("cli2", "10.0.0.21", "nodeB", app="client"))
+    with pytest.raises(AgentDiedError) as ei:
+        a.pump()
+    e = ei.value
+    assert e.node == "nodeA"
+    assert e.exit_code == -9  # SIGKILL, reaped and reported
+    assert "died" in str(e)
+    assert not isinstance(e, BrokenPipeError)
+    # stop() after death is a clean no-op (no second exception).
+    a.stop()
+
+
+@pytest.mark.chaos
+def test_wedged_agent_hits_rpc_deadline_and_is_killed(wired):
+    """A wedged child (SIGSTOP: alive but unresponsive) must not block
+    _rpc forever: the read deadline fires, the child is killed, and the
+    caller gets the typed error — the controller never hangs on one
+    node."""
+    import os
+    import signal
+    import time as _time
+
+    ctl, store, agents = wired
+    _pods(ctl)
+    ctl.upsert_k8s_policy(_np_web())
+    a = agents["nodeA"]
+    a.pump(); a.sync()  # prove the child responds when healthy (and is
+    a._rpc_timeout = 2.0  # past its slow import-time boot)
+
+    os.kill(a._proc.pid, signal.SIGSTOP)
+    t0 = _time.monotonic()
+    with pytest.raises(AgentDiedError) as ei:
+        a.sync()
+    assert _time.monotonic() - t0 < 30  # bounded, not forever
+    assert "wedged" in str(ei.value)
+    assert a._proc.poll() is not None  # the wedged child was reaped
+
+
+@pytest.mark.chaos
+def test_agent_died_error_carries_stderr_tail(wired):
+    """The typed error ships the child's stderr tail — the diagnostic an
+    operator needs without attaching a debugger."""
+    ctl, store, agents = wired
+    a = agents["nodeA"]
+    # A malformed event makes the child log to stderr (and survive); the
+    # following sync() response proves the log line was written.
+    a._send_frame({"ev": {"malformed": True}})
+    a.sync()
+    a._proc.kill()
+    a._proc.wait(timeout=10)
+    with pytest.raises(AgentDiedError) as ei:
+        a._rpc({"cmd": "summary"})
+    assert "bad frame" in ei.value.stderr_tail or (
+        "event failed" in ei.value.stderr_tail)
+
+
+@pytest.mark.chaos
+def test_bounded_watcher_resync_crosses_process_boundary():
+    """Overflowing a capped watcher behind a SubprocessAgent converts
+    into the bracketed re-list over the pipe: the child retracts state
+    deleted during the overflow window (same protocol as the wire)."""
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    with SubprocessAgent("nodeA", store, watcher_max_pending=4) as a:
+        _pods(ctl)
+        ctl.upsert_k8s_policy(_np_web())
+        a.pump(); a.sync()
+        assert a.state_summary()["policies"] == ["np-web"]
+
+        w = a._watcher
+        for i in range(8):  # churn past the cap with no pump
+            ctl.upsert_pod(mk_pod(f"c{i}", f"10.0.7.{i + 1}", "nodeB",
+                                  app="client"))
+        assert w.needs_resync and w.pending() == 0
+        ctl.delete_policy("np-web")  # invisible to the dropped buffer
+        a.pump()  # ships resync_begin / snapshot / resync_end
+        a.sync()
+        s = a.state_summary()
+        assert s["policies"] == []  # stale policy retracted by the re-list
+        assert s["addressGroups"] == [] and s["appliedToGroups"] == []
+
+
+@pytest.mark.chaos
+def test_injected_pipe_fault_surfaces_as_typed_error(wired):
+    """FaultyPipe chaos on the parent->child stream: an injected
+    BrokenPipeError mid-frame takes the same typed-death path as a real
+    crash (the transport cannot distinguish them, and must not)."""
+    from antrea_tpu.dissemination.faults import FaultPlan, FaultyPipe
+
+    ctl, store, agents = wired
+    _pods(ctl)
+    a = agents["nodeA"]
+    a.pump(); a.sync()
+
+    plan = FaultPlan()
+    plan.every("nodeA.pipe.write", 1, "reset", times=1)  # next write dies
+    a._proc.stdin = FaultyPipe(a._proc.stdin, plan, "nodeA.pipe")
+    with pytest.raises(AgentDiedError) as ei:
+        a.sync()
+    assert plan.count("reset") == 1
+    # The pipe close was an orderly EOF to the child: it exited cleanly,
+    # and the typed error still reports the reaped code.
+    assert ei.value.exit_code is not None
