@@ -25,6 +25,12 @@ Routes:
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
                         live ofproto/trace analog (Datapath.trace probe)
+  GET /traceflow?live=1&...[&dropped_only=1&sampling=N&wait=S]
+                        live-traffic Traceflow (the reference's
+                        liveTraffic mode): samples the next REAL packet
+                        matching the filter from the node's stepped
+                        traffic (requires a TraceflowController tap wired
+                        at construction) and returns its per-stage path
 """
 
 from __future__ import annotations
@@ -46,6 +52,7 @@ class AgentApiServer:
         ifaces=None,  # InterfaceStore
         memberlist=None,  # MemberlistCluster
         gates=None,  # FeatureGates
+        tf_controller=None,  # TraceflowController (live-traffic traceflow)
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -55,6 +62,12 @@ class AgentApiServer:
         self._ifaces = ifaces
         self._memberlist = memberlist
         self._gates = gates
+        self._tfc = tf_controller
+        # itertools.count: atomic under CPython — concurrent live-trace
+        # handlers must never mint the same session name.
+        import itertools
+
+        self._live_seq = itertools.count(1)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -178,6 +191,8 @@ class AgentApiServer:
                 return {}
             return self._gates.as_dict()
         if route == "/traceflow":
+            if q.get("live"):
+                return self._live_traceflow(q)
             if "src" not in q or "dst" not in q:
                 raise ValueError("traceflow needs src= and dst=")
             from ..packet import PacketBatch
@@ -194,3 +209,58 @@ class AgentApiServer:
             obs["dnat_ip"] = iputil.u32_to_ip(obs["dnat_ip"])
             return obs
         raise KeyError(route)
+
+    def _live_traceflow(self, q: dict) -> dict:
+        """Open a live-traffic Traceflow session and wait (bounded) for
+        the node's stepped traffic to complete it — the synchronous HTTP
+        face of TraceflowController.start_live for antctl."""
+        import time as _time
+
+        from ..controller.traceflow import TraceflowSpec, TraceflowStatus
+
+        if self._tfc is None:
+            raise ValueError(
+                "live traceflow needs a TraceflowController tap wired to "
+                "this agent's datapath"
+            )
+        if not q.get("src") and not q.get("dst"):
+            raise ValueError("live traceflow needs src= or dst=")
+        name = f"live-{self._node}-{next(self._live_seq)}"
+        tf = TraceflowSpec(
+            name=name,
+            src_ip=q.get("src", ""),
+            dst_ip=q.get("dst", ""),
+            proto=int(q.get("proto", 0)),
+            src_port=int(q.get("sport", 0)),
+            dst_port=int(q.get("dport", 0)),
+            live_traffic=True,
+            dropped_only=q.get("dropped_only", "0") not in ("", "0"),
+            sampling=int(q.get("sampling", 1)),
+        )
+        st = self._tfc.start_live(tf, self._node)
+        deadline = _time.monotonic() + float(q.get("wait", 5.0))
+        while st.phase == "Running" and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+            st = self._tfc.results[name]
+        if st.phase == "Running":
+            # Settle the timeout UNDER the controller lock: the stepping
+            # thread may complete the session between our last poll and
+            # here — a capture that actually happened must win over the
+            # timeout verdict.
+            with self._tfc.lock:
+                st = self._tfc.results[name]
+                if st.phase == "Running":
+                    self._tfc.release(name)
+                    st = self._tfc.results[name] = TraceflowStatus(
+                        name, st.tag, "Failed",
+                        [{"component": "LiveTraffic",
+                          "action": "no matching live packet within wait"}],
+                    )
+        # One-shot HTTP session: its result ships in this response, so
+        # evict it from the controller — a monitoring job polling --live
+        # periodically must not grow results without bound.
+        self._tfc.results.pop(name, None)
+        return {
+            "name": st.name, "tag": st.tag, "phase": st.phase,
+            "verdict": st.verdict, "observations": st.observations,
+        }

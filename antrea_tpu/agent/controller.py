@@ -34,6 +34,7 @@ from ..controller.networkpolicy import WatchEvent
 from ..datapath.interface import Datapath
 from ..dissemination.netwire import Backoff
 from ..dissemination.store import RamStore
+from ..observability.metrics import Histogram
 
 
 class AgentPolicyController:
@@ -61,6 +62,21 @@ class AgentPolicyController:
         # the agent never crashes on a flaky datapath.
         self.sync_failures_total = 0
         self.last_sync_error: str = ""
+        # Latency histograms (scraped via render_dissemination_metrics):
+        # sync_hist = duration of a sync() that applied state to the
+        # datapath; dissemination_hist = controller-commit (WatchEvent.ts)
+        # -> datapath-realized latency per event, observed at the first
+        # SUCCESSFUL install covering the event — retries extend it, which
+        # is the honest realization latency.
+        self.sync_hist = Histogram()
+        self.dissemination_hist = Histogram()
+        # Bounded (latency observations are droppable telemetry; during a
+        # persistent install outage events keep arriving and a successful
+        # sync may be hours away — the metrics buffer must not undo the
+        # plane's bounded-memory guarantee).  The OLDEST stamps are kept:
+        # they carry the worst-case latencies the histogram exists to show.
+        self._pending_ts: list[float] = []
+        self._pending_ts_cap = 4096
         # What the datapath actually enforces: refreshed ONLY on a
         # successful apply, so a failed install can never report upstream
         # as realized (the status plane would mark a generation Realized
@@ -129,6 +145,17 @@ class AgentPolicyController:
         self._resync_seen = set()
 
     def handle_event(self, ev: WatchEvent) -> None:
+        self._handle_event(ev)
+        # Dissemination-latency origin: a stamped event that left pending
+        # datapath work starts (or joins) the commit->realized clock,
+        # settled by the next successful sync().  Unstamped events
+        # (resync replays — reconnect catch-up, not live dissemination)
+        # are not measured.
+        if (ev.ts and (self._rules_dirty or self._deltas)
+                and len(self._pending_ts) < self._pending_ts_cap):
+            self._pending_ts.append(ev.ts)
+
+    def _handle_event(self, ev: WatchEvent) -> None:
         if self._in_resync:
             if ev.kind == "DELETED":
                 # A delete interleaved into the re-list window un-lists
@@ -187,6 +214,17 @@ class AgentPolicyController:
         self._retry_at = self._clock() + self._retry_backoff.next_delay()
         self._report_status(failure=str(e))
 
+    def _observe_synced(self, t0: float) -> None:
+        """A sync() successfully applied state: record its duration and
+        settle every pending commit->realized latency observation."""
+        t = self._clock()
+        self.sync_hist.observe(max(t - t0, 0.0))
+        for ts in self._pending_ts:
+            # Clamped: tests drive _clock with fake counters that are not
+            # comparable to the store's monotonic stamps.
+            self.dissemination_hist.observe(max(t - ts, 0.0))
+        self._pending_ts.clear()
+
     def sync(self) -> None:
         """Apply pending changes to the datapath: one bundle for structural
         changes, or the queued incremental deltas otherwise.  The filestore
@@ -200,8 +238,9 @@ class AgentPolicyController:
         never dropped."""
         if not self._rules_dirty and not self._deltas:
             return
+        t0 = self._clock()
         if self._rules_dirty:
-            if self._clock() < self._retry_at:
+            if t0 < self._retry_at:
                 return  # backing off a failed install; state stays pending
             # A bundle folds any pending deltas too (membership is already
             # reflected in the local PolicySet).
@@ -216,6 +255,7 @@ class AgentPolicyController:
             self._deltas.clear()
             self._realized = {p.uid: p.generation for p in self._ps.policies}
             self._save_filestore()
+            self._observe_synced(t0)
             self._report_status()
             return
         try:
@@ -238,6 +278,7 @@ class AgentPolicyController:
         self._deltas.clear()
         self._realized = {p.uid: p.generation for p in self._ps.policies}
         self._save_filestore()
+        self._observe_synced(t0)
         self._report_status()
 
     def realized_generations(self) -> dict:

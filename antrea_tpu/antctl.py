@@ -130,6 +130,26 @@ def _cmd_traceflow(args) -> int:
     from .packet import PacketBatch
     from .utils import ip as iputil
 
+    if args.live:
+        # Live-traffic mode (the reference's liveTraffic Traceflow):
+        # samples a REAL packet from the node's traffic, so it only works
+        # against a live agent.  Unset ports/proto wildcard the filter.
+        if not getattr(args, "server", None):
+            raise SystemExit("antctl: traceflow --live needs --server "
+                             "(live traffic is sampled on the agent)")
+        qs = (f"/traceflow?live=1&src={args.src or ''}&dst={args.dst or ''}"
+              f"&proto={args.proto if args.proto is not None else 0}"
+              f"&sport={args.sport or 0}&dport={args.dport or 0}"
+              f"&sampling={args.sampling}&wait={args.wait}"
+              + ("&dropped_only=1" if args.dropped_only else ""))
+        st = json.loads(_fetch(args.server, qs))
+        print(json.dumps(st, indent=2, default=str))
+        return 0 if st.get("phase") == "Succeeded" else 1
+    if not args.src or not args.dst:
+        raise SystemExit("antctl: traceflow needs --src and --dst")
+    args.proto = 6 if args.proto is None else args.proto
+    args.sport = 40000 if args.sport is None else args.sport
+    args.dport = 80 if args.dport is None else args.dport
     if getattr(args, "server", None):
         qs = (f"/traceflow?src={args.src}&dst={args.dst}&proto={args.proto}"
               f"&sport={args.sport}&dport={args.dport}")
@@ -287,14 +307,27 @@ def main(argv=None) -> int:
     m.add_argument("--server", required=True)
     m.set_defaults(fn=lambda a: (print(_fetch(a.server, "/metrics"), end=""), 0)[1])
 
-    t = sub.add_parser("traceflow", help="trace a crafted probe packet")
+    t = sub.add_parser(
+        "traceflow",
+        help="trace a crafted probe packet, or sample live traffic (--live)",
+    )
     t.add_argument("--state")
     t.add_argument("--server", help="live agent API base URL")
-    t.add_argument("--src", required=True)
-    t.add_argument("--dst", required=True)
-    t.add_argument("--proto", type=int, default=6)
-    t.add_argument("--sport", type=int, default=40000)
-    t.add_argument("--dport", type=int, default=80)
+    t.add_argument("--src", default="")
+    t.add_argument("--dst", default="")
+    # None = per-mode default: probe mode fills 6/40000/80 (a crafted
+    # packet needs concrete fields), live mode wildcards unset fields.
+    t.add_argument("--proto", type=int, default=None)
+    t.add_argument("--sport", type=int, default=None)
+    t.add_argument("--dport", type=int, default=None)
+    t.add_argument("--live", action="store_true",
+                   help="sample a real packet (liveTraffic mode)")
+    t.add_argument("--dropped-only", action="store_true", dest="dropped_only",
+                   help="live mode: only capture denied packets")
+    t.add_argument("--sampling", type=int, default=1,
+                   help="live mode: capture the Nth matching packet")
+    t.add_argument("--wait", type=float, default=5.0,
+                   help="live mode: seconds to wait for a match")
     t.set_defaults(fn=_cmd_traceflow)
 
     q = sub.add_parser("query", help="query subcommands")

@@ -64,6 +64,12 @@ class WatchEvent:
     # consumers that already hold the object need not reconcile (keeps the
     # incremental delta path from degrading into full bundle installs).
     span_only: bool = False
+    # Controller-commit timestamp (time.monotonic seconds — comparable
+    # across processes on one host): stamped by RamStore.apply when the
+    # event enters the dissemination plane, carried over the wire (serde),
+    # and differenced by the agent on successful datapath install into the
+    # antrea_tpu_dissemination_latency_seconds histogram.  0.0 = unstamped.
+    ts: float = 0.0
 
 
 def _members_of(pods: list[Pod]) -> list[cp.GroupMember]:

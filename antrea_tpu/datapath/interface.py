@@ -165,6 +165,20 @@ class Datapath(ABC):
         Keys: cache_hit, est, svc_idx, dnat_ip, dnat_port, egress_code,
         egress_rule, ingress_code, ingress_rule, code."""
 
+    def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
+                **kw) -> dict:
+        """Phase-timed churn-loop breakdown (the profiling plane; see
+        models/profile.py): run `batch` as the established hot set with a
+        rolling fresh-flow window drawn from `fresh`, and return
+        {"phases_s": {phase: seconds}, "total_s", "pps", ...}.  Phase
+        names are implementation-defined (the tpuflow kernel reports the
+        six-phase device chain; the oracle a coarse host-timed split).
+        Observable state is left untouched — profiling steps run on a
+        scratch copy."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement profile()"
+        )
+
 
 @dataclass
 class DatapathStats:

@@ -10,6 +10,8 @@ deltas, used to diff verdicts against tpuflow.
 
 from __future__ import annotations
 
+import copy
+import time
 from typing import Optional
 
 import numpy as np
@@ -40,6 +42,7 @@ from ..compiler.topology import (
     resolve_topology,
 )
 from ..compiler.compile import ACT_ALLOW
+from ..observability.metrics import Histogram
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..utils import ip as iputil
 from ..packet import PacketBatch
@@ -106,6 +109,9 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         self._bytes_out: Counter = Counter()
         self._default_allow = 0
         self._default_deny = 0
+        # Classify-batch latency histogram — same scrape surface as the
+        # kernel twin (antrea_tpu_datapath_step_seconds).
+        self.step_hist = Histogram()
         self._rebuild_l7_ids()
 
     def _rebuild_l7_ids(self) -> None:
@@ -256,6 +262,73 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             "evictions": self._oracle.evictions,
         }
 
+    def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
+                *, now: int = 1000, **_kw) -> dict:
+        """Coarse host-timed phase split (the scalar twin of the kernel's
+        six-phase device chain, TpuflowDatapath.profile): fast_path =
+        cache lookup of every lane, classify = the fresh ServiceLB+
+        classifier walk of the lanes that MISS (mirroring what step()
+        actually pays — a warmed hot set classifies nothing), and
+        commit_residual = full step minus both (the commit bookkeeping +
+        output assembly).  State and counters are snapshotted and
+        restored — profiling is observable-state-neutral."""
+        from ..models.pipeline import GEN_ETERNAL
+
+        o = self._oracle
+        gen_w = self._gen % GEN_ETERNAL
+        probes = [batch] + ([fresh] if fresh is not None else [])
+        packets = [b.packet(i) for b in probes for i in range(b.size)]
+        misses = []
+        t0 = time.perf_counter()
+        for p in packets:
+            h = o._flow_hash(p)
+            _slot, e = o.lookup(o.flow, p, h, now, gen_w)
+            if e is None:
+                misses.append(p)
+        t_fast = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for p in misses:
+            o.fresh_walk(o.aff, p, o._flow_hash(p), now)
+        t_cls = time.perf_counter() - t0
+        snap = (copy.deepcopy(o.flow), copy.deepcopy(o.aff), o.evictions,
+                dict(self._stats_in), dict(self._stats_out),
+                dict(self._bytes_in), dict(self._bytes_out),
+                self._default_allow, self._default_deny)
+        hist_snap = (list(self.step_hist._counts), self.step_hist.sum,
+                     self.step_hist.count)
+        try:
+            t0 = time.perf_counter()
+            for b in probes:
+                self.step(b, now)
+            total = time.perf_counter() - t0
+        finally:
+            (o.flow, o.aff, o.evictions, si, so, bi, bo,
+             self._default_allow, self._default_deny) = (
+                snap[0], snap[1], snap[2], snap[3], snap[4], snap[5],
+                snap[6], snap[7], snap[8])
+            self._stats_in = Counter(si)
+            self._stats_out = Counter(so)
+            self._bytes_in = Counter(bi)
+            self._bytes_out = Counter(bo)
+            (self.step_hist._counts, self.step_hist.sum,
+             self.step_hist.count) = hist_snap
+        n = len(packets)
+        phases = {
+            "fast_path": t_fast,
+            "classify": t_cls,
+            "commit_residual": max(total - t_fast - t_cls, 0.0),
+        }
+        return {
+            "batch": n,
+            "fresh_per_step": 0 if fresh is None else fresh.size,
+            "misses": len(misses),
+            "phases_s": phases,
+            "total_s": total,
+            "pps": n / max(total, 1e-9),
+            "phase_fractions": {k: v / max(total, 1e-9)
+                                for k, v in phases.items()},
+        }
+
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         """Read-only per-packet trace, same semantics as TpuflowDatapath:
         the FRESH pipeline walk for every packet plus the cache overlay
@@ -322,6 +395,13 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         return mcast_group_of(self._rt, idx)
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
+        t0 = time.perf_counter()
+        try:
+            return self._step(batch, now)
+        finally:
+            self.step_hist.observe(time.perf_counter() - t0)
+
+    def _step(self, batch: PacketBatch, now: int) -> StepResult:
         from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
 
         in_ports = batch.in_ports()

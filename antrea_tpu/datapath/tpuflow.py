@@ -28,6 +28,7 @@ its rule is gone; ref network_policy.go ct_label persistence).
 
 from __future__ import annotations
 
+import time
 from collections import Counter
 from typing import Optional
 
@@ -43,6 +44,7 @@ from ..compiler import topology
 from ..compiler.topology import FWD_TUNNEL, Topology, compile_topology
 from ..models import forwarding as fwd
 from ..models import pipeline as pl
+from ..observability.metrics import Histogram
 from ..ops.match import DeltaTable, to_device
 from ..packet import PacketBatch
 from ..utils import ip as iputil
@@ -123,6 +125,12 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._default_allow = 0
         self._default_deny = 0
         self._evictions = 0
+        # Classify-batch latency (scraped as the
+        # antrea_tpu_datapath_step_seconds histogram): wall time of step()
+        # as the CALLER sees it — dispatch + device walk + host fetch (the
+        # np.asarray conversions force completion), i.e. the latency the
+        # dissemination/observability planes actually wait out.
+        self.step_hist = Histogram()
         if self._topo is None:
             self._topo = Topology()
         self._compile_rules()
@@ -295,6 +303,13 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 jnp.asarray(batch.is6))
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
+        t0 = time.perf_counter()
+        try:
+            return self._step(batch, now)
+        finally:
+            self.step_hist.observe(time.perf_counter() - t0)
+
+    def _step(self, batch: PacketBatch, now: int) -> StepResult:
         # One materialization of the per-lane byte lengths, clamped
         # (negative pkt_len must never decrement a monotonic counter).
         lens = np.maximum(batch.lens(), 0)
@@ -503,6 +518,29 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         c = {k: int(v) for k, v in pl.cache_stats(self._state).items()}
         c["evictions"] = self._evictions
         return c
+
+    def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
+                *, n_new: Optional[int] = None, now: int = 1000,
+                k_small: int = 2, k_big: int = 8, repeats: int = 2) -> dict:
+        """On-device churn-loop phase breakdown (models/profile.py):
+        `batch` is warmed as the established hot set; each timed step
+        replaces its first n_new lanes with a rolling window of fresh
+        flows from `fresh` (None -> never-miss regime).  The datapath's
+        own state is untouched — the profiler steps a scratch copy."""
+        from ..models import profile as prof
+
+        if batch.has_v6 or (fresh is not None and fresh.has_v6):
+            raise ValueError(
+                "profile() probes are v4-only; dual-stack instances "
+                "profile their v4 lanes (the wide fast path is shared)"
+            )
+        hot = prof._dev_cols(batch)
+        pool = prof._dev_cols(fresh) if fresh is not None else None
+        return prof.profile_churn(
+            self._meta, self._state, self._drs, self._dsvc, hot, pool,
+            n_new=n_new, now0=now, gen=self._gen,
+            k_small=k_small, k_big=k_big, repeats=repeats,
+        )
 
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         """Traceflow analog: per-packet stage observations, state untouched.

@@ -31,7 +31,10 @@ class InjectedInstallError(RuntimeError):
 class _Rule:
     kind: str            # "reset" | "partial" | "delay" | "fail"
     every: int = 0       # fire on every Nth hit of the site (0 = off)
-    after: int = 0       # fire once the site's hit count exceeds this
+    # Fire once the site's hit count exceeds this; None = off.  Distinct
+    # from 0 so after(site, 0) means "from the first hit" — the
+    # plan.after(site, plan.hits(site)) idiom on a never-consulted site.
+    after: Optional[int] = None
     times: int = -1      # remaining firings (-1 = unlimited)
     prob: float = 0.0    # independent per-hit probability (0 = off)
     delay_s: float = 0.0  # for kind="delay"
@@ -93,7 +96,7 @@ class FaultPlan:
                 continue
             triggered = (
                 (rule.every and hit % rule.every == 0)
-                or (rule.after and hit > rule.after)
+                or (rule.after is not None and hit > rule.after)
                 or (rule.prob and self.rng.random() < rule.prob)
             )
             if triggered:

@@ -317,6 +317,9 @@ def encode_event(ev: WatchEvent) -> dict:
         "added": [_member(m) for m in ev.added],
         "removed": [_member(m) for m in ev.removed],
         "spanOnly": ev.span_only,
+        # Controller-commit stamp (dissemination-latency origin); omitted
+        # when unstamped so pre-existing captures stay byte-identical.
+        **({"ts": ev.ts} if ev.ts else {}),
     }
 
 
@@ -336,6 +339,7 @@ def decode_event(d: dict) -> WatchEvent:
         added=[_member_from(m) for m in d.get("added", ())],
         removed=[_member_from(m) for m in d.get("removed", ())],
         span_only=d.get("spanOnly", False),
+        ts=d.get("ts", 0.0),
     )
 
 

@@ -30,6 +30,7 @@ memory)."""
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, replace
 from typing import Callable, Optional
@@ -104,6 +105,12 @@ class RamStore:
     # -- producer side -------------------------------------------------------
 
     def apply(self, ev: WatchEvent) -> None:
+        # Controller-commit stamp (dissemination-latency origin): the
+        # moment the event enters the plane.  monotonic so it survives
+        # wall-clock jumps and stays comparable across same-host processes
+        # (the pipe/netwire transports); pre-stamped events keep theirs.
+        if not ev.ts:
+            ev = replace(ev, ts=time.monotonic())
         key = (ev.obj_type, ev.name)
         live = [w for w in self._watchers if not w._stopped]
         self._watchers = live
@@ -113,7 +120,8 @@ class RamStore:
                 if key in w._known:
                     w._known.discard(key)
                     w._deliver(WatchEvent(
-                        kind="DELETED", obj_type=ev.obj_type, name=ev.name
+                        kind="DELETED", obj_type=ev.obj_type, name=ev.name,
+                        ts=ev.ts,
                     ))
             return
 
@@ -129,7 +137,8 @@ class RamStore:
                 # Span shrank away from this node: retract the object.
                 w._known.discard(key)
                 w._deliver(WatchEvent(
-                    kind="DELETED", obj_type=ev.obj_type, name=ev.name
+                    kind="DELETED", obj_type=ev.obj_type, name=ev.name,
+                    ts=ev.ts,
                 ))
 
     # -- consumer side -------------------------------------------------------

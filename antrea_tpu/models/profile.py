@@ -1,0 +1,163 @@
+"""Churn-loop phase profiler for the stateful pipeline.
+
+The round-5 verdict's weak #1: the churn regime runs at ~0.5x the north
+star and ~3x below what the component numbers predict, and the slow-path
+loop had never been profiled.  This module attributes the churn-step time
+to named phases WITHOUT host-side timers (which lie in both directions on
+the tunneled platform, utils/timing.py): the slow path is compiled at a
+chain of cumulative phase masks (models/pipeline.PH_*), each variant is
+timed on-device with `device_loop_time`, and the per-phase cost is the
+telescoped difference between adjacent masks — so the phase breakdown sums
+EXACTLY to the full-step time by construction, and an independent
+full-step measurement cross-checks the chain (bench_profile.py gates on
++-15% agreement).
+
+Workload shape mirrors bench.measure_churn: a warmed hot set (established
+traffic, fast-path hits) with a rolling window of genuinely fresh flows
+from a pool replacing the first `n_new` lanes every step — every timed
+iteration pays the same miss work regardless of which phases are masked.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.timing import device_loop_time
+from . import pipeline as pl
+
+# The cumulative mask chain: phase k's cost = t(chain[k]) - t(chain[k-1]).
+# Order matters — each mask is a superset of the previous, and PH_EVICT
+# rides last because the eviction audit reads the commit's insert targets.
+PHASE_CHAIN: tuple[tuple[str, int], ...] = (
+    ("fast_path", 0),
+    ("miss_detect", pl.PH_SLOW),
+    ("service_lb", pl.PH_SLOW | pl.PH_LB),
+    ("classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
+    ("cache_commit", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+    ("eviction_scan", pl.PH_ALL),
+)
+
+
+def _dev_cols(batch) -> tuple:
+    """PacketBatch -> the pipeline's flipped/typed device columns."""
+    from ..utils import ip as iputil
+
+    return (
+        jnp.asarray(iputil.flip_u32(batch.src_ip)),
+        jnp.asarray(iputil.flip_u32(batch.dst_ip)),
+        jnp.asarray(batch.proto.astype(np.int32)),
+        jnp.asarray(batch.src_port.astype(np.int32)),
+        jnp.asarray(batch.dst_port.astype(np.int32)),
+    )
+
+
+def profile_churn(
+    meta: pl.PipelineMeta,
+    state: pl.PipelineState,
+    drs,
+    dsvc,
+    hot: tuple,
+    pool: Optional[tuple] = None,
+    *,
+    n_new: Optional[int] = None,
+    now0: int = 1000,
+    gen: int = 0,
+    k_small: int = 2,
+    k_big: int = 8,
+    repeats: int = 2,
+    chain: tuple = PHASE_CHAIN,
+) -> dict:
+    """Per-phase churn-loop breakdown -> structured dict.
+
+    hot/pool are 5-column tuples (src_f, dst_f, proto, sport, dport) of
+    device arrays — hot is the established set (warmed before timing),
+    pool supplies fresh flows (one lane per distinct flow); each timed
+    step replaces the first n_new hot lanes with the next rolling pool
+    window, so every iteration pays n_new genuine misses.  pool=None
+    times a pure fast-path (never-miss) regime — the slow-path phases
+    then measure only the lax.cond dispatch floor.
+
+    The state is treated functionally: the caller's `state` is never
+    mutated (warmup operates on a local copy of the carried pytree).
+    """
+    B = int(hot[0].shape[0])
+    if pool is not None:
+        pool_len = int(pool[0].shape[0])
+        if n_new is None:
+            n_new = max(1, B // 8)
+        if n_new > B or n_new >= pool_len:
+            raise ValueError(
+                f"n_new={n_new} must fit the batch ({B}) and pool "
+                f"({pool_len})"
+            )
+    else:
+        pool_len = 0
+        n_new = 0
+
+    # Warm the hot set (full-phase steps) so timed hot lanes are cache
+    # hits: two passes — classify + commit, then a hit pass to settle the
+    # partner-refresh stamps.
+    full = meta._replace(phases=pl.PH_ALL)
+    st = state
+    for w in range(2):
+        st, _ = pl.pipeline_step(
+            st, drs, dsvc, *hot, jnp.int32(now0 - 2 + w), jnp.int32(gen),
+            meta=full,
+        )
+
+    def timed(mask: int) -> float:
+        m = meta._replace(phases=mask)
+
+        def body(i, carry):
+            # acc leads the carry: device_loop_time fetches the FIRST leaf
+            # to detect completion (utils/timing.py), so it must change
+            # every iteration.
+            acc, cst, drs_, dsvc_, hcols, pcols = carry
+            if n_new:
+                off = (acc[1] * n_new) % (pool_len - n_new)
+
+                def mix(hcol, pcol):
+                    fresh = jax.lax.dynamic_slice(pcol, (off,), (n_new,))
+                    return jnp.concatenate([hcol[: B - n_new], fresh])
+
+                cols = tuple(mix(h, p) for h, p in zip(hcols, pcols))
+            else:
+                cols = hcols
+            cst, o = pl._pipeline_step(
+                cst, drs_, dsvc_, *cols, now0 + i, gen, meta=m,
+            )
+            acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
+            acc = acc.at[1].add(1)
+            return (acc, cst, drs_, dsvc_, hcols, pcols)
+
+        pcols = pool if pool is not None else hot  # unused when n_new == 0
+        carry = (jnp.zeros(8, jnp.int32), st, drs, dsvc, hot, pcols)
+        return device_loop_time(
+            body, carry, k_small=k_small, k_big=k_big, repeats=repeats
+        )
+
+    cumulative: dict[str, float] = {}
+    phases: dict[str, float] = {}
+    prev = 0.0
+    for name, mask in chain:
+        t = timed(mask)
+        cumulative[name] = t
+        # Raw telescoped difference: may go slightly negative under run-to-
+        # run jitter; kept UNCLAMPED so the phase sum equals the chain-end
+        # time exactly (the honesty property bench_profile gates on).
+        phases[name] = t - prev
+        prev = t
+    total = cumulative[chain[-1][0]]
+    return {
+        "batch": B,
+        "fresh_per_step": n_new,
+        "phases_s": phases,
+        "cumulative_s": cumulative,
+        "total_s": total,
+        "pps": B / total,
+        "phase_fractions": {k: v / total for k, v in phases.items()},
+    }

@@ -1,6 +1,17 @@
 """Observability plane: NP audit logging + metrics surface (SURVEY §5)."""
 
 from .audit import AuditLogger
-from .metrics import render_dissemination_metrics, render_metrics
+from .metrics import (
+    METRICS,
+    Histogram,
+    render_dissemination_metrics,
+    render_metrics,
+)
 
-__all__ = ["AuditLogger", "render_dissemination_metrics", "render_metrics"]
+__all__ = [
+    "AuditLogger",
+    "Histogram",
+    "METRICS",
+    "render_dissemination_metrics",
+    "render_metrics",
+]

@@ -4,16 +4,146 @@ The analog of the reference's agent metrics
 (/root/reference/pkg/agent/metrics/prometheus.go:33-188: rule counts,
 per-table flow counts, conntrack totals) rendered from this build's
 observable state: DatapathStats (per-rule packet counters), the flow-cache
-census (models/pipeline.cache_stats) and the cumulative eviction counter —
-the weak-#5 measurement surface.  render_metrics() is the scrape function;
-the simulator (or any collector) consumes the text directly.
+census (models/pipeline.cache_stats), the cumulative eviction counter (the
+weak-#5 measurement surface) and the latency histograms (datapath step,
+agent sync, controller-commit->datapath-realized dissemination).
+render_metrics() is the scrape function; the simulator (or any collector)
+consumes the text directly.
+
+Exposition discipline (enforced by tests/test_prom_exposition.py's strict
+parser and tools/check_metrics.py's README drift check):
+  * every emitted family is declared in METRICS (name -> type) and gets its
+    `# TYPE` line from _type_line — an undeclared name cannot be emitted;
+  * all label rendering goes through _labels (one escaping/formatting
+    path; empty values are omitted, so node="" composes everywhere).
 """
 
 from __future__ import annotations
 
+import bisect
+
+# The complete metric inventory: family name -> Prometheus type.  The ONE
+# registry tools/check_metrics.py diffs against the README "Observability"
+# table; render functions emit TYPE lines via _type_line so an unregistered
+# family fails loudly at render time, not silently at scrape time.
+METRICS: dict[str, str] = {
+    # controller (render_controller_metrics)
+    "antrea_tpu_controller_objects": "gauge",
+    "antrea_tpu_controller_connected_agents": "gauge",
+    # dissemination plane (render_dissemination_metrics)
+    "antrea_tpu_dissemination_watcher_pending": "gauge",
+    "antrea_tpu_dissemination_watcher_overflows_total": "counter",
+    "antrea_tpu_dissemination_watcher_needs_resync": "gauge",
+    "antrea_tpu_dissemination_resyncs_total": "counter",
+    "antrea_tpu_dissemination_reconnects_total": "counter",
+    "antrea_tpu_agent_reconnects_total": "counter",
+    "antrea_tpu_agent_resyncs_total": "counter",
+    "antrea_tpu_agent_sync_failures_total": "counter",
+    "antrea_tpu_agent_sync_seconds": "histogram",
+    "antrea_tpu_dissemination_latency_seconds": "histogram",
+    # datapath (render_metrics)
+    "antrea_tpu_rule_packets_total": "counter",
+    "antrea_tpu_rule_bytes_total": "counter",
+    "antrea_tpu_default_verdict_packets_total": "counter",
+    "antrea_tpu_flow_cache_entries": "gauge",
+    "antrea_tpu_flow_cache_slots": "gauge",
+    "antrea_tpu_flow_cache_evictions_total": "counter",
+    "antrea_tpu_datapath_step_seconds": "histogram",
+}
+
 
 def _esc(s: str) -> str:
-    return s.replace("\\", "\\\\").replace('"', '\\"')
+    # Label-value escaping per the exposition format: backslash, quote,
+    # AND newline (a raw newline inside a quoted value splits the sample
+    # line and breaks every scraper; rule names are user-controlled YAML).
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(**kv) -> str:
+    """Render a label set -> '{k="v",...}' (or '' when every value is
+    empty/None).  The single label-formatting path for all render
+    functions: insertion order is preserved, values are escaped."""
+    items = [(k, v) for k, v in kv.items() if v is not None and v != ""]
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(str(v))}"' for k, v in items) + "}"
+
+
+def _type_line(name: str) -> str:
+    return f"# TYPE {name} {METRICS[name]}"
+
+
+def _num(v: float) -> str:
+    """Prometheus float formatting: integral values render bare."""
+    return str(int(v)) if float(v) == int(v) else repr(float(v))
+
+
+class Histogram:
+    """Dependency-free Prometheus histogram (fixed upper bounds).
+
+    The exposition contract (prometheus.io/docs/instrumenting/exposition
+    _formats): cumulative `_bucket{le=...}` series ending in le="+Inf"
+    (== `_count`), plus `_sum`/`_count`.  Default bounds cover the
+    latencies this build observes: sub-ms device steps up to multi-second
+    dissemination convergence under backoff.
+    """
+
+    DEFAULT_BOUNDS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, bounds=DEFAULT_BOUNDS):
+        self.bounds: tuple[float, ...] = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self._counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def bucket_counts(self) -> list[int]:
+        """CUMULATIVE per-bound counts (le semantics), +Inf last."""
+        out, acc = [], 0
+        for c in self._counts:
+            acc += c
+            out.append(acc)
+        return out
+
+    def sample_lines(self, name: str, **labels) -> list[str]:
+        """The family's sample lines for ONE label set (no TYPE line —
+        several label sets may share a family; callers group them under a
+        single _type_line)."""
+        lines = []
+        cum = self.bucket_counts()
+        for bound, c in zip(self.bounds, cum):
+            lines.append(
+                f"{name}_bucket{_labels(**labels, le=_num(bound))} {c}"
+            )
+        lines.append(f'{name}_bucket{_labels(**labels, le="+Inf")} {self.count}')
+        lines.append(f"{name}_sum{_labels(**labels)} {repr(self.sum)}")
+        lines.append(f"{name}_count{_labels(**labels)} {self.count}")
+        return lines
+
+
+def _render_histograms(rows: list) -> list[str]:
+    """[(family, labels dict, Histogram)] -> exposition lines, grouped so
+    each family's TYPE line precedes all of its label sets exactly once."""
+    by_family: dict[str, list] = {}
+    for name, labels, hist in rows:
+        by_family.setdefault(name, []).append((labels, hist))
+    lines = []
+    for name, series in by_family.items():
+        lines.append(_type_line(name))
+        for labels, hist in series:
+            lines.extend(hist.sample_lines(name, **labels))
+    return lines
 
 
 def render_controller_metrics(controller, store=None) -> str:
@@ -21,18 +151,18 @@ def render_controller_metrics(controller, store=None) -> str:
     prometheus.go: antrea_controller_network_policy_processed etc. — here
     the live object gauges + the connected-agent gauge)."""
     counts = controller.object_counts()
-    lines = ["# TYPE antrea_tpu_controller_objects gauge"]
+    lines = [_type_line("antrea_tpu_controller_objects")]
     for key, kind in (
         ("networkPolicies", "network_policies"),
         ("addressGroups", "address_groups"),
         ("appliedToGroups", "applied_to_groups"),
     ):
         lines.append(
-            f'antrea_tpu_controller_objects{{kind="{kind}"}} {counts[key]}'
+            f"antrea_tpu_controller_objects{_labels(kind=kind)} {counts[key]}"
         )
     if store is not None:
         lines += [
-            "# TYPE antrea_tpu_controller_connected_agents gauge",
+            _type_line("antrea_tpu_controller_connected_agents"),
             f"antrea_tpu_controller_connected_agents {store.n_watchers}",
         ]
     return "\n".join(lines) + "\n"
@@ -42,109 +172,124 @@ def render_dissemination_metrics(server=None, agents=()) -> str:
     """Dissemination-plane health in Prometheus text — the scrape surface
     for the failure model (README "Failure model"): per-watcher queue
     depth/overflow/needs-resync from the server's dissemination_stats(),
-    plus per-agent reconnect/resync counters and the reconciler's
-    sync_failures_total.
+    per-agent reconnect/resync counters, the reconciler's
+    sync_failures_total, and the agent-side latency histograms (sync
+    duration + controller-commit->datapath-realized dissemination
+    latency).
 
     `server` is a DisseminationServer (or None for agent-only scrapes);
     `agents` is any iterable of NetAgent and/or AgentPolicyController —
     duck-typed, so a NetAgent contributes wire counters AND its embedded
-    controller's install-failure counter."""
+    controller's install-failure counter and histograms."""
     lines = []
     if server is not None:
         stats = server.dissemination_stats()
         watchers = sorted(stats["watchers"].items())
-        lines.append("# TYPE antrea_tpu_dissemination_watcher_pending gauge")
+        lines.append(_type_line("antrea_tpu_dissemination_watcher_pending"))
         for node, w in watchers:
             lines.append(
-                f'antrea_tpu_dissemination_watcher_pending{{node="{_esc(node)}"}} '
-                f'{w["pending"]}'
+                f"antrea_tpu_dissemination_watcher_pending{_labels(node=node)}"
+                f" {w['pending']}"
             )
-        lines.append(
-            "# TYPE antrea_tpu_dissemination_watcher_overflows_total counter")
+        lines.append(_type_line("antrea_tpu_dissemination_watcher_overflows_total"))
         for node, w in watchers:
             lines.append(
-                f'antrea_tpu_dissemination_watcher_overflows_total'
-                f'{{node="{_esc(node)}"}} {w["overflows"]}'
+                f"antrea_tpu_dissemination_watcher_overflows_total"
+                f"{_labels(node=node)} {w['overflows']}"
             )
-        lines.append(
-            "# TYPE antrea_tpu_dissemination_watcher_needs_resync gauge")
+        lines.append(_type_line("antrea_tpu_dissemination_watcher_needs_resync"))
         for node, w in watchers:
             lines.append(
-                f'antrea_tpu_dissemination_watcher_needs_resync'
-                f'{{node="{_esc(node)}"}} {int(w["needs_resync"])}'
+                f"antrea_tpu_dissemination_watcher_needs_resync"
+                f"{_labels(node=node)} {int(w['needs_resync'])}"
             )
         lines += [
-            "# TYPE antrea_tpu_dissemination_resyncs_total counter",
+            _type_line("antrea_tpu_dissemination_resyncs_total"),
             f"antrea_tpu_dissemination_resyncs_total {stats['resyncs_total']}",
-            "# TYPE antrea_tpu_dissemination_reconnects_total counter",
+            _type_line("antrea_tpu_dissemination_reconnects_total"),
             f"antrea_tpu_dissemination_reconnects_total "
             f"{stats['reconnects_total']}",
         ]
     agents = list(agents)
-    for metric, read in (
-        ("antrea_tpu_agent_reconnects_total counter",
+
+    # A NetAgent embeds its AgentPolicyController as .agent; a bare
+    # controller passed directly carries its fields itself.
+    def ctl(a):
+        return getattr(a, "agent", a)
+
+    for name, read in (
+        ("antrea_tpu_agent_reconnects_total",
          lambda a: getattr(a, "reconnects_total", None)),
-        ("antrea_tpu_agent_resyncs_total counter",
+        ("antrea_tpu_agent_resyncs_total",
          lambda a: getattr(a, "resyncs_total", None)),
-        # A NetAgent embeds its AgentPolicyController as .agent; a bare
-        # controller passed directly carries the counter itself.
-        ("antrea_tpu_agent_sync_failures_total counter",
-         lambda a: getattr(getattr(a, "agent", a),
-                           "sync_failures_total", None)),
+        ("antrea_tpu_agent_sync_failures_total",
+         lambda a: getattr(ctl(a), "sync_failures_total", None)),
     ):
         rows = [(a.node, read(a)) for a in agents if read(a) is not None]
         if rows:
-            name = metric.split(" ")[0]
-            lines.append(f"# TYPE {metric}")
+            lines.append(_type_line(name))
             for node, val in rows:
-                lines.append(f'{name}{{node="{_esc(node)}"}} {val}')
+                lines.append(f"{name}{_labels(node=node)} {val}")
+    hist_rows = []
+    for fam, attr in (
+        ("antrea_tpu_agent_sync_seconds", "sync_hist"),
+        ("antrea_tpu_dissemination_latency_seconds", "dissemination_hist"),
+    ):
+        for a in agents:
+            h = getattr(ctl(a), attr, None)
+            if h is not None and h.count:
+                hist_rows.append((fam, {"node": a.node}, h))
+    lines.extend(_render_histograms(hist_rows))
     return "\n".join(lines) + "\n"
 
 
 def render_metrics(datapath, node: str = "") -> str:
     """One Prometheus-text snapshot of a Datapath's observable state."""
     stats = datapath.stats()
-    lines = [
-        "# TYPE antrea_tpu_rule_packets_total counter",
-    ]
-    label_node = f',node="{_esc(node)}"' if node else ""
+    lines = [_type_line("antrea_tpu_rule_packets_total")]
     for direction, table in (("ingress", stats.ingress), ("egress", stats.egress)):
         for rule, count in sorted(table.items()):
             lines.append(
-                f'antrea_tpu_rule_packets_total{{direction="{direction}",'
-                f'rule="{_esc(rule)}"{label_node}}} {count}'
+                f"antrea_tpu_rule_packets_total"
+                f"{_labels(direction=direction, rule=rule, node=node)} {count}"
             )
     by_bytes = (("ingress", stats.ingress_bytes or {}),
                 ("egress", stats.egress_bytes or {}))
     if any(t for _d, t in by_bytes):
-        lines.append("# TYPE antrea_tpu_rule_bytes_total counter")
+        lines.append(_type_line("antrea_tpu_rule_bytes_total"))
         for direction, table in by_bytes:
             for rule, count in sorted(table.items()):
                 lines.append(
-                    f'antrea_tpu_rule_bytes_total{{direction="{direction}",'
-                    f'rule="{_esc(rule)}"{label_node}}} {count}'
+                    f"antrea_tpu_rule_bytes_total"
+                    f"{_labels(direction=direction, rule=rule, node=node)} "
+                    f"{count}"
                 )
     lines += [
-        "# TYPE antrea_tpu_default_verdict_packets_total counter",
-        f'antrea_tpu_default_verdict_packets_total{{verdict="allow"{label_node}}} '
-        f"{stats.default_allow}",
-        f'antrea_tpu_default_verdict_packets_total{{verdict="deny"{label_node}}} '
-        f"{stats.default_deny}",
+        _type_line("antrea_tpu_default_verdict_packets_total"),
+        f"antrea_tpu_default_verdict_packets_total"
+        f"{_labels(verdict='allow', node=node)} {stats.default_allow}",
+        f"antrea_tpu_default_verdict_packets_total"
+        f"{_labels(verdict='deny', node=node)} {stats.default_deny}",
     ]
     cs = getattr(datapath, "cache_stats", None)
     if cs is not None:
         c = cs()
+        lines.append(_type_line("antrea_tpu_flow_cache_entries"))
+        for kind in ("occupied", "committed", "denials"):
+            lines.append(
+                f"antrea_tpu_flow_cache_entries"
+                f"{_labels(kind=kind, node=node)} {c[kind]}"
+            )
         lines += [
-            "# TYPE antrea_tpu_flow_cache_entries gauge",
-            f'antrea_tpu_flow_cache_entries{{kind="occupied"{label_node}}} {c["occupied"]}',
-            f'antrea_tpu_flow_cache_entries{{kind="committed"{label_node}}} {c["committed"]}',
-            f'antrea_tpu_flow_cache_entries{{kind="denials"{label_node}}} {c["denials"]}',
-            "# TYPE antrea_tpu_flow_cache_slots gauge",
-            f"antrea_tpu_flow_cache_slots{{{label_node.lstrip(',')}}} {c['slots']}"
-            if node else f"antrea_tpu_flow_cache_slots {c['slots']}",
-            "# TYPE antrea_tpu_flow_cache_evictions_total counter",
-            f'antrea_tpu_flow_cache_evictions_total{{{label_node.lstrip(",")}}} '
-            f'{c["evictions"]}'
-            if node else f"antrea_tpu_flow_cache_evictions_total {c['evictions']}",
+            _type_line("antrea_tpu_flow_cache_slots"),
+            f"antrea_tpu_flow_cache_slots{_labels(node=node)} {c['slots']}",
+            _type_line("antrea_tpu_flow_cache_evictions_total"),
+            f"antrea_tpu_flow_cache_evictions_total{_labels(node=node)} "
+            f"{c['evictions']}",
         ]
+    sh = getattr(datapath, "step_hist", None)
+    if sh is not None and sh.count:
+        lines.extend(_render_histograms(
+            [("antrea_tpu_datapath_step_seconds", {"node": node}, sh)]
+        ))
     return "\n".join(lines) + "\n"

@@ -1,0 +1,126 @@
+"""Datapath profiler (ISSUE 2 tentpole part 1): phase-mask semantics of
+the churn loop and the Datapath.profile() surface on both engines.
+
+Timings on the hermetic CPU backend are noise — these tests assert the
+STRUCTURE (phase set, telescoped sum identity, state neutrality) and the
+phase-mask gating semantics (a commit-less mask never fills the cache);
+real numbers come from bench_profile.py on the TPU."""
+
+import numpy as np
+
+from antrea_tpu.compiler.compile import compile_policy_set
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.models import pipeline as pl
+from antrea_tpu.models.profile import PHASE_CHAIN, profile_churn
+from antrea_tpu.simulator import gen_cluster, gen_traffic
+
+SLOTS = 1 << 10
+
+
+def _world():
+    cluster = gen_cluster(60, n_nodes=2, pods_per_node=4, seed=5)
+    hot = gen_traffic(cluster.pod_ips, 32, n_flows=16, seed=6)
+    fresh = gen_traffic(cluster.pod_ips, 128, n_flows=128, seed=7,
+                        one_per_flow=True)
+    return cluster, hot, fresh
+
+
+def test_phase_mask_gating_semantics():
+    """PH_COMMIT off -> misses never fill the cache (repeat batch keeps
+    missing); full mask -> second step is all hits.  The gating must not
+    disturb the fast-path output image."""
+    import jax.numpy as jnp
+
+    from antrea_tpu.utils import ip as iputil
+
+    cluster, hot, _fresh = _world()
+    cps = compile_policy_set(cluster.ps)
+    svc = compile_services([])
+    step, state, (drs, dsvc) = pl.make_pipeline(
+        cps, svc, flow_slots=SLOTS, aff_slots=1 << 8, miss_chunk=16
+    )
+    cols = (
+        jnp.asarray(iputil.flip_u32(hot.src_ip)),
+        jnp.asarray(iputil.flip_u32(hot.dst_ip)),
+        jnp.asarray(hot.proto), jnp.asarray(hot.src_port),
+        jnp.asarray(hot.dst_port),
+    )
+    no_commit = step.meta._replace(
+        phases=pl.PH_SLOW | pl.PH_LB | pl.PH_CLS)
+    st = state
+    for now in (1, 2):
+        st, o = pl.pipeline_step(st, drs, dsvc, *cols, jnp.int32(now),
+                                 jnp.int32(0), meta=no_commit)
+        assert int(o["n_miss"]) == hot.size  # nothing was committed
+    st = state
+    st, o1 = pl.pipeline_step(st, drs, dsvc, *cols, jnp.int32(1),
+                              jnp.int32(0), meta=step.meta)
+    st, o2 = pl.pipeline_step(st, drs, dsvc, *cols, jnp.int32(2),
+                              jnp.int32(0), meta=step.meta)
+    assert int(o1["n_miss"]) == hot.size and int(o2["n_miss"]) == 0
+    # Verdicts of the commit-less walk match the full walk's fresh pass
+    # (classify ran identically; only state writes were masked).
+    _st, o_nc = pl.pipeline_step(state, drs, dsvc, *cols, jnp.int32(1),
+                                 jnp.int32(0), meta=no_commit)
+    assert o_nc["code"].tolist() == o1["code"].tolist()
+
+
+def test_tpuflow_profile_structure_and_state_neutrality():
+    cluster, hot, fresh = _world()
+    dp = TpuflowDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8,
+                         miss_chunk=16)
+    dp.step(hot, now=1)  # pre-existing state must survive profiling
+    before = dp.cache_stats()
+    prof = dp.profile(hot, fresh, n_new=8, k_small=1, k_big=2, repeats=1)
+    assert dp.cache_stats() == before  # observable-state neutral
+    expected = [name for name, _m in PHASE_CHAIN]
+    assert list(prof["phases_s"]) == expected
+    assert prof["total_s"] > 0 and prof["pps"] > 0
+    # Telescoped-sum identity: the breakdown sums EXACTLY to the chain end.
+    assert abs(sum(prof["phases_s"].values()) - prof["total_s"]) < 1e-12
+    assert abs(sum(prof["phase_fractions"].values()) - 1.0) < 1e-9
+    assert prof["fresh_per_step"] == 8 and prof["batch"] == hot.size
+
+
+def test_oracle_profile_structure_and_state_neutrality():
+    cluster, hot, _fresh = _world()
+    dp = OracleDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8)
+    dp.step(hot, now=1)
+    before = (dp.cache_stats(), dp.stats(), dp.step_hist.count)
+    prof = dp.profile(hot)
+    after = (dp.cache_stats(), dp.stats(), dp.step_hist.count)
+    assert before == after
+    assert set(prof["phases_s"]) == {"fast_path", "classify",
+                                     "commit_residual"}
+    assert prof["total_s"] > 0 and prof["pps"] > 0
+
+
+def test_profile_churn_direct_chain_override():
+    """profile_churn's chain override (bench_profile's independent
+    cross-check path) times a single full-mask variant."""
+    import jax.numpy as jnp
+
+    from antrea_tpu.utils import ip as iputil
+
+    cluster, hot, fresh = _world()
+    cps = compile_policy_set(cluster.ps)
+    svc = compile_services([])
+    step, state, (drs, dsvc) = pl.make_pipeline(
+        cps, svc, flow_slots=SLOTS, aff_slots=1 << 8, miss_chunk=16
+    )
+
+    def cols(tr):
+        return (
+            jnp.asarray(iputil.flip_u32(tr.src_ip)),
+            jnp.asarray(iputil.flip_u32(tr.dst_ip)),
+            jnp.asarray(tr.proto), jnp.asarray(tr.src_port),
+            jnp.asarray(tr.dst_port),
+        )
+
+    prof = profile_churn(
+        step.meta, state, drs, dsvc, cols(hot), cols(fresh), n_new=8,
+        k_small=1, k_big=2, repeats=1, chain=(("full", pl.PH_ALL),),
+    )
+    assert list(prof["phases_s"]) == ["full"]
+    assert prof["phases_s"]["full"] == prof["total_s"]

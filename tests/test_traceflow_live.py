@@ -1,0 +1,236 @@
+"""Live-traffic Traceflow (ISSUE 2 tentpole part 2): sampled real-packet
+traces, reconstructed per-stage from Datapath.trace(), must agree with the
+oracle engine across verdict scenarios — allowed, dropped-by-rule,
+default-deny — plus the droppedOnly filter and the 1-in-N sampler.
+
+Parity discipline (PR 1 lesson): every probe is a FRESH 5-tuple (unique
+src_port, monotonic now) so established flow-cache entries never mask the
+behavior under test."""
+
+import itertools
+import json
+import threading
+import time
+
+import numpy as np
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.controller.traceflow import (
+    TraceflowController,
+    TraceflowSpec,
+)
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+SLOTS = 1 << 10
+_SPORT = itertools.count(42000)  # fresh 5-tuples: unique src_port per probe
+_NOW = itertools.count(10)
+
+DROPPED_DST = "10.0.0.10"  # ACNP drops traffic FROM 10.0.0.5 only
+DENY_DST = "10.0.0.30"  # K8s NP isolates with zero rules: default deny
+OPEN_DST = "10.0.0.99"  # unregulated: default allow
+BLOCKED_SRC = "10.0.0.5"
+OTHER_SRC = "10.0.0.6"
+
+
+def _ps() -> PolicySet:
+    ps = PolicySet()
+    ps.applied_to_groups["atg-drop"] = cp.AppliedToGroup(
+        "atg-drop", [cp.GroupMember(ip=DROPPED_DST, node="n0")]
+    )
+    ps.applied_to_groups["atg-deny"] = cp.AppliedToGroup(
+        "atg-deny", [cp.GroupMember(ip=DENY_DST, node="n0")]
+    )
+    ps.address_groups["ag-blocked"] = cp.AddressGroup(
+        "ag-blocked", [cp.GroupMember(ip=BLOCKED_SRC, node="n0")]
+    )
+    ps.policies.append(cp.NetworkPolicy(
+        uid="drop-in", name="drop-in", type=cp.NetworkPolicyType.ACNP,
+        applied_to_groups=["atg-drop"], tier_priority=cp.TIER_APPLICATION,
+        priority=1.0,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(address_groups=["ag-blocked"]),
+            action=cp.RuleAction.DROP, priority=0,
+        )],
+    ))
+    # Zero-rule K8s NP with policyTypes=[IN]: pure isolation (default deny).
+    ps.policies.append(cp.NetworkPolicy(
+        uid="isolate", name="isolate", namespace="default",
+        type=cp.NetworkPolicyType.K8S, rules=[],
+        applied_to_groups=["atg-deny"], policy_types=[cp.Direction.IN],
+    ))
+    return ps
+
+
+def _pkt_batch(rows):
+    """rows: (src str, dst str, sport, dport)."""
+    return PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(r[0]) for r in rows], np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(r[1]) for r in rows], np.uint32),
+        proto=np.array([6] * len(rows), np.int32),
+        src_port=np.array([r[2] for r in rows], np.int32),
+        dst_port=np.array([r[3] for r in rows], np.int32),
+    )
+
+
+def _engines():
+    ps = _ps()
+    out = []
+    for dp in (
+        TpuflowDatapath(ps, [], flow_slots=SLOTS, aff_slots=1 << 8,
+                        miss_chunk=16),
+        OracleDatapath(ps, [], flow_slots=SLOTS, aff_slots=1 << 8),
+    ):
+        tfc = TraceflowController()
+        out.append((tfc, tfc.tap("n0", dp)))
+    return out
+
+
+def _live(dst, name, **kw):
+    return TraceflowSpec(
+        name=name, dst_ip=dst, proto=6, src_port=0, dst_port=80,
+        live_traffic=True, **kw,
+    )
+
+
+def test_live_verdict_scenarios_parity():
+    """Allowed / dropped-by-rule / default-deny live traces: sampled from
+    one real batch on each engine, identical status + per-stage verdicts."""
+    engines = _engines()
+    statuses = []
+    sport = {k: next(_SPORT) for k in ("open", "drop", "deny")}
+    now = next(_NOW)
+    for tfc, dp in engines:
+        tfc.start_live(_live(OPEN_DST, "t-open"), "n0")
+        tfc.start_live(_live(DROPPED_DST, "t-drop"), "n0")
+        tfc.start_live(_live(DENY_DST, "t-deny"), "n0")
+        batch = _pkt_batch([
+            (OTHER_SRC, OPEN_DST, sport["open"], 80),
+            (BLOCKED_SRC, DROPPED_DST, sport["drop"], 80),
+            (BLOCKED_SRC, DENY_DST, sport["deny"], 80),
+        ])
+        done = set()
+        r = dp.step(batch, now=now)
+        done = {n for n in ("t-open", "t-drop", "t-deny")
+                if tfc.results[n].phase == "Succeeded"}
+        assert done == {"t-open", "t-drop", "t-deny"}, (r.code, done)
+        statuses.append({n: tfc.results[n] for n in done})
+    tpu, orc = statuses
+    for name in ("t-open", "t-drop", "t-deny"):
+        assert tpu[name].verdict == orc[name].verdict, name
+        assert tpu[name].observations == orc[name].observations, name
+    assert tpu["t-open"].verdict == "Allow"
+    assert tpu["t-drop"].verdict == "Drop"
+    assert tpu["t-deny"].verdict == "Drop"
+    # Rule attribution: explicit rule vs K8s isolation (no rule).
+    ing = {s["component"]: s for s in tpu["t-drop"].observations}
+    assert ing["IngressSecurity"]["networkPolicyRule"] == "drop-in/In/0"
+    deny_ing = {s["component"]: s for s in tpu["t-deny"].observations}
+    assert deny_ing["IngressSecurity"]["action"] == "Dropped"
+    assert deny_ing["IngressSecurity"]["networkPolicyRule"] is None
+    # The sampled packet is reported verbatim.
+    cap = ing["Classification"]["capturedPacket"]
+    assert (cap["srcIP"], cap["srcPort"]) == (BLOCKED_SRC, sport["drop"])
+
+
+def test_live_dropped_only_skips_allowed_matches():
+    """droppedOnly: an ALLOWED packet matching the filter must NOT
+    complete the trace; the first DENIED match does — on both engines."""
+    for tfc, dp in _engines():
+        tfc.start_live(_live(DROPPED_DST, "t-do", dropped_only=True), "n0")
+        ok_sport, bad_sport = next(_SPORT), next(_SPORT)
+        # OTHER_SRC is not in the blocked group: allowed, matches filter.
+        dp.step(_pkt_batch([(OTHER_SRC, DROPPED_DST, ok_sport, 80)]),
+                now=next(_NOW))
+        assert tfc.results["t-do"].phase == "Running"
+        dp.step(_pkt_batch([(BLOCKED_SRC, DROPPED_DST, bad_sport, 80)]),
+                now=next(_NOW))
+        st = tfc.results["t-do"]
+        assert st.phase == "Succeeded" and st.verdict == "Drop"
+        cap = st.observations[0]["capturedPacket"]
+        assert cap["srcIP"] == BLOCKED_SRC and cap["srcPort"] == bad_sport
+        assert st.observations[0]["droppedOnly"] is True
+
+
+def test_live_sampling_captures_nth_match():
+    """sampling=2: the first matching packet is thinned out, the second
+    completes the trace."""
+    for tfc, dp in _engines():
+        tfc.start_live(_live(OPEN_DST, "t-s", sampling=2), "n0")
+        s1, s2 = next(_SPORT), next(_SPORT)
+        dp.step(_pkt_batch([(OTHER_SRC, OPEN_DST, s1, 80)]), now=next(_NOW))
+        assert tfc.results["t-s"].phase == "Running"
+        dp.step(_pkt_batch([(OTHER_SRC, OPEN_DST, s2, 80)]), now=next(_NOW))
+        st = tfc.results["t-s"]
+        assert st.phase == "Succeeded"
+        assert st.observations[0]["capturedPacket"]["srcPort"] == s2
+        assert st.observations[0]["sampling"] == 2
+
+
+def test_live_timeout_fails_session():
+    """A live session nothing matches fails at GC with a timeout status
+    and returns its tag to the pool."""
+    clock = [0.0]
+    tfc = TraceflowController(clock=lambda: clock[0])
+    dp = tfc.tap("n0", OracleDatapath(_ps(), [], flow_slots=SLOTS,
+                                      aff_slots=1 << 8))
+    tfc.start_live(_live(DROPPED_DST, "t-to",
+                         src_ip="10.9.9.9"), "n0")  # never matches
+    dp.step(_pkt_batch([(OTHER_SRC, OPEN_DST, next(_SPORT), 80)]),
+            now=next(_NOW))
+    assert tfc.results["t-to"].phase == "Running"
+    clock[0] = 1000.0
+    tfc.gc()
+    st = tfc.results["t-to"]
+    assert st.phase == "Failed"
+    assert "timeout" in st.observations[0]["action"]
+    assert len(tfc._free) == _free_full()
+
+
+def _free_full() -> int:
+    from antrea_tpu.controller.traceflow import _MAX_TAG
+
+    return _MAX_TAG
+
+
+def test_antctl_live_traceflow_end_to_end(capsys):
+    """antctl traceflow --live against a live agent API server whose
+    datapath is tapped: a background stepping loop supplies the traffic,
+    the CLI returns the sampled per-stage trace."""
+    from antrea_tpu import antctl
+    from antrea_tpu.agent.apiserver import AgentApiServer
+
+    tfc = TraceflowController()
+    dp = tfc.tap("n0", OracleDatapath(_ps(), [], flow_slots=SLOTS,
+                                      aff_slots=1 << 8))
+    srv = AgentApiServer(dp, node="n0", tf_controller=tfc).start()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            dp.step(_pkt_batch([
+                (BLOCKED_SRC, DROPPED_DST, next(_SPORT), 80),
+            ]), now=next(_NOW))
+            time.sleep(0.02)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        rc = antctl.main([
+            "traceflow", "--live", "--server", srv.address,
+            "--dst", DROPPED_DST, "--dport", "80", "--dropped-only",
+            "--wait", "10",
+        ])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0, out
+        assert out["phase"] == "Succeeded" and out["verdict"] == "Drop"
+        comps = [o["component"] for o in out["observations"]]
+        assert comps[0] == "Classification" and comps[-1] == "Output"
+        assert out["observations"][0]["capturedPacket"]["dstIP"] == DROPPED_DST
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        srv.close()
