@@ -151,16 +151,40 @@ class Service:
     icmp_code: Optional[int] = None
 
 
+@dataclass(frozen=True)
+class ServiceReference:
+    """Namespaced Service identity carried by a `toServices` peer.
+    Ref: controlplane.ServiceReference (types.go:371 — the internal form
+    the controller resolves crd ToServices into)."""
+
+    name: str
+    namespace: str = "default"
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.name)
+
+
 @dataclass
 class NetworkPolicyPeer:
-    """Rule peer: address groups and/or literal IP blocks. Ref: types.go:358."""
+    """Rule peer: address groups and/or literal IP blocks. Ref: types.go:358.
+
+    to_services (egress-only; ref types.go ToServices + the agent's
+    ServiceGroupID conjunction): the peer matches traffic RESOLVED to a
+    referenced Service by ServiceLB — lowered by the compiler into the
+    svc-key dimension's service-reference sub-space and matched against
+    the lane's LB resolution, so direct-to-endpoint traffic does NOT
+    match (the discriminator an IP-space lowering could not express).
+    Exclusive of the other peer forms per upstream validation."""
 
     address_groups: list[str] = field(default_factory=list)
     ip_blocks: list[IPBlock] = field(default_factory=list)
+    to_services: list[ServiceReference] = field(default_factory=list)
 
     @property
     def is_any(self) -> bool:
-        return not self.address_groups and not self.ip_blocks
+        return (not self.address_groups and not self.ip_blocks
+                and not self.to_services)
 
 
 @dataclass

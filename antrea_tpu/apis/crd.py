@@ -173,18 +173,39 @@ class K8sNetworkPolicy:
 
 
 @dataclass(frozen=True)
+class ServiceReference:
+    """Namespaced Service reference (crd NamespacedName, types.go:598 —
+    the `toServices` egress peer form)."""
+
+    name: str
+    namespace: str = "default"
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+@dataclass(frozen=True)
 class AntreaPeer:
     """ACNP/ANNP rule peer.  `group` references a ClusterGroup by name
     (crd NetworkPolicyPeer.group); `fqdn` is a domain-name peer whose
     membership is learned from the dataplane's DNS responses (ref
     pkg/agent/controller/networkpolicy/fqdn.go; egress rules only, per
-    upstream).  The forms are mutually exclusive per upstream validation."""
+    upstream).  The forms are mutually exclusive per upstream validation.
+
+    `to_services` (crd Rule.ToServices, types.go:598; resolved by the
+    reference controller in antreanetworkpolicy.go:130-131): the peer is
+    a set of SERVICES — the rule matches traffic addressed to any
+    frontend of a referenced Service (the ServiceGroupID conjunction of
+    the reference's openflow layer).  Egress-only; exclusive of every
+    other peer field and of rule ports, per upstream validation."""
 
     pod_selector: Optional[LabelSelector] = None
     ns_selector: Optional[LabelSelector] = None
     ip_block: Optional[IPBlock] = None
     group: str = ""
     fqdn: str = ""
+    to_services: tuple[ServiceReference, ...] = ()
 
 
 @dataclass(frozen=True)

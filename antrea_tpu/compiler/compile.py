@@ -67,6 +67,47 @@ FULL_SPACE = ((0, iputil.KEYSPACE_END),)
 
 _PORT_PROTOS = (PROTO_TCP, PROTO_UDP, PROTO_SCTP)
 
+# Service-reference sub-space of the svc key dimension (the toServices
+# lowering; ref controlplane ServiceReference + the agent's ServiceGroupID
+# conjunction).  Ordinary svc keys are (proto << 16 | dst_port) < 2^24;
+# keys at SVCREF_BASE + service_index express "the lane's ServiceLB
+# resolution IS service i" — probed by the pipeline with a SECOND svc-dim
+# key derived from the lane's resolved LB program (ops/match.classify_batch
+# svc_ref), so the two sub-spaces can never cross-match.  SVCREF_NONE is
+# the probe key of lanes with no service resolution: above every
+# reference (and every port key), inside only the match-all group — which
+# is correct, since a rule without port constraints matches any lane.
+SVCREF_BASE = 1 << 24
+SVCREF_NONE = 1 << 30
+
+
+def svcref_ranges(
+    refs, svc_index: dict
+) -> tuple[tuple[int, int], ...]:
+    """toServices references -> merged svc-key ranges in the reference
+    sub-space.  Unresolvable references (service unknown to this datapath)
+    contribute nothing — all-unresolved peers match no traffic, like the
+    reference's dangling ServiceReference."""
+    ranges = [
+        (SVCREF_BASE + idx, SVCREF_BASE + idx + 1)
+        for ref in refs
+        for idx in svc_index.get((ref.namespace, ref.name), ())
+    ]
+    return _merge(ranges)
+
+
+def service_index_of(services) -> dict:
+    """(namespace, name) -> list of service indices for toServices
+    resolution (every entry sharing the identity — e.g. the per-family
+    slices of a dual-stack Service — is referenced together, matching the
+    scalar oracle's identity compare).  Unnamed services are not
+    referenceable (no identity to match)."""
+    idx: dict[tuple[str, str], list[int]] = {}
+    for i, s in enumerate(services or ()):
+        if s.name:
+            idx.setdefault((s.namespace, s.name), []).append(i)
+    return idx
+
 
 def _svc_key_ranges(services: list[Service]) -> tuple[tuple[int, int], ...]:
     """Service list -> merged ranges over the (proto << 16 | dst_port) key.
@@ -226,6 +267,11 @@ class CompiledPolicySet:
     # The incremental-update path uses this to find every bitmap column a
     # named-group membership delta must patch.
     gid_ident: dict[int, tuple] = field(default_factory=dict)
+    # Any egress rule lowered a toServices peer into the svc-reference
+    # sub-space: the pipeline must derive + probe the second svc-dim key
+    # (ops/match StaticMeta.svcref), and a SERVICE-set change must
+    # recompile rules (reference indices shift with the service list).
+    has_svcref: bool = False
 
     # -- lazy (interval x group) introspection tables (test/debug surface) --
     # The kernel reads the rule-incidence tables from ops/match, never these;
@@ -266,12 +312,17 @@ class CompiledPolicySet:
 _flip = iputil.flip_u32
 
 
-def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
+def compile_policy_set(ps: PolicySet, services=None) -> CompiledPolicySet:
+    """services (list[ServiceEntry], optional): the datapath's Service view,
+    consumed ONLY by toServices peer lowering (svcref_ranges) — policies
+    without toServices compile identically with or without it."""
     from .ir import resolve_named_ports
 
     ps = resolve_named_ports(ps)
     ip_space = _GroupSpace()
     svc_space = _GroupSpace()
+    svc_index = service_index_of(services)
+    has_svcref = False
 
     ag_ranges: dict[str, tuple[tuple[int, int], ...]] = {
         name: tuple(g.ranges()) for name, g in ps.address_groups.items()
@@ -337,11 +388,37 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
                 phase, sort_key = 2, (p.tier_priority, p.priority, r.priority, p.uid)
             else:
                 phase, sort_key = 0, (p.tier_priority, p.priority, r.priority, p.uid)
+            if r.peer.to_services:
+                # toServices lowering: the peer's IP dimension is ANY (the
+                # match rides entirely on the lane's ServiceLB resolution)
+                # and its svc dimension is the reference sub-space
+                # (admission guarantees exclusivity with ports/other peer
+                # forms, and egress-only).
+                if r.direction != Direction.OUT:
+                    raise ValueError(
+                        f"policy {p.uid} rule {i}: toServices peers are "
+                        f"egress-only"
+                    )
+                if r.peer.address_groups or r.peer.ip_blocks or r.services:
+                    # The admission webhook enforces this upstream; a
+                    # controlplane object arriving without it must fail
+                    # loud, never silently drop the non-service peers.
+                    raise ValueError(
+                        f"policy {p.uid} rule {i}: toServices is exclusive "
+                        f"of other peers and of rule ports"
+                    )
+                has_svcref = True
+                pg = ip_space.any
+                sg = svc_space.intern(svcref_ranges(r.peer.to_services,
+                                                    svc_index))
+            else:
+                pg = peer_repr(r.peer)
+                sg = svc_space.intern(_svc_key_ranges(r.services))
             row = (
                 sort_key,
                 applied_gid(p, r),
-                peer_repr(r.peer),
-                svc_space.intern(_svc_key_ranges(r.services)),
+                pg,
+                sg,
                 _ACTION_CODE[r.action],
                 rule_id(p, i),
                 1 if r.l7_protocols else 0,
@@ -417,4 +494,5 @@ def compile_policy_set(ps: PolicySet) -> CompiledPolicySet:
         svc_groups=list(svc_space.groups),
         ag_gids=ag_gids,
         gid_ident=dict(ip_space.ident_of),
+        has_svcref=has_svcref,
     )

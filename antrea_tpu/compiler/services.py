@@ -76,6 +76,13 @@ class ServiceTables:
     # per-program: a ClusterIP and a NodePort of the same service share a
     # program but only the external entry is marked.
     slot_snat: np.ndarray
+    # (P,) i32 — OWNING service index of each LB program (cluster views:
+    # their own index; ETP=Local / DSR shadow views: the service they
+    # shadow).  The toServices probe key space (compiler/compile.py
+    # SVCREF_BASE) is service-indexed, so the pipeline maps a lane's
+    # resolved program through this before probing — any frontend of a
+    # referenced Service matches, whichever program realized it.
+    prog_svc: np.ndarray
     # (P,) i32 0/1 per PROGRAM — DSR delivery (ref pipeline.go
     # DSRServiceMark): DSR external frontends compile to a DEDICATED
     # program (never shared with the ClusterIP view).  The slow path reads
@@ -141,6 +148,7 @@ def compile_services(
             "aff": svc.affinity_timeout_s,
             "name": svc_name,
             "dsr": False,  # the ClusterIP path is always regular DNAT
+            "svc": si,
         })
     frontends: list[tuple[int, int, int, int]] = []  # (ip_key, key, prog, snat)
     for si, svc in enumerate(services):
@@ -161,6 +169,7 @@ def compile_services(
                 "aff": svc.affinity_timeout_s,
                 "name": progs[si]["name"],
                 "dsr": svc.dsr,
+                "svc": si,
             })
         elif svc.dsr:
             # DSR: dedicated program (full endpoint view) carrying the
@@ -171,6 +180,7 @@ def compile_services(
                 "aff": svc.affinity_timeout_s,
                 "name": progs[si]["name"],
                 "dsr": True,
+                "svc": si,
             })
         else:
             # Cluster policy: identical endpoint view — share the cluster
@@ -197,6 +207,7 @@ def compile_services(
     has_ep = np.zeros(P, dtype=np.int32)
     aff = np.zeros(P, dtype=np.int32)
     prog_dsr = np.zeros(P, dtype=np.int32)
+    prog_svc = np.zeros(P, dtype=np.int32)
     ep_base = np.zeros(P, dtype=np.int32)
     names: list[str] = [""] * P
     flat_ip: list[int] = []  # narrow u32 (0 for v6 rows — v4 lanes only)
@@ -209,6 +220,7 @@ def compile_services(
         has_ep[pi] = 1 if eps else 0
         aff[pi] = pr["aff"]
         prog_dsr[pi] = 1 if pr.get("dsr") else 0
+        prog_svc[pi] = pr.get("svc", pi)
         names[pi] = pr["name"]
         for ep in eps:
             k = iputil.ip_to_key(ep.ip)
@@ -278,6 +290,7 @@ def compile_services(
         ep_ip_f=_flip(np.asarray(flat_ip, dtype=np.uint32)),
         ep_port=np.asarray(flat_port, dtype=np.int32),
         slot_snat=slot_snat[order],
+        prog_svc=prog_svc,
         prog_dsr=prog_dsr,
         uip6_w=uip6_w,
         ppk6=ppk6,

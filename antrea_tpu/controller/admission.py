@@ -127,6 +127,8 @@ def _peer_forms(peer: AntreaPeer) -> int:
         forms += 1
     if peer.fqdn:
         forms += 1
+    if peer.to_services:
+        forms += 1
     return forms
 
 
@@ -250,6 +252,27 @@ def validate_antrea_policy(
                 _check_ip_block(peer.ip_block)
             if peer.fqdn and r.direction != cp.Direction.OUT:
                 _deny("fqdn peers are only supported in egress rules")
+            # toServices placement (validate.go toServices checks, crd
+            # types.go:598): egress-only, exclusive of rule ports (the
+            # referenced Services' own (proto, port) define the match),
+            # and exclusive of every OTHER peer in the rule — upstream
+            # rejects ToServices combined with `to`, and a merged rule
+            # peer would otherwise silently drop the non-service peers
+            # (the compiler's to_services branch matches on the ServiceLB
+            # resolution alone).
+            if peer.to_services:
+                if r.direction != cp.Direction.OUT:
+                    _deny("`toServices` can only be used in egress rules")
+                if r.ports:
+                    _deny(
+                        "`toServices` cannot be used with `ports` in the "
+                        "same rule"
+                    )
+                if len(r.peers) > 1:
+                    _deny(
+                        "`toServices` cannot be used with other rule "
+                        "peers"
+                    )
         _check_ports(r.ports, f"rule {r.name or r.direction.value}")
         # L7 rules must be Allow (validate.go:938-971).
         if r.l7_protocols and r.action != cp.RuleAction.ALLOW:

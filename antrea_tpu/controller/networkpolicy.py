@@ -602,7 +602,18 @@ class NetworkPolicyController:
             return cp.NetworkPolicyPeer()
         groups: list[str] = []
         blocks: list[cp.IPBlock] = []
+        svc_refs: list[cp.ServiceReference] = []
         for p in peers:
+            if p.to_services:
+                # toServices resolves to internal ServiceReference peers
+                # (ref antreanetworkpolicy.go:130-131); the agent-side
+                # compiler lowers them into the svc-key space against its
+                # own Service view.
+                svc_refs.extend(
+                    cp.ServiceReference(name=sr.name, namespace=sr.namespace)
+                    for sr in p.to_services
+                )
+                continue
             if p.fqdn:
                 groups.append(self._ensure_fqdn_group(p.fqdn, anp.uid))
                 continue
@@ -621,7 +632,8 @@ class NetworkPolicyController:
                 sel = GroupSelector(namespace=anp.namespace,
                                     pod_selector=p.pod_selector or LabelSelector())
             groups.append(self._ensure_group(self._ags, sel, anp.uid, "AddressGroup"))
-        return cp.NetworkPolicyPeer(address_groups=groups, ip_blocks=blocks)
+        return cp.NetworkPolicyPeer(address_groups=groups, ip_blocks=blocks,
+                                    to_services=svc_refs)
 
     # -- install / delete ----------------------------------------------------
 

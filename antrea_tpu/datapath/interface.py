@@ -55,6 +55,13 @@ class StepResult:
     egress_rule: list
     committed: np.ndarray  # 0/1 — conntrack commit happened this step
     n_miss: int
+    # 0/1 — the lane was a cache miss ADMITTED to the async miss queue
+    # (datapath/slowpath): its `code` is the admission policy's
+    # PROVISIONAL verdict (default-forward ALLOW or hold DROP), not a
+    # classification; the flow's real verdict lands when the background
+    # engine drains the queue.  None on synchronous datapaths (misses
+    # classify inline).
+    pending: np.ndarray = None
     # 0/1 — reverse-tuple (reply-direction) conntrack hit: the packet is the
     # reply leg of a committed connection (endpoint -> client); dnat_ip/
     # dnat_port then carry the un-DNAT source rewrite (ref UnSNAT/
@@ -164,6 +171,87 @@ class Datapath(ABC):
         packet, the stage-by-stage observations WITHOUT mutating any state.
         Keys: cache_hit, est, svc_idx, dnat_ip, dnat_port, egress_code,
         egress_rule, ingress_code, ingress_rule, code."""
+
+    # -- async slow-path surface (datapath/slowpath; both engines) ----------
+    # Shared plumbing: each engine implements the CLASSIFY callbacks
+    # (_drain_classify/_epoch_revalidate/_epoch_age_scan) and calls
+    # _init_slowpath from its constructor; queue admission, drain
+    # orchestration, dumps and stats live here once so the two twins
+    # cannot drift on the observability surface.  Synchronous instances
+    # keep _slowpath = None and the inert defaults.
+
+    _slowpath = None  # the SlowPathEngine of async instances
+    _async = False
+
+    def _init_slowpath(self, async_slowpath: bool, dual_stack: bool,
+                       miss_queue_slots: int, admission: str,
+                       drain_batch: int) -> None:
+        """Constructor hook: validate + build the engine (async mode is
+        v4-only for now, like profile() probes — the queue columns are
+        narrow)."""
+        if async_slowpath and dual_stack:
+            raise ValueError(
+                "async slow-path mode is v4-only; dual-stack instances "
+                "use the synchronous slow path"
+            )
+        self._async = async_slowpath
+        if async_slowpath:
+            from .slowpath import SlowPathEngine
+
+            self._slowpath = SlowPathEngine(
+                self, capacity=miss_queue_slots, admission=admission,
+                drain_batch=drain_batch,
+            )
+
+    @staticmethod
+    def _queue_cols(batch: PacketBatch, flags, lens) -> dict:
+        """The miss queue's admission columns from a stepped batch (one
+        schema for both engines — MissQueue.COLUMNS sans epoch/enq_ts)."""
+        return {
+            "src_ip": batch.src_ip.astype(np.int64),
+            "dst_ip": batch.dst_ip.astype(np.int64),
+            "proto": batch.proto.astype(np.int64),
+            "src_port": batch.src_port.astype(np.int64),
+            "dst_port": batch.dst_port.astype(np.int64),
+            "flags": np.asarray(flags).astype(np.int64),
+            "lens": np.asarray(lens).astype(np.int64),
+        }
+
+    def drain_slowpath(self, now: int, max_batches: Optional[int] = None) -> dict:
+        """Classify queued misses in coalesced batches and publish the new
+        cache epoch -> stats dict (drained/batches/revalidated/...)."""
+        if self._slowpath is None:
+            raise RuntimeError(
+                f"{type(self).__name__} was built without the async "
+                f"slow-path engine (async_slowpath=False): misses classify "
+                f"inline and there is nothing to drain"
+            )
+        return self._slowpath.drain(now, max_batches)
+
+    def dump_miss_queue(self) -> list[dict]:
+        """Queued (not-yet-classified) miss-queue rows, FIFO order — the
+        queued-state half of the conntrack dump.  Empty when synchronous."""
+        if self._slowpath is None:
+            return []
+        from ..utils import ip as iputil
+
+        return [
+            {
+                "src": iputil.u32_to_ip(r["src_ip"]),
+                "dst": iputil.u32_to_ip(r["dst_ip"]),
+                "proto": r["proto"],
+                "sport": r["src_port"],
+                "dport": r["dst_port"],
+                "epoch": r["epoch"],
+                "enqueued_at": r["enq_ts"],
+            }
+            for r in self._slowpath.queue.dump()
+        ]
+
+    def slowpath_stats(self) -> Optional[dict]:
+        """Engine/queue/epoch counters for the metrics plane (None when
+        synchronous)."""
+        return None if self._slowpath is None else self._slowpath.stats()
 
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
                 **kw) -> dict:

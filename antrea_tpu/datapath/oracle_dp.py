@@ -41,13 +41,14 @@ from ..compiler.topology import (
     oracle_spoof,
     resolve_topology,
 )
-from ..compiler.compile import ACT_ALLOW
+from ..compiler.compile import ACT_ALLOW, ACT_DROP
 from ..observability.metrics import Histogram
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..utils import ip as iputil
 from ..packet import PacketBatch
 from . import persist
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
+from .slowpath import ADMIT_HOLD
 
 
 def _group_ranges(g) -> set:
@@ -79,11 +80,20 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         feature_gates=None,
         topology: Optional[Topology] = None,
         dual_stack: bool = False,
+        async_slowpath: bool = False,
+        miss_queue_slots: int = 1 << 16,
+        admission: str = "forward",
+        drain_batch: int = 4096,
     ):
         from ..features import DEFAULT_GATES
 
         self._gates = feature_gates or DEFAULT_GATES
         self._dual_stack = dual_stack
+        # Async slow path — the scalar twin of TpuflowDatapath's engine,
+        # same admission/drain/epoch semantics (shared plumbing on the
+        # Datapath base) so the differential harness diffs mode-for-mode.
+        self._init_slowpath(async_slowpath, dual_stack, miss_queue_slots,
+                            admission, drain_batch)
         self._flow_stats = self._gates.enabled("FlowExporter")
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -162,6 +172,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             ps=ps, services=list(services) if services is not None else None
         )
         self._gen += 1
+        if self._slowpath is not None:
+            self._slowpath.mark_stale(self._gen)
         self._persist()
         return self._gen
 
@@ -199,6 +211,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             return self._gen
         self._oracle.update(ps=self._ps)
         self._gen += 1
+        if self._slowpath is not None:
+            self._slowpath.mark_stale(self._gen)
         # Delta path marks dirty instead of rewriting the whole snapshot —
         # see TpuflowDatapath.apply_group_delta for the recovery contract;
         # the generation itself is journaled (cookie-round append).
@@ -262,8 +276,60 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             "evictions": self._oracle.evictions,
         }
 
+    # -- async slow path (scalar twin of TpuflowDatapath's engine; shared
+    # drain/dump/stats plumbing lives on the Datapath base) ------------------
+
+    def _drain_classify(self, block: dict, now: int) -> None:
+        """One popped queue block through the full scalar slow path — the
+        same batch-simultaneous semantics and no-commit gating as the
+        device drain step, and the point where each queued packet's real
+        attribution is counted."""
+        from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
+
+        batch = PacketBatch(
+            src_ip=block["src_ip"].astype(np.uint32),
+            dst_ip=block["dst_ip"].astype(np.uint32),
+            proto=block["proto"].astype(np.int32),
+            src_port=block["src_port"].astype(np.int32),
+            dst_port=block["dst_port"].astype(np.int32),
+            tcp_flags=block["flags"].astype(np.int32),
+            pkt_len=block["lens"].astype(np.int32),
+        )
+        flags = batch.flags()
+        lens = np.maximum(batch.lens(), 0)
+        no_commit = [
+            is_mcast_u32(batch.dst_key(i))
+            or (int(batch.proto[i]) == PROTO_TCP
+                and (int(flags[i]) & _TEARDOWN_FLAGS) != 0)
+            for i in range(batch.size)
+        ]
+        outs = self._oracle.step(
+            batch, now, gen=self._gen, no_commit=no_commit, flags=flags,
+            lens=lens if self._flow_stats else None,
+        )
+        self._count_outcomes(outs, lens)
+
+    def _epoch_revalidate(self) -> int:
+        from ..models.pipeline import GEN_ETERNAL
+
+        o = self._oracle
+        gen_w = self._gen % GEN_ETERNAL
+        stale = [s for s, e in o.flow.items()
+                 if e["gen"] is not None and e["gen"] != gen_w]
+        for s in stale:
+            del o.flow[s]
+        return len(stale)
+
+    def _epoch_age_scan(self, now: int) -> int:
+        o = self._oracle
+        dead = [s for s, e in o.flow.items()
+                if (now - e["ts"]) > o.timeout_of(e, e["key"][3])]
+        for s in dead:
+            del o.flow[s]
+        return len(dead)
+
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
-                *, now: int = 1000, **_kw) -> dict:
+                *, now: int = 1000, mode: str = "sync", **_kw) -> dict:
         """Coarse host-timed phase split (the scalar twin of the kernel's
         six-phase device chain, TpuflowDatapath.profile): fast_path =
         cache lookup of every lane, classify = the fresh ServiceLB+
@@ -271,7 +337,14 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         actually pays — a warmed hot set classifies nothing), and
         commit_residual = full step minus both (the commit bookkeeping +
         output assembly).  State and counters are snapshotted and
-        restored — profiling is observable-state-neutral."""
+        restored — profiling is observable-state-neutral.
+
+        mode="async" reports the decoupled-regime names (async_fast_path /
+        drain_classify / drain_commit_residual) over the same coarse
+        split — on the scalar engine the fast-lookup and miss-walk costs
+        ARE the fast-step and drain costs."""
+        if mode not in ("sync", "async"):
+            raise ValueError(f"unknown profile mode {mode!r}")
         from ..models.pipeline import GEN_ETERNAL
 
         o = self._oracle
@@ -313,11 +386,18 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             (self.step_hist._counts, self.step_hist.sum,
              self.step_hist.count) = hist_snap
         n = len(packets)
-        phases = {
-            "fast_path": t_fast,
-            "classify": t_cls,
-            "commit_residual": max(total - t_fast - t_cls, 0.0),
-        }
+        if mode == "async":
+            phases = {
+                "async_fast_path": t_fast,
+                "drain_classify": t_cls,
+                "drain_commit_residual": max(total - t_fast - t_cls, 0.0),
+            }
+        else:
+            phases = {
+                "fast_path": t_fast,
+                "classify": t_cls,
+                "commit_residual": max(total - t_fast - t_cls, 0.0),
+            }
         return {
             "batch": n,
             "fresh_per_step": 0 if fresh is None else fresh.size,
@@ -358,7 +438,14 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             else:
                 eff_dst = w["dnat_ip"]
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
+            queued = (
+                self._slowpath is not None
+                and self._slowpath.queue.contains(
+                    int(p.src_ip), int(p.dst_ip), int(batch.proto[i]),
+                    int(batch.src_port[i]), int(batch.dst_port[i]))
+            )
             out.append({
+                "queued": queued,
                 "spoofed": oracle_spoof(self._rt, p.src_ip, int(in_ports[i])),
                 "fwd_kind": f["kind"],
                 "out_port": f["out_port"],
@@ -435,18 +522,40 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                     and (int(flags[i]) & _TEARDOWN_FLAGS) != 0)
             )
         lens = np.maximum(batch.lens(), 0)
+        fast_only = None
+        if self._async:
+            fast_only = (ACT_DROP
+                         if self._slowpath.admission == ADMIT_HOLD
+                         else ACT_ALLOW)
         outs = self._oracle.step(
             batch, now, gen=self._gen, lane_modes=lane_modes,
             no_commit=no_commit, flags=flags,
             lens=lens if self._flow_stats else None,
+            fast_only=fast_only,
         )
+        if self._async:
+            pend = np.array([o.pending for o in outs], bool)
+            if pend.any():
+                self._slowpath.admit(
+                    self._queue_cols(batch, flags, lens), pend, now,
+                )
         fwd = self._forward_fields(batch, outs, in_ports, lane_modes,
                                    arp_ops)
+        self._count_outcomes(outs, lens)
+        return self._to_result(outs, fwd)
+
+    def _count_outcomes(self, outs, lens) -> None:
+        """NetworkPolicyStats accounting shared by step() and the drain
+        path — one implementation so the counted-exactly-once contract
+        (skipped lanes never, pending lanes at drain time) cannot drift
+        between the two."""
         if not self._gates.enabled("NetworkPolicyStats"):
-            return self._to_result(outs, fwd)
+            return
         for i, o in enumerate(outs):
             if o.skipped:
                 continue  # SpoofGuard drop: before the policy tables
+            if o.pending:
+                continue  # provisional verdict: counted at drain time
             ln = int(lens[i])
             if o.ingress_rule is not None:
                 self._stats_in[o.ingress_rule] += 1
@@ -461,7 +570,6 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
                     self._default_allow += 1
                 else:
                     self._default_deny += 1
-        return self._to_result(outs, fwd)
 
     def _forward_fields(
         self, batch: PacketBatch, outs, in_ports, lane_modes, arp_ops=None
@@ -546,6 +654,8 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         return StepResult(
             code=np.array([o.code for o in outs], np.int32),
             est=np.array([int(o.est) for o in outs], np.int32),
+            pending=(np.array([int(o.pending) for o in outs], np.int32)
+                     if self._async else None),
             svc_idx=np.array([o.svc_idx for o in outs], np.int32),
             dnat_ip=np.array([narrow(o.dnat_ip) for o in outs], np.uint32),
             dnat_port=np.array([o.dnat_port for o in outs], np.int32),

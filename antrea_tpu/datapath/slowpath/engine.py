@@ -1,0 +1,212 @@
+"""Background classification engine + epoch-swapped publication.
+
+One engine instance serves one datapath (either twin — the owner is
+duck-typed).  The contract with the owner:
+
+  owner.generation           current bundle generation (epoch staleness)
+  owner._drain_classify(block, now)
+                             run the full slow path over one popped queue
+                             block (ServiceLB -> classify -> commit via
+                             the coalesced drain step), publish the new
+                             cache state, and account rule metrics
+  owner._epoch_revalidate()  reclaim stale-generation denial entries off
+                             the hot step -> count (lazy revalidation;
+                             established entries untouched)
+  owner._epoch_age_scan(now) reclaim idle-expired entries -> count
+
+Admission policies (the provisional verdict a queued miss carries until
+the engine classifies its flow):
+
+  ADMIT_FORWARD  default-forward (ACT_ALLOW, no DNAT): the packet
+                 proceeds un-rewritten while its flow awaits
+                 classification — the OVS "handle the first packet in
+                 userspace, let it through per the default" shape.
+  ADMIT_HOLD     drop until classified (ACT_DROP): strict admission for
+                 deny-by-default postures; the flow passes only after a
+                 drain has committed its verdict.
+
+Epoch discipline: every published slow-plane mutation (drain commit,
+revalidation, aging scan) bumps `epoch`; `install_bundle` marks the
+current epoch STALE (`mark_stale`).  A stale epoch is healed lazily —
+the next drain first runs the owner's revalidation scan (reclaiming
+dead denial slots; nothing is flushed), and an in-flight drain whose
+bundle generation changed between `begin_drain` and `finish_drain` is
+re-classified under the NEW tensors (counted in
+`stale_reclassified_total`) instead of publishing stale verdicts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...observability.metrics import Histogram
+from .queue import MissQueue
+
+ADMIT_FORWARD = "forward"
+ADMIT_HOLD = "hold"
+
+# Drain-batch sizes are packet counts, not seconds: dedicated bounds.
+_DRAIN_BOUNDS = (16, 64, 256, 1024, 4096, 16384, 65536)
+
+
+class SlowPathEngine:
+    def __init__(
+        self,
+        owner,
+        *,
+        capacity: int = 1 << 16,
+        admission: str = ADMIT_FORWARD,
+        drain_batch: int = 4096,
+    ):
+        if admission not in (ADMIT_FORWARD, ADMIT_HOLD):
+            raise ValueError(
+                f"unknown admission policy {admission!r} "
+                f"(expected {ADMIT_FORWARD!r} or {ADMIT_HOLD!r})"
+            )
+        if drain_batch <= 0:
+            raise ValueError(f"drain_batch must be positive, got {drain_batch}")
+        self.owner = owner
+        self.queue = MissQueue(capacity)
+        self.admission = admission
+        self.drain_batch = int(drain_batch)
+        self.epoch = 1
+        self.stale = False  # bundle swapped since the last publish
+        self.drains_total = 0  # published drain batches
+        self.stale_reclassified_total = 0  # in-flight rows re-classified
+        self.revalidations_total = 0
+        self.revalidated_entries_total = 0
+        self.aged_entries_total = 0
+        self.drain_hist = Histogram(bounds=_DRAIN_BOUNDS)
+        self._inflight: Optional[tuple[dict, int, int]] = None
+        # Packet-clock bookkeeping for the epoch-age gauge: the engine sees
+        # time only through the `now` its callers pass (the datapath's own
+        # clock), so age is measured on that clock.
+        self._published_at = 0
+        self._seen_now = 0
+
+    # -- admission (fast-step side) ------------------------------------------
+
+    def admit(self, cols: dict, miss_mask, now: int) -> tuple[int, int]:
+        """Admit the fast step's miss lanes -> (admitted, dropped)."""
+        self._seen_now = max(self._seen_now, int(now))
+        if self._published_at == 0:
+            # Epoch age is measured from the last publish; before the
+            # first one, anchor to the first traffic the engine sees so
+            # the gauge reports time-since-birth, not the raw clock.
+            self._published_at = int(now)
+        return self.queue.admit(cols, miss_mask, self.epoch, int(now))
+
+    # -- epoch plane ---------------------------------------------------------
+
+    def _publish(self, now: int) -> None:
+        self.epoch += 1
+        self._published_at = int(now)
+        self._seen_now = max(self._seen_now, int(now))
+
+    def mark_stale(self, gen: int) -> None:
+        """A bundle swap invalidated the current epoch: denials of older
+        generations are dead to lookups already; the next drain reclaims
+        them lazily and any in-flight drain re-classifies (no flush)."""
+        del gen  # staleness is a flag; the owner always classifies at its CURRENT gen
+        self.stale = True
+
+    def epoch_age(self, now: Optional[int] = None) -> int:
+        """Seconds (packet clock) since the last epoch publish."""
+        ref = self._seen_now if now is None else int(now)
+        return max(0, ref - self._published_at)
+
+    def revalidate(self, now: int) -> int:
+        """Lazy revalidation pass: reclaim stale-generation denial slots
+        off the hot step, publish, clear the stale flag -> entries cleared."""
+        cleared = int(self.owner._epoch_revalidate())
+        self.revalidations_total += 1
+        self.revalidated_entries_total += cleared
+        self.stale = False
+        self._publish(now)
+        return cleared
+
+    def age_scan(self, now: int) -> int:
+        """Off-hot-step aging: physically reclaim idle-expired entries
+        (the synchronous path leaves them to die by lookup-freshness) —
+        publish via epoch swap; -> entries reclaimed."""
+        reclaimed = int(self.owner._epoch_age_scan(now))
+        self.aged_entries_total += reclaimed
+        self._publish(now)
+        return reclaimed
+
+    # -- drain (background side) ---------------------------------------------
+
+    def begin_drain(self, now: int, n: Optional[int] = None) -> bool:
+        """Pop one coalesced batch and pin it with its epoch + bundle
+        generation; False when the queue is empty.  Split from
+        finish_drain so callers (and the chaos tier) can interleave a
+        bundle swap with an in-flight drain."""
+        if self._inflight is not None:
+            raise RuntimeError("a drain batch is already in flight")
+        block = self.queue.pop(n if n is not None else self.drain_batch)
+        if block is None:
+            return False
+        self._inflight = (block, self.epoch, int(self.owner.generation))
+        self._seen_now = max(self._seen_now, int(now))
+        return True
+
+    def finish_drain(self, now: int) -> dict:
+        """Classify + commit the in-flight batch and publish the new cache
+        epoch.  If the bundle generation moved since begin_drain, the
+        batch's pinned epoch is stale: it is re-classified under the
+        CURRENT tensors (lazy revalidation of in-flight work) and counted,
+        never published stale and never dropped."""
+        if self._inflight is None:
+            raise RuntimeError("no drain batch in flight")
+        block, _epoch0, gen0 = self._inflight
+        self._inflight = None
+        k = len(block["src_ip"])
+        stale = int(self.owner.generation) != gen0
+        if stale:
+            self.stale_reclassified_total += k
+        self.owner._drain_classify(block, int(now))
+        self.drains_total += 1
+        self.drain_hist.observe(k)
+        self._publish(now)
+        return {"drained": k, "stale_reclassified": k if stale else 0}
+
+    def drain(self, now: int, max_batches: Optional[int] = None) -> dict:
+        """Drain the queue: heal a stale epoch first (lazy revalidation),
+        then classify up to max_batches coalesced batches -> stats."""
+        stats = {"drained": 0, "batches": 0, "stale_reclassified": 0,
+                 "revalidated": 0}
+        if self.stale:
+            stats["revalidated"] = self.revalidate(now)
+        while max_batches is None or stats["batches"] < max_batches:
+            if not self.begin_drain(now):
+                break
+            one = self.finish_drain(now)
+            stats["drained"] += one["drained"]
+            stats["stale_reclassified"] += one["stale_reclassified"]
+            stats["batches"] += 1
+        return stats
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        q = self.queue
+        return {
+            "depth": q.depth,
+            "capacity": q.capacity,
+            "admitted_total": q.admitted_total,
+            "overflows_total": q.overflows_total,
+            "drained_total": q.drained_total,
+            "drains_total": self.drains_total,
+            "stale_reclassified_total": self.stale_reclassified_total,
+            "revalidations_total": self.revalidations_total,
+            "revalidated_entries_total": self.revalidated_entries_total,
+            "aged_entries_total": self.aged_entries_total,
+            "epoch": self.epoch,
+            "epoch_stale": int(self.stale),
+            "epoch_age_s": self.epoch_age(),
+            "admission": self.admission,
+            "drain_batch": self.drain_batch,
+            # Live Histogram object (coalesced drain sizes) for the
+            # metrics renderer; scalar consumers ignore it.
+            "drain_hist": self.drain_hist,
+        }

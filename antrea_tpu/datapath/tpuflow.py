@@ -35,9 +35,9 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from ..apis.controlplane import GroupMember
+from ..apis.controlplane import GroupMember, PROTO_TCP
 from ..apis.service import ServiceEntry
-from ..compiler.compile import compile_policy_set
+from ..compiler.compile import ACT_ALLOW, ACT_DROP, compile_policy_set
 from ..compiler.ir import PolicySet
 from ..compiler.services import compile_services
 from ..compiler import topology
@@ -50,6 +50,7 @@ from ..packet import PacketBatch
 from ..utils import ip as iputil
 from . import persist
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
+from .slowpath import ADMIT_HOLD
 
 
 class TpuflowDatapath(persist.PersistableDatapath, Datapath):
@@ -73,6 +74,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         feature_gates=None,
         topology: Optional[Topology] = None,
         dual_stack: bool = False,
+        async_slowpath: bool = False,
+        miss_queue_slots: int = 1 << 16,
+        admission: str = "forward",
+        drain_batch: int = 4096,
     ):
         from ..features import DEFAULT_GATES
 
@@ -87,6 +92,12 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # proxier.go:1379-1465 / route_linux.go).  Static per instance:
         # pure-v4 nodes keep the narrow fast path compiled unchanged.
         self._dual_stack = dual_stack
+        # Async slow path (datapath/slowpath): step() runs ONLY the fast
+        # path; misses are admitted to the bounded queue with a provisional
+        # verdict and classified later by drain_slowpath() in coalesced
+        # batches (shared plumbing on the Datapath base).
+        self._init_slowpath(async_slowpath, dual_stack, miss_queue_slots,
+                            admission, drain_batch)
         # Node identity: NodePort frontends bind to these addresses and
         # externalTrafficPolicy=Local filters endpoints to this node
         # (ref proxier.go nodePortAddresses / externalPolicyLocal).
@@ -148,21 +159,46 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         return self._gen
 
     def install_bundle(self, ps=None, services=None) -> int:
+        # Compile-before-assign (the install_topology convention): the
+        # service tables compile from the STAGED list first, and
+        # self._services/_dsvc commit only after every compile in the
+        # bundle has succeeded — a rejected bundle leaves spec and device
+        # tables consistent on the previous value.  The staged list also
+        # feeds the rule compile: toServices lowering is service-indexed
+        # (compiler svcref_ranges), so rules in this bundle must see the
+        # NEW service view.
+        staged = list(services) if services is not None else None
+        staged_dsvc = None
+        if staged is not None:
+            staged_dsvc = pl.svc_to_device(compile_services(
+                staged, node_ips=self._node_ips, node_name=self._node_name
+            ))
         if ps is not None:
             old_in = self._cps.ingress.rule_ids
             old_out = self._cps.egress.rule_ids
             self._ps = ps
-            self._compile_rules()
+            self._compile_rules(services=staged)
             # Cached flow-entry attribution follows rule IDENTITY across the
             # renumbering bundle: remap stored indices old->new by stable
             # rule id; vanished rules lose attribution (the oracle twin
             # applies the same identity rule in PipelineOracle.update, so
             # stats/l7 attribution of established hits cannot drift).
             self._remap_cached_attribution(old_in, old_out)
-        if services is not None:
-            self._services = list(services)
-            self._compile_services()
+        elif staged is not None and self._cps.has_svcref:
+            # Service-only bundle under toServices rules: reference
+            # indices shift with the service list — recompile rules (ids
+            # unchanged, so no attribution remap is needed).
+            self._compile_rules(services=staged)
+        if staged is not None:
+            self._services = staged
+            self._dsvc = staged_dsvc
         self._gen += 1
+        if self._slowpath is not None:
+            # Revalidation plane: the swap marks the cache epoch stale;
+            # stale-gen denials die lazily (lookup gen compare) and their
+            # slots are reclaimed by the next drain's revalidation pass —
+            # established entries survive, nothing is flushed.
+            self._slowpath.mark_stale(self._gen)
         self._persist()
         return self._gen
 
@@ -259,6 +295,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         elif rows:
             self._append_deltas(rows)
         self._gen += 1
+        if self._slowpath is not None:
+            self._slowpath.mark_stale(self._gen)
         # Incremental deltas do NOT rewrite the snapshot (that would turn
         # the O(delta) path into O(total-state) disk I/O per event): the
         # authoritative crash-recovery source for membership churn is the
@@ -331,15 +369,26 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             # pure-IP batches keep the round-3 compiled program.
             jnp.asarray(batch.arp_ops()) if batch.arp_op is not None else None,
             jnp.asarray(lens) if self._flow_stats else None,
-            meta=self._meta,
+            meta=self._meta_step,
             v6=self._v6_lanes(batch),
         )
         self._state = state
         o = {k: np.asarray(v) for k, v in out.items()}
         self._evictions += int(o["n_evict"])
+        pending = None
+        if self._async:
+            # Admit the fast step's miss lanes to the bounded queue (the
+            # upcall handoff); their outputs carry the provisional
+            # admission verdict (miss_code) until a drain classifies the
+            # flow.  Overflowed admissions are counted, never blocked on.
+            pending = o["miss"]
+            self._slowpath.admit(
+                self._queue_cols(batch, batch.flags(), lens),
+                pending != 0, now,
+            )
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
-        self._count_metrics(o, in_ids, out_ids, lens)
+        self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
 
         def unflip(col):
             return (col.astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32)
@@ -376,6 +425,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         return StepResult(
             code=o["code"],
             est=o["est"],
+            pending=pending,
             reply=o["reply"],
             reject_kind=o["reject_kind"],
             snat=o["snat"],
@@ -432,8 +482,12 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         keys = np.asarray(flow.keys)[:-1].astype(np.int64)
         meta = np.asarray(flow.meta)[:-1].astype(np.int64)
         ts = np.asarray(flow.ts)[:-1]
-        pkts = np.asarray(flow.pkts)[:-1]
-        octets = np.asarray(flow.octets)[:-1]
+        # 64-bit volumes from the two i32 limbs (FlowCache docstring): the
+        # low limb's U32 view plus the carry limb shifted up.
+        pkts = (np.asarray(flow.pkts)[:-1].astype(np.uint32).astype(np.int64)
+                + (np.asarray(flow.pkts_hi)[:-1].astype(np.int64) << 32))
+        octets = (np.asarray(flow.octets)[:-1].astype(np.uint32).astype(np.int64)
+                  + (np.asarray(flow.octets_hi)[:-1].astype(np.int64) << 32))
         A = self._meta.key_words - 2
         DC, M1C, RC, ZC = pl._meta_cols(A)
         kpg = keys[:, A + 1]
@@ -519,14 +573,96 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         c["evictions"] = self._evictions
         return c
 
+    # -- async slow path (datapath/slowpath engine callbacks) ----------------
+    # (drain_slowpath / dump_miss_queue / slowpath_stats live on the
+    # Datapath base; only the classify/scan callbacks are per-engine.)
+
+    def _drain_classify(self, block: dict, now: int) -> None:
+        """Classify + commit one popped queue block through the coalesced
+        drain step (ONE slow-path round at miss_chunk == drain_batch, the
+        fused consumer fed a full batch) and publish the new cache state —
+        the epoch-swap commit.  Padding lanes ride masked out via `valid`
+        (they neither refresh nor commit, like SpoofGuard lanes)."""
+        k = len(block["src_ip"])
+        D = self._slowpath.drain_batch
+
+        def pad(col, dtype=np.int32):
+            out = np.zeros(D, dtype)
+            out[:k] = np.asarray(col)[:k].astype(dtype)
+            return out
+
+        src = pad(block["src_ip"], np.uint32)
+        dst = pad(block["dst_ip"], np.uint32)
+        proto = pad(block["proto"])
+        sport = pad(block["src_port"])
+        dport = pad(block["dst_port"])
+        flags = pad(block["flags"])
+        lens = np.maximum(pad(block["lens"]), 0)
+        valid = np.arange(D) < k
+        # Same no-commit gating the synchronous walk applies
+        # (models/forwarding.py): multicast misses classify-but-never-cache,
+        # and a FIN/RST-flagged TCP miss never establishes.
+        no_commit = ((dst >> 28) == 0xE) | (
+            (proto == PROTO_TCP) & ((flags & pl._TEARDOWN_FLAGS) != 0)
+        )
+        state, out = pl.pipeline_step(
+            self._state,
+            self._drs,
+            self._dsvc,
+            jnp.asarray(iputil.flip_u32(src)),
+            jnp.asarray(iputil.flip_u32(dst)),
+            jnp.asarray(proto),
+            jnp.asarray(sport),
+            jnp.asarray(dport),
+            jnp.int32(now),
+            jnp.int32(self._gen),
+            meta=self._meta_drain,
+            valid=jnp.asarray(valid),
+            no_commit=jnp.asarray(no_commit),
+            flags=jnp.asarray(flags),
+            lens=jnp.asarray(lens) if self._flow_stats else None,
+        )
+        self._state = state
+        o = {key: np.asarray(v) for key, v in out.items()}
+        self._evictions += int(o["n_evict"])
+        # Each queued packet's REAL attribution counts exactly once, here
+        # (its fast-step image was provisional and went uncounted).
+        sel = valid
+        self._count_metrics(
+            {key: o[key][sel]
+             for key in ("code", "ingress_rule", "egress_rule")},
+            self._cps.ingress.rule_ids,
+            self._cps.egress.rule_ids,
+            lens[sel],
+        )
+
+    def _epoch_revalidate(self) -> int:
+        state, n = pl.revalidate_scan(self._state, jnp.int32(self._gen))
+        self._state = state
+        return int(n)
+
+    def _epoch_age_scan(self, now: int) -> int:
+        state, n = pl.age_scan(self._state, jnp.int32(now),
+                               timeouts=self._meta.timeouts)
+        self._state = state
+        return int(n)
+
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
                 *, n_new: Optional[int] = None, now: int = 1000,
-                k_small: int = 2, k_big: int = 8, repeats: int = 2) -> dict:
+                k_small: int = 2, k_big: int = 8, repeats: int = 2,
+                mode: str = "sync") -> dict:
         """On-device churn-loop phase breakdown (models/profile.py):
         `batch` is warmed as the established hot set; each timed step
         replaces its first n_new lanes with a rolling window of fresh
         flows from `fresh` (None -> never-miss regime).  The datapath's
-        own state is untouched — the profiler steps a scratch copy."""
+        own state is untouched — the profiler steps a scratch copy.
+
+        mode="async" profiles the DECOUPLED regime instead (the
+        datapath/slowpath cadence: fast dispatch + coalesced drain
+        dispatch per step) and attributes the drain phases
+        (profile.ASYNC_PHASE_CHAIN); `fresh` is then required.  Either
+        mode profiles on any instance — the mode is a meta variant, not
+        an engine dependency."""
         from ..models import profile as prof
 
         if batch.has_v6 or (fresh is not None and fresh.has_v6):
@@ -536,6 +672,14 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             )
         hot = prof._dev_cols(batch)
         pool = prof._dev_cols(fresh) if fresh is not None else None
+        if mode == "async":
+            return prof.profile_churn_async(
+                self._meta, self._state, self._drs, self._dsvc, hot, pool,
+                n_new=n_new, now0=now, gen=self._gen,
+                k_small=k_small, k_big=k_big, repeats=repeats,
+            )
+        if mode != "sync":
+            raise ValueError(f"unknown profile mode {mode!r}")
         return prof.profile_churn(
             self._meta, self._state, self._drs, self._dsvc, hot, pool,
             n_new=n_new, now0=now, gen=self._gen,
@@ -606,7 +750,17 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                 eff_dst = dnat_u
             spoofed = oracle_spoof(self._rt, p.src_ip, int(in_ports[i]))
             f = oracle_forward(self._rt, eff_dst, int(in_ports[i]))
+            # Async overlay: is this exact 5-tuple sitting in the miss
+            # queue awaiting classification?  (Always False when
+            # synchronous — there is no queue.)
+            queued = (
+                self._slowpath is not None
+                and self._slowpath.queue.contains(
+                    int(p.src_ip), int(p.dst_ip), int(batch.proto[i]),
+                    int(batch.src_port[i]), int(batch.dst_port[i]))
+            )
             out.append({
+                "queued": queued,
                 "cache_hit": bool(o["cache_hit"][i]),
                 "est": bool(o["est"][i]),
                 "reply": bool(o["reply"][i]),
@@ -632,7 +786,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
     # -- internals -----------------------------------------------------------
 
     def _count_metrics(self, o: dict, in_ids: list, out_ids: list,
-                       lens=None) -> None:
+                       lens=None, pending=None) -> None:
         if not self._gates.enabled("NetworkPolicyStats"):
             return
         # SpoofGuard drops and IGMP punts happen BEFORE the policy tables
@@ -666,15 +820,25 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         none_mask = (o["ingress_rule"] < 0) & (o["egress_rule"] < 0)
         if not_spoofed is not None:
             none_mask = none_mask & not_spoofed
+        if pending is not None:
+            # Queue-admitted miss lanes carry a PROVISIONAL verdict; the
+            # real one is counted once, at drain time (_drain_classify).
+            none_mask = none_mask & (pending == 0)
         self._default_allow += int(((o["code"] == 0) & none_mask).sum())
         self._default_deny += int(((o["code"] != 0) & none_mask).sum())
 
-    def _compile_rules(self) -> None:
+    def _compile_rules(self, services=None) -> None:
+        """services: the service view toServices lowering resolves against
+        — None means the currently-committed list; install_bundle passes
+        its STAGED list so a mixed bundle compiles consistently."""
         self._has_named_ports = any(
             s.port_name
             for p in self._ps.policies for r in p.rules for s in r.services
         )
-        cps = compile_policy_set(self._ps)
+        cps = compile_policy_set(
+            self._ps,
+            services=self._services if services is None else services,
+        )
         pl.check_rule_capacity(cps)
         drs, match_meta = to_device(cps, delta_slots=self._delta_slots)
         self._cps = cps
@@ -692,6 +856,25 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             key_words=10 if self._dual_stack else 4,
             count_flow_stats=self._flow_stats,
         )
+        # Async-mode step/drain variants of the meta: the FAST step masks
+        # the whole slow path out (phases=0 — misses keep the admission
+        # policy's provisional image, models/pipeline miss_code) and the
+        # DRAIN step classifies one coalesced queue batch in a SINGLE
+        # slow-path round (miss_chunk == drain_batch), amortizing the
+        # per-round fixed costs the phase profiler exposed.
+        if self._async:
+            self._meta_step = self._meta._replace(
+                phases=0,
+                miss_code=(ACT_DROP
+                           if self._slowpath.admission == ADMIT_HOLD
+                           else ACT_ALLOW),
+            )
+            self._meta_drain = self._meta._replace(
+                miss_chunk=self._slowpath.drain_batch
+            )
+        else:
+            self._meta_step = self._meta
+            self._meta_drain = None
         # Reset incremental bookkeeping: the compile folded all prior deltas.
         D = self._delta_slots
         self._n_deltas = 0

@@ -51,16 +51,28 @@ def _block_from(d: dict) -> cp.IPBlock:
 
 
 def _peer(p: cp.NetworkPolicyPeer) -> dict:
-    return {
+    out = {
         "addressGroups": list(p.address_groups),
         "ipBlocks": [_block(b) for b in p.ip_blocks],
     }
+    if p.to_services:
+        # controlplane ServiceReference list (types.go ToServices wire form).
+        out["toServices"] = [
+            {"name": sr.name, "namespace": sr.namespace}
+            for sr in p.to_services
+        ]
+    return out
 
 
 def _peer_from(d: dict) -> cp.NetworkPolicyPeer:
     return cp.NetworkPolicyPeer(
         address_groups=list(d.get("addressGroups", ())),
         ip_blocks=[_block_from(b) for b in d.get("ipBlocks", ())],
+        to_services=[
+            cp.ServiceReference(name=sr["name"],
+                                namespace=sr.get("namespace", "default"))
+            for sr in d.get("toServices", ())
+        ],
     )
 
 

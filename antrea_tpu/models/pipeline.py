@@ -155,12 +155,19 @@ class FlowCache(NamedTuple):
         persistence analog — both marks live in ct_mark in the reference);
         bit 29 is the conntrack CONFIRMED state (see CONF_BIT)
       ts   (N+1,)  i32: last-seen seconds (refreshed on every hit)
-      pkts/octets (N+1,) i32: per-DIRECTION saturating traffic counters
-        (conntrack OriginalPackets/OriginalBytes,
-        flowexporter/types.go:59) — 1-D columns because the hit path
-        updates them with fast column scatters (the layout rationale
-        above); zero-cost when PipelineMeta.count_flow_stats is off
-        (the update compiles out).
+      pkts/octets + pkts_hi/octets_hi (N+1,) i32: per-DIRECTION traffic
+        counters (conntrack OriginalPackets/OriginalBytes,
+        flowexporter/types.go:59) in 64-bit little-endian limb pairs —
+        the low limb is the u32 view of the i32 column, the high limb
+        carries the overflow, so volumes accumulate to 2^63 like the
+        kernel's u64 counters instead of saturating at i32 (the old
+        documented 2GB bound).  TPU lanes stay i32 (no x64 dependency);
+        the hit path adds with a wrapping scatter + one carry per slot
+        (_wide_add), exact as long as ONE entry receives < 2^32 bytes
+        within a single batch.  1-D columns because the hit path updates
+        them with fast column scatters (the layout rationale above);
+        zero-cost when PipelineMeta.count_flow_stats is off (the update
+        compiles out).
 
     dst in keys is the ORIGINAL (pre-DNAT) dst; dnat_ip_f the resolved one.
 
@@ -180,6 +187,8 @@ class FlowCache(NamedTuple):
     ts: jax.Array
     pkts: jax.Array
     octets: jax.Array
+    pkts_hi: jax.Array
+    octets_hi: jax.Array
 
 
 class AffinityTable(NamedTuple):
@@ -207,6 +216,7 @@ class DeviceServiceTables(NamedTuple):
     ep_ip_f: jax.Array  # (E,) flat — unbounded endpoints per program
     ep_port: jax.Array  # (E,) flat
     slot_snat: jax.Array  # (NU, MAXP) 0/1 per-frontend SNAT-mark flag
+    prog_svc: jax.Array  # (P,) owning service index per program (toServices)
     prog_dsr: jax.Array  # (P,) 0/1 per-program DSR delivery flag
     # v6 frontend sub-table + wide endpoint words (compiler/services.py
     # dual-stack split; (0, ...) shapes compile the v6 probe out).
@@ -246,9 +256,19 @@ class PipelineMeta(NamedTuple):
     # word form, the xxreg3 analog).  Static, so pure-v4 worlds compile the
     # narrow fast path unchanged.
     key_words: int = 4
-    # Slow-path phase mask (PH_* bits) — profiling-only; masked phases
-    # compile out and are replaced by cheap defaults (see models/profile).
+    # Slow-path phase mask (PH_* bits).  Two legitimate uses: the profiler
+    # compiles cumulative chains of it (models/profile), and the ASYNC
+    # slow-path engine (datapath/slowpath) runs its fast step at phases=0 —
+    # misses then keep the fast-path default image, get admitted to the
+    # miss queue, and are classified later by a coalesced drain step at
+    # PH_ALL.  Synchronous production datapaths always run PH_ALL.
     phases: int = PH_ALL
+    # Fast-path default verdict for UNclassified miss lanes (only
+    # observable when PH_SLOW is masked, i.e. in the async fast step):
+    # the miss-queue admission policy — ACT_ALLOW = provisional
+    # default-forward (the OVS "normal" upcall treatment), ACT_DROP =
+    # hold until the background engine classifies (datapath/slowpath).
+    miss_code: int = ACT_ALLOW
 
     @property
     def timeouts(self) -> tuple[int, int, int, int]:
@@ -275,6 +295,7 @@ def svc_to_host(st: ServiceTables) -> DeviceServiceTables:
         ep_ip_f=np.asarray(st.ep_ip_f),
         ep_port=np.asarray(st.ep_port),
         slot_snat=np.asarray(st.slot_snat),
+        prog_svc=np.asarray(st.prog_svc),
         prog_dsr=np.asarray(st.prog_dsr),
         uip6_w=np.asarray(st.uip6_w),
         ppk6=np.asarray(st.ppk6),
@@ -306,6 +327,8 @@ def init_state(
         ts=zeros(flow_slots),
         pkts=zeros(flow_slots),
         octets=zeros(flow_slots),
+        pkts_hi=zeros(flow_slots),
+        octets_hi=zeros(flow_slots),
     )
     aff = AffinityTable(
         # Wide worlds key affinity on the client's 4-word form (v6
@@ -610,6 +633,18 @@ def _service_lb(
     return svc_idx, no_ep, dnat_ip, dnat_port, snat, dsr, dnat_w, learn
 
 
+def _svc_ref_of(svc_idx: jax.Array, dsvc: DeviceServiceTables) -> jax.Array:
+    """toServices probe identity (ops/match svcref contract): the lane's
+    resolved LB program mapped to its OWNING service index via prog_svc;
+    MISS (-1) for non-service lanes.  The ONE implementation shared by
+    step and trace so the probe-key contract cannot drift between them."""
+    return jnp.where(
+        svc_idx >= 0,
+        dsvc.prog_svc[jnp.clip(svc_idx, 0, dsvc.prog_svc.shape[0] - 1)],
+        MISS,
+    )
+
+
 def entry_timeout(conf, proto, timeouts, xp=jnp):
     """Per-entry idle timeout from the CONFIRMED bit + protocol (scalar or
     array): the kernel's per-state conntrack lifetime selection.  Single
@@ -747,21 +782,30 @@ def _pipeline_step(
     if meta.count_flow_stats:
         # Per-direction traffic counters (conntrack OriginalPackets/
         # OriginalBytes, flowexporter/types.go:59): every hit adds to ITS
-        # entry's columns.  Saturating via per-lane headroom clamp —
-        # exact except when many same-batch duplicates land within one
-        # batch-sum of 2^31, where a slight overshoot can occur (the
-        # reference's u64 counters never reach this boundary in practice).
+        # entry's columns.  64-bit accumulation in two i32 limbs (the
+        # kernel's u64 counters; the old i32 saturation capped volumes at
+        # 2GB): the low limb adds with a wrapping scatter, and one carry
+        # per slot propagates into the high limb — exact as long as one
+        # entry receives < 2^32 bytes within a SINGLE batch (a per-batch
+        # bound, not a lifetime cap).
         lv = jnp.zeros(B, jnp.int32) if lens is None else lens
         ctgt = jnp.where(hit, slot, dump)
+        cwin = _winner_mask(N, slot, hit, dump)  # one carry writer per slot
 
-        def sat_add(col, add):
-            room = jnp.int32(2**31 - 1) - col[ctgt]
-            return col.at[ctgt].add(jnp.minimum(add, jnp.maximum(room, 0)))
+        def wide_add(lo, hi, add):
+            old = lo[ctgt]
+            lo = lo.at[ctgt].add(add)
+            # u32 view shrank => the slot's low limb wrapped exactly once.
+            carried = lo[ctgt].astype(jnp.uint32) < old.astype(jnp.uint32)
+            hi = hi.at[jnp.where(cwin & carried, ctgt, dump)].add(1)
+            return lo, hi
 
-        flow = flow._replace(
-            pkts=sat_add(flow.pkts, jnp.ones(B, jnp.int32)),
-            octets=sat_add(flow.octets, jnp.maximum(lv, 0)),
-        )
+        new_pk, new_pkh = wide_add(flow.pkts, flow.pkts_hi,
+                                   jnp.ones(B, jnp.int32))
+        new_oc, new_och = wide_add(flow.octets, flow.octets_hi,
+                                   jnp.maximum(lv, 0))
+        flow = flow._replace(pkts=new_pk, octets=new_oc,
+                             pkts_hi=new_pkh, octets_hi=new_och)
 
     # Conntrack refreshes BOTH tuple directions on traffic in either
     # direction (one kernel-ct connection == our two cache entries): an
@@ -889,7 +933,17 @@ def _pipeline_step(
     def outbuf(vals):
         return jnp.concatenate([vals, jnp.zeros((1,), jnp.int32)])
 
-    out_code = outbuf(jnp.where(hit, c_code, ACT_ALLOW))
+    # ADMITTED miss lanes default to meta.miss_code: ACT_ALLOW in
+    # synchronous mode (overwritten by the slow path anyway), the
+    # admission policy's provisional verdict in the async fast step
+    # (PH_SLOW masked, misses queued for the background engine —
+    # datapath/slowpath).  Valid-masked lanes (SpoofGuard/ARP/IGMP-punt,
+    # handled BEFORE the pipeline) are NOT misses and keep the plain
+    # ALLOW image their kind overrides expect (forwarding.py) — a hold
+    # policy must never report DROP for a lane it never evaluated.
+    out_code = outbuf(jnp.where(
+        hit, c_code, jnp.where(miss, meta.miss_code, ACT_ALLOW)
+    ))
     out_svc = outbuf(jnp.where(hit, c_svc, MISS))
     out_dnat_ip = outbuf(jnp.where(hit, c_dnat_ip, dst_f))
     out_dnat_port = outbuf(jnp.where(hit, c_dport, dport))
@@ -995,6 +1049,7 @@ def _pipeline_step(
                     # hit_combine.
                     fused=meta.fused,
                     v6=None if wide_m is None else (saddr_m, dnat_w, is6_m),
+                    svc_ref=_svc_ref_of(svc_idx, dsvc),
                 )
                 code = jnp.where(
                     no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
@@ -1150,25 +1205,35 @@ def _pipeline_step(
                 if meta.count_flow_stats:
                     # Fresh entries start at this packet's contribution on
                     # the forward leg; the reply leg starts empty (its own
-                    # direction's traffic hasn't flowed yet).
+                    # direction's traffic hasn't flowed yet).  High limbs
+                    # reset to zero — a reused slot must not inherit the
+                    # evicted entry's carry.
                     pk2 = jnp.stack(
                         [jnp.ones(M, jnp.int32), jnp.zeros(M, jnp.int32)],
                         axis=1).reshape(2 * M)
                     oc2 = jnp.stack(
                         [lv_m, jnp.zeros(M, jnp.int32)],
                         axis=1).reshape(2 * M)
+                    z2 = jnp.zeros(2 * M, jnp.int32)
                     new_pkts = _scatter_last(flow.pkts, slot2, pk2, ins2,
                                              dump)
                     new_octets = _scatter_last(flow.octets, slot2, oc2,
                                                ins2, dump)
+                    new_pkts_hi = _scatter_last(flow.pkts_hi, slot2, z2,
+                                                ins2, dump)
+                    new_octets_hi = _scatter_last(flow.octets_hi, slot2, z2,
+                                                  ins2, dump)
                 else:
                     new_pkts, new_octets = flow.pkts, flow.octets
+                    new_pkts_hi, new_octets_hi = flow.pkts_hi, flow.octets_hi
                 flow = FlowCache(
                     keys=_scatter_last_rows(flow.keys, slot2, keys2, ins2, dump),
                     meta=_scatter_last_rows(flow.meta, slot2, meta2, ins2, dump),
                     ts=_scatter_last(flow.ts, slot2, jnp.full((2 * M,), now, jnp.int32), ins2, dump),
                     pkts=new_pkts,
                     octets=new_octets,
+                    pkts_hi=new_pkts_hi,
+                    octets_hi=new_octets_hi,
                 )
                 lm = learn["mask"] & valid
                 adump = meta.aff_slots
@@ -1248,6 +1313,11 @@ def _pipeline_step(
         "ingress_rule": out_rule_in[:B],
         "egress_rule": out_rule_out[:B],
         "committed": out_committed[:B],
+        # Per-lane cache-miss mask (1 = this lane took / would take the
+        # slow path).  In synchronous mode an informational overlay; in
+        # the async fast step (PH_SLOW masked) it is the miss-queue
+        # ADMISSION mask the engine consumes (datapath/slowpath).
+        "miss": miss.astype(jnp.int32),
         # SNAT-mark classification (pipeline.go SNATMark analog): external
         # frontend traffic under ETP=Cluster needs masquerade on egress.
         "snat": out_snat[:B],
@@ -1289,6 +1359,69 @@ def _cache_stats(state: PipelineState):
 
 
 cache_stats = jax.jit(_cache_stats)
+
+
+def _live_rows(keys: jax.Array) -> jax.Array:
+    """Occupied-entry mask over the full (N+1,) row space with the dump
+    row (index N, the masked-scatter junk target) excluded."""
+    kpg = keys[:, -1]
+    n = kpg.shape[0]
+    return (kpg != 0) & (jnp.arange(n, dtype=jnp.int32) < n - 1)
+
+
+def _age_scan(state: PipelineState, now: jax.Array, *, timeouts):
+    """Off-hot-step aging scan (datapath/slowpath epoch plane): physically
+    clear entries idle past their per-state conntrack lifetime.
+
+    Semantics-neutral by construction: an expired entry is already dead to
+    lookups (_cache_lookup freshness check), so clearing it changes no
+    verdict — it reclaims the slot, turning a later insert over it from an
+    "eviction" into plain occupancy.  The synchronous datapath never runs
+    this (expiry-by-lookup suffices); the async engine runs it between
+    drains and publishes the result via epoch swap.
+
+    -> (state', n_reclaimed).
+    """
+    flow = state.flow
+    kpg = flow.keys[:, -1]
+    conf = (flow.meta[:, _meta_cols(flow.keys.shape[1] - 2)[3]] >> 29) & 1
+    tmo = entry_timeout(conf, kpg & 0xFF, timeouts)
+    expired = _live_rows(flow.keys) & ((now - flow.ts) > tmo)
+    keys = jnp.where(expired[:, None], 0, flow.keys)
+    return (
+        state._replace(flow=flow._replace(keys=keys)),
+        expired.sum(dtype=jnp.int32),
+    )
+
+
+age_scan = jax.jit(_age_scan, static_argnames=("timeouts",))
+
+
+def _revalidate_scan(state: PipelineState, gen: jax.Array):
+    """Off-hot-step revalidation (datapath/slowpath epoch plane): clear
+    DENIAL entries whose generation predates the current bundle.
+
+    Stale-gen denials are already dead to lookups (the megaflow
+    revalidation analog — _cache_lookup's gen compare), so this is the
+    lazy slot-reclaim a bundle swap schedules instead of flushing the
+    cache; established (eternal-gen) entries, reply legs included, are
+    untouched — the flows-survive-churn invariant.  -> (state', n_cleared).
+    """
+    flow = state.flow
+    kpg = flow.keys[:, -1]
+    egen = (kpg >> 9) & GEN_ETERNAL
+    gen_w = jnp.asarray(gen, jnp.int32) % GEN_ETERNAL
+    stale = (
+        _live_rows(flow.keys) & (egen != GEN_ETERNAL) & (egen != gen_w)
+    )
+    keys = jnp.where(stale[:, None], 0, flow.keys)
+    return (
+        state._replace(flow=flow._replace(keys=keys)),
+        stale.sum(dtype=jnp.int32),
+    )
+
+
+revalidate_scan = jax.jit(_revalidate_scan)
 
 
 def _pipeline_trace(
@@ -1360,6 +1493,7 @@ def _pipeline_trace(
         drs, src_f, dnat_ip, proto, dnat_port,
         meta=meta.match, hit_combine=hit_combine,
         v6=None if A == 2 else (saddr, dnat_w, is6),
+        svc_ref=_svc_ref_of(svc_idx, dsvc),
     )
     fresh_code = jnp.where(no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
     code = jnp.where(hit, c_code, fresh_code)

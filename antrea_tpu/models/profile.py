@@ -41,6 +41,23 @@ PHASE_CHAIN: tuple[tuple[str, int], ...] = (
     ("eviction_scan", pl.PH_ALL),
 )
 
+# Async-regime chain (datapath/slowpath): the floor is the decoupled FAST
+# step (phases=0 — misses admitted, not classified), then each drain
+# phase adds one PH_ bit to the COALESCED drain step, which runs the
+# fresh window as ONE slow-path round (miss_chunk == drain batch) instead
+# of the sync path's many chunked rounds.  Same telescoped-differencing
+# honesty property; same PH_* bit set (tools/check_phases.py gates the
+# two chains and the pipeline masks against each other).
+ASYNC_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
+    ("async_fast_path", 0),
+    ("drain_miss_detect", pl.PH_SLOW),
+    ("drain_service_lb", pl.PH_SLOW | pl.PH_LB),
+    ("drain_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
+    ("drain_cache_commit",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+    ("drain_eviction_scan", pl.PH_ALL),
+)
+
 
 def _dev_cols(batch) -> tuple:
     """PacketBatch -> the pipeline's flipped/typed device columns."""
@@ -155,6 +172,110 @@ def profile_churn(
     return {
         "batch": B,
         "fresh_per_step": n_new,
+        "phases_s": phases,
+        "cumulative_s": cumulative,
+        "total_s": total,
+        "pps": B / total,
+        "phase_fractions": {k: v / total for k, v in phases.items()},
+    }
+
+
+def profile_churn_async(
+    meta: pl.PipelineMeta,
+    state: pl.PipelineState,
+    drs,
+    dsvc,
+    hot: tuple,
+    pool: tuple,
+    *,
+    n_new: Optional[int] = None,
+    now0: int = 1000,
+    gen: int = 0,
+    k_small: int = 2,
+    k_big: int = 8,
+    repeats: int = 2,
+    chain: tuple = ASYNC_PHASE_CHAIN,
+) -> dict:
+    """Per-phase breakdown of the ASYNC churn regime (datapath/slowpath).
+
+    Models the engine's steady cadence — every step is one decoupled FAST
+    dispatch over the mixed batch (phases=0: hot lanes hit, the n_new
+    fresh lanes are admitted unclassified) plus one COALESCED drain
+    dispatch over exactly that fresh window (miss_chunk == n_new, a
+    single slow-path round).  chain[0] times the fast dispatch alone; the
+    drain entries then add one PH_ bit at a time to the drain dispatch,
+    so `drain_miss_detect` carries the drain call's fixed costs (its own
+    lookup pass + dispatch) and the rest attribute like the sync chain.
+    Telescoped differencing: phase sums equal the chain-end (full async
+    step) time by construction.
+    """
+    B = int(hot[0].shape[0])
+    if pool is None:
+        raise ValueError("async profiling needs a fresh-flow pool "
+                         "(the regime under study is miss handling)")
+    pool_len = int(pool[0].shape[0])
+    if n_new is None:
+        n_new = max(1, B // 8)
+    if n_new > B or n_new >= pool_len:
+        raise ValueError(
+            f"n_new={n_new} must fit the batch ({B}) and pool ({pool_len})"
+        )
+
+    full = meta._replace(phases=pl.PH_ALL)
+    meta_fast = meta._replace(phases=0)
+    st = state
+    for w in range(2):
+        st, _ = pl.pipeline_step(
+            st, drs, dsvc, *hot, jnp.int32(now0 - 2 + w), jnp.int32(gen),
+            meta=full,
+        )
+
+    def timed(mask: int, with_drain: bool) -> float:
+        m_drain = meta._replace(phases=mask, miss_chunk=n_new)
+
+        def body(i, carry):
+            acc, cst, drs_, dsvc_, hcols, pcols = carry
+            off = (acc[1] * n_new) % (pool_len - n_new)
+            fresh = tuple(
+                jax.lax.dynamic_slice(pc, (off,), (n_new,)) for pc in pcols
+            )
+            cols = tuple(
+                jnp.concatenate([h[: B - n_new], f])
+                for h, f in zip(hcols, fresh)
+            )
+            cst, o = pl._pipeline_step(
+                cst, drs_, dsvc_, *cols, now0 + i, gen, meta=meta_fast,
+            )
+            acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
+            if with_drain:
+                cst, od = pl._pipeline_step(
+                    cst, drs_, dsvc_, *fresh, now0 + i, gen, meta=m_drain,
+                )
+                acc = acc.at[0].add(
+                    od["code"].sum(dtype=jnp.int32) + od["n_miss"]
+                )
+            acc = acc.at[1].add(1)
+            return (acc, cst, drs_, dsvc_, hcols, pcols)
+
+        carry = (jnp.zeros(8, jnp.int32), st, drs, dsvc, hot, pool)
+        return device_loop_time(
+            body, carry, k_small=k_small, k_big=k_big, repeats=repeats
+        )
+
+    cumulative: dict[str, float] = {}
+    phases: dict[str, float] = {}
+    prev = 0.0
+    for j, (name, mask) in enumerate(chain):
+        t = timed(mask, with_drain=j > 0)
+        cumulative[name] = t
+        phases[name] = t - prev  # unclamped (honesty property; see sync)
+        prev = t
+    total = cumulative[chain[-1][0]]
+    return {
+        "mode": "async",
+        "batch": B,
+        "fresh_per_step": n_new,
+        "drain_batch": n_new,
         "phases_s": phases,
         "cumulative_s": cumulative,
         "total_s": total,

@@ -49,6 +49,17 @@ METRICS: dict[str, str] = {
     "antrea_tpu_flow_cache_slots": "gauge",
     "antrea_tpu_flow_cache_evictions_total": "counter",
     "antrea_tpu_datapath_step_seconds": "histogram",
+    # async slow-path engine (datapath/slowpath; rendered when the
+    # datapath exposes slowpath_stats())
+    "antrea_tpu_miss_queue_depth": "gauge",
+    "antrea_tpu_miss_queue_capacity": "gauge",
+    "antrea_tpu_miss_queue_admitted_total": "counter",
+    "antrea_tpu_miss_queue_overflows_total": "counter",
+    "antrea_tpu_slowpath_drained_total": "counter",
+    "antrea_tpu_slowpath_stale_reclassified_total": "counter",
+    "antrea_tpu_slowpath_drain_batch_size": "histogram",
+    "antrea_tpu_flow_cache_epoch": "gauge",
+    "antrea_tpu_flow_cache_epoch_age_seconds": "gauge",
 }
 
 
@@ -287,6 +298,28 @@ def render_metrics(datapath, node: str = "") -> str:
             f"antrea_tpu_flow_cache_evictions_total{_labels(node=node)} "
             f"{c['evictions']}",
         ]
+    sp = getattr(datapath, "slowpath_stats", None)
+    sp = sp() if sp is not None else None
+    if sp is not None:
+        # Async slow-path plane (datapath/slowpath): queue depth/capacity/
+        # pressure, drained volume, and the epoch-swap bookkeeping.
+        for fam, key in (
+            ("antrea_tpu_miss_queue_depth", "depth"),
+            ("antrea_tpu_miss_queue_capacity", "capacity"),
+            ("antrea_tpu_miss_queue_admitted_total", "admitted_total"),
+            ("antrea_tpu_miss_queue_overflows_total", "overflows_total"),
+            ("antrea_tpu_slowpath_drained_total", "drained_total"),
+            ("antrea_tpu_slowpath_stale_reclassified_total",
+             "stale_reclassified_total"),
+            ("antrea_tpu_flow_cache_epoch", "epoch"),
+            ("antrea_tpu_flow_cache_epoch_age_seconds", "epoch_age_s"),
+        ):
+            lines += [_type_line(fam), f"{fam}{_labels(node=node)} {sp[key]}"]
+        dh = sp.get("drain_hist")
+        if dh is not None and dh.count:
+            lines.extend(_render_histograms(
+                [("antrea_tpu_slowpath_drain_batch_size", {"node": node}, dh)]
+            ))
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

@@ -166,6 +166,11 @@ class StaticMeta(NamedTuple):
     # a CPU mesh on a TPU-default host (the virtual-device dryrun) must
     # interpret, and vice versa.
     fused_interpret: "bool | None" = None
+    # Egress rules include toServices lowerings (compiler SVCREF_BASE
+    # sub-space): classify_batch probes the egress svc dimension with a
+    # SECOND key derived from the lane's ServiceLB resolution.  Static so
+    # svcref-free rule sets compile the extra gather out entirely.
+    svcref: bool = False
 
 
 def empty_delta(slots: int, w_in: int, w_out: int, xp=jnp) -> DeltaTable:
@@ -386,6 +391,7 @@ def to_host(
         w_in=w_in,
         w_out=w_out,
         delta_slots=delta_slots,
+        svcref=cps.has_svcref,
     )
     return drs, meta
 
@@ -678,6 +684,7 @@ def classify_batch(
     hit_combine=None,
     fused: bool = False,
     v6=None,
+    svc_ref=None,
 ):
     """-> dict with final/egress/ingress codes and deciding rule indices.
 
@@ -738,6 +745,22 @@ def classify_batch(
     out_at = dim_row(eg.at, src_ip_f, s6)
     out_peer = dim_row(eg.peer, dst_ip_f, d6)
     out_svc = dim_row(eg.svc, svc_key)
+    if meta.svcref:
+        # toServices probe (the ServiceGroupID-conjunction analog): a
+        # second egress svc-dim gather keyed on the lane's ServiceLB
+        # resolution in the reference sub-space.  OR is exact — ordinary
+        # port ranges live below SVCREF_BASE and reference ranges at
+        # SVCREF_BASE + idx, so each rule can match via exactly one of
+        # the two probes (compiler/compile.py SVCREF_BASE contract).
+        from ..compiler.compile import SVCREF_BASE, SVCREF_NONE
+
+        if svc_ref is None:
+            ref_key = jnp.full_like(svc_key, SVCREF_NONE)
+        else:
+            ref_key = jnp.where(
+                svc_ref >= 0, SVCREF_BASE + svc_ref, SVCREF_NONE
+            )
+        out_svc = out_svc | dim_row(eg.svc, ref_key)
     iso_in = iso_bit(drs.iso_in, dst_ip_f, d6)
     iso_out = iso_bit(drs.iso_out, src_ip_f, s6)
 

@@ -100,7 +100,8 @@ class Oracle:
     # -- single rule ---------------------------------------------------------
 
     def _rule_matches(
-        self, policy: NetworkPolicy, rule: NetworkPolicyRule, pkt: Packet
+        self, policy: NetworkPolicy, rule: NetworkPolicyRule, pkt: Packet,
+        svc_ref=None,
     ) -> bool:
         if rule.direction == Direction.IN:
             pod_ip, peer_ip = pkt.dst_ip, pkt.src_ip
@@ -108,6 +109,15 @@ class Oracle:
             pod_ip, peer_ip = pkt.src_ip, pkt.dst_ip
         if not self.ps.applied_to_contains(policy, rule, pod_ip):
             return False
+        if rule.direction == Direction.OUT and rule.peer.to_services:
+            # toServices peer (egress-only): the match rides on the
+            # packet's ServiceLB RESOLUTION, not its addresses — the
+            # scalar twin of the device's svcref probe (ops/match).
+            # svc_ref is the resolved service's (namespace, name), or
+            # None when the packet resolved to no service.
+            return svc_ref is not None and svc_ref in {
+                sr.key for sr in rule.peer.to_services
+            }
         if not self.ps.peer_contains(rule.peer, peer_ip):
             return False
         if rule.services and not any(_service_matches(s, pkt) for s in rule.services):
@@ -128,11 +138,12 @@ class Oracle:
         out.sort(key=lambda t: t[0])
         return out
 
-    def evaluate_direction(self, pkt: Packet, direction: Direction) -> DirectionVerdict:
+    def evaluate_direction(self, pkt: Packet, direction: Direction,
+                           svc_ref=None) -> DirectionVerdict:
         # Phase 1: Antrea-native, non-Baseline tiers.
         passed = False
         for _, p, i, r in self._ordered_antrea_rules(direction, baseline=False):
-            if self._rule_matches(p, r, pkt):
+            if self._rule_matches(p, r, pkt, svc_ref):
                 if r.action == RuleAction.PASS:
                     passed = True
                     break
@@ -158,7 +169,7 @@ class Oracle:
 
         # Phase 3: Baseline tier.
         for _, p, i, r in self._ordered_antrea_rules(direction, baseline=True):
-            if self._rule_matches(p, r, pkt):
+            if self._rule_matches(p, r, pkt, svc_ref):
                 if r.action == RuleAction.PASS:
                     break
                 code = {
@@ -173,9 +184,12 @@ class Oracle:
 
     # -- full packet ---------------------------------------------------------
 
-    def classify(self, pkt: Packet) -> Verdict:
-        eg = self.evaluate_direction(pkt, Direction.OUT)
-        ing = self.evaluate_direction(pkt, Direction.IN)
+    def classify(self, pkt: Packet, svc_ref=None) -> Verdict:
+        """svc_ref: the packet's ServiceLB resolution as the resolved
+        service's (namespace, name) — None when not service-addressed.
+        Consumed only by toServices egress peers."""
+        eg = self.evaluate_direction(pkt, Direction.OUT, svc_ref)
+        ing = self.evaluate_direction(pkt, Direction.IN, svc_ref)
         if eg.code != VerdictCode.ALLOW:
             final = eg.code
         else:

@@ -68,6 +68,10 @@ class ScalarOutcome:
     # punt): handled BEFORE the pipeline — no state touched, not a cache
     # miss either.
     skipped: bool = False
+    # Async slow-path mode (datapath/slowpath): the lane missed the cache
+    # and was left UNclassified — `code` is the admission policy's
+    # provisional verdict; the caller admits the packet to the miss queue.
+    pending: bool = False
 
 
 def _reject_kind(code: int, proto: int) -> int:
@@ -90,6 +94,10 @@ class _LBProgram:
     endpoints: list
     affinity_timeout_s: int
     dsr: bool = False
+    # Owning service identity (namespace, name) — the scalar twin of the
+    # compiler's prog_svc mapping (toServices peers match on it); None for
+    # unnamed services, which cannot be referenced.
+    ref: Optional[tuple] = None
 
 
 def _build_programs(services, node_ips, node_name):
@@ -104,7 +112,9 @@ def _build_programs(services, node_ips, node_name):
     node_ips4 = [ip for ip in node_ips if not iputil.is_v6(ip)]
     node_ips6 = [ip for ip in node_ips if iputil.is_v6(ip)]
     progs = [
-        _LBProgram(list(s.endpoints), s.affinity_timeout_s) for s in services
+        _LBProgram(list(s.endpoints), s.affinity_timeout_s,
+                   ref=(s.namespace, s.name) if s.name else None)
+        for s in services
     ]
     fronts: dict[tuple[int, int, int], tuple[int, int]] = {}
 
@@ -148,6 +158,7 @@ def _build_programs(services, node_ips, node_name):
                 [e for e in svc.endpoints if e.node == node_name],
                 svc.affinity_timeout_s,
                 dsr=svc.dsr,
+                ref=progs[si].ref,
             ))
         elif svc.dsr:
             # DSR: dedicated program (full endpoint view) carrying the
@@ -155,6 +166,7 @@ def _build_programs(services, node_ips, node_name):
             ext, ext_snat = len(progs), 0
             progs.append(_LBProgram(
                 list(svc.endpoints), svc.affinity_timeout_s, dsr=True,
+                ref=progs[si].ref,
             ))
         else:
             ext, ext_snat = si, 1
@@ -379,7 +391,10 @@ class PipelineOracle:
 
         v = self.oracle.classify(
             Packet(src_ip=p.src_ip, dst_ip=dnat_ip, proto=p.proto,
-                   src_port=p.src_port, dst_port=dnat_port)
+                   src_port=p.src_port, dst_port=dnat_port),
+            # toServices resolution: the owning service identity of the
+            # lane's LB program (the device twin's prog_svc gather).
+            svc_ref=prog.ref if prog is not None else None,
         )
         code = ACT_REJECT if no_ep else int(v.code)
         return {
@@ -407,8 +422,13 @@ class PipelineOracle:
 
     def step(
         self, batch: PacketBatch, now: int, gen: int = 0, lane_modes=None,
-        no_commit=None, flags=None, lens=None,
+        no_commit=None, flags=None, lens=None, fast_only=None,
     ) -> list[ScalarOutcome]:
+        """fast_only (async slow-path mode, datapath/slowpath): when set
+        to a verdict code, cache MISSES are not classified — they report
+        that provisional code with pending=True and touch no state (the
+        caller queues them for a later full-mode drain step).  Hits behave
+        exactly as in synchronous mode (refresh/confirm/teardown)."""
         # The device packs entry generations into GEN_BITS (22) bits, with
         # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
         # against the same wrapped value so spec and device agree across the
@@ -468,13 +488,14 @@ class PipelineOracle:
                 )
                 refreshes.append(slot)
                 if self.count_flow_stats:
+                    # Unbounded Python ints — the scalar twin of the
+                    # device's two-limb 64-bit accumulation (the old i32
+                    # saturation cap is gone on both engines).
                     ln = 0 if lens is None else max(0, int(lens[i]))
                     live = self.flow.get(slot)
                     if live is not None:
-                        cap = 2**31 - 1
-                        live["pkts"] = min(live.get("pkts", 0) + 1, cap)
-                        live["octets"] = min(
-                            live.get("octets", 0) + ln, cap)
+                        live["pkts"] = live.get("pkts", 0) + 1
+                        live["octets"] = live.get("octets", 0) + ln
                 # SYN_SENT -> ESTABLISHED confirmation (device twin: the
                 # CONF_BIT cond in models/pipeline): first reply-direction
                 # hit confirms BOTH tuple directions.
@@ -507,6 +528,15 @@ class PipelineOracle:
                     p_slot = self._partner_live(flow0, e, p)
                     if p_slot is not None:
                         refreshes.append(p_slot)
+                continue
+
+            if fast_only is not None:
+                # Async fast step: the miss is ADMITTED, not classified —
+                # provisional verdict, no DNAT, no commit, no learn.
+                outs.append(ScalarOutcome(
+                    fast_only, False, -1, p.dst_ip, p.dst_port, None, None,
+                    False, pending=True,
+                ))
                 continue
 
             # ---- slow path: ServiceLB -> classify -> commit ---------------

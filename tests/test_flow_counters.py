@@ -138,3 +138,34 @@ def test_np_byte_metrics_and_live_api(tmp_path):
         assert row["packets"] == 2 and row["bytes"] == 300
     finally:
         srv.close()
+
+
+@pytest.mark.parametrize("cls", [OracleDatapath, TpuflowDatapath])
+def test_counters_accumulate_past_i32(cls):
+    """ISSUE 3 satellite: volumes accumulate in 64-bit (two i32 limbs on
+    device) instead of saturating at 2^31 — three near-max-length hits
+    cross BOTH the old saturation bound and the 2^32 low-limb boundary
+    (exercising the carry), exactly and in device/oracle agreement."""
+    dp = _mk(cls)
+    fwd = _pkt(CLIENT, SRV)
+    big = 2**31 - 1
+    dp.step(_batch([fwd], [big]), now=1)      # commit
+    dp.step(_batch([fwd], [big]), now=2)      # est hit: past 2^31
+    dp.step(_batch([fwd], [big]), now=3)      # est hit: past 2^32 (carry)
+    [f] = [r for r in dp.dump_flows(now=3) if not r["reply"]]
+    assert f["packets"] == 3
+    assert f["bytes"] == 3 * big  # == 6442450941, exact
+    assert f["bytes"] > 2**32
+
+
+def test_counters_past_i32_device_oracle_parity():
+    a, b = _mk(TpuflowDatapath), _mk(OracleDatapath)
+    fwd = _pkt(CLIENT, SRV)
+    lens = [2**31 - 1, 2**30, 123, 2**31 - 7]
+    for now, ln in enumerate(lens, start=1):
+        a.step(_batch([fwd], [ln]), now=now)
+        b.step(_batch([fwd], [ln]), now=now)
+    fa = [r for r in a.dump_flows(now=5) if not r["reply"]]
+    fb = [r for r in b.dump_flows(now=5) if not r["reply"]]
+    assert fa and (fa[0]["packets"], fa[0]["bytes"]) == (
+        fb[0]["packets"], fb[0]["bytes"]) == (4, sum(lens))

@@ -124,3 +124,45 @@ def test_profile_churn_direct_chain_override():
     )
     assert list(prof["phases_s"]) == ["full"]
     assert prof["phases_s"]["full"] == prof["total_s"]
+
+
+def test_tpuflow_profile_async_mode():
+    """profile(mode="async") attributes the drain phases
+    (ASYNC_PHASE_CHAIN) with the same telescoped-sum identity, state
+    untouched."""
+    from antrea_tpu.models.profile import ASYNC_PHASE_CHAIN
+
+    cluster, hot, fresh = _world()
+    dp = TpuflowDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8,
+                         miss_chunk=16)
+    dp.step(hot, now=1)
+    before = dp.cache_stats()
+    prof = dp.profile(hot, fresh, n_new=8, k_small=1, k_big=2, repeats=1,
+                      mode="async")
+    assert dp.cache_stats() == before
+    assert list(prof["phases_s"]) == [n for n, _m in ASYNC_PHASE_CHAIN]
+    assert prof["mode"] == "async" and prof["drain_batch"] == 8
+    assert abs(sum(prof["phases_s"].values()) - prof["total_s"]) < 1e-12
+    assert prof["total_s"] > 0 and prof["pps"] > 0
+
+
+def test_oracle_profile_async_mode_names():
+    cluster, hot, fresh = _world()
+    dp = OracleDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8)
+    prof = dp.profile(hot, fresh, mode="async")
+    assert set(prof["phases_s"]) == {"async_fast_path", "drain_classify",
+                                     "drain_commit_residual"}
+
+
+def test_check_phases_tool_runs_clean():
+    """tools/check_phases.py (satellite: phase-drift CI check) exits 0 —
+    pipeline PH_* masks, profile chains, and bench_profile stay in sync."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    tool = Path(__file__).resolve().parent.parent / "tools" / "check_phases.py"
+    res = subprocess.run([sys.executable, str(tool)], capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "phases consistent" in res.stdout

@@ -1,0 +1,420 @@
+"""Async slow-path engine (ISSUE 3 tentpole): decoupled miss pipeline +
+epoch-swapped flow cache, differential tpuflow-vs-oracle throughout.
+
+Probe discipline (the flow-cache-semantics satellite): every
+oracle-parity assertion uses FRESH, never-before-seen 5-tuples — an
+established flow legitimately survives policy churn, so a reused tuple
+would est-bypass the new verdict and mask divergence.  Tuple freshness
+comes from a monotonic source-port counter shared by the whole module;
+tests that WANT established behavior reuse a tuple explicitly.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+CLIENT, CLIENT2, SRV = "10.0.1.1", "10.0.1.2", "10.0.0.10"
+BLOCKED = "10.0.9.9"
+
+# Monotonic clocks: packet time and the fresh-tuple source port.
+_NOW = itertools.count(1000)
+_SPORT = itertools.count(20000)
+
+
+def _fresh_pkt(src, dst, dport=80, proto=6):
+    """A never-before-seen 5-tuple (unique sport)."""
+    return Packet(src_ip=iputil.ip_to_u32(src), dst_ip=iputil.ip_to_u32(dst),
+                  proto=proto, src_port=next(_SPORT), dst_port=dport)
+
+
+def _drop_policy(uid, blocked_ip=BLOCKED, target_ip=SRV):
+    """ACNP: drop `blocked_ip` -> `target_ip` ingress."""
+    return cp.NetworkPolicy(
+        uid=uid, name=uid, type=cp.NetworkPolicyType.ACNP,
+        rules=[cp.NetworkPolicyRule(
+            direction=cp.Direction.IN,
+            from_peer=cp.NetworkPolicyPeer(address_groups=["blocked"]),
+            action=cp.RuleAction.DROP, priority=0)],
+        applied_to_groups=["web"], tier_priority=250, priority=1.0,
+    )
+
+
+def _world(blocked_ip=BLOCKED):
+    ps = PolicySet(
+        policies=[_drop_policy("p1")],
+        address_groups={"blocked": cp.AddressGroup(
+            name="blocked", members=[cp.GroupMember(ip=blocked_ip)])},
+        applied_to_groups={"web": cp.AppliedToGroup(
+            name="web", members=[cp.GroupMember(ip=SRV)])},
+    )
+    svcs = [ServiceEntry(cluster_ip="10.96.0.1", port=80, protocol=6,
+                         name="web", namespace="default",
+                         endpoints=[Endpoint(ip=SRV, port=8080)])]
+    return ps, svcs
+
+
+def _pair(ps, svcs, *, flow_slots=1 << 10, queue=256, admission="forward",
+          drain_batch=8, **kw):
+    mk = dict(flow_slots=flow_slots, aff_slots=1 << 4,
+              async_slowpath=True, miss_queue_slots=queue,
+              admission=admission, drain_batch=drain_batch, **kw)
+    return (TpuflowDatapath(ps, svcs, miss_chunk=16, **mk),
+            OracleDatapath(ps, svcs, **mk))
+
+
+def _assert_parity(rt, ro, where=""):
+    for f in ("code", "est", "pending", "reply", "svc_idx", "dnat_port",
+              "committed", "snat", "reject_kind"):
+        a, b = getattr(rt, f), getattr(ro, f)
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"{where}: {f} diverged: tpuflow={a} oracle={b}")
+    assert np.array_equal(rt.dnat_ip, ro.dnat_ip), where
+    assert rt.ingress_rule == ro.ingress_rule, where
+    assert rt.egress_rule == ro.egress_rule, where
+
+
+def _step_both(t, o, pkts, now):
+    bt = PacketBatch.from_packets(pkts)
+    bo = PacketBatch.from_packets(pkts)
+    rt, ro = t.step(bt, now), o.step(bo, now)
+    _assert_parity(rt, ro, f"now={now}")
+    return rt, ro
+
+
+def _drain_both(t, o, now):
+    st, so = t.drain_slowpath(now), o.drain_slowpath(now)
+    assert st["drained"] == so["drained"], (st, so)
+    return st
+
+
+def test_async_parity_and_convergence_to_sync_verdicts():
+    """Fresh tuples: provisional on admission, then — after one drain —
+    the flows' verdicts equal what a synchronous engine classifies, and
+    reply-direction traffic est-bypasses on both engines."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs)
+    sync = OracleDatapath(ps, svcs, flow_slots=1 << 10, aff_slots=1 << 4)
+
+    probes = [
+        _fresh_pkt(BLOCKED, SRV),       # denied by p1
+        _fresh_pkt(CLIENT, SRV),        # plain allow
+        _fresh_pkt(CLIENT2, "10.96.0.1"),  # via the service (DNAT)
+    ]
+    now = next(_NOW)
+    rt, _ = _step_both(t, o, probes, now)
+    assert list(rt.pending) == [1, 1, 1]
+    assert list(rt.code) == [0, 0, 0]  # forward admission: provisional allow
+    assert t.slowpath_stats()["depth"] == 3
+
+    _drain_both(t, o, next(_NOW))
+    rt2, _ = _step_both(t, o, probes, next(_NOW))
+    assert list(rt2.pending) == [0, 0, 0]
+    rsync = sync.step(PacketBatch.from_packets(probes), next(_NOW))
+    assert list(rt2.code) == list(rsync.code) == [1, 0, 0]
+    # The service flow resolved its endpoint through the drain commit.
+    assert rt2.dnat_ip[2] == iputil.ip_to_u32(SRV)
+    assert rt2.dnat_port[2] == 8080
+
+    # Reply leg of the service connection: est reply-direction hit.
+    reply = Packet(src_ip=iputil.ip_to_u32(SRV),
+                   dst_ip=probes[2].src_ip, proto=6,
+                   src_port=8080, dst_port=probes[2].src_port)
+    rt3, _ = _step_both(t, o, [reply], next(_NOW))
+    assert list(rt3.reply) == [1] and list(rt3.est) == [1]
+
+
+def test_hold_admission_drops_until_classified():
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, admission="hold")
+    allowed = _fresh_pkt(CLIENT, SRV)
+    rt, _ = _step_both(t, o, [allowed], next(_NOW))
+    assert list(rt.code) == [1] and list(rt.pending) == [1]  # held
+    assert list(rt.reject_kind) == [0]  # hold is a DROP, never a REJECT
+    _drain_both(t, o, next(_NOW))
+    rt2, _ = _step_both(t, o, [allowed], next(_NOW))
+    assert list(rt2.code) == [0] and list(rt2.pending) == [0]
+
+
+def test_churn_established_survives_fresh_reclassifies():
+    """Bundle swap: the established flow keeps flowing (conntrack
+    semantics) while a FRESH tuple of the same pair classifies under the
+    new policy — asserted with parity on both, plus the revalidation
+    plane reclaiming the stale denial slots."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs)
+
+    est = _fresh_pkt(CLIENT, SRV)       # will be established pre-churn
+    denied = _fresh_pkt(BLOCKED, SRV)   # cached denial pre-churn
+    _step_both(t, o, [est, denied], next(_NOW))
+    _drain_both(t, o, next(_NOW))
+    rt, _ = _step_both(t, o, [est, denied], next(_NOW))
+    assert list(rt.code) == [0, 1] and list(rt.est) == [1, 0]
+
+    # New bundle: now CLIENT is the blocked source.
+    ps2, _ = _world(blocked_ip=CLIENT)
+    t.install_bundle(ps=ps2)
+    o.install_bundle(ps=ps2)
+    assert t.slowpath_stats()["epoch_stale"] == 1
+
+    # The ESTABLISHED tuple survives the swap on both engines...
+    rt2, _ = _step_both(t, o, [est], next(_NOW))
+    assert list(rt2.code) == [0] and list(rt2.est) == [1]
+    # ...while a FRESH tuple of the same pair takes the new verdict.
+    fresh = _fresh_pkt(CLIENT, SRV)
+    _step_both(t, o, [fresh], next(_NOW))
+    st = _drain_both(t, o, next(_NOW))
+    assert st["revalidated"] >= 1  # the stale BLOCKED denial reclaimed
+    rt3, _ = _step_both(t, o, [fresh], next(_NOW))
+    assert list(rt3.code) == [1]
+    # Old-policy denial is gone from the published epoch; the old blocked
+    # source now classifies ALLOW under the new bundle (fresh tuple).
+    fresh_old = _fresh_pkt(BLOCKED, SRV)
+    _step_both(t, o, [fresh_old], next(_NOW))
+    _drain_both(t, o, next(_NOW))
+    rt4, _ = _step_both(t, o, [fresh_old], next(_NOW))
+    assert list(rt4.code) == [0]
+
+
+def test_eviction_pressure_with_full_miss_queue():
+    """Tiny cache (direct-mapped collisions every drain) + tiny queue
+    (admissions tail-drop): overflow accounting matches on both engines,
+    overflowed flows stay unclassified until re-admitted, and the
+    eviction races stay in exact parity (shared hash discipline)."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, flow_slots=1 << 4, queue=4, drain_batch=4)
+
+    probes = [_fresh_pkt(CLIENT, SRV) for _ in range(4)] + \
+             [_fresh_pkt(CLIENT2, SRV) for _ in range(4)]
+    rt, _ = _step_both(t, o, probes, next(_NOW))
+    assert list(rt.pending) == [1] * 8
+    for dp in (t, o):
+        s = dp.slowpath_stats()
+        assert (s["depth"], s["overflows_total"]) == (4, 4)
+
+    # 16 slots vs 8 flows x 2 conntrack legs: commits race for slots, so
+    # flows can keep re-missing as drains evict each other's entries —
+    # the assertion is exact PARITY every round (shared hash/eviction
+    # discipline), not convergence.  Overflowed flows re-admit as they
+    # re-miss; every non-pending lane reports the true classify verdict.
+    for _ in range(5):
+        _drain_both(t, o, next(_NOW))
+        rti, _ = _step_both(t, o, probes, next(_NOW))
+        pend = np.asarray(rti.pending)
+        assert np.array_equal(
+            np.asarray(rti.code)[pend == 0],
+            np.zeros(int((pend == 0).sum()), np.int32),
+        )
+        ct, co = t.cache_stats(), o.cache_stats()
+        # (evictions is excluded: within-batch collision ACCOUNTING is
+        # implementation-defined per the oracle's docstring — the
+        # resulting cache STATE, below, is the parity surface.)
+        for k in ("occupied", "committed", "denials"):
+            assert ct[k] == co[k], (k, ct, co)
+        st, so = t.slowpath_stats(), o.slowpath_stats()
+        for k in ("depth", "admitted_total", "overflows_total",
+                  "drained_total", "epoch"):
+            assert st[k] == so[k], (k, st, so)
+
+
+def test_epoch_swap_during_inflight_drain_reclassifies():
+    """A bundle swap landing between begin_drain and finish_drain: the
+    in-flight batch is re-classified under the NEW tensors (counted in
+    stale_reclassified_total), never published stale — asserted against
+    the sync oracle compiled from the new bundle."""
+    ps, svcs = _world()
+    ps2, _ = _world(blocked_ip=CLIENT)  # the swap flips who is blocked
+    results = {}
+    for dp_cls in (TpuflowDatapath, OracleDatapath):
+        kw = {"miss_chunk": 16} if dp_cls is TpuflowDatapath else {}
+        dp = dp_cls(ps, svcs, flow_slots=1 << 10, aff_slots=1 << 4,
+                    async_slowpath=True, miss_queue_slots=64,
+                    drain_batch=8, **kw)
+        probe = _fresh_pkt(CLIENT, SRV)
+        now = next(_NOW)
+        r = dp.step(PacketBatch.from_packets([probe]), now)
+        assert list(r.pending) == [1]
+        eng = dp._slowpath
+        assert eng.begin_drain(next(_NOW))
+        dp.install_bundle(ps=ps2)  # mid-drain epoch swap
+        st = eng.finish_drain(next(_NOW))
+        assert st["stale_reclassified"] == 1
+        assert dp.slowpath_stats()["stale_reclassified_total"] == 1
+        r2 = dp.step(PacketBatch.from_packets([probe]), next(_NOW))
+        results[dp_cls.__name__] = int(r2.code[0])
+        # Classified under the NEW bundle: CLIENT -> SRV is now denied...
+        sync = OracleDatapath(ps2, svcs, flow_slots=1 << 10,
+                              aff_slots=1 << 4)
+        rs = sync.step(PacketBatch.from_packets(
+            [_fresh_pkt(CLIENT, SRV)]), next(_NOW))
+        assert int(r2.code[0]) == int(rs.code[0]) == 1
+    assert len(set(results.values())) == 1
+
+
+def test_age_scan_reclaims_expired_entries_only():
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, ct_timeout_s=5)
+    young_now = next(_NOW)
+    _step_both(t, o, [_fresh_pkt(CLIENT, SRV)], young_now)
+    _drain_both(t, o, young_now + 1)
+    occ_t = t.cache_stats()["occupied"]
+    assert occ_t == o.cache_stats()["occupied"] > 0
+    # Well past the idle timeout: the scan physically reclaims both legs.
+    late = young_now + 500
+    nt = t._slowpath.age_scan(late)
+    no = o._slowpath.age_scan(late)
+    assert nt == no == occ_t
+    assert t.cache_stats()["occupied"] == o.cache_stats()["occupied"] == 0
+    assert t.slowpath_stats()["aged_entries_total"] == nt
+
+
+def test_queue_dump_and_metrics_families():
+    from antrea_tpu.observability.metrics import render_metrics
+
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs)
+    _step_both(t, o, [_fresh_pkt(CLIENT, SRV)], next(_NOW))
+    for dp in (t, o):
+        [row] = dp.dump_miss_queue()
+        assert row["src"] == CLIENT and row["dst"] == SRV
+        assert row["epoch"] >= 1 and row["enqueued_at"] >= 1000
+        text = render_metrics(dp, node="n1")
+        for fam in ("antrea_tpu_miss_queue_depth",
+                    "antrea_tpu_miss_queue_capacity",
+                    "antrea_tpu_miss_queue_overflows_total",
+                    "antrea_tpu_flow_cache_epoch",
+                    "antrea_tpu_flow_cache_epoch_age_seconds"):
+            assert f'{fam}{{node="n1"}}' in text, fam
+        assert 'antrea_tpu_miss_queue_depth{node="n1"} 1' in text
+    _drain_both(t, o, next(_NOW))
+    for dp in (t, o):
+        text = render_metrics(dp, node="n1")
+        assert 'antrea_tpu_miss_queue_depth{node="n1"} 0' in text
+        # Drain-batch histogram appears once a drain has run.
+        assert "antrea_tpu_slowpath_drain_batch_size_bucket" in text
+        assert dp.dump_miss_queue() == []
+    # Trace overlay cleared after the drain.
+    b = PacketBatch.from_packets([_fresh_pkt(CLIENT, SRV)])
+    assert t.trace(b, next(_NOW))[0]["queued"] is False
+
+
+@pytest.mark.chaos
+def test_chaos_install_failure_mid_epoch_swap_reconverges():
+    """Chaos smoke (satellite): a datapath install failure injected via
+    dissemination/faults.py lands MID-epoch-swap (between begin_drain and
+    finish_drain); the retry succeeds, the in-flight batch re-classifies
+    under the eventually-installed bundle, and the engine reconverges to
+    oracle verdict parity on fresh tuples."""
+    from antrea_tpu.dissemination.faults import (
+        FaultPlan, FlakyDatapath, InjectedInstallError,
+    )
+
+    ps, svcs = _world()
+    ps2, _ = _world(blocked_ip=CLIENT)
+    plan = FaultPlan(seed=3)
+    inner = TpuflowDatapath(ps, svcs, flow_slots=1 << 10, aff_slots=1 << 4,
+                            miss_chunk=16, async_slowpath=True,
+                            miss_queue_slots=64, drain_batch=8)
+    dp = FlakyDatapath(inner, plan, "n1")
+    oracle = OracleDatapath(ps, svcs, flow_slots=1 << 10, aff_slots=1 << 4,
+                            async_slowpath=True, miss_queue_slots=64,
+                            drain_batch=8)
+
+    probe = _fresh_pkt(CLIENT, SRV)
+    now = next(_NOW)
+    dp.step(PacketBatch.from_packets([probe]), now)
+    oracle.step(PacketBatch.from_packets([probe]), now)
+
+    # Begin the drain, then fail the FIRST install attempt mid-swap (the
+    # reconciler's retry path re-issues it, as in PR 1's agent loop).
+    assert inner._slowpath.begin_drain(next(_NOW))
+    assert oracle._slowpath.begin_drain(next(_NOW))
+    plan.after("n1.install", plan.hits("n1.install"), "fail", times=1)
+    with pytest.raises(InjectedInstallError):
+        dp.install_bundle(ps=ps2)
+    dp.install_bundle(ps=ps2)  # the retry lands
+    oracle.install_bundle(ps=ps2)
+    assert plan.count("fail") == 1  # the chaos actually happened
+    inner._slowpath.finish_drain(next(_NOW))
+    oracle._slowpath.finish_drain(next(_NOW))
+
+    # Reconvergence: fresh tuples agree with the oracle twin AND with a
+    # clean sync oracle holding the final bundle.
+    sync = OracleDatapath(ps2, svcs, flow_slots=1 << 10, aff_slots=1 << 4)
+    probes = [_fresh_pkt(CLIENT, SRV), _fresh_pkt(BLOCKED, SRV)]
+    now = next(_NOW)
+    rt = dp.step(PacketBatch.from_packets(probes), now)
+    ro = oracle.step(PacketBatch.from_packets(probes), now)
+    inner.drain_slowpath(next(_NOW))
+    oracle.drain_slowpath(next(_NOW))
+    now = next(_NOW)
+    rt = dp.step(PacketBatch.from_packets(probes), now)
+    ro = oracle.step(PacketBatch.from_packets(probes), now)
+    rs = sync.step(PacketBatch.from_packets(
+        [_fresh_pkt(CLIENT, SRV), _fresh_pkt(BLOCKED, SRV)]), next(_NOW))
+    assert list(rt.code) == list(ro.code) == list(rs.code) == [1, 0]
+
+
+@pytest.mark.slow
+def test_async_mode_matches_reachability_fixtures():
+    """Acceptance: async mode reaches oracle verdict parity on the FULL
+    hand-authored reachability suite — every scenario's probes are
+    admitted (provisional), drained, and re-probed; post-drain verdicts
+    must equal the fixture truth table on both engines."""
+    from fixtures_reachability import SCENARIOS, _ip
+
+    for scenario in SCENARIOS:
+        t = TpuflowDatapath(scenario.ps, [], flow_slots=1 << 10,
+                            aff_slots=1 << 4, miss_chunk=16,
+                            async_slowpath=True, drain_batch=64)
+        o = OracleDatapath(scenario.ps, [], flow_slots=1 << 10,
+                           aff_slots=1 << 4, async_slowpath=True,
+                           drain_batch=64)
+        pkts = [
+            Packet(src_ip=iputil.ip_to_u32(_ip(p.src)),
+                   dst_ip=iputil.ip_to_u32(_ip(p.dst)),
+                   proto=p.proto, src_port=p.sport, dst_port=p.dport)
+            for p in scenario.probes
+        ]
+        now = next(_NOW)
+        rt, _ro = _step_both(t, o, pkts, now)
+        assert int(np.asarray(rt.pending).sum()) == len(pkts), scenario.name
+        _drain_both(t, o, next(_NOW))
+        rt2, _ = _step_both(t, o, pkts, next(_NOW))
+        got = [int(c) for c in rt2.code]
+        want = [p.expect for p in scenario.probes]
+        assert got == want, (scenario.name, scenario.cite,
+                             list(zip(scenario.probes, got)))
+
+
+def test_hold_admission_leaves_punt_and_arp_lanes_alone():
+    """Regression: lanes handled BEFORE the pipeline (IGMP punt, ARP)
+    are not misses — a hold admission policy must not stamp its
+    provisional DROP on them, and they are never queued (parity with the
+    oracle's skipped-lane ALLOW image)."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, admission="hold")
+    igmp = Packet(src_ip=iputil.ip_to_u32(CLIENT),
+                  dst_ip=iputil.ip_to_u32("224.0.0.22"), proto=2,
+                  src_port=0, dst_port=0)
+    arp = Packet(src_ip=iputil.ip_to_u32(CLIENT),
+                 dst_ip=iputil.ip_to_u32(SRV), proto=0,
+                 src_port=0, dst_port=0)
+    miss = _fresh_pkt(CLIENT, SRV)
+    bt = PacketBatch.from_packets([igmp, arp, miss])
+    bt.arp_op = np.array([0, 1, 0], np.int32)
+    bo = PacketBatch.from_packets([igmp, arp, miss])
+    bo.arp_op = np.array([0, 1, 0], np.int32)
+    now = next(_NOW)
+    rt, ro = t.step(bt, now), o.step(bo, now)
+    _assert_parity(rt, ro, "punt/arp lanes")
+    assert list(rt.code) == [0, 0, 1]     # punt/ARP allow; only the real
+    assert list(rt.pending) == [0, 0, 1]  # miss is held + queued
+    assert t.slowpath_stats()["depth"] == o.slowpath_stats()["depth"] == 1

@@ -1,0 +1,208 @@
+"""ACNP `toServices` egress peer kind (ISSUE 3 satellite; ref crd
+types.go:598, controller resolution antreanetworkpolicy.go:130-131, agent
+ServiceGroupID conjunction): controlplane type -> compiler lowering into
+the svc-key reference sub-space -> oracle parity on both engines.
+
+The discriminating property (which an IP-space lowering could not
+express): traffic addressed to ANY frontend of the referenced Service
+matches, while traffic sent DIRECTLY to the very same endpoint does not.
+"""
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis import crd
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.controller.admission import AdmissionDenied
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+CLIENT = "10.0.1.1"
+DB_EP, WEB_EP = "10.0.2.2", "10.0.3.3"
+NODE_IP = "172.16.0.9"
+
+SVCS = [
+    ServiceEntry(cluster_ip="10.96.0.10", port=5432, protocol=6,
+                 name="db", namespace="prod",
+                 endpoints=[Endpoint(ip=DB_EP, port=5432)],
+                 node_port=30032),
+    ServiceEntry(cluster_ip="10.96.0.11", port=80, protocol=6,
+                 name="web", namespace="prod",
+                 endpoints=[Endpoint(ip=WEB_EP, port=8080)]),
+]
+
+
+def _ps():
+    return PolicySet(
+        policies=[cp.NetworkPolicy(
+            uid="deny-db", name="deny-db", type=cp.NetworkPolicyType.ACNP,
+            rules=[cp.NetworkPolicyRule(
+                direction=cp.Direction.OUT,
+                to_peer=cp.NetworkPolicyPeer(to_services=[
+                    cp.ServiceReference(name="db", namespace="prod")]),
+                action=cp.RuleAction.DROP, priority=0)],
+            applied_to_groups=["clients"], tier_priority=250, priority=1.0,
+        )],
+        applied_to_groups={"clients": cp.AppliedToGroup(
+            name="clients", members=[cp.GroupMember(ip=CLIENT)])},
+    )
+
+
+def _pkt(src, dst, dport, sport=40000):
+    return Packet(src_ip=iputil.ip_to_u32(src), dst_ip=iputil.ip_to_u32(dst),
+                  proto=6, src_port=sport, dst_port=dport)
+
+
+def _mk(cls, ps, svcs=SVCS):
+    kw = {"miss_chunk": 16} if cls is TpuflowDatapath else {}
+    return cls(ps, svcs, flow_slots=1 << 10, aff_slots=1 << 4,
+               node_ips=[NODE_IP], node_name="n1", **kw)
+
+
+@pytest.mark.parametrize("cls", [TpuflowDatapath, OracleDatapath])
+def test_toservices_matches_frontends_not_endpoints(cls):
+    """Every frontend of the referenced Service (ClusterIP + NodePort)
+    drops; DIRECT traffic to the same endpoint — and to other services —
+    is untouched."""
+    dp = _mk(cls, _ps())
+    probes = [
+        _pkt(CLIENT, "10.96.0.10", 5432),         # db ClusterIP -> DROP
+        _pkt(CLIENT, NODE_IP, 30032),             # db NodePort  -> DROP
+        _pkt(CLIENT, DB_EP, 5432),                # direct to endpoint -> ALLOW
+        _pkt(CLIENT, "10.96.0.11", 80),           # other service -> ALLOW
+        _pkt("10.0.8.8", "10.96.0.10", 5432),     # other client  -> ALLOW
+    ]
+    r = dp.step(PacketBatch.from_packets(probes), now=5)
+    assert list(r.code) == [1, 1, 0, 0, 0]
+    assert r.egress_rule[0] == r.egress_rule[1] == "deny-db/Out/0"
+    assert r.egress_rule[2] is None
+    # Cached entries replay the verdict (fresh tuples on re-probe).
+    probes2 = [_pkt(CLIENT, "10.96.0.10", 5432, sport=40001),
+               _pkt(CLIENT, DB_EP, 5432, sport=40001)]
+    r2 = dp.step(PacketBatch.from_packets(probes2), now=6)
+    assert list(r2.code) == [1, 0]
+
+
+def test_toservices_device_oracle_parity_randomized():
+    a, b = _mk(TpuflowDatapath, _ps()), _mk(OracleDatapath, _ps())
+    rng = np.random.default_rng(7)
+    dsts = [("10.96.0.10", 5432), (NODE_IP, 30032), (DB_EP, 5432),
+            ("10.96.0.11", 80), (WEB_EP, 8080), ("10.0.7.7", 443)]
+    for now in range(1, 4):
+        pkts = []
+        for _ in range(24):
+            d, dport = dsts[int(rng.integers(len(dsts)))]
+            src = CLIENT if rng.random() < 0.6 else "10.0.8.8"
+            pkts.append(_pkt(src, d, dport,
+                             sport=int(rng.integers(41000, 41100))))
+        ra = a.step(PacketBatch.from_packets(pkts), now)
+        rb = b.step(PacketBatch.from_packets(pkts), now)
+        assert list(ra.code) == list(rb.code)
+        assert ra.egress_rule == rb.egress_rule
+        assert list(ra.svc_idx) == list(rb.svc_idx)
+
+
+@pytest.mark.parametrize("cls", [TpuflowDatapath, OracleDatapath])
+def test_toservices_service_set_changes_track(cls):
+    """Service-only bundles renumber the service list; the reference
+    lowering follows IDENTITY (a reorder keeps matching, a deletion makes
+    the reference dangle -> matches nothing)."""
+    dp = _mk(cls, _ps())
+    r = dp.step(PacketBatch.from_packets(
+        [_pkt(CLIENT, "10.96.0.10", 5432)]), now=1)
+    assert list(r.code) == [1]
+    # Reorder: indices shift, identity keeps matching (fresh tuple).
+    dp.install_bundle(services=[SVCS[1], SVCS[0]])
+    r2 = dp.step(PacketBatch.from_packets(
+        [_pkt(CLIENT, "10.96.0.10", 5432, sport=40002)]), now=2)
+    assert list(r2.code) == [1]
+    # Delete db: the reference dangles; its old ClusterIP is no longer a
+    # service frontend and classifies by address alone (fresh tuple).
+    dp.install_bundle(services=[SVCS[1]])
+    r3 = dp.step(PacketBatch.from_packets(
+        [_pkt(CLIENT, "10.96.0.10", 5432, sport=40003)]), now=3)
+    assert list(r3.code) == [0]
+
+
+def test_controller_conversion_and_admission():
+    """crd AntreaPeer.to_services -> internal ServiceReference peers via
+    the NP controller; admission rejects the combinations the reference
+    rejects (toServices in ingress / with ports / with other peer
+    fields)."""
+    ctl = NetworkPolicyController()
+    ctl.upsert_namespace(crd.Namespace(name="default"))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="c1", ip=CLIENT,
+                           node="n1", labels={"app": "client"}))
+
+    def acnp(rules, uid="ts1"):
+        return crd.AntreaNetworkPolicy(
+            uid=uid, name=uid, namespace="", tier_priority=250, priority=1,
+            applied_to=[crd.AntreaAppliedTo(
+                pod_selector=crd.LabelSelector.make({"app": "client"}),
+                ns_selector=crd.LabelSelector.make())],
+            rules=rules,
+        )
+
+    ref = crd.ServiceReference(name="db", namespace="prod")
+    ctl.upsert_antrea_policy(acnp([crd.AntreaNPRule(
+        direction=cp.Direction.OUT, action=cp.RuleAction.DROP,
+        peers=[crd.AntreaPeer(to_services=(ref,))])]))
+    ps = ctl.policy_set()
+    [np_] = [p for p in ps.policies if p.uid == "ts1"]
+    assert np_.rules[0].to_peer.to_services == [
+        cp.ServiceReference(name="db", namespace="prod")]
+
+    # The converted set enforces on both engines (full path: crd ->
+    # controller -> compiler -> verdict).
+    for cls in (TpuflowDatapath, OracleDatapath):
+        dp = _mk(cls, ps)
+        r = dp.step(PacketBatch.from_packets(
+            [_pkt(CLIENT, "10.96.0.10", 5432),
+             _pkt(CLIENT, DB_EP, 5432)]), now=1)
+        assert list(r.code) == [1, 0], cls
+
+    with pytest.raises(AdmissionDenied):
+        ctl.upsert_antrea_policy(acnp([crd.AntreaNPRule(
+            direction=cp.Direction.IN, action=cp.RuleAction.DROP,
+            peers=[crd.AntreaPeer(to_services=(ref,))])], uid="bad1"))
+    with pytest.raises(AdmissionDenied):
+        ctl.upsert_antrea_policy(acnp([crd.AntreaNPRule(
+            direction=cp.Direction.OUT, action=cp.RuleAction.DROP,
+            peers=[crd.AntreaPeer(to_services=(ref,))],
+            ports=[crd.PortSpec(protocol=6, port=5432)])], uid="bad2"))
+    with pytest.raises(AdmissionDenied):
+        ctl.upsert_antrea_policy(acnp([crd.AntreaNPRule(
+            direction=cp.Direction.OUT, action=cp.RuleAction.DROP,
+            peers=[crd.AntreaPeer(
+                to_services=(ref,),
+                ip_block=crd.IPBlock("10.0.0.0/8"))])], uid="bad3"))
+    # toServices must be the rule's ONLY peer (upstream rejects it
+    # combined with `to`): a sibling selector peer would otherwise be
+    # silently dropped by the merged lowering.
+    with pytest.raises(AdmissionDenied):
+        ctl.upsert_antrea_policy(acnp([crd.AntreaNPRule(
+            direction=cp.Direction.OUT, action=cp.RuleAction.DROP,
+            peers=[crd.AntreaPeer(to_services=(ref,)),
+                   crd.AntreaPeer(pod_selector=crd.LabelSelector.make(
+                       {"app": "victim"}))])], uid="bad4"))
+    # The compiler itself refuses a merged peer that bypassed admission.
+    from antrea_tpu.compiler.compile import compile_policy_set
+    bad_ps = _ps()
+    bad_ps.policies[0].rules[0].to_peer.ip_blocks = [cp.IPBlock("10.0.0.0/8")]
+    with pytest.raises(ValueError):
+        compile_policy_set(bad_ps, services=SVCS)
+
+
+def test_toservices_serde_round_trip():
+    from antrea_tpu.dissemination import serde
+
+    ps = _ps()
+    doc = serde.encode_policy_set(ps)
+    back = serde.decode_policy_set(doc)
+    peer = back.policies[0].rules[0].to_peer
+    assert peer.to_services == [
+        cp.ServiceReference(name="db", namespace="prod")]
