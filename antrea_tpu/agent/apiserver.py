@@ -21,6 +21,11 @@ Routes:
   GET /appliedtogroups  agent-held appliedTo groups
   GET /ovsflows?now=N   conntrack/flow-cache dump (Datapath.dump_flows)
   GET /cache            flow-cache census (Datapath.cache_stats)
+  GET /commitplane      bundle commit-plane state (Datapath.commit_stats:
+                        degraded flag, LKG generation/age, per-stage
+                        commit outcomes, rollback/canary counters — the
+                        operator's first stop when a policy push is
+                        rejected; see datapath/commit.py)
   GET /memberlist       alive members of the gossip cluster
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
@@ -181,6 +186,14 @@ class AgentApiServer:
             return self._dp.dump_flows(now=int(q.get("now", 0)))
         if route == "/cache":
             return self._dp.cache_stats()
+        if route == "/commitplane":
+            cs = getattr(self._dp, "commit_stats", None)
+            body = cs() if cs is not None else None
+            if body is None:
+                # Datapath without a commit plane (the Datapath base
+                # default returns None): 404, not a literal null body.
+                raise KeyError(route)
+            return body
         if route == "/memberlist":
             if self._memberlist is None:
                 return []

@@ -236,6 +236,15 @@ class AgentPolicyController:
         (sync_failures_total), reported upstream as a Failed realization,
         and retried with backoff on later sync() calls — the dirty state is
         never dropped."""
+        if getattr(self.datapath, "degraded", False):
+            # Quarantined datapath (datapath/commit.py): it is serving
+            # last-known-good verdicts after a rollback and rejects
+            # incremental deltas until a full-bundle recompile passes its
+            # canary.  The agent holds the authoritative PolicySet, so
+            # force the bundle path — even with nothing locally pending —
+            # and let the existing retry/backoff discipline pace the
+            # recovery attempts.
+            self._rules_dirty = True
         if not self._rules_dirty and not self._deltas:
             return
         t0 = self._clock()

@@ -75,7 +75,22 @@ class FqdnController:
             else:
                 by_group.setdefault(group, []).append(ip)
         for group, ips in by_group.items():
-            self.datapath.apply_group_delta(group, ips, [])
+            self._apply_delta(group, ips, [])
+
+    def _apply_delta(self, group: str, added: list, removed: list) -> bool:
+        """Guarded datapath delta: a QUARANTINED datapath (degraded after a
+        commit-plane rollback, datapath/commit.py) rejects deltas with
+        BundleQuarantinedError — that must not crash the DNS packet-in or
+        TTL-GC paths.  Returns False then; recovery is a full bundle, after
+        which the agent calls configure() and learned membership re-applies
+        from self._learned."""
+        from ..datapath.commit import BundleQuarantinedError
+
+        try:
+            self.datapath.apply_group_delta(group, added, removed)
+            return True
+        except BundleQuarantinedError:
+            return False
 
     def observe_dns(self, name: str, ips: list[str], ttl_s: int, now: int) -> int:
         """One DNS response (the packet-in payload): add the resolved
@@ -94,8 +109,14 @@ class FqdnController:
                     self._learned[k] = _Learned(expires=now + ttl_s)
                     added.append(ip)
             if added:
-                self.datapath.apply_group_delta(group, added, [])
-                updates += 1
+                if self._apply_delta(group, added, []):
+                    updates += 1
+                else:
+                    # Quarantined: forget the rejected members so the next
+                    # DNS response (or post-recovery configure()) re-adds
+                    # them — _learned must mirror what was actually pushed.
+                    for ip in added:
+                        self._learned.pop((group, ip), None)
         return updates
 
     def tick(self, now: int) -> int:
@@ -107,5 +128,9 @@ class FqdnController:
                 by_group.setdefault(group, []).append(ip)
                 del self._learned[(group, ip)]
         for group, ips in by_group.items():
-            self.datapath.apply_group_delta(group, [], ips)
+            # A quarantine here leaves the expired members installed a
+            # little longer (deny rules fail CLOSED, never open); the
+            # post-recovery bundle + configure() rebuilds membership from
+            # _learned, which already dropped them.
+            self._apply_delta(group, [], ips)
         return len(by_group)

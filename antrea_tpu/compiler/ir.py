@@ -254,3 +254,113 @@ def replace_rule(r: NetworkPolicyRule, **kw) -> NetworkPolicyRule:
     from dataclasses import replace
 
     return replace(r, **kw)
+
+
+# -- canary probe derivation (datapath/commit.py commit plane) ---------------
+
+# Addresses matched by NO sane policy fixture: the canary must always carry
+# at least one default-allow probe, so a miscompile that drops everything
+# (or allows everything) is visible even on an empty rule set.
+_CANARY_SENTINELS = ("203.0.113.250", "198.18.255.251")
+_CANARY_PORT_SENTINEL = 47808  # unlikely to sit inside a rule's port range
+
+
+def canary_probe_tuples(ps: PolicySet, *, seq: int = 0, limit: int = 96,
+                        groups=None, extra_ips=()
+                        ) -> list[tuple[int, int, int, int, int]]:
+    """Deterministic 5-tuple probe set derived from a rule set's own
+    address/port material -> [(src_u32, dst_u32, proto, src_port, dst_port)].
+
+    The commit plane (datapath/commit.py) classifies these through a
+    CANDIDATE bundle's fresh-walk path and diffs each verdict against the
+    scalar Oracle interpreter before the bundle may swap in.  Derivation
+    rules:
+
+      * addresses come from group members and ipBlock BOUNDARIES (first,
+        last, and one-past-the-end of every range — off-by-one compiles
+        are boundary bugs), plus fixed outside-sentinel addresses so the
+        default verdict is probed even under an empty rule set;
+      * dst ports come from rule service port-range boundaries (lo, hi,
+        hi+1) plus a sentinel port, so port-dimension compiles are probed;
+      * src_port is derived from `seq` (the owner's commit sequence):
+        every canary round is a FRESH flow — established-entry semantics
+        (conntrack survival across bundles) can never mask a miscompile;
+      * v4 only (the probe path is the narrow fast path; v6 shares the
+        match compiler) and capped at `limit` pairs, address-sorted so the
+        set is stable for a given rule set;
+      * `groups` (a set of group names) scopes address derivation to those
+        groups — the incremental-delta canary certifies the touched
+        group's blast radius at the delta's own latency class instead of
+        re-deriving the full bundle's probe matrix; `extra_ips` adds
+        explicit members/CIDRs (the delta's added AND removed addresses,
+        so a removal is probed as a non-member too).
+    """
+    rps = resolve_named_ports(ps)
+    addrs: set[int] = set()
+
+    def add_range(lo: int, hi: int) -> None:
+        if lo >= iputil.V6_OFF:
+            return
+        addrs.update((lo, max(lo, hi - 1)))
+        if hi < iputil.V6_OFF:
+            addrs.add(hi & 0xFFFFFFFF)  # one past the range
+
+    for table in (rps.address_groups, rps.applied_to_groups):
+        for name, g in table.items():
+            if groups is not None and name not in groups:
+                continue
+            for m in g.members:
+                k = iputil.ip_to_key(m.ip)
+                if not iputil.key_is_v6(k):
+                    addrs.add(k & 0xFFFFFFFF)
+            for b in getattr(g, "ip_blocks", ()) or ():
+                for lo, hi in iputil.ipblock_to_ranges(b.cidr, b.excepts):
+                    add_range(lo, hi)
+    for ip in extra_ips:
+        try:
+            add_range(*iputil.cidr_to_range(ip))
+        except ValueError:
+            continue
+    addrs.update(iputil.ip_to_u32(s) for s in _CANARY_SENTINELS)
+
+    ports: set[int] = {_CANARY_PORT_SENTINEL}
+    protos: set[int] = {6}
+    for p in rps.policies:
+        for r in p.rules:
+            for s in r.services:
+                if s.protocol is not None:
+                    protos.add(int(s.protocol))
+                if s.port is not None:
+                    hi = s.end_port if s.end_port is not None else s.port
+                    ports.update((int(s.port), int(hi), min(int(hi) + 1, 65535)))
+
+    # Bounded, deterministic pair fan-out: every address appears as both a
+    # src and a dst against a rolling window of peers (covers ingress AND
+    # egress evaluation of each address) instead of the full cross product.
+    alist = sorted(addrs)
+    plist = sorted(ports)
+    src_port = 40000 + (int(seq) * 17) % 20000  # fresh per commit round
+    out: list[tuple[int, int, int, int, int]] = []
+    seen: set[tuple] = set()
+    n = len(alist)
+    prlist = sorted(protos)
+    for i, a in enumerate(alist):
+        for off in sorted({1, 2, n // 2 or 1}):
+            b = alist[(i + off) % n]
+            if a == b:
+                continue
+            dport = plist[(i + off) % len(plist)]
+            proto = prlist[(i + off) % len(prlist)]
+            # ICMP lanes carry (type<<8)|code in dst_port; probing them
+            # with rule-derived TCP ports would encode nonsense types —
+            # keep ICMP probes on type 8 (echo), code 0.
+            if proto == 1:
+                dport = 8 << 8
+            t = (a, b, proto, src_port, dport)
+            if t in seen:
+                continue
+            seen.add(t)
+            out.append(t)
+            if len(out) >= limit:
+                return out
+    return out

@@ -1,5 +1,6 @@
 """Datapath plugin boundary (ref: pkg/ovs/ovsconfig OVSDatapathType seam)."""
 
+from .commit import BundleQuarantinedError, CanaryMismatchError, CommitPlane
 from .interface import Datapath, DatapathType, StepResult
 from .oracle_dp import OracleDatapath
 from .tpuflow import TpuflowDatapath
@@ -17,6 +18,9 @@ def make_datapath(kind: DatapathType | str, *args, **kwargs) -> Datapath:
 
 
 __all__ = [
+    "BundleQuarantinedError",
+    "CanaryMismatchError",
+    "CommitPlane",
     "Datapath",
     "DatapathType",
     "StepResult",

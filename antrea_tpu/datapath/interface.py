@@ -16,6 +16,15 @@ and returns the new generation; in tpuflow this is the double-buffered
 (drs', dsvc', gen+1) tensor swap.  `apply_group_delta` is the incremental
 path (address-group watch deltas, docs/design/architecture.md:61-62):
 bounded host work + a small device upload, no recompile.
+
+Both install paths are TRANSACTIONAL (datapath/commit.py): every commit
+runs compile -> canary -> atomic swap -> settle, a canary-rejected or
+compile-failed candidate rolls back to the retained last-known-good
+bundle, and a rolled-back datapath serves LKG verdicts in a visible
+degraded mode (deltas raise BundleQuarantinedError) until a full-bundle
+recompile passes its canary.  The commit surface on every datapath:
+`degraded`, `commit_stats()`, `canary_scan(now)` (the off-hot-step
+live-bundle watchdog), `arm_commit_faults(plan, name)` (chaos tier).
 """
 
 from __future__ import annotations
@@ -171,6 +180,17 @@ class Datapath(ABC):
         packet, the stage-by-stage observations WITHOUT mutating any state.
         Keys: cache_hit, est, svc_idx, dnat_ip, dnat_port, egress_code,
         egress_rule, ingress_code, ingress_rule, code."""
+
+    # -- transactional commit surface (datapath/commit.py; both engines
+    # override via the TransactionalDatapath mixin — these are the inert
+    # defaults for datapaths without a commit plane, e.g. test doubles) ------
+
+    degraded = False  # serving LKG after a rollback; deltas quarantined
+
+    def commit_stats(self) -> Optional[dict]:
+        """Commit-plane counters (stage outcomes, rollbacks, canary
+        probes/mismatches, LKG generation/age) — None without a plane."""
+        return None
 
     # -- async slow-path surface (datapath/slowpath; both engines) ----------
     # Shared plumbing: each engine implements the CLASSIFY callbacks

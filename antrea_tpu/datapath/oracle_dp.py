@@ -47,6 +47,7 @@ from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..utils import ip as iputil
 from ..packet import PacketBatch
 from . import persist
+from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 from .slowpath import ADMIT_HOLD
 
@@ -62,7 +63,8 @@ def _group_ranges(g) -> set:
     return set(iputil.merge_ranges(rs))
 
 
-class OracleDatapath(persist.PersistableDatapath, Datapath):
+class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
+                     Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -84,11 +86,13 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         miss_queue_slots: int = 1 << 16,
         admission: str = "forward",
         drain_batch: int = 4096,
+        canary_probes: int = 64,
     ):
         from ..features import DEFAULT_GATES
 
         self._gates = feature_gates or DEFAULT_GATES
         self._dual_stack = dual_stack
+        self._node_ips = list(node_ips or [])
         # Async slow path — the scalar twin of TpuflowDatapath's engine,
         # same admission/drain/epoch semantics (shared plumbing on the
         # Datapath base) so the differential harness diffs mode-for-mode.
@@ -123,6 +127,9 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         # kernel twin (antrea_tpu_datapath_step_seconds).
         self.step_hist = Histogram()
         self._rebuild_l7_ids()
+        # Commit plane LAST (datapath/commit.py): boot state is the LKG
+        # baseline — same contract as the kernel twin.
+        self._init_commit_plane(canary_probes=canary_probes)
 
     def _rebuild_l7_ids(self) -> None:
         """Stable ids of rules carrying L7 protocols in the CURRENT policy
@@ -162,22 +169,24 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
     def generation(self) -> int:
         return self._gen
 
-    def install_bundle(self, ps=None, services=None) -> int:
+    def _install_bundle_impl(self, ps=None, services=None) -> int:
+        # Compile stage of the commit plane (datapath/commit.py): the plane
+        # owns canary gating, rollback, and settle-time persistence.
         if ps is not None:
             self._ps = ps
             self._rebuild_l7_ids()
         if services is not None:
             self._services = list(services)
         self._oracle.update(
-            ps=ps, services=list(services) if services is not None else None
+            ps=ps, services=list(services) if services is not None else None,
+            scrub_log=getattr(self, "_scrub_log", None),
         )
         self._gen += 1
         if self._slowpath is not None:
             self._slowpath.mark_stale(self._gen)
-        self._persist()
         return self._gen
 
-    def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
+    def _apply_group_delta_impl(self, group_name, added_ips, removed_ips) -> int:
         touched = False
         changed = False
         for table in (self._ps.address_groups, self._ps.applied_to_groups):
@@ -209,15 +218,15 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
             # TpuflowDatapath's no-op fast path so the differential harness
             # sees identical gen/cache behavior.
             return self._gen
-        self._oracle.update(ps=self._ps)
+        self._oracle.update(ps=self._ps,
+                            scrub_log=getattr(self, "_scrub_log", None))
         self._gen += 1
         if self._slowpath is not None:
             self._slowpath.mark_stale(self._gen)
         # Delta path marks dirty instead of rewriting the whole snapshot —
-        # see TpuflowDatapath.apply_group_delta for the recovery contract;
-        # the generation itself is journaled (cookie-round append).
-        self._persist_dirty = True
-        self._record_round()
+        # see TpuflowDatapath._apply_group_delta_impl for the recovery
+        # contract; the generation is journaled by the plane's settle
+        # stage (cookie-round append) after the canary certifies it.
         return self._gen
 
     def stats(self) -> DatapathStats:
@@ -327,6 +336,80 @@ class OracleDatapath(persist.PersistableDatapath, Datapath):
         for s in dead:
             del o.flow[s]
         return len(dead)
+
+    # -- commit plane hooks (datapath/commit.py; scalar twin of the kernel's
+    # snapshot/restore/canary surface) ----------------------------------------
+
+    def _commit_snapshot(self, group: Optional[str] = None) -> dict:
+        """The retained last-known-good generation.  PipelineOracle.update
+        replaces its Oracle/service tables wholesale (reference copies
+        suffice); its ONLY in-place flow mutation is the vanished-rule
+        attribution scrub, captured copy-on-scrub via the armed
+        `_scrub_log` (so the happy path never clones the cache) and
+        replayed by _commit_restore.  The delta path mutates group member
+        lists in place — `group` scopes that copy to the touched group
+        (the twin of TpuflowDatapath's O(delta) contract)."""
+        o = self._oracle
+        if group is None:
+            ps_members = [
+                (g, list(g.members))
+                for table in (self._ps.address_groups,
+                              self._ps.applied_to_groups)
+                for g in table.values()
+            ]
+        else:
+            ps_members = [
+                (g, list(g.members))
+                for g in (self._ps.address_groups.get(group),
+                          self._ps.applied_to_groups.get(group))
+                if g is not None
+            ]
+        # Armed for the impl call this snapshot brackets: update() appends
+        # (slot, rule_in, rule_out) pre-images before scrubbing.
+        self._scrub_log: list = []
+        return {
+            "gen": self._gen,
+            "ps": self._ps,
+            "ps_members": ps_members,
+            "services": self._services,
+            "rules": o.oracle,
+            "o_services": (o.services, o.programs, o.svc_by_key),
+            "flow": o.flow,  # by reference; mutations ride the scrub log
+            "aff": o.aff,  # neither update() nor the delta path touches it
+            "scrub_log": self._scrub_log,
+            "l7_ids": self._l7_ids,
+            "has_named_ports": self._has_named_ports,
+            "exemplars": self._exemplars,
+        }
+
+    def _commit_restore(self, snap: dict) -> None:
+        o = self._oracle
+        self._gen = snap["gen"]
+        self._ps = snap["ps"]
+        for g, members in snap["ps_members"]:
+            g.members = members
+        self._services = snap["services"]
+        o.oracle = snap["rules"]
+        o.services, o.programs, o.svc_by_key = snap["o_services"]
+        o.flow = snap["flow"]
+        o.aff = snap["aff"]
+        for slot, ri, ro in snap["scrub_log"]:
+            e = o.flow.get(slot)
+            if e is not None:
+                e["rule_in"], e["rule_out"] = ri, ro
+        self._l7_ids = snap["l7_ids"]
+        self._has_named_ports = snap["has_named_ports"]
+        self._exemplars = snap["exemplars"]
+
+    def _canary_classify(self, batch: PacketBatch, now: int) -> np.ndarray:
+        """Fresh-walk verdict of each probe, state untouched (fresh_walk is
+        read-only: affinity learns are returned, never applied)."""
+        o = self._oracle
+        return np.asarray([
+            o.fresh_walk(o.aff, batch.packet(i),
+                         o._flow_hash(batch.packet(i)), now)["code"]
+            for i in range(batch.size)
+        ], np.int32)
 
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
                 *, now: int = 1000, mode: str = "sync", **_kw) -> dict:

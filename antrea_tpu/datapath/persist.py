@@ -23,6 +23,7 @@ alias a pre-restart denial.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 
@@ -30,12 +31,19 @@ from ..apis.service import ServiceEntry
 from ..compiler.ir import PolicySet
 from ..dissemination import serde
 
-SNAPSHOT_VERSION = 1
+SNAPSHOT_VERSION = 2  # v2: two-slot + checksum; v1 (no checksum) still loads
 _FILE = "datapath_snapshot.json"
+_LKG_FILE = "datapath_snapshot.lkg.json"
 
 
 def snapshot_path(persist_dir: str) -> str:
     return os.path.join(persist_dir, _FILE)
+
+
+def lkg_snapshot_path(persist_dir: str) -> str:
+    """The last-known-good slot: on every save the PREVIOUS latest snapshot
+    (which passed its commit canary when it was written) rotates here."""
+    return os.path.join(persist_dir, _LKG_FILE)
 
 
 def atomic_write_json(path: str, body: object) -> None:
@@ -63,26 +71,55 @@ def read_json(path: str, expect: type = dict):
     return body if isinstance(body, expect) else None
 
 
+def _checksum(body: dict) -> str:
+    """Integrity digest over the canonical JSON of the payload fields
+    (hashlib is stdlib — NOT the `cryptography` wheel, absent on some
+    images; this is corruption detection, not authentication)."""
+    payload = json.dumps(
+        {k: v for k, v in body.items() if k != "checksum"},
+        sort_keys=True, separators=(",", ":"),
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _verify(body: dict) -> bool:
+    if body.get("v") == 1:
+        return True  # pre-checksum snapshots carry no integrity field
+    return body.get("checksum") == _checksum(body)
+
+
 def save_snapshot(
-    persist_dir: str, ps: PolicySet, services: list[ServiceEntry], gen: int
+    persist_dir: str, ps: PolicySet, services: list[ServiceEntry], gen: int,
+    *, fault=None,
 ) -> None:
-    atomic_write_json(snapshot_path(persist_dir), {
+    """Two-slot rotating save: the previous LATEST (canary-certified when
+    it was committed) is copied to the LKG slot, then the new snapshot
+    atomically replaces latest.  Crash windows:
+
+      * mid-rotate: latest intact; a torn LKG fails its checksum and the
+        loader skips it (latest still wins);
+      * between the two writes (`fault("between_slots")` lets tests inject
+        exactly this crash): latest still holds the OLD state and LKG a
+        copy of it — the two slots can never BOTH be lost;
+      * mid-latest-write: atomic_write_json leaves the old latest intact.
+    """
+    latest = snapshot_path(persist_dir)
+    prev = read_json(latest)
+    if prev is not None and _verify(prev):
+        atomic_write_json(lkg_snapshot_path(persist_dir), prev)
+    if fault is not None:
+        fault("between_slots")
+    body = {
         "v": SNAPSHOT_VERSION,
         "generation": gen,
         "policySet": serde.encode_policy_set(ps),
         "services": [serde.encode_service_entry(s) for s in services],
-    })
+    }
+    body["checksum"] = _checksum(body)
+    atomic_write_json(latest, body)
 
 
-def load_snapshot(persist_dir: str):
-    """-> (PolicySet, services, generation) or None if absent/unreadable.
-
-    Unreadable snapshots are treated as absent (fresh boot) — the reference
-    behaves the same when OVSDB external-IDs are missing: new round, full
-    reinstall."""
-    body = read_json(snapshot_path(persist_dir))
-    if body is None or body.get("v") != SNAPSHOT_VERSION:
-        return None
+def _decode_snapshot(body: dict):
     try:
         return (
             serde.decode_policy_set(body["policySet"]),
@@ -91,6 +128,26 @@ def load_snapshot(persist_dir: str):
         )
     except (ValueError, KeyError, TypeError, AttributeError):
         return None
+
+
+def load_snapshot(persist_dir: str):
+    """-> (PolicySet, services, generation) from the newest INTACT slot:
+    latest first, then the LKG slot when latest is absent, truncated,
+    checksum-corrupt, or undecodable.  Only when BOTH slots fail is the
+    boot fresh — the reference behaves the same when OVSDB external-IDs
+    are missing: new round, full reinstall.  (The cookie-round journal is
+    consulted separately, so an LKG fallback never rolls the generation
+    backwards — see PersistableDatapath.)"""
+    for path in (snapshot_path(persist_dir), lkg_snapshot_path(persist_dir)):
+        body = read_json(path)
+        if body is None or body.get("v") not in (1, SNAPSHOT_VERSION):
+            continue
+        if not _verify(body):
+            continue
+        got = _decode_snapshot(body)
+        if got is not None:
+            return got
+    return None
 
 
 # Topology persists in its OWN small file, written per topology event —
@@ -112,9 +169,11 @@ def save_topology(persist_dir: str, topo) -> None:
 
 
 def load_topology(persist_dir: str):
-    """-> Topology or None (absent/unreadable == fresh boot)."""
+    """-> Topology or None (absent/unreadable == fresh boot).  v1 files
+    (written before the two-slot snapshot bumped SNAPSHOT_VERSION) still
+    load — the topology schema itself did not change."""
     body = read_json(topology_path(persist_dir))
-    if body is None or body.get("v") != SNAPSHOT_VERSION:
+    if body is None or body.get("v") not in (1, SNAPSHOT_VERSION):
         return None
     try:
         return serde.decode_topology(body["topology"])
@@ -182,7 +241,11 @@ class PersistableDatapath:
 
     def _persist(self) -> None:
         if self._persist_dir is not None:
-            save_snapshot(self._persist_dir, self._ps, self._services, self._gen)
+            # _persist_fault: optional crash-injection hook (tests) fired
+            # between the two slot writes — see save_snapshot.
+            save_snapshot(self._persist_dir, self._ps, self._services,
+                          self._gen,
+                          fault=getattr(self, "_persist_fault", None))
             self._record_round()
         self._persist_dirty = False
 

@@ -49,11 +49,13 @@ from ..ops.match import DeltaTable, to_device
 from ..packet import PacketBatch
 from ..utils import ip as iputil
 from . import persist
+from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 from .slowpath import ADMIT_HOLD
 
 
-class TpuflowDatapath(persist.PersistableDatapath, Datapath):
+class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
+                      Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -78,6 +80,7 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         miss_queue_slots: int = 1 << 16,
         admission: str = "forward",
         drain_batch: int = 4096,
+        canary_probes: int = 64,
     ):
         from ..features import DEFAULT_GATES
 
@@ -147,6 +150,9 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         self._compile_rules()
         self._compile_services()
         self._compile_topology()
+        # Commit plane LAST: the boot state (possibly persistence-restored)
+        # is the last-known-good baseline every later commit retains.
+        self._init_commit_plane(canary_probes=canary_probes)
 
     # -- Datapath ------------------------------------------------------------
 
@@ -158,7 +164,10 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
     def generation(self) -> int:
         return self._gen
 
-    def install_bundle(self, ps=None, services=None) -> int:
+    def _install_bundle_impl(self, ps=None, services=None) -> int:
+        # Compile stage of the commit plane (datapath/commit.py): the plane
+        # owns canary gating, rollback, and settle-time persistence; this
+        # impl compiles and swaps only.
         # Compile-before-assign (the install_topology convention): the
         # service tables compile from the STAGED list first, and
         # self._services/_dsvc commit only after every compile in the
@@ -199,7 +208,6 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             # slots are reclaimed by the next drain's revalidation pass —
             # established entries survive, nothing is flushed.
             self._slowpath.mark_stale(self._gen)
-        self._persist()
         return self._gen
 
     def _remap_cached_attribution(self, old_in: list, old_out: list) -> None:
@@ -228,7 +236,11 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
             meta=meta.at[:, RC].set(r_in[vi] | (r_out[vo] << 16))
         ))
 
-    def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
+    def _apply_group_delta_impl(self, group_name, added_ips, removed_ips) -> int:
+        # Incremental compile stage of the commit plane: the plane snapshots
+        # the retained generation first, so a delta that throws mid-apply
+        # (bad member string, compile fault) is rolled back to a no-op
+        # instead of leaving tensors half-mutated.
         gids = self._name_gids.get(group_name, [])
         if not gids and group_name not in self._group_members:
             raise KeyError(f"unknown group {group_name!r}")
@@ -302,10 +314,8 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
         # authoritative crash-recovery source for membership churn is the
         # AGENT's filestore replay (filestore.go model); the datapath
         # snapshot catches up on the next bundle commit or checkpoint().
-        # The GENERATION, however, is journaled now (cookie-round append)
-        # so it stays monotonic across a crash with pending deltas.
-        self._persist_dirty = True
-        self._record_round()
+        # The GENERATION is journaled by the commit plane's settle stage
+        # (cookie-round append) AFTER the canary certifies this delta.
         return self._gen
 
     def install_topology(self, topo: Topology) -> None:
@@ -646,6 +656,137 @@ class TpuflowDatapath(persist.PersistableDatapath, Datapath):
                                timeouts=self._meta.timeouts)
         self._state = state
         return int(n)
+
+    # -- commit plane hooks (datapath/commit.py) ------------------------------
+
+    def _commit_snapshot(self, group: Optional[str] = None) -> dict:
+        """The retained last-known-good generation: every attribute a
+        bundle/delta commit can touch.  Device tensors and compiled
+        products are immutable (replaced wholesale, never mutated), so
+        they snapshot by reference; host-side membership bookkeeping and
+        the in-place-mutated group member lists are copied.  `state`
+        covers the flow-cache attribution remap a bundle performs
+        (_remap_cached_attribution) — restoring the reference restores the
+        pre-remap attribution exactly (no traffic steps mid-transaction).
+
+        `group` scopes a DELTA snapshot to the touched group — the delta
+        path mutates in place only that group's Counter and member lists
+        (everything else is replaced wholesale, even on an overflow
+        recompile), so copying all membership mirrors would turn the
+        O(delta) path into O(total-membership) host work.  Rows the failed
+        delta wrote into `_delta_host` past the restored `n_deltas` are
+        dead (the kernel gates on n) and overwritten by the next append."""
+        if group is None:
+            ps_members = [
+                (g, list(g.members))
+                for table in (self._ps.address_groups,
+                              self._ps.applied_to_groups)
+                for g in table.values()
+            ]
+            group_members = {k: Counter(v)
+                             for k, v in self._group_members.items()}
+            delta_host = {k: v.copy() for k, v in self._delta_host.items()}
+            touched = None
+        else:
+            ps_members = [
+                (g, list(g.members))
+                for g in (self._ps.address_groups.get(group),
+                          self._ps.applied_to_groups.get(group))
+                if g is not None
+            ]
+            group_members = self._group_members  # dict ref + touched entry
+            delta_host = self._delta_host
+            own = self._group_members.get(group)
+            touched = (group, None if own is None else Counter(own))
+        return {
+            "gen": self._gen,
+            "ps": self._ps,
+            "ps_members": ps_members,
+            "services": self._services,
+            "cps": self._cps,
+            "drs": self._drs,
+            "dsvc": self._dsvc,
+            "meta": self._meta,
+            "meta_step": self._meta_step,
+            "meta_drain": self._meta_drain,
+            "state": self._state,
+            "has_named_ports": self._has_named_ports,
+            "n_deltas": self._n_deltas,
+            "delta_host": delta_host,
+            "name_gids": self._name_gids,
+            "gid_ident": self._gid_ident,
+            "group_members": group_members,
+            "touched": touched,
+            "static_blocks": self._static_blocks,
+            "member_meta": (self._member_meta if group is not None else
+                            {k: dict(v) for k, v in self._member_meta.items()}),
+        }
+
+    def _commit_restore(self, snap: dict) -> None:
+        self._gen = snap["gen"]
+        self._ps = snap["ps"]
+        for g, members in snap["ps_members"]:
+            g.members = members
+        self._services = snap["services"]
+        self._cps = snap["cps"]
+        self._drs = snap["drs"]
+        self._dsvc = snap["dsvc"]
+        self._meta = snap["meta"]
+        self._meta_step = snap["meta_step"]
+        self._meta_drain = snap["meta_drain"]
+        self._state = snap["state"]
+        self._has_named_ports = snap["has_named_ports"]
+        self._n_deltas = snap["n_deltas"]
+        self._delta_host = snap["delta_host"]
+        self._name_gids = snap["name_gids"]
+        self._gid_ident = snap["gid_ident"]
+        self._group_members = snap["group_members"]
+        if snap["touched"] is not None:
+            name, ctr = snap["touched"]
+            if ctr is None:
+                self._group_members.pop(name, None)
+            else:
+                self._group_members[name] = ctr
+        self._static_blocks = snap["static_blocks"]
+        self._member_meta = snap["member_meta"]
+
+    def _canary_classify(self, batch: PacketBatch, now: int) -> np.ndarray:
+        """Fresh-walk verdict of each probe through the CURRENT compiled
+        tables, state untouched.  Runs EAGERLY (unjitted): the canary
+        fires on every commit and rule-table shapes change per bundle, so
+        a jitted probe would pay an XLA compile per install; eager
+        execution walks the same compiled TABLES, which is what the
+        canary certifies.  Narrow (v4-only) instances classify through the
+        bare match kernel — probes avoid service frontends, so the
+        ServiceLB/cache stages of the trace walk certify nothing and
+        would only tax the delta path's latency bound; dual-stack
+        instances take the full trace walk (its wide-lane plumbing is the
+        part worth certifying there)."""
+        src_f = jnp.asarray(iputil.flip_u32(batch.src_ip))
+        dst_f = jnp.asarray(iputil.flip_u32(batch.dst_ip))
+        proto = jnp.asarray(batch.proto.astype(np.int32))
+        dport = jnp.asarray(batch.dst_port.astype(np.int32))
+        if not self._dual_stack:
+            cls = pl.classify_batch(
+                self._drs, src_f, dst_f, proto, dport,
+                meta=self._meta.match,
+            )
+            return np.asarray(cls["code"])
+        o = pl._pipeline_trace(
+            self._state,
+            self._drs,
+            self._dsvc,
+            src_f,
+            dst_f,
+            proto,
+            jnp.asarray(batch.src_port.astype(np.int32)),
+            dport,
+            jnp.int32(now),
+            jnp.int32(self._gen),
+            meta=self._meta,
+            v6=self._v6_lanes(batch),
+        )
+        return np.asarray(o["fresh_code"])
 
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
                 *, n_new: Optional[int] = None, now: int = 1000,

@@ -24,7 +24,19 @@ from typing import Optional
 
 class InjectedInstallError(RuntimeError):
     """Raised by FlakyDatapath.install_bundle when the plan fires — a
-    stand-in for a real datapath rejecting/timing out a rule install."""
+    stand-in for a real datapath rejecting/timing out a rule install.
+    Fires BEFORE the datapath's commit plane is entered, so it models the
+    TRANSIENT fault the agent's retry/backoff loop absorbs."""
+
+
+class InjectedCompileError(RuntimeError):
+    """Raised INSIDE the commit plane's compile stage (datapath/commit.py)
+    when the plan fires at site f"{name}.compile" — a stand-in for the
+    compiler/tensor build rejecting a bundle.  Unlike InjectedInstallError
+    this reaches the plane, so it drives the rollback-to-LKG + degraded
+    path, not the transient retry path.  (Canary-stage faults at
+    f"{name}.canary" surface as synthetic verdict mismatches instead: a
+    deterministic miscompile injection.)"""
 
 
 @dataclass
@@ -219,12 +231,22 @@ class FlakyDatapath:
     """Datapath wrapper whose install_bundle raises per the plan (site
     f"{name}.install") — drives the agent's install-retry path.  All other
     datapath behavior (step/trace/stats/...) passes through, so verdict
-    parity checks run against the real datapath underneath."""
+    parity checks run against the real datapath underneath.
+
+    Wrapping a transactional datapath (datapath/commit.py) also arms the
+    commit plane's OWN fault sites from the same plan — f"{name}.compile"
+    (raises InjectedCompileError inside the compile stage) and
+    f"{name}.canary" (forces a canary mismatch) — so one plan scripts both
+    the transient-install faults outside the plane and the
+    rollback-forcing faults inside it."""
 
     def __init__(self, inner, plan: FaultPlan, name: str):
         self._inner = inner
         self._plan = plan
         self._name = name
+        arm = getattr(inner, "arm_commit_faults", None)
+        if arm is not None:
+            arm(plan, name)
 
     def install_bundle(self, *a, **kw):
         rule = self._plan.fire(f"{self._name}.install")

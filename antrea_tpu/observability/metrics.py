@@ -60,6 +60,15 @@ METRICS: dict[str, str] = {
     "antrea_tpu_slowpath_drain_batch_size": "histogram",
     "antrea_tpu_flow_cache_epoch": "gauge",
     "antrea_tpu_flow_cache_epoch_age_seconds": "gauge",
+    # transactional bundle commit plane (datapath/commit.py; rendered when
+    # the datapath exposes commit_stats())
+    "antrea_tpu_bundle_commits_total": "counter",
+    "antrea_tpu_bundle_rollbacks_total": "counter",
+    "antrea_tpu_canary_probes_total": "counter",
+    "antrea_tpu_canary_mismatches_total": "counter",
+    "antrea_tpu_datapath_degraded": "gauge",
+    "antrea_tpu_bundle_lkg_generation": "gauge",
+    "antrea_tpu_bundle_lkg_age_seconds": "gauge",
 }
 
 
@@ -320,6 +329,32 @@ def render_metrics(datapath, node: str = "") -> str:
             lines.extend(_render_histograms(
                 [("antrea_tpu_slowpath_drain_batch_size", {"node": node}, dh)]
             ))
+    cp = getattr(datapath, "commit_stats", None)
+    cp = cp() if cp is not None else None
+    if cp is not None:
+        # Bundle commit plane (datapath/commit.py): per-stage outcomes,
+        # rollback/canary counters, degraded flag, LKG retention.
+        if cp["commits"]:
+            lines.append(_type_line("antrea_tpu_bundle_commits_total"))
+            for key, n in sorted(cp["commits"].items()):
+                stage, outcome = key.split("/", 1)
+                lines.append(
+                    f"antrea_tpu_bundle_commits_total"
+                    f"{_labels(stage=stage, outcome=outcome, node=node)} {n}"
+                )
+        for fam, key in (
+            ("antrea_tpu_bundle_rollbacks_total", "rollbacks_total"),
+            ("antrea_tpu_canary_probes_total", "canary_probes_total"),
+            ("antrea_tpu_canary_mismatches_total", "canary_mismatches_total"),
+            ("antrea_tpu_datapath_degraded", "degraded"),
+            ("antrea_tpu_bundle_lkg_generation", "lkg_generation"),
+        ):
+            lines += [_type_line(fam), f"{fam}{_labels(node=node)} {cp[key]}"]
+        lines += [
+            _type_line("antrea_tpu_bundle_lkg_age_seconds"),
+            f"antrea_tpu_bundle_lkg_age_seconds{_labels(node=node)} "
+            f"{_num(cp['lkg_age_s'])}",
+        ]
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

@@ -96,6 +96,79 @@ class Oracle:
         # Named ports resolve through the SAME pass the compiler uses —
         # twin parity on named-port semantics by construction.
         self.ps = resolve_named_ports(ps)
+        # An Oracle treats its PolicySet as immutable (every consumer —
+        # PipelineOracle.update, the commit-plane canary, parity suites —
+        # builds a fresh Oracle on change), so membership material is
+        # resolved once per instance instead of per classify: batch
+        # consumers (the canary probes every bundle commit) would otherwise
+        # re-sort the rule set and re-merge every group's ranges per
+        # packet.  The cached forms preserve the PolicySet helpers'
+        # semantics exactly (same ranges()/ip_to_key comparisons).
+        self._ordered_cache: dict = {}  # (direction, baseline) -> rules
+        self._group_ranges: dict = {}  # address-group name -> merged ranges
+        self._applied_keys: dict = {}  # appliedTo-group name -> member keys
+        self._block_ranges: dict = {}  # (cidr, excepts) -> ranges
+        self._isolated_keys: dict = {}  # direction -> isolated pod keys
+
+    # -- memoized membership (same semantics as the PolicySet helpers) -------
+
+    def _ranges_of_group(self, gname: str):
+        got = self._group_ranges.get(gname)
+        if got is None:
+            g = self.ps.address_groups.get(gname)
+            got = self._group_ranges[gname] = (
+                g.ranges() if g is not None else [])
+        return got
+
+    def _keys_of_applied(self, gname: str):
+        got = self._applied_keys.get(gname)
+        if got is None:
+            from ..utils import ip as iputil
+
+            g = self.ps.applied_to_groups.get(gname)
+            got = self._applied_keys[gname] = (
+                frozenset(iputil.ip_to_key(m.ip) for m in g.members)
+                if g is not None else frozenset())
+        return got
+
+    def _ranges_of_block(self, block):
+        from ..utils import ip as iputil
+
+        key = (block.cidr, tuple(block.excepts))
+        got = self._block_ranges.get(key)
+        if got is None:
+            got = self._block_ranges[key] = iputil.ipblock_to_ranges(
+                block.cidr, block.excepts)
+        return got
+
+    def _peer_contains(self, peer, ip_key: int) -> bool:
+        from ..utils import ip as iputil
+
+        if peer.is_any:
+            return True
+        for gname in peer.address_groups:
+            if iputil.ip_in_ranges(ip_key, self._ranges_of_group(gname)):
+                return True
+        return any(
+            iputil.ip_in_ranges(ip_key, self._ranges_of_block(b))
+            for b in peer.ip_blocks
+        )
+
+    def _applied_to_contains(self, policy, rule, ip_key: int) -> bool:
+        groups = rule.applied_to_groups or policy.applied_to_groups
+        return any(ip_key in self._keys_of_applied(g) for g in groups)
+
+    def _k8s_isolated(self, ip_key: int, direction: Direction) -> bool:
+        got = self._isolated_keys.get(direction)
+        if got is None:
+            keys: set = set()
+            for p in self.ps.policies:
+                if not p.is_k8s or direction not in p.policy_types:
+                    continue
+                for gname in p.applied_to_groups:
+                    keys |= self._keys_of_applied(gname)
+            got = self._isolated_keys[direction] = frozenset(keys)
+        return ip_key in got
 
     # -- single rule ---------------------------------------------------------
 
@@ -107,7 +180,7 @@ class Oracle:
             pod_ip, peer_ip = pkt.dst_ip, pkt.src_ip
         else:
             pod_ip, peer_ip = pkt.src_ip, pkt.dst_ip
-        if not self.ps.applied_to_contains(policy, rule, pod_ip):
+        if not self._applied_to_contains(policy, rule, pod_ip):
             return False
         if rule.direction == Direction.OUT and rule.peer.to_services:
             # toServices peer (egress-only): the match rides on the
@@ -118,7 +191,7 @@ class Oracle:
             return svc_ref is not None and svc_ref in {
                 sr.key for sr in rule.peer.to_services
             }
-        if not self.ps.peer_contains(rule.peer, peer_ip):
+        if not self._peer_contains(rule.peer, peer_ip):
             return False
         if rule.services and not any(_service_matches(s, pkt) for s in rule.services):
             return False
@@ -127,6 +200,9 @@ class Oracle:
     # -- one direction -------------------------------------------------------
 
     def _ordered_antrea_rules(self, direction: Direction, baseline: bool):
+        cached = self._ordered_cache.get((direction, baseline))
+        if cached is not None:
+            return cached
         out = []
         for p in self.ps.policies:
             if p.is_k8s or p.is_baseline != baseline:
@@ -136,6 +212,7 @@ class Oracle:
                     continue
                 out.append(((p.tier_priority, p.priority, r.priority, p.uid), p, i, r))
         out.sort(key=lambda t: t[0])
+        self._ordered_cache[(direction, baseline)] = out
         return out
 
     def evaluate_direction(self, pkt: Packet, direction: Direction,
@@ -156,7 +233,7 @@ class Oracle:
 
         # Phase 2: K8s NetworkPolicies (allow rules + isolation default-deny).
         pod_ip = pkt.dst_ip if direction == Direction.IN else pkt.src_ip
-        isolated = self.ps.k8s_isolated(pod_ip, direction)
+        isolated = self._k8s_isolated(pod_ip, direction)
         if isolated:
             for p in self.ps.policies:
                 if not p.is_k8s:

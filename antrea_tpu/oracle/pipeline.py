@@ -235,10 +235,17 @@ class PipelineOracle:
             services, self.node_ips, self.node_name
         )
 
-    def update(self, ps: PolicySet = None, services: list[ServiceEntry] = None):
+    def update(self, ps: PolicySet = None, services: list[ServiceEntry] = None,
+               scrub_log: list = None):
         """Control-plane bundle commit: swap rules/services.  The caller
         bumps the device-side gen; here denials are invalidated lazily via
-        the stored gen value mismatching."""
+        the stored gen value mismatching.
+
+        scrub_log: the ONLY in-place flow mutation this method performs is
+        the vanished-rule attribution scrub below; a caller holding a
+        rollback snapshot (the commit plane, oracle_dp._commit_snapshot)
+        passes a list and gets (slot, rule_in, rule_out) pre-images
+        appended — copy-on-scrub, so the snapshot never clones the cache."""
         if ps is not None:
             self.oracle = Oracle(ps)
             # Attribution follows rule IDENTITY across the bundle (the
@@ -252,10 +259,17 @@ class PipelineOracle:
                 for p in self.oracle.ps.policies
                 for i in range(len(p.rules))
             }
-            for e in self.flow.values():
-                if e.get("rule_in") is not None and e["rule_in"] not in live:
+            for slot, e in self.flow.items():
+                ri, ro = e.get("rule_in"), e.get("rule_out")
+                scrub = ((ri is not None and ri not in live)
+                         or (ro is not None and ro not in live))
+                if not scrub:
+                    continue
+                if scrub_log is not None:
+                    scrub_log.append((slot, ri, ro))
+                if ri is not None and ri not in live:
                     e["rule_in"] = None
-                if e.get("rule_out") is not None and e["rule_out"] not in live:
+                if ro is not None and ro not in live:
                     e["rule_out"] = None
         if services is not None:
             self._set_services(services)

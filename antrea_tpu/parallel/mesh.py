@@ -68,6 +68,22 @@ from ..ops import match as m
 DATA, RULE = "data", "rule"
 
 
+def _shard_map(body, *, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the public alias (with its
+    check_vma kwarg) landed after 0.4.x; older images carry only
+    jax.experimental.shard_map (kwarg check_rep).  Outputs are replicated
+    over ``rule`` by construction, which neither checker can prove —
+    hence the disabled check on both branches (module docstring)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def make_mesh(n_data: int, n_rule: int, devices=None) -> Mesh:
     need = n_data * n_rule
     if devices is None:
@@ -184,12 +200,11 @@ def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh):
             drs, src_f, dst_f, proto, dport, meta=meta, hit_combine=_pmin_rule
         )
 
-    shmapped = jax.shard_map(
+    shmapped = _shard_map(
         body,
         mesh=mesh,
         in_specs=(_drs_specs(), P(DATA), P(DATA), P(DATA), P(DATA)),
         out_specs=P(DATA),
-        check_vma=False,
     )
     jitted = jax.jit(shmapped)
 
@@ -273,14 +288,11 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
             P(DATA), P(), P(),
         )
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(_shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=(_state_specs(), P(DATA)),
-        # Verdicts after the pmin are replicated over `rule` by
-        # construction; check_vma cannot prove it (module docstring).
-        check_vma=False,
     ))
     return step, state, drs, dsvc, dft
 

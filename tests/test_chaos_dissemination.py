@@ -239,6 +239,85 @@ def test_install_retry_counts_and_backoff():
     ]
 
 
+def test_degraded_datapath_recovery_via_agent_sync():
+    """Repeated IN-PLANE install failure (canary rejects the candidate,
+    datapath/commit.py): the datapath rolls back to last-known-good,
+    degrades, and keeps serving LKG verdicts; the agent's sync loop —
+    which folds everything into full-bundle recompiles while the datapath
+    is degraded — reconverges to oracle parity once the fault clears, and
+    the rollback/degraded metrics observably transition."""
+    from antrea_tpu.observability.metrics import render_metrics
+
+    plan = FaultPlan()
+    inner = OracleDatapath(flow_slots=1 << 8, aff_slots=1 << 4)
+    dp = FlakyDatapath(inner, plan, "nX")  # arms nX.compile / nX.canary
+    t = [0.0]
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    agent = AgentPolicyController("nX", dp, store, clock=lambda: t[0])
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="w", ip="10.0.1.1",
+                           node="nX", labels={"app": "web"}))
+    ctl.upsert_antrea_policy(_policy("P1"))
+    agent.sync()  # P1 lands clean
+    assert dp.generation == 1 and not dp.degraded
+
+    def fresh_parity():
+        # Fresh 5-tuples only: an established flow survives policy churn.
+        now = next(_NOW)
+        pkts = [Packet(src_ip=iputil.ip_to_u32(s),
+                       dst_ip=iputil.ip_to_u32("10.0.1.1"),
+                       proto=6, src_port=30000 + now % 30000, dst_port=80)
+                for s in ("192.0.2.7", "198.51.100.9")]
+        oracle = Oracle(ctl.policy_set_for_node("nX"))
+        got = [int(x) for x in
+               np.asarray(dp.step(PacketBatch.from_packets(pkts), now).code)]
+        return got == [int(oracle.classify(p).code) for p in pkts]
+
+    # The next two bundle canaries reject their candidates (persistent
+    # miscompile injection), then the fault clears.
+    plan.after("nX.canary", plan.hits("nX.canary"), "fail", times=2)
+    ctl.upsert_antrea_policy(_policy("P2", cidr="198.51.100.0/24"))
+
+    agent.sync()  # attempt 1: canary blocks the swap -> degraded
+    assert agent.sync_failures_total == 1
+    assert "canary" in agent.last_sync_error
+    assert dp.degraded and dp.generation == 1
+    # LKG (P1-only) verdicts keep serving with zero divergence from the
+    # P1-only oracle, while upstream already wants P1+P2.
+    lkg_oracle = Oracle(agent.policy_set)
+    now = next(_NOW)
+    probe = Packet(src_ip=iputil.ip_to_u32("192.0.2.7"),
+                   dst_ip=iputil.ip_to_u32("10.0.1.1"),
+                   proto=6, src_port=31000 + now % 30000, dst_port=80)
+    got = int(dp.step(PacketBatch.from_packets([probe]), now).code[0])
+    assert got == 1  # P1's deny CIDR still enforced from LKG
+
+    t[0] += 1.0
+    agent.sync()  # attempt 2: still injected -> still degraded
+    assert agent.sync_failures_total == 2 and dp.degraded
+    text = render_metrics(inner, node="nX")
+    assert 'antrea_tpu_datapath_degraded{node="nX"} 1' in text
+    assert 'antrea_tpu_bundle_rollbacks_total{node="nX"} 2' in text
+
+    t[0] += 2.0
+    agent.sync()  # attempt 3: fault exhausted -> recompile certifies
+    assert not dp.degraded
+    assert agent.sync_failures_total == 2
+    assert fresh_parity()
+    text = render_metrics(inner, node="nX")
+    assert 'antrea_tpu_datapath_degraded{node="nX"} 0' in text
+    assert "antrea_tpu_canary_mismatches_total" in text
+
+    # Membership deltas flow again after the quarantine lifted.
+    ctl.upsert_pod(crd.Pod(namespace="default", name="w2", ip="10.0.1.2",
+                           node="nX", labels={"app": "web"}))
+    t[0] += 1.0
+    agent.sync()
+    assert fresh_parity()
+
+
 def test_bounded_watcher_overflow_forces_resync():
     """A consumer that stops pumping must cost one resync, never unbounded
     controller memory: the queue caps, overflow flips needs_resync, and

@@ -1,0 +1,415 @@
+"""Self-healing bundle commit plane: transactional install, parity canaries,
+last-known-good rollback (datapath/commit.py).
+
+The differential bar (ISSUE 4 acceptance): with an injected miscompile the
+canary blocks the swap, the datapath keeps serving last-known-good verdicts
+with ZERO parity mismatches on live traffic (fresh 5-tuples — an
+established flow legitimately survives a policy change, so every probe is a
+new connection), and the plane reconverges after the fault clears, with
+`bundle_rollbacks_total` / `datapath_degraded` observably transitioning.
+"""
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis import crd
+from antrea_tpu.controller.networkpolicy import NetworkPolicyController
+from antrea_tpu.datapath import (
+    BundleQuarantinedError,
+    CanaryMismatchError,
+    OracleDatapath,
+    TpuflowDatapath,
+)
+from antrea_tpu.dissemination import FaultPlan
+from antrea_tpu.dissemination.faults import FlakyDatapath, InjectedCompileError
+from antrea_tpu.observability.metrics import render_metrics
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+# Monotonic packet clock + fresh src_port source shared by every parity
+# probe (see tests/test_chaos_dissemination._parity: re-using a 5-tuple
+# would measure conntrack survival, not the bundle under test).
+_NOW = itertools.count(5000)
+
+SMALL = dict(flow_slots=1 << 8, aff_slots=1 << 4)
+
+WEB_IP = "10.0.1.1"
+DB_IP = "10.0.2.1"
+
+
+def _dp(dp_cls, **kw):
+    if dp_cls is TpuflowDatapath:
+        kw.setdefault("miss_chunk", 32)
+    return dp_cls(**SMALL, **kw)
+
+
+def _world(cidr: str, uid: str = "P1"):
+    """Span-filtered PolicySet for node n1: one deny-from-CIDR policy
+    applied to the web pod, assembled through the real controller."""
+    ctl = NetworkPolicyController()
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="web", ip=WEB_IP,
+                           node="n1", labels={"app": "web"}))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="db", ip=DB_IP,
+                           node="n1", labels={"app": "db"}))
+    ctl.upsert_antrea_policy(crd.AntreaNetworkPolicy(
+        uid=uid, name=uid, namespace="", tier_priority=250, priority=1,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"app": "web"}),
+            ns_selector=crd.LabelSelector.make())],
+        rules=[
+            crd.AntreaNPRule(direction=cp.Direction.IN,
+                             action=cp.RuleAction.DROP,
+                             peers=[crd.AntreaPeer(
+                                 ip_block=crd.IPBlock(cidr))]),
+            # Selector peer -> a real AddressGroup for the delta tests.
+            crd.AntreaNPRule(direction=cp.Direction.IN,
+                             action=cp.RuleAction.DROP,
+                             peers=[crd.AntreaPeer(
+                                 pod_selector=crd.LabelSelector.make(
+                                     {"app": "db"}),
+                                 ns_selector=crd.LabelSelector.make())]),
+        ],
+    ))
+    return ctl.policy_set_for_node("n1")
+
+
+# Sources covering both verdict flips between the two fixture CIDRs, plus
+# the unaffected pod-to-pod lane.
+_SRCS = ("192.0.2.7", "198.51.100.9", DB_IP)
+
+
+def _live_parity(dp, ps) -> int:
+    """Step a FRESH probe matrix through the datapath and diff every
+    verdict against Oracle(ps) -> mismatch count."""
+    now = next(_NOW)
+    pkts = [Packet(src_ip=iputil.ip_to_u32(s),
+                   dst_ip=iputil.ip_to_u32(WEB_IP),
+                   proto=6, src_port=20000 + now % 40000, dst_port=80)
+            for s in _SRCS]
+    got = dp.step(PacketBatch.from_packets(pkts), now).code
+    oracle = Oracle(ps)
+    return sum(int(got[i]) != int(oracle.classify(p).code)
+               for i, p in enumerate(pkts))
+
+
+def _live_parity_async(dp, ps) -> int:
+    """Async-mode parity: a fresh miss returns the PROVISIONAL admission
+    verdict, so step the fresh matrix, drain the queue (committing the
+    real verdicts), and compare the cached verdicts on a re-step."""
+    now = next(_NOW)
+    pkts = [Packet(src_ip=iputil.ip_to_u32(s),
+                   dst_ip=iputil.ip_to_u32(WEB_IP),
+                   proto=6, src_port=26000 + now % 30000, dst_port=80)
+            for s in _SRCS]
+    batch = PacketBatch.from_packets(pkts)
+    dp.step(batch, now)
+    dp.drain_slowpath(now)
+    got = dp.step(batch, next(_NOW)).code
+    oracle = Oracle(ps)
+    return sum(int(got[i]) != int(oracle.classify(p).code)
+               for i, p in enumerate(pkts))
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_canary_blocks_miscompile_and_rolls_back(dp_cls):
+    """The acceptance harness: injected miscompile -> canary blocks the
+    swap -> LKG keeps serving with zero live mismatches -> deltas are
+    quarantined -> recovery reconverges once the fault clears, with the
+    rollback/degraded metrics transitioning."""
+    ps_a, ps_b = _world("192.0.2.0/24"), _world("198.51.100.0/24")
+    dp = _dp(dp_cls)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+
+    g1 = dp.install_bundle(ps=ps_a)
+    assert not dp.degraded
+    assert _live_parity(dp, ps_a) == 0
+    text = render_metrics(dp, node="n1")
+    assert 'antrea_tpu_bundle_rollbacks_total{node="n1"} 0' in text
+    assert 'antrea_tpu_datapath_degraded{node="n1"} 0' in text
+
+    # Injected miscompile: the canary must block the swap.
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=1)
+    with pytest.raises(CanaryMismatchError) as ei:
+        dp.install_bundle(ps=ps_b)
+    assert ei.value.mismatches  # the records name what diverged
+    assert dp.generation == g1  # the swap never happened
+    assert dp.degraded
+
+    # ZERO parity mismatches on live traffic against the LKG bundle —
+    # repeatedly, with fresh 5-tuples every round.
+    for _ in range(3):
+        assert _live_parity(dp, ps_a) == 0
+
+    # Degraded mode is visible and deltas are quarantined.
+    st = dp.commit_stats()
+    assert st["degraded"] == 1 and st["rollbacks_total"] == 1
+    assert st["lkg_generation"] == g1
+    assert st["canary_mismatches_total"] >= 1
+    text = render_metrics(dp, node="n1")
+    assert 'antrea_tpu_bundle_rollbacks_total{node="n1"} 1' in text
+    assert 'antrea_tpu_datapath_degraded{node="n1"} 1' in text
+    ag = sorted(ps_a.address_groups)[0] if ps_a.address_groups else None
+    with pytest.raises(BundleQuarantinedError):
+        dp.apply_group_delta(ag or "any-group", ["10.9.9.9"], [])
+    assert dp.commit_stats()["quarantined_deltas_total"] == 1
+
+    # Fault cleared: the full-bundle recompile passes its canary and the
+    # datapath reconverges to the NEW policy's verdicts.
+    g2 = dp.install_bundle(ps=ps_b)
+    assert g2 == g1 + 1 and not dp.degraded
+    assert _live_parity(dp, ps_b) == 0
+    text = render_metrics(dp, node="n1")
+    assert 'antrea_tpu_datapath_degraded{node="n1"} 0' in text
+    assert 'antrea_tpu_bundle_lkg_generation{node="n1"} 2' in text
+    # Stage accounting saw the whole story.
+    commits = dp.commit_stats()["commits"]
+    assert commits["canary/mismatch"] == 1
+    assert commits["settle/ok"] >= 2
+    # The stats body is the agent API's /commitplane payload: JSON-clean.
+    json.dumps(dp.commit_stats())
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_compile_fault_rolls_back_and_after_zero_fires_first(dp_cls):
+    """after(site, 0) must fire from the FIRST hit at the new
+    compile/canary sites (regression for the PR 2 sentinel bug: 0 is a
+    threshold, not 'off') — and a compile-stage fault rolls back to LKG."""
+    ps_a = _world("192.0.2.0/24")
+    dp = _dp(dp_cls)
+    g0 = dp.install_bundle(ps=ps_a)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+
+    plan.after("n1.compile", 0, "fail", times=1)
+    with pytest.raises(InjectedCompileError):
+        dp.install_bundle(ps=_world("198.51.100.0/24"))
+    assert plan.count("fail") == 1, "after(site, 0) did not fire on hit 1"
+    assert dp.generation == g0 and dp.degraded
+    assert _live_parity(dp, ps_a) == 0
+
+    # Recovery: the next bundle recompiles in full and clears the flag.
+    dp.install_bundle(ps=ps_a)
+    assert not dp.degraded and _live_parity(dp, ps_a) == 0
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_delta_midapply_failure_is_noop(dp_cls):
+    """A delta that throws mid-apply (valid member followed by a garbage
+    one) must be a no-op: copy-on-write against the retained generation,
+    verified against a twin that never saw the failed delta."""
+    ps = _world("192.0.2.0/24")
+    group = sorted(ps.address_groups)[0]
+    dp, twin = _dp(dp_cls), _dp(dp_cls)
+    dp.install_bundle(ps=ps)
+    twin.install_bundle(ps=_world("192.0.2.0/24"))
+    g = dp.generation
+
+    with pytest.raises(ValueError):
+        dp.apply_group_delta(group, ["10.9.9.9", "not-an-ip"], [])
+    assert dp.generation == g  # half-applied member rolled back
+    # The spec/datapath views diverged mid-apply: quarantined until a
+    # full-bundle recompile (run it on the twin too, for lockstep gens).
+    assert dp.degraded and dp.commit_stats()["rollbacks_total"] == 1
+    dp.install_bundle(ps=_world("192.0.2.0/24"))
+    twin.install_bundle(ps=_world("192.0.2.0/24"))
+    assert not dp.degraded
+
+    # The failed delta left NO trace: a subsequent good delta lands on
+    # both twins identically (same generation, same fresh verdicts).
+    assert dp.apply_group_delta(group, ["203.0.113.77"], []) \
+        == twin.apply_group_delta(group, ["203.0.113.77"], [])
+    now = next(_NOW)
+    for src in ("10.9.9.9", "203.0.113.77"):
+        b = PacketBatch.from_packets([Packet(
+            src_ip=iputil.ip_to_u32(src), dst_ip=iputil.ip_to_u32(WEB_IP),
+            proto=6, src_port=21000 + now % 30000, dst_port=80)])
+        assert int(dp.step(b, now).code[0]) == int(twin.step(b, now).code[0])
+
+
+def test_delta_canary_mismatch_quarantines_then_bundle_recovers():
+    """A delta whose canary fails rolls the membership back and degrades;
+    the agent-style full-bundle retry then recovers."""
+    ps = _world("192.0.2.0/24")
+    group = sorted(ps.address_groups)[0]
+    dp = _dp(OracleDatapath)
+    dp.install_bundle(ps=ps)
+    g = dp.generation
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=1)
+
+    with pytest.raises(CanaryMismatchError):
+        dp.apply_group_delta(group, ["203.0.113.50"], [])
+    assert dp.generation == g and dp.degraded
+    # Membership rolled back: the would-be member does not match.
+    assert _live_parity(dp, ps) == 0
+    with pytest.raises(BundleQuarantinedError):
+        dp.apply_group_delta(group, ["203.0.113.51"], [])
+    dp.install_bundle(ps=ps)
+    assert not dp.degraded
+    assert dp.apply_group_delta(group, ["203.0.113.50"], []) == dp.generation
+
+
+def test_epoch_swap_mid_drain_during_rollback():
+    """A rollback interleaved with an in-flight drain lands on a
+    CONSISTENT bundle: begin_drain pins the generation, the failed install
+    restores it, and finish_drain publishes without stale reclassification
+    — then a REAL mid-drain swap still reclassifies (the PR 3 contract)."""
+    ps_a, ps_b = _world("192.0.2.0/24"), _world("198.51.100.0/24")
+    dp = _dp(OracleDatapath, async_slowpath=True, miss_queue_slots=64,
+             drain_batch=16)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+    dp.install_bundle(ps=ps_a)
+    eng = dp._slowpath
+
+    now = next(_NOW)
+    pkts = [Packet(src_ip=iputil.ip_to_u32(s),
+                   dst_ip=iputil.ip_to_u32(WEB_IP),
+                   proto=6, src_port=23000 + i, dst_port=80)
+            for i, s in enumerate(_SRCS)]
+    r = dp.step(PacketBatch.from_packets(pkts), now)
+    assert int(np.asarray(r.pending).sum()) == len(pkts)
+    # Heal the install-marked stale epoch first, then pin a drain batch.
+    eng.revalidate(now)
+    assert eng.begin_drain(now)
+    gen_pinned = dp.generation
+
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=1)
+    with pytest.raises(CanaryMismatchError):
+        dp.install_bundle(ps=ps_b)
+    assert dp.generation == gen_pinned  # rollback restored the pin
+
+    one = eng.finish_drain(next(_NOW))
+    assert one["drained"] == len(pkts)
+    assert one["stale_reclassified"] == 0  # consistent bundle, no churn
+    # Fresh traffic drained through the LKG bundle keeps oracle parity.
+    assert _live_parity_async(dp, ps_a) == 0
+
+    # Contrast: a REAL swap mid-drain still takes the reclassify path.
+    dp.install_bundle(ps=ps_b)  # clears degraded, bumps gen
+    now = next(_NOW)
+    pkts2 = [Packet(src_ip=iputil.ip_to_u32(s),
+                    dst_ip=iputil.ip_to_u32(DB_IP),
+                    proto=6, src_port=24000 + i, dst_port=80)
+             for i, s in enumerate(_SRCS)]
+    dp.step(PacketBatch.from_packets(pkts2), now)
+    eng.revalidate(now)
+    assert eng.begin_drain(now)
+    dp.install_bundle(ps=ps_a)
+    one = eng.finish_drain(next(_NOW))
+    assert one["stale_reclassified"] == one["drained"] > 0
+
+
+def test_canary_scan_watchdog_detects_and_selfheals():
+    """The runtime watchdog: a live-bundle canary failure (injected
+    corruption) degrades the datapath and the immediate recompile — itself
+    canary-gated — either heals it or leaves it safely quarantined."""
+    ps = _world("192.0.2.0/24")
+    dp = _dp(OracleDatapath)
+    dp.install_bundle(ps=ps)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+
+    # Clean scan: nothing to report.
+    scan = dp.canary_scan(now=next(_NOW))
+    assert scan["mismatches"] == 0 and not scan["degraded"]
+
+    # One-shot corruption: detected, recompiled, recovered in one scan.
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=1)
+    scan = dp.canary_scan(now=next(_NOW))
+    assert scan["mismatches"] == 1 and scan["recovered"]
+    assert not dp.degraded and _live_parity(dp, ps) == 0
+
+    # Persistent corruption (recompile canary fails too): quarantined but
+    # still serving; the next scan — fault exhausted — self-heals.
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=2)
+    scan = dp.canary_scan(now=next(_NOW))
+    assert scan["mismatches"] == 1 and not scan["recovered"]
+    assert dp.degraded and _live_parity(dp, ps) == 0
+    scan = dp.canary_scan(now=next(_NOW))
+    assert scan["recovered"] and not dp.degraded
+    assert dp.commit_stats()["commits"]["watchdog/mismatch"] == 2
+
+
+def test_canary_scan_survives_probe_path_exception():
+    """Corruption bad enough to make probe CLASSIFICATION raise must
+    degrade the datapath and keep the watchdog loop alive — never
+    propagate out of canary_scan."""
+    ps = _world("192.0.2.0/24")
+    dp = _dp(OracleDatapath)
+    dp.install_bundle(ps=ps)
+
+    real = dp._canary_classify
+    dp._canary_classify = lambda batch, now: (_ for _ in ()).throw(
+        RuntimeError("corrupted tables"))
+    scan = dp.canary_scan(now=next(_NOW))  # must not raise
+    assert scan["mismatches"] >= 1 and not scan["recovered"]
+    assert dp.degraded and _live_parity(dp, ps) == 0
+
+    dp._canary_classify = real  # corruption cleared: next scan self-heals
+    scan = dp.canary_scan(now=next(_NOW))
+    assert scan["recovered"] and not dp.degraded
+
+
+def test_two_slot_fallback_fast(tmp_path):
+    """Corrupting the newest snapshot recovers the LKG slot, not a fresh
+    boot (the fast twin of the test_persistence coverage)."""
+    from antrea_tpu.datapath import persist
+
+    ps_a, ps_b = _world("192.0.2.0/24"), _world("198.51.100.0/24", uid="P2")
+    dp = _dp(OracleDatapath, persist_dir=str(tmp_path))
+    dp.install_bundle(ps=ps_a)
+    dp.install_bundle(ps=ps_b)  # rotation: latest=P2, lkg=P1
+    del dp
+
+    with open(persist.snapshot_path(str(tmp_path)), "w") as f:
+        f.write('{"v": 2, "generation": 99, "truncated')  # torn write
+    dp2 = _dp(OracleDatapath, persist_dir=str(tmp_path))
+    assert [p.uid for p in dp2._ps.policies] == ["P1"]  # the LKG bundle
+    assert dp2.generation >= 2  # round journal keeps gen monotonic
+    assert _live_parity(dp2, ps_a) == 0
+
+
+def test_flaky_wrapper_arms_commit_sites():
+    """FlakyDatapath over a transactional datapath scripts BOTH fault
+    layers from one plan: .install (transient, pre-plane) and .compile
+    (in-plane, rollback-driving)."""
+    ps = _world("192.0.2.0/24")
+    plan = FaultPlan()
+    dp = FlakyDatapath(_dp(OracleDatapath), plan, "nX")
+    plan.every("nX.install", 1, "fail", times=1)
+    with pytest.raises(Exception) as ei:
+        dp.install_bundle(ps=ps)
+    assert "injected install failure" in str(ei.value)
+    assert not dp.degraded  # pre-plane fault: no rollback, no quarantine
+    dp.install_bundle(ps=ps)
+
+    plan.after("nX.compile", plan.hits("nX.compile"), "fail", times=1)
+    with pytest.raises(InjectedCompileError):
+        dp.install_bundle(ps=_world("198.51.100.0/24"))
+    assert dp.degraded  # in-plane fault: quarantined until recompile
+    dp.install_bundle(ps=ps)
+    assert not dp.degraded
+
+
+def test_check_commit_plane_tool_runs_clean():
+    """tools/check_commit_plane.py (satellite: CI routing check) exits 0 —
+    both engines route all installs through the shared commit plane."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    tool = (Path(__file__).resolve().parent.parent / "tools"
+            / "check_commit_plane.py")
+    res = subprocess.run([sys.executable, str(tool)], capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "commit plane consistent" in res.stdout
