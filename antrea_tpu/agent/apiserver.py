@@ -26,6 +26,11 @@ Routes:
                         commit outcomes, rollback/canary counters — the
                         operator's first stop when a policy push is
                         rejected; see datapath/commit.py)
+  GET /audit            continuous-revalidator status (datapath/audit.py:
+                        cursor position, coverage ratio, per-kind
+                        divergences, scrub outcomes, last divergence);
+                        ?force=1 runs a synchronous full-cache sweep first
+                        (the antctl audit --force path)
   GET /memberlist       alive members of the gossip cluster
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
@@ -193,6 +198,20 @@ class AgentApiServer:
                 # Datapath without a commit plane (the Datapath base
                 # default returns None): 404, not a literal null body.
                 raise KeyError(route)
+            return body
+        if route == "/audit":
+            austats = getattr(self._dp, "audit_stats", None)
+            body = austats() if austats is not None else None
+            if body is None:
+                raise KeyError(route)  # datapath without an audit plane
+            if q.get("force", "0") not in ("", "0"):
+                # Operator-triggered full sweep (antctl audit --force):
+                # run it synchronously, then report the refreshed status
+                # with the sweep's own findings attached.
+                scan = self._dp.audit_scan(now=int(q.get("now", 0)),
+                                           full=True)
+                body = self._dp.audit_stats()
+                body["last_scan"] = scan
             return body
         if route == "/memberlist":
             if self._memberlist is None:

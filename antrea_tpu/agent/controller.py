@@ -62,6 +62,13 @@ class AgentPolicyController:
         # the agent never crashes on a flaky datapath.
         self.sync_failures_total = 0
         self.last_sync_error: str = ""
+        # Poison-bundle quarantine: a DETERMINISTIC compile rejection
+        # (models/pipeline.PolicyCapacityError and kin) fails the same way
+        # on every attempt, so retrying it hot just burns the backoff loop
+        # forever.  When set, sync() reports the Failed realization
+        # upstream and stops retrying until NEW upstream state arrives
+        # (any watch event clears it — the next spec may fit).
+        self.permanent_failure: str = ""
         # Latency histograms (scraped via render_dissemination_metrics):
         # sync_hist = duration of a sync() that applied state to the
         # datapath; dissemination_hist = controller-commit (WatchEvent.ts)
@@ -145,6 +152,9 @@ class AgentPolicyController:
         self._resync_seen = set()
 
     def handle_event(self, ev: WatchEvent) -> None:
+        # New upstream state invalidates a poison-bundle verdict: the next
+        # sync() gets exactly one fresh attempt at the changed spec.
+        self.permanent_failure = ""
         self._handle_event(ev)
         # Dissemination-latency origin: a stamped event that left pending
         # datapath work starts (or joins) the commit->realized clock,
@@ -205,13 +215,29 @@ class AgentPolicyController:
 
     # -- reconciler ----------------------------------------------------------
 
+    @staticmethod
+    def _is_permanent(e: Exception) -> bool:
+        """Deterministic compile rejections: the same bundle fails the
+        same way every time, so retrying cannot succeed.  The commit
+        plane re-raises the impl's exception unwrapped, so isinstance
+        classification sees the original type."""
+        from ..models.pipeline import PolicyCapacityError
+
+        return isinstance(e, PolicyCapacityError)
+
     def _install_failed(self, e: Exception) -> None:
         """Record a failed datapath install: the dirty flag STAYS set (the
         state is still pending, exactly the reference reconciler's requeue)
-        and the next attempt waits out a capped exponential backoff."""
+        and the next attempt waits out a capped exponential backoff — or,
+        for a DETERMINISTIC compile rejection, is quarantined entirely
+        (permanent_failure) until new upstream state arrives, instead of
+        burning the backoff loop forever on a poison bundle."""
         self.sync_failures_total += 1
         self.last_sync_error = str(e)
-        self._retry_at = self._clock() + self._retry_backoff.next_delay()
+        if self._is_permanent(e):
+            self.permanent_failure = f"{type(e).__name__}: {e}"
+        else:
+            self._retry_at = self._clock() + self._retry_backoff.next_delay()
         self._report_status(failure=str(e))
 
     def _observe_synced(self, t0: float) -> None:
@@ -246,6 +272,12 @@ class AgentPolicyController:
             # recovery attempts.
             self._rules_dirty = True
         if not self._rules_dirty and not self._deltas:
+            return
+        if self.permanent_failure:
+            # Poison bundle (deterministic compile rejection, e.g.
+            # PolicyCapacityError): already reported as a Failed
+            # realization; hot-retrying cannot succeed.  Quarantined until
+            # a new watch event changes the spec (handle_event clears).
             return
         t0 = self._clock()
         if self._rules_dirty:

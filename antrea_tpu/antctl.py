@@ -25,6 +25,10 @@ API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
        ovsflows,memberlist,featuregates,agentinfo,cache} --server URL
   traceflow --server URL --src IP --dst IP [...]
   metrics --server URL
+  audit --server URL [--force] [--now N]
+        continuous-revalidator status (GET /audit: cursor position,
+        coverage ratio, last divergence); --force triggers a synchronous
+        full-cache sweep on the agent before reporting
 """
 
 from __future__ import annotations
@@ -258,6 +262,16 @@ def _cmd_check(args) -> int:
     return 0 if all(s == "ok" for _, s in checks) else 1
 
 
+def _cmd_audit(args) -> int:
+    """Continuous-revalidator status / forced full sweep over the live
+    agent API (datapath/audit.py; route GET /audit on agent/apiserver)."""
+    path = "/audit"
+    if args.force:
+        path += f"?force=1&now={args.now}"
+    print(json.dumps(json.loads(_fetch(args.server, path)), indent=2))
+    return 0
+
+
 def _cmd_query_endpoint(args) -> int:
     """Snapshot-based endpoint query: membership sets computed by pod IP,
     then the shared policy scan (controller/endpoint_querier.scan_policies
@@ -338,6 +352,16 @@ def main(argv=None) -> int:
     qe.add_argument("--pod", default="")
     qe.add_argument("--ip", required=True)
     qe.set_defaults(fn=_cmd_query_endpoint)
+
+    au = sub.add_parser(
+        "audit", help="cache-revalidator status / forced full sweep"
+    )
+    au.add_argument("--server", required=True, help="live agent API base URL")
+    au.add_argument("--force", action="store_true",
+                    help="run a synchronous full-cache sweep first")
+    au.add_argument("--now", type=int, default=0,
+                    help="packet-clock seconds for the forced sweep")
+    au.set_defaults(fn=_cmd_audit)
 
     c = sub.add_parser("check", help="installation self-diagnostics")
     c.set_defaults(fn=_cmd_check)

@@ -1,5 +1,6 @@
 """Datapath plugin boundary (ref: pkg/ovs/ovsconfig OVSDatapathType seam)."""
 
+from .audit import AuditPlane
 from .commit import BundleQuarantinedError, CanaryMismatchError, CommitPlane
 from .interface import Datapath, DatapathType, StepResult
 from .oracle_dp import OracleDatapath
@@ -18,6 +19,7 @@ def make_datapath(kind: DatapathType | str, *args, **kwargs) -> Datapath:
 
 
 __all__ = [
+    "AuditPlane",
     "BundleQuarantinedError",
     "CanaryMismatchError",
     "CommitPlane",

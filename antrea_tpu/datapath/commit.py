@@ -246,6 +246,16 @@ class CommitPlane:
         self.rollbacks_total += 1
         self.degraded = True
         self.last_error = f"{type(err).__name__}: {err}"
+        self._refresh_audit_golden()
+
+    def _refresh_audit_golden(self) -> None:
+        """The tensors just changed legitimately (an accepted candidate or
+        a restore to LKG): re-anchor the audit plane's checksum-scrub
+        golden digests (datapath/audit.py) so the scrub certifies the NEW
+        bytes, not the previous generation's."""
+        refresh = getattr(self.owner, "_audit_refresh_golden", None)
+        if refresh is not None:
+            refresh()
 
     def _settle(self, gen: int, *, delta: bool) -> None:
         """Durability + LKG retention for an accepted candidate.  The
@@ -269,6 +279,7 @@ class CommitPlane:
         self.last_error = ""
         self.lkg_generation = int(gen)
         self.lkg_at = self._clock()
+        self._refresh_audit_golden()
 
     # -- canary ---------------------------------------------------------------
 

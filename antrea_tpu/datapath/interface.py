@@ -192,6 +192,14 @@ class Datapath(ABC):
         probes/mismatches, LKG generation/age) — None without a plane."""
         return None
 
+    # -- continuous audit surface (datapath/audit.py; both engines override
+    # via the AuditableDatapath mixin — inert default for test doubles) ------
+
+    def audit_stats(self) -> Optional[dict]:
+        """Audit-plane counters (cursor coverage, divergences, scrub
+        outcomes, repairs) — None without a plane."""
+        return None
+
     # -- async slow-path surface (datapath/slowpath; both engines) ----------
     # Shared plumbing: each engine implements the CLASSIFY callbacks
     # (_drain_classify/_epoch_revalidate/_epoch_age_scan) and calls

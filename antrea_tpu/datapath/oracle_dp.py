@@ -43,10 +43,12 @@ from ..compiler.topology import (
 )
 from ..compiler.compile import ACT_ALLOW, ACT_DROP
 from ..observability.metrics import Histogram
+from ..oracle.interpreter import Oracle
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..utils import ip as iputil
-from ..packet import PacketBatch
+from ..packet import Packet, PacketBatch
 from . import persist
+from .audit import AuditableDatapath
 from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 from .slowpath import ADMIT_HOLD
@@ -63,8 +65,8 @@ def _group_ranges(g) -> set:
     return set(iputil.merge_ranges(rs))
 
 
-class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
-                     Datapath):
+class OracleDatapath(TransactionalDatapath, AuditableDatapath,
+                     persist.PersistableDatapath, Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -87,6 +89,8 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
         admission: str = "forward",
         drain_batch: int = 4096,
         canary_probes: int = 64,
+        audit_window: int = 64,
+        audit_divergence_trip: int = 8,
     ):
         from ..features import DEFAULT_GATES
 
@@ -130,6 +134,10 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
         # Commit plane LAST (datapath/commit.py): boot state is the LKG
         # baseline — same contract as the kernel twin.
         self._init_commit_plane(canary_probes=canary_probes)
+        # Audit plane after the commit plane (datapath/audit.py): the boot
+        # interpreter/program tables anchor the scrub's golden digests.
+        self._init_audit_plane(audit_window=audit_window,
+                               audit_divergence_trip=audit_divergence_trip)
 
     def _rebuild_l7_ids(self) -> None:
         """Stable ids of rules carrying L7 protocols in the CURRENT policy
@@ -181,6 +189,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
             ps=ps, services=list(services) if services is not None else None,
             scrub_log=getattr(self, "_scrub_log", None),
         )
+        self._state_mutations += 1  # update may scrub cached attribution
         self._gen += 1
         if self._slowpath is not None:
             self._slowpath.mark_stale(self._gen)
@@ -220,6 +229,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
             return self._gen
         self._oracle.update(ps=self._ps,
                             scrub_log=getattr(self, "_scrub_log", None))
+        self._state_mutations += 1
         self._gen += 1
         if self._slowpath is not None:
             self._slowpath.mark_stale(self._gen)
@@ -316,6 +326,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
             batch, now, gen=self._gen, no_commit=no_commit, flags=flags,
             lens=lens if self._flow_stats else None,
         )
+        self._state_mutations += 1
         self._count_outcomes(outs, lens)
 
     def _epoch_revalidate(self) -> int:
@@ -327,6 +338,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
                  if e["gen"] is not None and e["gen"] != gen_w]
         for s in stale:
             del o.flow[s]
+        self._state_mutations += 1
         return len(stale)
 
     def _epoch_age_scan(self, now: int) -> int:
@@ -335,6 +347,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
                 if (now - e["ts"]) > o.timeout_of(e, e["key"][3])]
         for s in dead:
             del o.flow[s]
+        self._state_mutations += 1
         return len(dead)
 
     # -- commit plane hooks (datapath/commit.py; scalar twin of the kernel's
@@ -400,6 +413,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
         self._l7_ids = snap["l7_ids"]
         self._has_named_ports = snap["has_named_ports"]
         self._exemplars = snap["exemplars"]
+        self._state_mutations += 1
 
     def _canary_classify(self, batch: PacketBatch, now: int) -> np.ndarray:
         """Fresh-walk verdict of each probe, state untouched (fresh_walk is
@@ -410,6 +424,171 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
                          o._flow_hash(batch.packet(i)), now)["code"]
             for i in range(batch.size)
         ], np.int32)
+
+    # -- audit plane hooks (datapath/audit.py; scalar twin of the kernel's
+    # window/fresh/scrub surface — identical semantics so tests can diff
+    # the planes mode-for-mode) -----------------------------------------------
+
+    def _audit_slots(self) -> int:
+        return self._oracle.flow_slots
+
+    @staticmethod
+    def _crc(obj) -> int:
+        """Deterministic host digest (zlib.crc32 over repr) — the scalar
+        twin of the device XOR/sum fold; compared only within a process."""
+        import zlib
+
+        return zlib.crc32(repr(obj).encode())
+
+    def _audit_rule_digests(self) -> dict:
+        """Digests of the verdict-determining derived material — the
+        scalar twin of the kernel's rule-side tensors: the interpreter's
+        resolved policy set and the compiled LB program/frontend tables."""
+        o = self._oracle
+        ps = o.oracle.ps
+        return {
+            "rules": self._crc((
+                ps.policies,
+                sorted(ps.address_groups.items()),
+                sorted(ps.applied_to_groups.items()),
+            )),
+            "programs": self._crc(
+                (o.programs, sorted(o.svc_by_key.items()))),
+        }
+
+    def _audit_state_digest(self) -> int:
+        o = self._oracle
+        return self._crc((
+            tuple(sorted((s, tuple(sorted(e.items())))
+                         for s, e in o.flow.items())),
+            tuple(sorted((s, tuple(sorted(e.items())))
+                         for s, e in o.aff.items())),
+        ))
+
+    def _audit_reupload(self) -> None:
+        """Rule-side self-heal: rebuild the interpreter and the LB program
+        tables from the authoritative held spec (the host-mirror analog);
+        flow/affinity state untouched."""
+        o = self._oracle
+        o.oracle = Oracle(self._ps)
+        o._set_services(self._services)
+
+    def _audit_window(self, cursor: int, k: int, now: int) -> list[dict]:
+        """Decode k consecutive flow slots (full sweeps walk the dict
+        directly) into the shared audit row schema; LIVE entries only,
+        same liveness rule as dump_flows."""
+        from ..models.pipeline import GEN_ETERNAL
+
+        o = self._oracle
+        N = o.flow_slots
+        gen_w = self._gen % GEN_ETERNAL
+        if k >= N:
+            slots = sorted(o.flow)
+        else:
+            slots = [(cursor + j) % N for j in range(k)]
+        rows = []
+        for slot in slots:
+            e = o.flow.get(slot)
+            if e is None:
+                continue
+            if (now - e["ts"]) > o.timeout_of(e, e["key"][3]):
+                continue
+            if e["gen"] is not None and e["gen"] != gen_w:
+                continue
+            src, dst, pp, proto = e["key"]
+            rows.append({
+                "slot": slot,
+                "src": src,
+                "dst": dst,
+                "proto": proto,
+                "sport": (pp >> 16) & 0xFFFF,
+                "dport": pp & 0xFFFF,
+                "code": int(e["code"]),
+                "svc": int(e["svc"]),
+                "dnat_ip": int(e["dnat_ip"]),
+                "dnat_port": int(e["dnat_port"]),
+                "rule_in": e["rule_in"],
+                "rule_out": e["rule_out"],
+                "committed": e["gen"] is None,
+                "reply": e.get("rpl", False),
+                # Affinity-bearing program: divergence may be drift of the
+                # CURRENT affinity table, not corruption (audit.py keeps
+                # it outside the degrade trip) — kernel-twin semantics.
+                "aff": bool(
+                    0 <= e["svc"] < len(o.programs)
+                    and o.programs[e["svc"]].affinity_timeout_s > 0),
+            })
+        return rows
+
+    def _audit_fresh(self, rows: list, now: int) -> list[dict]:
+        """Fresh-walk re-proof per audited entry (fresh_walk is read-only:
+        affinity learns are returned, never applied)."""
+        o = self._oracle
+        out = []
+        for r in rows:
+            p = Packet(src_ip=r["src"], dst_ip=r["dst"], proto=r["proto"],
+                       src_port=r["sport"], dst_port=r["dport"])
+            w = o.fresh_walk(o.aff, p, o._flow_hash(p), now)
+            no_ep = w["no_ep"]
+            out.append({
+                "code": int(w["code"]),
+                "svc": int(w["svc_idx"]),
+                "dnat_ip": int(w["dnat_ip"]),
+                "dnat_port": int(w["dnat_port"]),
+                # SvcReject precedes the policy tables: no attribution —
+                # the same gating the commit path applied at insert.
+                "rule_in": None if no_ep else w["ingress_rule"],
+                "rule_out": None if no_ep else w["egress_rule"],
+            })
+        return out
+
+    def _audit_evict(self, slots: list) -> None:
+        for s in slots:
+            self._oracle.flow.pop(s, None)
+        self._state_mutations += 1
+
+    def _audit_corrupt(self, kind: str, now: Optional[int] = None) -> str:
+        """Chaos-tier injection (site f"{name}.cache") — the scalar twin
+        of the kernel's corrupt hook.  kind "tensor" flips derived service
+        material (the canary-blind class: probes avoid frontends); any
+        other kind flips a sampled cached verdict bit.  `now` scopes the
+        victim to fully-live rows (idle timeout included) so the scan can
+        always detect its own injection.  The mutation counter is
+        deliberately NOT bumped."""
+        import dataclasses
+
+        o = self._oracle
+        if kind == "tensor":
+            for pi, prog in enumerate(o.programs):
+                if prog.endpoints:
+                    ep = prog.endpoints[0]
+                    prog.endpoints[0] = dataclasses.replace(
+                        ep, port=ep.port ^ 1)
+                    return f"flipped program {pi} endpoint 0 port bit 0"
+            if o.svc_by_key:
+                k0 = sorted(o.svc_by_key)[0]
+                prog, snat = o.svc_by_key[k0]
+                o.svc_by_key[k0] = (prog, snat ^ 1)
+                return f"flipped frontend snat bit of {k0}"
+            kind = "verdict"  # nothing service-side to flip
+        # Victim must be GENERATION-LIVE (same filter as the kernel twin's
+        # corrupt hook): flipping a stale-gen row the audit window skips
+        # would break the chaos-site contract that the scan detects its
+        # own injection.
+        from ..models.pipeline import GEN_ETERNAL
+
+        gen_w = self._gen % GEN_ETERNAL
+        live = sorted(
+            s for s, e in o.flow.items()
+            if (e["gen"] is None or e["gen"] == gen_w)
+            and (now is None
+                 or (now - e["ts"]) <= o.timeout_of(e, e["key"][3]))
+        )
+        if not live:
+            return "no live entry to corrupt"
+        slot = live[0]
+        o.flow[slot]["code"] ^= 1
+        return f"flipped cached verdict bit of slot {slot}"
 
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
                 *, now: int = 1000, mode: str = "sync", **_kw) -> dict:
@@ -616,6 +795,7 @@ class OracleDatapath(TransactionalDatapath, persist.PersistableDatapath,
             lens=lens if self._flow_stats else None,
             fast_only=fast_only,
         )
+        self._state_mutations += 1
         if self._async:
             pend = np.array([o.pending for o in outs], bool)
             if pend.any():

@@ -32,6 +32,7 @@ import time
 from collections import Counter
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,16 +47,23 @@ from ..models import forwarding as fwd
 from ..models import pipeline as pl
 from ..observability.metrics import Histogram
 from ..ops.match import DeltaTable, to_device
-from ..packet import PacketBatch
+from ..packet import Packet, PacketBatch
 from ..utils import ip as iputil
 from . import persist
+from .audit import AuditableDatapath
 from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 from .slowpath import ADMIT_HOLD
 
 
-class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
-                      Datapath):
+def _rid(ids: list, idx: int):
+    """Stored rule INDEX -> stable rule id, None for default/vanished —
+    the one attribution-resolution rule shared by dump/trace/audit."""
+    return ids[idx] if 0 <= idx < len(ids) and ids[idx] else None
+
+
+class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
+                      persist.PersistableDatapath, Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -81,6 +89,8 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
         admission: str = "forward",
         drain_batch: int = 4096,
         canary_probes: int = 64,
+        audit_window: int = 64,
+        audit_divergence_trip: int = 8,
     ):
         from ..features import DEFAULT_GATES
 
@@ -153,6 +163,10 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
         # Commit plane LAST: the boot state (possibly persistence-restored)
         # is the last-known-good baseline every later commit retains.
         self._init_commit_plane(canary_probes=canary_probes)
+        # Audit plane after the commit plane: the boot tensors anchor the
+        # checksum scrub's golden digests (datapath/audit.py).
+        self._init_audit_plane(audit_window=audit_window,
+                               audit_divergence_trip=audit_divergence_trip)
 
     # -- Datapath ------------------------------------------------------------
 
@@ -235,6 +249,7 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
         self._state = self._state._replace(flow=self._state.flow._replace(
             meta=meta.at[:, RC].set(r_in[vi] | (r_out[vo] << 16))
         ))
+        self._state_mutations += 1
 
     def _apply_group_delta_impl(self, group_name, added_ips, removed_ips) -> int:
         # Incremental compile stage of the commit plane: the plane snapshots
@@ -328,6 +343,9 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
         self._rt = topology.resolve_topology(topo)
         self._dft = fwd.fwd_to_device(ft)
         self._persist_topology()
+        # The forwarding tensors changed legitimately: re-anchor the
+        # checksum scrub's golden digests (datapath/audit.py).
+        self._audit_refresh_golden()
 
     def _v6_lanes(self, batch: PacketBatch):
         """Batch -> the pipeline's v6 lane tuple (or None).  Dual-stack
@@ -383,6 +401,7 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
             v6=self._v6_lanes(batch),
         )
         self._state = state
+        self._state_mutations += 1
         o = {k: np.asarray(v) for k, v in out.items()}
         self._evictions += int(o["n_evict"])
         pending = None
@@ -501,23 +520,7 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
         A = self._meta.key_words - 2
         DC, M1C, RC, ZC = pl._meta_cols(A)
         kpg = keys[:, A + 1]
-        # Live = occupied, within idle timeout, AND valid under the current
-        # generation: stale-gen denial entries survive in the table after a
-        # bundle but are dead to lookups — dumping them would resolve their
-        # packed rule indices against the NEW rule table (misattribution).
-        entry_gen = (kpg >> 9) & pl.GEN_ETERNAL
-        gen_w = self._gen % pl.GEN_ETERNAL
-        # Liveness uses the per-STATE timeout (entry_timeout), matching the
-        # lookup path: a half-open TCP entry past its syn lifetime is dead
-        # to lookups and must not appear in the conntrack dump either.
-        tmo = pl.entry_timeout(
-            (meta[:, ZC] >> 29) & 1, kpg & 0xFF, self._meta.timeouts, xp=np
-        )
-        live = (
-            (kpg != 0)
-            & ((now - ts) <= tmo)
-            & ((entry_gen == pl.GEN_ETERNAL) | (entry_gen == gen_w))
-        )
+        live, entry_gen = self._live_mask(keys, meta, ts, now)
         out = []
 
         def unflip_ip(v: int) -> str:
@@ -530,9 +533,6 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
             if (v >> 32) == 0xFFFF:
                 return iputil.u32_to_ip(v & 0xFFFFFFFF)
             return iputil.key_to_ip(iputil.V6_OFF + v)
-
-        def rid(ids: list, idx: int):
-            return ids[idx] if 0 <= idx < len(ids) and ids[idx] else None
 
         for i in np.nonzero(live)[0]:
             pg = int(kpg[i])
@@ -559,8 +559,8 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
                 "svc_idx": svc_idx,
                 "dnat_ip": dnat,
                 "dnat_port": dnat_port,
-                "ingress_rule": rid(self._cps.ingress.rule_ids, rule_in),
-                "egress_rule": rid(self._cps.egress.rule_ids, rule_out),
+                "ingress_rule": _rid(self._cps.ingress.rule_ids, rule_in),
+                "egress_rule": _rid(self._cps.egress.rule_ids, rule_out),
                 "last_seen": int(ts[i]),
                 # Per-direction traffic volumes (OriginalPackets/
                 # OriginalBytes analog); zeros when the FlowExporter gate
@@ -633,6 +633,7 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
             lens=jnp.asarray(lens) if self._flow_stats else None,
         )
         self._state = state
+        self._state_mutations += 1
         o = {key: np.asarray(v) for key, v in out.items()}
         self._evictions += int(o["n_evict"])
         # Each queued packet's REAL attribution counts exactly once, here
@@ -649,12 +650,14 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
     def _epoch_revalidate(self) -> int:
         state, n = pl.revalidate_scan(self._state, jnp.int32(self._gen))
         self._state = state
+        self._state_mutations += 1
         return int(n)
 
     def _epoch_age_scan(self, now: int) -> int:
         state, n = pl.age_scan(self._state, jnp.int32(now),
                                timeouts=self._meta.timeouts)
         self._state = state
+        self._state_mutations += 1
         return int(n)
 
     # -- commit plane hooks (datapath/commit.py) ------------------------------
@@ -749,6 +752,7 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
                 self._group_members[name] = ctr
         self._static_blocks = snap["static_blocks"]
         self._member_meta = snap["member_meta"]
+        self._state_mutations += 1
 
     def _canary_classify(self, batch: PacketBatch, now: int) -> np.ndarray:
         """Fresh-walk verdict of each probe through the CURRENT compiled
@@ -787,6 +791,232 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
             v6=self._v6_lanes(batch),
         )
         return np.asarray(o["fresh_code"])
+
+    # -- audit plane hooks (datapath/audit.py) --------------------------------
+
+    def _audit_slots(self) -> int:
+        return self._meta.flow_slots
+
+    def _audit_rule_digests(self) -> dict:
+        """Checksum-scrub digests of every rule-side mutable device tensor
+        group (SCRUB_MANIFEST): the compiled rule set (delta table
+        included), the service tables, and the forwarding tables."""
+        leaves = jax.tree_util.tree_leaves
+        return {
+            "drs": pl.tensor_digest(leaves(self._drs)),
+            "dsvc": pl.tensor_digest(leaves(self._dsvc)),
+            "dft": pl.tensor_digest(leaves(self._dft)),
+        }
+
+    def _audit_state_digest(self) -> int:
+        """Digest of the state-side tensors (PipelineState: flow cache,
+        affinity table, two-limb counters) — pinned by the plane to the
+        accounted-mutation counter."""
+        return pl.tensor_digest(jax.tree_util.tree_leaves(self._state))
+
+    def _audit_reupload(self) -> None:
+        """Rule-side self-heal: rebuild every rule-side device tensor from
+        its HOST mirror — the compiled policy set (cps), the committed
+        service list, the compiled topology, and the delta-table host
+        mirror.  Pure tensor re-uploads: no XLA recompile, no generation
+        change, nothing a caller can observe but the healed bytes."""
+        drs, _match_meta = to_device(self._cps, delta_slots=self._delta_slots)
+        self._drs = drs
+        self._upload_delta_table()
+        self._compile_services()
+        self._dft = fwd.fwd_to_device(self._ft)
+
+    def _live_mask(self, keys, meta, ts, now):
+        """The ONE liveness predicate over decoded (int64) entry rows,
+        shared by dump_flows and the audit window: occupied, within the
+        per-STATE idle timeout (entry_timeout — a half-open TCP entry past
+        its syn lifetime is dead to lookups and must not be dumped or
+        audited), AND valid under the current generation (stale-gen
+        denials survive in the table after a bundle but are dead to
+        lookups — decoding them would resolve their packed rule indices
+        against the NEW rule table).  -> (live mask, entry generations)."""
+        A = self._meta.key_words - 2
+        ZC = pl._meta_cols(A)[3]
+        kpg = keys[:, A + 1]
+        entry_gen = (kpg >> 9) & pl.GEN_ETERNAL
+        gen_w = self._gen % pl.GEN_ETERNAL
+        tmo = pl.entry_timeout(
+            (meta[:, ZC] >> 29) & 1, kpg & 0xFF, self._meta.timeouts, xp=np
+        )
+        live = (
+            (kpg != 0)
+            & ((now - ts) <= tmo)
+            & ((entry_gen == pl.GEN_ETERNAL) | (entry_gen == gen_w))
+        )
+        return live, entry_gen
+
+    def _wide_row_key(self, row) -> int:
+        """4 flipped word lanes -> combined-keyspace int (mapped form = v4);
+        the int twin of dump_flows' wide_ip decode."""
+        w = [iputil.unflip_u32(int(x)) for x in row]
+        v = (w[0] << 96) | (w[1] << 64) | (w[2] << 32) | w[3]
+        return v & 0xFFFFFFFF if (v >> 32) == 0xFFFF else iputil.V6_OFF + v
+
+    def _audit_window(self, cursor: int, k: int, now: int) -> list[dict]:
+        """Decode `k` consecutive flow-cache slots from `cursor` (wrapping)
+        into the audit row schema (datapath/audit.AuditPlane._check_rows).
+        LIVE entries only, under the same liveness rule as dump_flows
+        (occupied, within the per-state idle timeout, valid generation) —
+        dead rows are dead to lookups already and carry nothing to
+        re-prove.  The window gather runs on device (pl.audit_gather);
+        only k rows transfer to the host."""
+        keys_d, meta_d, ts_d = pl.audit_gather(
+            self._state, jnp.int32(cursor % self._meta.flow_slots), window=k)
+        keys = np.asarray(keys_d).astype(np.int64)
+        meta = np.asarray(meta_d).astype(np.int64)
+        ts = np.asarray(ts_d)
+        N = self._meta.flow_slots
+        A = self._meta.key_words - 2
+        DC, M1C, RC, _ZC = pl._meta_cols(A)
+        kpg = keys[:, A + 1]
+        live, entry_gen = self._live_mask(keys, meta, ts, now)
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+        aff_tmo = np.asarray(self._dsvc.aff_timeout)
+        rows = []
+        for i in np.nonzero(live)[0]:
+            pg = int(kpg[i])
+            code, svc_idx, dnat_port = pl._unpack_meta1(int(meta[i, M1C]))
+            rule_in, rule_out = pl._unpack_rules(int(meta[i, RC]))
+            if A == 2:
+                src = iputil.unflip_u32(int(keys[i, 0]))
+                dst = iputil.unflip_u32(int(keys[i, 1]))
+                dnat = iputil.unflip_u32(int(meta[i, DC]))
+            else:
+                src = self._wide_row_key(keys[i, 0:4])
+                dst = self._wide_row_key(keys[i, 4:8])
+                dnat = self._wide_row_key(meta[i, 0:4])
+            rows.append({
+                "slot": (cursor + int(i)) % N,
+                "src": int(src),
+                "dst": int(dst),
+                "proto": pg & 0xFF,
+                "sport": (int(keys[i, A]) >> 16) & 0xFFFF,
+                "dport": int(keys[i, A]) & 0xFFFF,
+                "code": code,
+                "svc": svc_idx,
+                "dnat_ip": int(dnat),
+                "dnat_port": dnat_port,
+                "rule_in": _rid(in_ids, rule_in),
+                "rule_out": _rid(out_ids, rule_out),
+                "committed": int(entry_gen[i]) == pl.GEN_ETERNAL,
+                "reply": bool(pg & (1 << 31)),
+                # Session affinity on the cached program: the fresh
+                # re-proof reads the CURRENT affinity table, so divergence
+                # on these rows may be drift, not corruption (audit.py
+                # counts them outside the degrade trip).
+                "aff": bool(0 <= svc_idx < aff_tmo.shape[0]
+                            and aff_tmo[svc_idx] > 0),
+            })
+        return rows
+
+    def _audit_fresh(self, rows: list, now: int) -> list[dict]:
+        """Fresh-walk re-proof of audited entries through the CURRENT
+        compiled tables — the canary's EAGER `_pipeline_trace` machinery
+        (audit batch shapes vary per scan, so a jitted probe would pay an
+        XLA compile per scan); state untouched."""
+        pkts = [Packet(src_ip=r["src"], dst_ip=r["dst"], proto=r["proto"],
+                       src_port=r["sport"], dst_port=r["dport"])
+                for r in rows]
+        batch = PacketBatch.from_packets(pkts)
+        o = pl._pipeline_trace(
+            self._state,
+            self._drs,
+            self._dsvc,
+            jnp.asarray(iputil.flip_u32(batch.src_ip)),
+            jnp.asarray(iputil.flip_u32(batch.dst_ip)),
+            jnp.asarray(batch.proto.astype(np.int32)),
+            jnp.asarray(batch.src_port.astype(np.int32)),
+            jnp.asarray(batch.dst_port.astype(np.int32)),
+            jnp.int32(now),
+            jnp.int32(self._gen),
+            meta=self._meta,
+            v6=self._v6_lanes(batch),
+        )
+        o = {key: np.asarray(v) for key, v in o.items()}
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+        out = []
+        for i in range(len(rows)):
+            no_ep = bool(o["no_ep"][i])
+            if self._dual_stack:
+                dnat = self._wide_row_key(o["dnat_w_f"][i])
+            else:
+                dnat = iputil.unflip_u32(int(o["dnat_ip_f"][i]))
+            out.append({
+                "code": int(o["fresh_code"][i]),
+                "svc": int(o["svc_idx"][i]),
+                "dnat_ip": int(dnat),
+                "dnat_port": int(o["dnat_port"][i]),
+                # SvcReject precedes the policy tables: no attribution for
+                # it — the same gating the commit path applied at insert.
+                "rule_in": (None if no_ep
+                            else _rid(in_ids, int(o["ingress_rule"][i]))),
+                "rule_out": (None if no_ep
+                             else _rid(out_ids, int(o["egress_rule"][i]))),
+            })
+        return out
+
+    def _audit_evict(self, slots: list) -> None:
+        """Repair divergent entries by eviction (jitted masked key-clear,
+        pl.audit_evict) — the flows reclassify lazily on their next
+        packet.  Padded to a power-of-two lane count so repeat repairs
+        share compiled kernels."""
+        n = max(1, len(slots))
+        padded = np.full(1 << (n - 1).bit_length(), -1, np.int32)
+        padded[:len(slots)] = np.asarray(slots, np.int32)
+        state, _n = pl.audit_evict(self._state, jnp.asarray(padded))
+        self._state = state
+        self._state_mutations += 1
+
+    def _audit_corrupt(self, kind: str, now: Optional[int] = None) -> str:
+        """Chaos-tier injection (site f"{name}.cache"): REAL, unaccounted
+        damage the audit scan must then detect and repair.  kind "tensor"
+        flips one service-table word — the canary-BLIND tensor class
+        (canary probes deliberately avoid service frontends), which only
+        the checksum scrub can see; any other kind flips a sampled cached
+        verdict bit (invisible to fresh-tuple canaries by construction).
+        `now` scopes the victim to FULLY-live rows (the _live_mask rule) —
+        a flip on an idle-expired row the audit window skips would break
+        the site contract that the scan detects its own injection.  The
+        mutation counter is deliberately NOT bumped — silent corruption is
+        the thing being modeled."""
+        if kind == "tensor":
+            if int(self._dsvc.ep_port.shape[0]) > 0:
+                col = self._dsvc.ep_port
+                self._dsvc = self._dsvc._replace(
+                    ep_port=col.at[0].set(col[0] ^ 1))
+                return "flipped dsvc.ep_port[0] bit 0"
+            # No services: flip a word in the (quiescent) delta table — a
+            # verdict-inert region no probe can reach, only the scrub.
+            d = self._drs.ip_delta
+            self._drs = self._drs._replace(ip_delta=d._replace(
+                lo_f=d.lo_f.at[0].set(d.lo_f[0] ^ 1)))
+            return "flipped drs.ip_delta.lo_f[0] bit 0"
+        keys = np.asarray(self._state.flow.keys)[:-1].astype(np.int64)
+        kpg = keys[:, -1]
+        if now is not None:
+            meta_np = np.asarray(self._state.flow.meta)[:-1].astype(np.int64)
+            ts_np = np.asarray(self._state.flow.ts)[:-1]
+            live, _egen = self._live_mask(keys, meta_np, ts_np, now)
+        else:
+            gen_w = self._gen % pl.GEN_ETERNAL
+            egen = (kpg >> 9) & pl.GEN_ETERNAL
+            live = (kpg != 0) & ((egen == pl.GEN_ETERNAL) | (egen == gen_w))
+        idx = np.nonzero(live)[0]
+        if idx.size == 0:
+            return self._audit_corrupt("tensor")
+        slot = int(idx[0])
+        _, M1C, _, _ = pl._meta_cols(self._meta.key_words - 2)
+        m = self._state.flow.meta
+        self._state = self._state._replace(flow=self._state.flow._replace(
+            meta=m.at[slot, M1C].set(m[slot, M1C] ^ 1)))
+        return f"flipped cached verdict bit of slot {slot}"
 
     def profile(self, batch: PacketBatch, fresh: Optional[PacketBatch] = None,
                 *, n_new: Optional[int] = None, now: int = 1000,
@@ -855,14 +1085,6 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
 
-        def rid(ids, i):
-            return ids[i] if 0 <= i < len(ids) and ids[i] else None
-
-        def wide_key(row) -> int:
-            w = [iputil.unflip_u32(int(x)) for x in row]
-            v = (w[0] << 96) | (w[1] << 64) | (w[2] << 32) | w[3]
-            return v & 0xFFFFFFFF if (v >> 32) == 0xFFFF else iputil.V6_OFF + v
-
         from ..compiler.topology import oracle_forward, oracle_spoof
 
         in_ports = batch.in_ports()
@@ -874,8 +1096,8 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
             # keys (family-agnostic spec).
             p = batch.packet(i)
             if self._dual_stack:
-                dnat_u = wide_key(o["dnat_w_f"][i])
-                cached_dnat = wide_key(o["cached_dnat_w_f"][i])
+                dnat_u = self._wide_row_key(o["dnat_w_f"][i])
+                cached_dnat = self._wide_row_key(o["cached_dnat_w_f"][i])
             else:
                 dnat_u = iputil.unflip_u32(o["dnat_ip_f"][i])
                 cached_dnat = iputil.unflip_u32(o["cached_dnat_ip_f"][i])
@@ -913,9 +1135,9 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
                 "dnat_ip": dnat_u,
                 "dnat_port": int(o["dnat_port"][i]),
                 "egress_code": int(o["egress_code"][i]),
-                "egress_rule": rid(out_ids, int(o["egress_rule"][i])),
+                "egress_rule": _rid(out_ids, int(o["egress_rule"][i])),
                 "ingress_code": int(o["ingress_code"][i]),
-                "ingress_rule": rid(in_ids, int(o["ingress_rule"][i])),
+                "ingress_rule": _rid(in_ids, int(o["ingress_rule"][i])),
                 "fresh_code": int(o["fresh_code"][i]),
                 "code": int(o["code"][i]),
                 "spoofed": spoofed,
@@ -1139,6 +1361,14 @@ class TpuflowDatapath(TransactionalDatapath, persist.PersistableDatapath,
                 2 if gid == cps.iso_out_gid else 0
             )
             self._n_deltas += 1
+        self._upload_delta_table()
+
+    def _upload_delta_table(self) -> None:
+        """Upload the host delta mirror (_delta_host/_n_deltas) as the
+        device DeltaTable — shared by the incremental append path and the
+        audit plane's rule-side self-heal (which rebuilds `drs` from the
+        compiled set and must re-apply the pending deltas)."""
+        h = self._delta_host
         self._drs = self._drs._replace(ip_delta=DeltaTable(
             lo_f=jnp.asarray(h["lo_f"]),
             hi_f=jnp.asarray(h["hi_f"]),

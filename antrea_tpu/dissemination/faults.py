@@ -238,15 +238,24 @@ class FlakyDatapath:
     (raises InjectedCompileError inside the compile stage) and
     f"{name}.canary" (forces a canary mismatch) — so one plan scripts both
     the transient-install faults outside the plane and the
-    rollback-forcing faults inside it."""
+    rollback-forcing faults inside it.
+
+    An auditable datapath (datapath/audit.py) additionally gets its
+    revalidator sites armed: f"{name}.cache" REALLY corrupts live state
+    before an audit scan runs (kind "partial" flips one rule-side tensor
+    word — the canary-blind service-table class; any other kind flips a
+    sampled cached verdict bit), and f"{name}.audit" forces a
+    false-positive divergence finding — so the chaos tier can prove
+    corruption -> detection -> repair -> reconvergence deterministically."""
 
     def __init__(self, inner, plan: FaultPlan, name: str):
         self._inner = inner
         self._plan = plan
         self._name = name
-        arm = getattr(inner, "arm_commit_faults", None)
-        if arm is not None:
-            arm(plan, name)
+        for arm_name in ("arm_commit_faults", "arm_audit_faults"):
+            arm = getattr(inner, arm_name, None)
+            if arm is not None:
+                arm(plan, name)
 
     def install_bundle(self, *a, **kw):
         rule = self._plan.fire(f"{self._name}.install")

@@ -425,12 +425,22 @@ def _unpack_rules(rp):
     return (rp & 0xFFFF) - 1, ((rp >> 16) & 0xFFFF) - 1
 
 
+class PolicyCapacityError(ValueError):
+    """A compiled policy set exceeds a hard datapath capacity bound (e.g.
+    the 16-bit packed rule-attribution space).  DETERMINISTIC: the same
+    bundle fails the same way every time, so the agent classifies it as a
+    permanent (poison-bundle) rejection and reports a Failed realization
+    upstream instead of burning its retry/backoff loop on it
+    (agent/controller.sync).  Subclasses ValueError for callers that
+    predate the typed error."""
+
+
 def check_rule_capacity(cps: CompiledPolicySet) -> None:
     """Rule attribution is cached in one packed 16/16 column (_pack_rules);
     guard both the single-chip and sharded pipelines against overflow."""
     for dt in (cps.ingress, cps.egress):
         if dt.n_rules >= 0xFFFE:
-            raise ValueError(
+            raise PolicyCapacityError(
                 f"flow-cache rule packing supports < 65534 rules per "
                 f"direction, got {dt.n_rules}; split the policy set across "
                 f"datapath instances (per-Node span dissemination keeps "
@@ -1422,6 +1432,87 @@ def _revalidate_scan(state: PipelineState, gen: jax.Array):
 
 
 revalidate_scan = jax.jit(_revalidate_scan)
+
+
+# ---- audit plane transforms (datapath/audit.py) ---------------------------
+# The continuous revalidator runs OFF the hot step, like age_scan and
+# canary_scan: nothing here is reachable from pipeline_step, so with the
+# audit plane idle the compiled step is bit-identical to a plane-less
+# build (tests/test_cache_audit.py verifies the lowered HLO, the same way
+# tools/check_phases.py pins the PH_* masks).
+
+
+def _audit_gather(state: PipelineState, cursor: jax.Array, *, window: int):
+    """Rotating-cursor window gather for the cache revalidation scan: rows
+    [cursor, cursor+window) of the flow cache (mod slot count, dump row
+    excluded) -> (keys, meta, ts) — the device side of one audit step; the
+    host decodes and re-proves the sampled entries."""
+    N = state.flow.keys.shape[0] - 1
+    idx = (jnp.arange(window, dtype=jnp.int32) + cursor) % N
+    return state.flow.keys[idx], state.flow.meta[idx], state.flow.ts[idx]
+
+
+audit_gather = jax.jit(_audit_gather, static_argnames=("window",))
+
+
+def _audit_evict(state: PipelineState, slots: jax.Array):
+    """Repair-by-eviction for divergent audited entries: clear the key rows
+    of `slots` ((K,) i32, -1 padding ignored) so the flows reclassify
+    lazily on their next packet — the mark_stale discipline; the cached
+    value is never trusted, never patched in place.  -> (state', n)."""
+    N = state.flow.keys.shape[0] - 1
+    live = (slots >= 0) & (slots < N)
+    tgt = jnp.where(live, slots, N)
+    keys = state.flow.keys.at[tgt].set(0)
+    return (
+        state._replace(flow=state.flow._replace(keys=keys)),
+        live.sum(dtype=jnp.int32),
+    )
+
+
+audit_evict = jax.jit(_audit_evict)
+
+
+def _digest_pair(words: jax.Array) -> jax.Array:
+    """(N,) i32 -> (2,) i32 [xor-fold, wrapping sum]: the Fletcher-style
+    pair the tensor scrub compares — XOR catches any single bit flip, the
+    order-weighted-by-nothing sum catches the paired flips XOR folds out."""
+    return jnp.stack([
+        jax.lax.reduce(words, jnp.int32(0), jax.lax.bitwise_xor, (0,)),
+        jnp.sum(words, dtype=jnp.int32),
+    ])
+
+
+_digest_fold = jax.jit(_digest_pair)
+
+
+def _digest_words_of(arr) -> jax.Array:
+    """Any device array -> a flat i32 view (32-bit dtypes bitcast, others
+    value-cast — determinism is what the digest needs, not bit fidelity)."""
+    a = jnp.asarray(arr).reshape(-1)
+    if a.dtype == jnp.int32:
+        return a
+    if a.dtype.itemsize == 4:
+        return jax.lax.bitcast_convert_type(a, jnp.int32)
+    return a.astype(jnp.int32)
+
+
+def tensor_digest(leaves) -> int:
+    """Checksum-scrub digest of a pytree-leaf iterable: per-leaf jitted
+    XOR/sum folds (device-side; only two scalars transfer back per leaf)
+    combined into one host int.  Shape-stable per bundle, so the folds hit
+    the jit cache on every scan after the first."""
+    h = 0
+    for leaf in leaves:
+        words = _digest_words_of(leaf)
+        if words.shape[0] == 0:
+            xor, s = 0, 0
+        else:
+            pair = np.asarray(_digest_fold(words))
+            xor, s = int(pair[0]) & 0xFFFFFFFF, int(pair[1]) & 0xFFFFFFFF
+        h = (h * 1000003 + xor) & 0xFFFFFFFFFFFFFFFF
+        h = (h * 1000003 + s) & 0xFFFFFFFFFFFFFFFF
+    return h
 
 
 def _pipeline_trace(

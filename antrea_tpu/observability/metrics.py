@@ -69,6 +69,14 @@ METRICS: dict[str, str] = {
     "antrea_tpu_datapath_degraded": "gauge",
     "antrea_tpu_bundle_lkg_generation": "gauge",
     "antrea_tpu_bundle_lkg_age_seconds": "gauge",
+    # continuous flow-cache revalidator (datapath/audit.py; rendered when
+    # the datapath exposes audit_stats())
+    "antrea_tpu_cache_audit_scans_total": "counter",
+    "antrea_tpu_cache_audit_entries_total": "counter",
+    "antrea_tpu_cache_audit_divergences_total": "counter",
+    "antrea_tpu_cache_audit_repairs_total": "counter",
+    "antrea_tpu_tensor_scrub_total": "counter",
+    "antrea_tpu_audit_cursor_coverage_ratio": "gauge",
 }
 
 
@@ -354,6 +362,36 @@ def render_metrics(datapath, node: str = "") -> str:
             _type_line("antrea_tpu_bundle_lkg_age_seconds"),
             f"antrea_tpu_bundle_lkg_age_seconds{_labels(node=node)} "
             f"{_num(cp['lkg_age_s'])}",
+        ]
+    au = getattr(datapath, "audit_stats", None)
+    au = au() if au is not None else None
+    if au is not None:
+        # Continuous flow-cache revalidator (datapath/audit.py): scan/
+        # sweep coverage, per-kind divergences, scrub outcomes, repairs.
+        for fam, key in (
+            ("antrea_tpu_cache_audit_scans_total", "scans_total"),
+            ("antrea_tpu_cache_audit_entries_total", "entries_total"),
+            ("antrea_tpu_cache_audit_repairs_total", "repairs_total"),
+        ):
+            lines += [_type_line(fam), f"{fam}{_labels(node=node)} {au[key]}"]
+        if au["divergences"]:
+            lines.append(_type_line("antrea_tpu_cache_audit_divergences_total"))
+            for kind, n in sorted(au["divergences"].items()):
+                lines.append(
+                    f"antrea_tpu_cache_audit_divergences_total"
+                    f"{_labels(kind=kind, node=node)} {n}"
+                )
+        if au["scrub"]:
+            lines.append(_type_line("antrea_tpu_tensor_scrub_total"))
+            for outcome, n in sorted(au["scrub"].items()):
+                lines.append(
+                    f"antrea_tpu_tensor_scrub_total"
+                    f"{_labels(outcome=outcome, node=node)} {n}"
+                )
+        lines += [
+            _type_line("antrea_tpu_audit_cursor_coverage_ratio"),
+            f"antrea_tpu_audit_cursor_coverage_ratio{_labels(node=node)} "
+            f"{_num(au['coverage_ratio'])}",
         ]
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:

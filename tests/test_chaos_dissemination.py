@@ -318,6 +318,66 @@ def test_degraded_datapath_recovery_via_agent_sync():
     assert fresh_parity()
 
 
+def test_chaos_cache_corruption_detected_repaired_reconverges():
+    """ISSUE 5 chaos case: the plan's {name}.cache site REALLY flips a
+    sampled cached verdict bit (silent device-state corruption — invisible
+    to every fresh-tuple canary and to live fresh-tuple parity), the
+    continuous revalidator detects it within <= 2 full audit sweeps,
+    repairs by eviction, and the fleet reconverges to oracle verdict
+    parity INCLUDING the previously-corrupted cached tuple."""
+    plan = FaultPlan()
+    inner = OracleDatapath(flow_slots=1 << 8, aff_slots=1 << 4,
+                           audit_window=1 << 7)  # 2 scans == 1 full sweep
+    dp = FlakyDatapath(inner, plan, "nX")  # arms nX.cache / nX.audit too
+    ctl = NetworkPolicyController()
+    store = RamStore()
+    ctl.subscribe(store.apply)
+    agent = AgentPolicyController("nX", dp, store)
+    ctl.upsert_namespace(crd.Namespace(name="default", labels={}))
+    ctl.upsert_pod(crd.Pod(namespace="default", name="w", ip="10.0.1.1",
+                           node="nX", labels={"app": "web"}))
+    ctl.upsert_antrea_policy(_policy("P1"))
+    agent.sync()
+
+    # Cache a denial (the blocked CIDR) and an allowed connection.
+    blocked = Packet(src_ip=iputil.ip_to_u32("192.0.2.7"),
+                     dst_ip=iputil.ip_to_u32("10.0.1.1"),
+                     proto=6, src_port=39001, dst_port=80)
+    allowed = Packet(src_ip=iputil.ip_to_u32("10.0.5.5"),
+                     dst_ip=iputil.ip_to_u32("10.0.1.1"),
+                     proto=6, src_port=39002, dst_port=80)
+    dp.step(PacketBatch.from_packets([blocked, allowed]), next(_NOW))
+    dp.audit_scan(now=next(_NOW))  # anchor the scrub digests
+
+    # Inject: the next audit scan's .cache site fires, corrupting a live
+    # cached verdict at scan start — which that same pass must detect.
+    plan.after("nX.cache", plan.hits("nX.cache"), "fail", times=1)
+    repaired = 0
+    for _ in range(4):  # 4 scans at window N/2 == 2 full sweeps
+        out = dp.audit_scan(now=next(_NOW))
+        repaired += out["repaired"]
+        if repaired:
+            break
+    assert plan.count("fail") == 1, "the chaos run injected nothing"
+    assert repaired >= 1, "corruption not repaired within 2 sweeps"
+    assert dp.audit_stats()["divergences"]
+    plan.quiesce()
+
+    # Reconvergence bar: fresh tuples AND the cached tuples re-prove to
+    # parity with an oracle over the controller's own snapshot.
+    oracle = Oracle(ctl.policy_set_for_node("nX"))
+    now = next(_NOW)
+    probes = [blocked, allowed,
+              Packet(src_ip=iputil.ip_to_u32("192.0.2.8"),
+                     dst_ip=iputil.ip_to_u32("10.0.1.1"),
+                     proto=6, src_port=39000 + now % 20000, dst_port=80)]
+    got = [int(c) for c in
+           np.asarray(dp.step(PacketBatch.from_packets(probes), now).code)]
+    assert got == [int(oracle.classify(p).code) for p in probes]
+    assert not dp.degraded
+    assert dp.audit_scan(now=next(_NOW))["divergences"] == 0
+
+
 def test_bounded_watcher_overflow_forces_resync():
     """A consumer that stops pumping must cost one resync, never unbounded
     controller memory: the queue caps, overflow flips needs_resync, and
