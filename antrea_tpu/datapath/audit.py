@@ -128,7 +128,6 @@ SCRUB_ALLOWLIST = {
     "exemplars": "host membership bookkeeping, no device residency",
     "meta": "static trace-time constants (PipelineMeta), not a tensor",
     "meta_step": "static meta variant (see meta)",
-    "meta_drain": "static meta variant (see meta)",
     "has_named_ports": "host bool derived from ps",
     "n_deltas": "host int mirrored alongside delta_host",
     "delta_host": "host numpy mirror; the ip_delta re-upload source",

@@ -210,25 +210,41 @@ class Datapath(ABC):
 
     _slowpath = None  # the SlowPathEngine of async instances
     _async = False
+    _overlap = False  # two-slot deferred drain commits (overlap_commits)
 
     def _init_slowpath(self, async_slowpath: bool, dual_stack: bool,
                        miss_queue_slots: int, admission: str,
-                       drain_batch: int) -> None:
+                       drain_batch: int, autotune_drain: bool = False,
+                       autotune_bounds=None,
+                       overlap_commits: bool = False) -> None:
         """Constructor hook: validate + build the engine (async mode is
         v4-only for now, like profile() probes — the queue columns are
-        narrow)."""
+        narrow).  autotune_drain replaces the fixed drain_batch with the
+        queue-pressure hysteresis controller (drain_batch seeds the
+        starting rung); overlap_commits enables the two-slot deferred
+        drain-commit staging (the double-buffered churn datapath)."""
         if async_slowpath and dual_stack:
             raise ValueError(
                 "async slow-path mode is v4-only; dual-stack instances "
                 "use the synchronous slow path"
             )
+        if (overlap_commits or autotune_drain) and not async_slowpath:
+            raise ValueError(
+                "overlap_commits/autotune_drain configure the async "
+                "slow-path engine; pass async_slowpath=True (a "
+                "synchronous datapath has no drain pipeline to overlap "
+                "or retune)"
+            )
         self._async = async_slowpath
+        self._overlap = bool(overlap_commits)
         if async_slowpath:
             from .slowpath import SlowPathEngine
 
             self._slowpath = SlowPathEngine(
                 self, capacity=miss_queue_slots, admission=admission,
-                drain_batch=drain_batch,
+                drain_batch=drain_batch, autotune=autotune_drain,
+                autotune_bounds=autotune_bounds,
+                overlap_commits=overlap_commits,
             )
 
     @staticmethod
@@ -275,6 +291,15 @@ class Datapath(ABC):
             }
             for r in self._slowpath.queue.dump()
         ]
+
+    def flush_slowpath(self) -> int:
+        """Retire every staged (deferred) overlapped drain commit ->
+        number retired (0 when synchronous or nothing staged).  The state
+        itself published at dispatch time; flushing settles only the
+        deferred OBSERVATION (rule metrics, eviction counters)."""
+        if self._slowpath is None:
+            return 0
+        return self._slowpath.flush_commits()
 
     def slowpath_stats(self) -> Optional[dict]:
         """Engine/queue/epoch counters for the metrics plane (None when

@@ -88,6 +88,9 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         miss_queue_slots: int = 1 << 16,
         admission: str = "forward",
         drain_batch: int = 4096,
+        autotune_drain: bool = False,
+        autotune_bounds: Optional[tuple] = None,
+        overlap_commits: bool = False,
         canary_probes: int = 64,
         audit_window: int = 64,
         audit_divergence_trip: int = 8,
@@ -99,9 +102,13 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         self._node_ips = list(node_ips or [])
         # Async slow path — the scalar twin of TpuflowDatapath's engine,
         # same admission/drain/epoch semantics (shared plumbing on the
-        # Datapath base) so the differential harness diffs mode-for-mode.
+        # Datapath base) so the differential harness diffs mode-for-mode;
+        # the overlap/autotune knobs build the SAME engine configuration,
+        # so staging depth, autotuner decisions and reclaim accounting
+        # stay diffable counter-for-counter.
         self._init_slowpath(async_slowpath, dual_stack, miss_queue_slots,
-                            admission, drain_batch)
+                            admission, drain_batch, autotune_drain,
+                            autotune_bounds, overlap_commits)
         self._flow_stats = self._gates.enabled("FlowExporter")
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -293,16 +300,25 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
             "denials": len(flow) - committed,
             "slots": self._oracle.flow_slots,
             "evictions": self._oracle.evictions,
+            "reclaims": self._oracle.reclaims,
         }
 
     # -- async slow path (scalar twin of TpuflowDatapath's engine; shared
     # drain/dump/stats plumbing lives on the Datapath base) ------------------
 
-    def _drain_classify(self, block: dict, now: int) -> None:
+    def _drain_classify(self, block: dict, now: int):
         """One popped queue block through the full scalar slow path — the
         same batch-simultaneous semantics and no-commit gating as the
         device drain step, and the point where each queued packet's real
-        attribution is counted."""
+        attribution is counted.  Drains run with reclaim=True (the fused
+        eviction+aging accounting of the device's drain_reclaim meta).
+
+        Overlapped mode: the scalar engine has no asynchronous device
+        work to overlap, but it returns the SAME deferred-finalizer shape
+        (state mutated now, observation counted at retire time) so the
+        engine's staging depth, deferred counters and metric timing stay
+        behaviorally identical to the tpuflow twin — the differential
+        harness diffs the overlap semantics themselves."""
         from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
 
         batch = PacketBatch(
@@ -324,10 +340,25 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         ]
         outs = self._oracle.step(
             batch, now, gen=self._gen, no_commit=no_commit, flags=flags,
-            lens=lens if self._flow_stats else None,
+            lens=lens if self._flow_stats else None, reclaim=True,
         )
         self._state_mutations += 1
-        self._count_outcomes(outs, lens)
+
+        def finalize():
+            self._count_outcomes(outs, lens)
+
+        if self._overlap:
+            return finalize
+        finalize()
+        return None
+
+    def _epoch_maintain(self, now: int) -> tuple[int, int]:
+        """Fused aging + stale-generation revalidation — the scalar twin
+        of pl.maintain_scan's single pass, same partition (aging runs
+        first, so a row both expired and stale counts as aged)."""
+        aged = self._epoch_age_scan(now)
+        stale = self._epoch_revalidate()
+        return aged, stale
 
     def _epoch_revalidate(self) -> int:
         from ..models.pipeline import GEN_ETERNAL
@@ -604,8 +635,12 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         mode="async" reports the decoupled-regime names (async_fast_path /
         drain_classify / drain_commit_residual) over the same coarse
         split — on the scalar engine the fast-lookup and miss-walk costs
-        ARE the fast-step and drain costs."""
-        if mode not in ("sync", "async"):
+        ARE the fast-step and drain costs.  mode="overlap" reports the
+        overlapped-regime names over the identical split: the scalar
+        engine is host-sequential, so its overlap numbers ARE its async
+        numbers — the honest statement that there is nothing to overlap
+        here, kept mode-for-mode so harnesses can call either twin."""
+        if mode not in ("sync", "async", "overlap"):
             raise ValueError(f"unknown profile mode {mode!r}")
         from ..models.pipeline import GEN_ETERNAL
 
@@ -653,6 +688,12 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
                 "async_fast_path": t_fast,
                 "drain_classify": t_cls,
                 "drain_commit_residual": max(total - t_fast - t_cls, 0.0),
+            }
+        elif mode == "overlap":
+            phases = {
+                "overlap_fast_path": t_fast,
+                "overlap_classify": t_cls,
+                "overlap_commit_residual": max(total - t_fast - t_cls, 0.0),
             }
         else:
             phases = {

@@ -25,7 +25,9 @@ flushing — established flows survive policy churn, per conntrack
 semantics.
 """
 
-from .engine import ADMIT_FORWARD, ADMIT_HOLD, SlowPathEngine
+from .engine import (ADMIT_FORWARD, ADMIT_HOLD, CHUNK_LADDER, DrainAutotuner,
+                     SlowPathEngine)
 from .queue import MissQueue
 
-__all__ = ["ADMIT_FORWARD", "ADMIT_HOLD", "MissQueue", "SlowPathEngine"]
+__all__ = ["ADMIT_FORWARD", "ADMIT_HOLD", "CHUNK_LADDER", "DrainAutotuner",
+           "MissQueue", "SlowPathEngine"]

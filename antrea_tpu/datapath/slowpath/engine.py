@@ -28,16 +28,48 @@ the engine classifies its flow):
 Epoch discipline: every published slow-plane mutation (drain commit,
 revalidation, aging scan) bumps `epoch`; `install_bundle` marks the
 current epoch STALE (`mark_stale`).  A stale epoch is healed lazily —
-the next drain first runs the owner's revalidation scan (reclaiming
-dead denial slots; nothing is flushed), and an in-flight drain whose
-bundle generation changed between `begin_drain` and `finish_drain` is
-re-classified under the NEW tensors (counted in
-`stale_reclassified_total`) instead of publishing stale verdicts.
+the next drain first runs the owner's FUSED maintenance pass
+(`_epoch_maintain`: aging + stale-generation revalidation in ONE pass
+over the cache, round 6 — previously two separate full-table scans),
+and an in-flight drain whose bundle generation changed between
+`begin_drain` and `finish_drain` is re-classified under the NEW tensors
+(counted in `stale_reclassified_total`) instead of publishing stale
+verdicts.
+
+Round-6 additions (the overlapped churn datapath, ROADMAP item 2):
+
+  OVERLAPPED COMMITS (`overlap_commits=True`): `_drain_classify` may
+  return a deferred FINALIZER (the host-side materialization + metrics
+  accounting of an already-dispatched drain) instead of blocking on the
+  device.  The engine stages finalizers in a two-slot pending-commit
+  ring: dispatching a third drain retires the oldest (by then its device
+  work has completed under the newer dispatches — the double-buffer),
+  so classify of batch N+1 is dispatched BEFORE blocking on the commit
+  of batch N.  The lost-update guard is structural: the owner publishes
+  its new state pytree at DISPATCH time, so batch N's committed entries
+  are a data dependency of batch N+1's lookups; a flow admitted before
+  its commit landed simply re-enqueues and re-classifies (idempotent —
+  deterministic endpoint hash, same entry).  Only OBSERVATION lags:
+  rule metrics / eviction counters land at retire time, bounded by the
+  two-slot depth and surfaced as `deferred_commit_staleness_s`.
+
+  QUEUE-DEPTH AUTOTUNING (`autotune=True`): `drain_batch` is no longer a
+  fixed 4096 but a rung on a small pre-compiled chunk ladder, moved at
+  most one rung per decision by a hysteresis controller fed from the
+  queue metrics the engine already exports — depth >= 2 rungs of backlog
+  or an overflow since the last decision presses UP (drain faster than
+  arrival), depth under a quarter rung presses DOWN (smaller batches,
+  lower latency, cheaper padding).  A move needs AUTOTUNE_STICKY
+  consecutive same-direction signals, so a step-function arrival rate
+  converges without oscillating, and the ladder is closed — every rung
+  is a size the owner has (or will have) a compiled drain variant for,
+  so retuning can never trigger an XLA recompile storm.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from collections import deque
+from typing import Callable, Optional
 
 from ...observability.metrics import Histogram
 from .queue import MissQueue
@@ -48,6 +80,75 @@ ADMIT_HOLD = "hold"
 # Drain-batch sizes are packet counts, not seconds: dedicated bounds.
 _DRAIN_BOUNDS = (16, 64, 256, 1024, 4096, 16384, 65536)
 
+# The autotuner's closed chunk ladder (pre-compiled drain variants: one
+# XLA program per rung ever, no recompile storms) and its hysteresis —
+# consecutive same-direction pressure signals required before a move.
+CHUNK_LADDER = (256, 1024, 4096, 16384, 65536)
+AUTOTUNE_STICKY = 2
+
+# Two-slot pending-commit staging: the drain double-buffer depth.  Two is
+# the point of the curve — slot 1 overlaps host work with the in-flight
+# device drain, slot 2 lets the NEXT drain dispatch before the first
+# retires; deeper rings only grow observation staleness.
+OVERLAP_SLOTS = 2
+
+
+class DrainAutotuner:
+    """Bounded hysteresis controller for the drain chunk size.
+
+    Pure decision logic (no engine state) so the unit tests can drive it
+    with synthetic signals: observe(depth, overflow_delta) -> the chunk
+    to use for the NEXT drain.  Movement is one rung at a time, only
+    after `sticky` consecutive same-direction pressure signals, and a
+    move resets the streak — a step-function arrival rate walks the
+    ladder monotonically and then holds (no oscillation)."""
+
+    def __init__(self, initial: int, lo: int, hi: int,
+                 sticky: int = AUTOTUNE_STICKY):
+        self.lo, self.hi = int(lo), int(hi)
+        self.rungs = [r for r in CHUNK_LADDER if self.lo <= r <= self.hi]
+        if not self.rungs:
+            raise ValueError(
+                f"autotune bounds ({lo}, {hi}) exclude every ladder rung "
+                f"{CHUNK_LADDER}"
+            )
+        # Seed at the nearest rung (ties snap down, to the cheaper chunk).
+        self.idx = min(
+            range(len(self.rungs)),
+            key=lambda i: (abs(self.rungs[i] - int(initial)), self.rungs[i]),
+        )
+        self.sticky = int(sticky)
+        self._streak = 0  # +k consecutive up signals, -k down
+        self.decisions_up = 0
+        self.decisions_down = 0
+
+    @property
+    def chunk(self) -> int:
+        return self.rungs[self.idx]
+
+    def observe(self, depth: int, overflow_delta: int) -> int:
+        """Feed one decision point's queue pressure -> current chunk."""
+        chunk = self.chunk
+        if overflow_delta > 0 or depth >= 2 * chunk:
+            signal = 1  # backlog >= two drains' worth, or drops: go up
+        elif depth <= chunk // 4:
+            signal = -1  # queue nearly idle at this rung: go down
+        else:
+            signal = 0  # in band: hold (the hysteresis dead zone)
+        if signal == 0 or (self._streak and (signal > 0) != (self._streak > 0)):
+            self._streak = signal  # reset on hold or direction flip
+            return self.chunk
+        self._streak += signal
+        if self._streak >= self.sticky and self.idx < len(self.rungs) - 1:
+            self.idx += 1
+            self.decisions_up += 1
+            self._streak = 0
+        elif self._streak <= -self.sticky and self.idx > 0:
+            self.idx -= 1
+            self.decisions_down += 1
+            self._streak = 0
+        return self.chunk
+
 
 class SlowPathEngine:
     def __init__(
@@ -57,6 +158,9 @@ class SlowPathEngine:
         capacity: int = 1 << 16,
         admission: str = ADMIT_FORWARD,
         drain_batch: int = 4096,
+        autotune: bool = False,
+        autotune_bounds: Optional[tuple[int, int]] = None,
+        overlap_commits: bool = False,
     ):
         if admission not in (ADMIT_FORWARD, ADMIT_HOLD):
             raise ValueError(
@@ -68,7 +172,18 @@ class SlowPathEngine:
         self.owner = owner
         self.queue = MissQueue(capacity)
         self.admission = admission
-        self.drain_batch = int(drain_batch)
+        self.autotuner: Optional[DrainAutotuner] = None
+        if autotune:
+            lo, hi = autotune_bounds or (CHUNK_LADDER[0], CHUNK_LADDER[-1])
+            self.autotuner = DrainAutotuner(int(drain_batch), lo, hi)
+            self.drain_batch = self.autotuner.chunk
+        else:
+            self.drain_batch = int(drain_batch)
+        self._overflows_seen = 0  # autotune: overflow delta baseline
+        self.overlap = bool(overlap_commits)
+        # Two-slot pending-commit ring: (finalize, staged packet-clock).
+        self._staged: deque[tuple[Callable[[], None], int]] = deque()
+        self.deferred_commits_total = 0
         self.epoch = 1
         self.stale = False  # bundle swapped since the last publish
         self.drains_total = 0  # published drain batches
@@ -134,6 +249,21 @@ class SlowPathEngine:
         self._publish(now)
         return reclaimed
 
+    def maintain(self, now: int) -> tuple[int, int]:
+        """FUSED maintenance (round 6): aging + stale-generation
+        revalidation in ONE pass over the cache (owner._epoch_maintain)
+        instead of the two separate full-table scans revalidate() +
+        age_scan() cost.  Publishes, clears the stale flag ->
+        (aged, revalidated)."""
+        aged, revalidated = self.owner._epoch_maintain(now)
+        aged, revalidated = int(aged), int(revalidated)
+        self.revalidations_total += 1
+        self.revalidated_entries_total += revalidated
+        self.aged_entries_total += aged
+        self.stale = False
+        self._publish(now)
+        return aged, revalidated
+
     # -- drain (background side) ---------------------------------------------
 
     def begin_drain(self, now: int, n: Optional[int] = None) -> bool:
@@ -155,7 +285,14 @@ class SlowPathEngine:
         epoch.  If the bundle generation moved since begin_drain, the
         batch's pinned epoch is stale: it is re-classified under the
         CURRENT tensors (lazy revalidation of in-flight work) and counted,
-        never published stale and never dropped."""
+        never published stale and never dropped.
+
+        Overlapped mode: the owner's classify may return a deferred
+        finalizer (host materialization + metrics of the dispatched
+        drain); it is staged in the two-slot ring and the OLDEST staged
+        commit retires first when the ring is full — the publish itself
+        (state swap + epoch bump) still happens here, at dispatch, which
+        is what makes batch N's entries visible to batch N+1."""
         if self._inflight is None:
             raise RuntimeError("no drain batch in flight")
         block, _epoch0, gen0 = self._inflight
@@ -164,19 +301,65 @@ class SlowPathEngine:
         stale = int(self.owner.generation) != gen0
         if stale:
             self.stale_reclassified_total += k
-        self.owner._drain_classify(block, int(now))
+        fin = self.owner._drain_classify(block, int(now))
+        if fin is not None:
+            while len(self._staged) >= OVERLAP_SLOTS:
+                self._retire_oldest()
+            self._staged.append((fin, int(now)))
+            self.deferred_commits_total += 1
         self.drains_total += 1
         self.drain_hist.observe(k)
         self._publish(now)
         return {"drained": k, "stale_reclassified": k if stale else 0}
 
+    def _retire_oldest(self) -> None:
+        fin, _staged_at = self._staged.popleft()
+        fin()
+
+    def flush_commits(self) -> int:
+        """Retire every staged (deferred) drain commit -> number retired.
+        Blocks on the device work those drains dispatched; after this the
+        engine's metric counters are fully settled."""
+        n = 0
+        while self._staged:
+            self._retire_oldest()
+            n += 1
+        return n
+
+    @property
+    def overlap_depth(self) -> int:
+        return len(self._staged)
+
+    def deferred_staleness(self) -> int:
+        """Packet-clock age of the OLDEST staged commit (0 when none) —
+        the observation lag the two-slot deferral buys overlap with."""
+        if not self._staged:
+            return 0
+        return max(0, self._seen_now - self._staged[0][1])
+
+    def _autotune_observe(self) -> None:
+        """Feed the controller one decision point from the queue metrics
+        (depth + overflow delta since the last decision)."""
+        if self.autotuner is None:
+            return
+        delta = self.queue.overflows_total - self._overflows_seen
+        self._overflows_seen = self.queue.overflows_total
+        self.drain_batch = self.autotuner.observe(self.queue.depth, delta)
+
     def drain(self, now: int, max_batches: Optional[int] = None) -> dict:
-        """Drain the queue: heal a stale epoch first (lazy revalidation),
-        then classify up to max_batches coalesced batches -> stats."""
+        """Drain the queue: heal a stale epoch first — ONE fused
+        maintenance pass (aging + revalidation, round 6) instead of the
+        two scans it used to take — then classify up to max_batches
+        coalesced batches -> stats.  With autotuning on, the controller
+        observes queue pressure once per drain() call, BEFORE popping, so
+        the chosen chunk reflects the backlog this call faces."""
         stats = {"drained": 0, "batches": 0, "stale_reclassified": 0,
-                 "revalidated": 0}
+                 "revalidated": 0, "aged": 0}
+        self._autotune_observe()
         if self.stale:
-            stats["revalidated"] = self.revalidate(now)
+            aged, revalidated = self.maintain(now)
+            stats["revalidated"] = revalidated
+            stats["aged"] = aged
         while max_batches is None or stats["batches"] < max_batches:
             if not self.begin_drain(now):
                 break
@@ -190,6 +373,7 @@ class SlowPathEngine:
 
     def stats(self) -> dict:
         q = self.queue
+        at = self.autotuner
         return {
             "depth": q.depth,
             "capacity": q.capacity,
@@ -206,6 +390,16 @@ class SlowPathEngine:
             "epoch_age_s": self.epoch_age(),
             "admission": self.admission,
             "drain_batch": self.drain_batch,
+            # Overlapped-commit plane (two-slot staging; zeros when the
+            # mode is off, so the scrape surface is mode-stable).
+            "overlap": int(self.overlap),
+            "overlap_depth": self.overlap_depth,
+            "deferred_commits_total": self.deferred_commits_total,
+            "deferred_staleness_s": self.deferred_staleness(),
+            # Autotuner surface (chunk == drain_batch when disabled).
+            "autotune": int(at is not None),
+            "autotune_decisions_up": 0 if at is None else at.decisions_up,
+            "autotune_decisions_down": 0 if at is None else at.decisions_down,
             # Live Histogram object (coalesced drain sizes) for the
             # metrics renderer; scalar consumers ignore it.
             "drain_hist": self.drain_hist,

@@ -88,6 +88,9 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         miss_queue_slots: int = 1 << 16,
         admission: str = "forward",
         drain_batch: int = 4096,
+        autotune_drain: bool = False,
+        autotune_bounds: Optional[tuple] = None,
+        overlap_commits: bool = False,
         canary_probes: int = 64,
         audit_window: int = 64,
         audit_divergence_trip: int = 8,
@@ -109,8 +112,16 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         # path; misses are admitted to the bounded queue with a provisional
         # verdict and classified later by drain_slowpath() in coalesced
         # batches (shared plumbing on the Datapath base).
+        # autotune_drain: drain_batch seeds a hysteresis controller that
+        # retunes the coalesced chunk against queue pressure, padding to a
+        # closed pre-compiled rung ladder (no recompile storms).
+        # overlap_commits: the round-6 double-buffer — drain commits are
+        # dispatched with the state DONATED and their host-side
+        # materialization deferred in a two-slot ring, so classify of
+        # batch N+1 dispatches before blocking on the commit of batch N.
         self._init_slowpath(async_slowpath, dual_stack, miss_queue_slots,
-                            admission, drain_batch)
+                            admission, drain_batch, autotune_drain,
+                            autotune_bounds, overlap_commits)
         # Node identity: NodePort frontends bind to these addresses and
         # externalTrafficPolicy=Local filters endpoints to this node
         # (ref proxier.go nodePortAddresses / externalPolicyLocal).
@@ -149,6 +160,9 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         self._default_allow = 0
         self._default_deny = 0
         self._evictions = 0
+        # Dead rows (idle-expired / stale-gen) reclaimed by overlapped
+        # drain inserts — the n_reclaim split of meta.drain_reclaim.
+        self._reclaims = 0
         # Classify-batch latency (scraped as the
         # antrea_tpu_datapath_step_seconds histogram): wall time of step()
         # as the CALLER sees it — dispatch + device walk + host fetch (the
@@ -581,18 +595,37 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         entries overwritten by a different tuple since construction."""
         c = {k: int(v) for k, v in pl.cache_stats(self._state).items()}
         c["evictions"] = self._evictions
+        c["reclaims"] = self._reclaims
         return c
 
     # -- async slow path (datapath/slowpath engine callbacks) ----------------
     # (drain_slowpath / dump_miss_queue / slowpath_stats live on the
     # Datapath base; only the classify/scan callbacks are per-engine.)
 
-    def _drain_classify(self, block: dict, now: int) -> None:
+    def _drain_meta(self, chunk: int) -> pl.PipelineMeta:
+        """The drain-step meta for one coalesced chunk rung: a single
+        slow-path round (miss_chunk == chunk) with the fused
+        eviction+aging commit pass (drain_reclaim)."""
+        return self._meta._replace(miss_chunk=int(chunk), drain_reclaim=True)
+
+    def _drain_classify(self, block: dict, now: int):
         """Classify + commit one popped queue block through the coalesced
-        drain step (ONE slow-path round at miss_chunk == drain_batch, the
-        fused consumer fed a full batch) and publish the new cache state —
-        the epoch-swap commit.  Padding lanes ride masked out via `valid`
-        (they neither refresh nor commit, like SpoofGuard lanes)."""
+        drain step (ONE slow-path round at miss_chunk == the engine's
+        current chunk rung, the fused consumer fed a full batch) and
+        publish the new cache state — the epoch-swap commit.  Padding
+        lanes ride masked out via `valid` (they neither refresh nor
+        commit, like SpoofGuard lanes).
+
+        Overlapped mode (overlap_commits): the step is dispatched with
+        the state DONATED (pl.pipeline_step_donated — XLA aliases the
+        commit scatters in place instead of copying the cache columns)
+        and the new state pytree published immediately, which is the
+        lost-update guard: batch N+1's lookups consume these arrays as a
+        data dependency.  The host-side materialization of the OUTPUTS
+        (metrics, eviction accounting) is returned as a deferred
+        finalizer for the engine's two-slot staging; a flow whose packets
+        re-missed before this commit landed is simply re-enqueued and
+        re-classified — idempotent by the deterministic endpoint hash."""
         k = len(block["src_ip"])
         D = self._slowpath.drain_batch
 
@@ -615,7 +648,9 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         no_commit = ((dst >> 28) == 0xE) | (
             (proto == PROTO_TCP) & ((flags & pl._TEARDOWN_FLAGS) != 0)
         )
-        state, out = pl.pipeline_step(
+        step_fn = (pl.pipeline_step_donated if self._overlap
+                   else pl.pipeline_step)
+        state, out = step_fn(
             self._state,
             self._drs,
             self._dsvc,
@@ -626,7 +661,7 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
             jnp.asarray(dport),
             jnp.int32(now),
             jnp.int32(self._gen),
-            meta=self._meta_drain,
+            meta=self._drain_meta(D),
             valid=jnp.asarray(valid),
             no_commit=jnp.asarray(no_commit),
             flags=jnp.asarray(flags),
@@ -634,18 +669,40 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         )
         self._state = state
         self._state_mutations += 1
-        o = {key: np.asarray(v) for key, v in out.items()}
-        self._evictions += int(o["n_evict"])
-        # Each queued packet's REAL attribution counts exactly once, here
-        # (its fast-step image was provisional and went uncounted).
-        sel = valid
-        self._count_metrics(
-            {key: o[key][sel]
-             for key in ("code", "ingress_rule", "egress_rule")},
-            self._cps.ingress.rule_ids,
-            self._cps.egress.rule_ids,
-            lens[sel],
+        # Attribution tables captured at DISPATCH time: a bundle swap that
+        # lands while this commit is staged must not remap the verdicts
+        # this drain actually classified under.
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+
+        def finalize():
+            o = {key: np.asarray(v) for key, v in out.items()}
+            self._evictions += int(o["n_evict"])
+            self._reclaims += int(o["n_reclaim"])
+            # Each queued packet's REAL attribution counts exactly once,
+            # here (its fast-step image was provisional and uncounted).
+            sel = valid
+            self._count_metrics(
+                {key: o[key][sel]
+                 for key in ("code", "ingress_rule", "egress_rule")},
+                in_ids, out_ids, lens[sel],
+            )
+
+        if self._overlap:
+            return finalize
+        finalize()
+        return None
+
+    def _epoch_maintain(self, now: int) -> tuple[int, int]:
+        """Fused aging + stale-generation revalidation: ONE pass over the
+        cache (pl.maintain_scan) where the engine used to run two."""
+        state, n_aged, n_stale = pl.maintain_scan(
+            self._state, jnp.int32(now), jnp.int32(self._gen),
+            timeouts=self._meta.timeouts,
         )
+        self._state = state
+        self._state_mutations += 1
+        return int(n_aged), int(n_stale)
 
     def _epoch_revalidate(self) -> int:
         state, n = pl.revalidate_scan(self._state, jnp.int32(self._gen))
@@ -711,7 +768,6 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
             "dsvc": self._dsvc,
             "meta": self._meta,
             "meta_step": self._meta_step,
-            "meta_drain": self._meta_drain,
             "state": self._state,
             "has_named_ports": self._has_named_ports,
             "n_deltas": self._n_deltas,
@@ -736,7 +792,6 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         self._dsvc = snap["dsvc"]
         self._meta = snap["meta"]
         self._meta_step = snap["meta_step"]
-        self._meta_drain = snap["meta_drain"]
         self._state = snap["state"]
         self._has_named_ports = snap["has_named_ports"]
         self._n_deltas = snap["n_deltas"]
@@ -1031,9 +1086,12 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         mode="async" profiles the DECOUPLED regime instead (the
         datapath/slowpath cadence: fast dispatch + coalesced drain
         dispatch per step) and attributes the drain phases
-        (profile.ASYNC_PHASE_CHAIN); `fresh` is then required.  Either
-        mode profiles on any instance — the mode is a meta variant, not
-        an engine dependency."""
+        (profile.ASYNC_PHASE_CHAIN); mode="overlap" profiles the
+        double-buffered regime (drain of window i-1 overlapping the fast
+        step of window i, profile.OVERLAP_PHASE_CHAIN) — diffing the two
+        breakdowns attributes the overlap win phase by phase.  `fresh`
+        is required for both.  Any mode profiles on any instance — the
+        mode is a meta variant, not an engine dependency."""
         from ..models import profile as prof
 
         if batch.has_v6 or (fresh is not None and fresh.has_v6):
@@ -1045,6 +1103,12 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         pool = prof._dev_cols(fresh) if fresh is not None else None
         if mode == "async":
             return prof.profile_churn_async(
+                self._meta, self._state, self._drs, self._dsvc, hot, pool,
+                n_new=n_new, now0=now, gen=self._gen,
+                k_small=k_small, k_big=k_big, repeats=repeats,
+            )
+        if mode == "overlap":
+            return prof.profile_churn_overlap(
                 self._meta, self._state, self._drs, self._dsvc, hot, pool,
                 n_new=n_new, now0=now, gen=self._gen,
                 k_small=k_small, k_big=k_big, repeats=repeats,
@@ -1224,7 +1288,12 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         # policy's provisional image, models/pipeline miss_code) and the
         # DRAIN step classifies one coalesced queue batch in a SINGLE
         # slow-path round (miss_chunk == drain_batch), amortizing the
-        # per-round fixed costs the phase profiler exposed.
+        # per-round fixed costs the phase profiler exposed; drain_reclaim
+        # fuses the aging/revalidation of touched rows into its commit
+        # pass (round 6).  With the autotuner on, drain chunks move on a
+        # closed rung ladder — _drain_meta derives the per-rung meta on
+        # demand (PipelineMeta is a hashable NamedTuple, so jit caches
+        # one compiled drain variant per rung, never a recompile storm).
         if self._async:
             self._meta_step = self._meta._replace(
                 phases=0,
@@ -1232,12 +1301,8 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
                            if self._slowpath.admission == ADMIT_HOLD
                            else ACT_ALLOW),
             )
-            self._meta_drain = self._meta._replace(
-                miss_chunk=self._slowpath.drain_batch
-            )
         else:
             self._meta_step = self._meta
-            self._meta_drain = None
         # Reset incremental bookkeeping: the compile folded all prior deltas.
         D = self._delta_slots
         self._n_deltas = 0

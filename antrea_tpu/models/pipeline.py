@@ -269,6 +269,18 @@ class PipelineMeta(NamedTuple):
     # default-forward (the OVS "normal" upcall treatment), ACT_DROP =
     # hold until the background engine classifies (datapath/slowpath).
     miss_code: int = ACT_ALLOW
+    # Overlapped-drain maintenance fusion (ROADMAP item 2): the commit
+    # pass already gathers each insert target's old key row for the
+    # eviction audit; with drain_reclaim set it additionally reads the
+    # target's ts/conf and splits overwrites of DEAD rows (idle-expired
+    # per the per-state timeout, or stale-generation denials — both
+    # already invisible to lookups) out of `n_evict` into `n_reclaim`.
+    # The drain round thus ages and revalidates the rows it touches in
+    # the one pass that already holds them, and the engine's dedicated
+    # full-table scans (age_scan/revalidate_scan) collapse into ONE fused
+    # maintain_scan run only on epoch-stale heal.  Off (False) for
+    # synchronous steps so their compiled program is unchanged.
+    drain_reclaim: bool = False
 
     @property
     def timeouts(self) -> tuple[int, int, int, int]:
@@ -984,18 +996,19 @@ def _pipeline_step(
     def slow(args):
         flow, aff, outs = args
         (out_code, out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
-         out_rule_out, out_committed, out_snat, out_dsr, n_evict0) = outs[:10]
-        out_dnat_w = outs[10] if A == 8 else None
+         out_rule_out, out_committed, out_snat, out_dsr, n_evict0,
+         n_reclaim0) = outs[:11]
+        out_dnat_w = outs[11] if A == 8 else None
         # Batch semantics: affinity LOOKUPS see start-of-batch state even
         # across slow-path rounds; learns land in the carried table.
         aff_snap = aff
         midx = jnp.nonzero(miss, size=B, fill_value=B)[0].astype(jnp.int32)
 
         def round_body(carry):
-            (r, n_evict, flow, aff, out_code, out_svc, out_dnat_ip,
-             out_dnat_port, out_rule_in, out_rule_out, out_committed,
-             out_snat, out_dsr) = carry[:13]
-            out_dnat_w = carry[13] if A == 8 else None
+            (r, n_evict, n_reclaim, flow, aff, out_code, out_svc,
+             out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
+             out_committed, out_snat, out_dsr) = carry[:14]
+            out_dnat_w = carry[14] if A == 8 else None
             idx = jax.lax.dynamic_slice(
                 jnp.concatenate([midx, jnp.full((M,), B, jnp.int32)]),
                 (r * M,),
@@ -1105,7 +1118,7 @@ def _pipeline_step(
             # Phase-gated (PH_COMMIT; the eviction audit additionally
             # requires PH_COMMIT since it reads the insert targets) so the
             # profiler can isolate the commit scatters' cost.
-            def do_commit(flow, aff, n_evict):
+            def do_commit(flow, aff, n_evict, n_reclaim):
                 egen = jnp.where(committed_m, GEN_ETERNAL, gen_w)
                 pg_ins = p_m | 0x100 | (egen << 9)
                 m1 = _pack_meta1(code, svc_idx, dnat_port)
@@ -1202,15 +1215,36 @@ def _pipeline_step(
                     # insert over a live entry whose TUPLE differs (cols
                     # 0-2 + proto/direction bits of col 3 — a same-tuple
                     # rewrite is an update, not an eviction).
-                    okr = flow.keys[jnp.where(ins2, slot2, dump)]
+                    tgt2 = jnp.where(ins2, slot2, dump)
+                    okr = flow.keys[tgt2]
                     id3 = 0xFF | REPLY_BIT
                     tuple_differs = (
                         (okr[:, : A + 1] != keys2[:, : A + 1]).any(axis=1)
                         | ((okr[:, A + 1] & id3) != (keys2[:, A + 1] & id3))
                     )
-                    n_evict = n_evict + (
-                        ins2 & (okr[:, A + 1] != 0) & tuple_differs
-                    ).sum(dtype=jnp.int32)
+                    overwrote = ins2 & (okr[:, A + 1] != 0) & tuple_differs
+                    if meta.drain_reclaim:
+                        # Fused maintenance (overlapped drain): a target
+                        # row that is DEAD to lookups — idle-expired per
+                        # its per-state timeout, or a stale-generation
+                        # denial — is reclaimed occupancy, not a live
+                        # eviction; the drain round ages/revalidates the
+                        # rows it touches in the pass that already
+                        # gathered them (the ts/conf reads ride the same
+                        # tgt2 the audit uses).
+                        om3 = flow.meta[tgt2, ZC]
+                        otmo = entry_timeout(
+                            (om3 >> 29) & 1, okr[:, A + 1] & 0xFF,
+                            meta.timeouts,
+                        )
+                        ogen = (okr[:, A + 1] >> 9) & GEN_ETERNAL
+                        dead = ((now - flow.ts[tgt2]) > otmo) | (
+                            (ogen != GEN_ETERNAL) & (ogen != gen_w)
+                        )
+                        n_reclaim = n_reclaim + (overwrote & dead).sum(
+                            dtype=jnp.int32)
+                        overwrote = overwrote & ~dead
+                    n_evict = n_evict + overwrote.sum(dtype=jnp.int32)
 
                 if meta.count_flow_stats:
                     # Fresh entries start at this packet's contribution on
@@ -1261,11 +1295,12 @@ def _pipeline_step(
                     ep=_scatter_last(aff.ep, learn["aslot"], learn["ep"], lm, adump),
                     ts=_scatter_last(aff.ts, learn["aslot"], jnp.full((M,), now, jnp.int32), lm, adump),
                 )
-                return flow, aff, n_evict
+                return flow, aff, n_evict, n_reclaim
 
             if meta.phases & PH_COMMIT:
-                flow, aff, n_evict = do_commit(flow, aff, n_evict)
-            return (r + 1, n_evict, flow, aff, out_code, out_svc,
+                flow, aff, n_evict, n_reclaim = do_commit(
+                    flow, aff, n_evict, n_reclaim)
+            return (r + 1, n_evict, n_reclaim, flow, aff, out_code, out_svc,
                     out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
                     out_committed, out_snat, out_dsr) + (
                     (out_dnat_w,) if A == 8 else ())
@@ -1274,25 +1309,26 @@ def _pipeline_step(
             r = carry[0]
             return r * M < n_miss
 
-        carry = (jnp.int32(0), n_evict0, flow, aff, out_code, out_svc,
-                 out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
-                 out_committed, out_snat, out_dsr) + (
+        carry = (jnp.int32(0), n_evict0, n_reclaim0, flow, aff, out_code,
+                 out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
+                 out_rule_out, out_committed, out_snat, out_dsr) + (
                  (out_dnat_w,) if A == 8 else ())
         carry = jax.lax.while_loop(round_cond, round_body, carry)
-        (_, n_evict, flow, aff, out_code, out_svc, out_dnat_ip,
+        (_, n_evict, n_reclaim, flow, aff, out_code, out_svc, out_dnat_ip,
          out_dnat_port, out_rule_in, out_rule_out, out_committed,
-         out_snat, out_dsr) = carry[:13]
+         out_snat, out_dsr) = carry[:14]
         return flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
                            out_rule_in, out_rule_out, out_committed,
-                           out_snat, out_dsr, n_evict) + (
-                           (carry[13],) if A == 8 else ())
+                           out_snat, out_dsr, n_evict, n_reclaim) + (
+                           (carry[14],) if A == 8 else ())
 
     def noop(args):
         return args
 
     slow_init = (flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
                              out_rule_in, out_rule_out, out_committed,
-                             out_snat, out_dsr, jnp.int32(0)) + (
+                             out_snat, out_dsr, jnp.int32(0),
+                             jnp.int32(0)) + (
                              (out_dnat_w,) if A == 8 else ()))
     if meta.phases & PH_SLOW:
         flow, aff, outs = jax.lax.cond(n_miss > 0, slow, noop, slow_init)
@@ -1302,9 +1338,9 @@ def _pipeline_step(
         flow, aff, outs = slow_init
     (out_code, out_svc, out_dnat_ip, out_dnat_port,
      out_rule_in, out_rule_out, out_committed, out_snat, out_dsr,
-     n_evict) = outs[:10]
+     n_evict, n_reclaim) = outs[:11]
     if A == 8:
-        out_dnat_w = outs[10]
+        out_dnat_w = outs[11]
 
     final_code = out_code[:B]
     out = {
@@ -1340,6 +1376,10 @@ def _pipeline_step(
         # Live entries overwritten by a different tuple this step (the
         # direct-mapped collision cost; weak-#5 measurement surface).
         "n_evict": n_evict,
+        # Dead rows (idle-expired / stale-gen) reclaimed by inserts —
+        # split out of n_evict only under meta.drain_reclaim (the
+        # overlapped drain's fused maintenance); always 0 otherwise.
+        "n_reclaim": n_reclaim,
     }
     if A == 8:
         # Wide (4-word) DNAT resolution — the full-address view v6
@@ -1351,6 +1391,21 @@ def _pipeline_step(
 
 # jit wrapper: meta is static.
 pipeline_step = jax.jit(_pipeline_step, static_argnames=("meta", "hit_combine"))
+
+# Overlapped-drain variant with the STATE argument DONATED (the donated
+# carries of SNIPPETS [3]'s pjit shape): the drain rewrites keys/meta/ts
+# wholesale, so without donation XLA must allocate fresh output buffers
+# for ~150MB of cache columns per drain and the dispatch pipeline stalls
+# on the copies.  Donation lets XLA alias the scatters in place and
+# pipeline drain N's commit under batch N+1's dispatch.  Callers MUST
+# drop every reference to the passed state (the datapath's single-owner
+# `self._state` discipline guarantees this between host calls; the
+# commit plane's snapshots live only inside an install transaction,
+# during which no drain runs).
+pipeline_step_donated = jax.jit(
+    _pipeline_step, static_argnames=("meta", "hit_combine"),
+    donate_argnums=(0,),
+)
 
 
 def _cache_stats(state: PipelineState):
@@ -1432,6 +1487,43 @@ def _revalidate_scan(state: PipelineState, gen: jax.Array):
 
 
 revalidate_scan = jax.jit(_revalidate_scan)
+
+
+def _maintain_scan(state: PipelineState, now: jax.Array, gen: jax.Array,
+                   *, timeouts):
+    """FUSED off-hot-step maintenance (ROADMAP item 2 / round 6): one pass
+    over the flow cache performing both the aging scan and the
+    stale-generation revalidation that previously ran as two separate
+    full-table transforms — keys/meta/ts are each read ONCE and the keys
+    written once, halving the HBM traffic of an epoch-stale heal.
+
+    Semantics-neutral exactly like its two parents: both row classes are
+    already dead to lookups (freshness / gen compare), so clearing them
+    changes no verdict.  A row that is both expired AND stale counts as
+    aged (the partition the oracle twin applies in the same order).
+
+    -> (state', n_aged, n_revalidated).
+    """
+    flow = state.flow
+    kpg = flow.keys[:, -1]
+    live = _live_rows(flow.keys)
+    conf = (flow.meta[:, _meta_cols(flow.keys.shape[1] - 2)[3]] >> 29) & 1
+    tmo = entry_timeout(conf, kpg & 0xFF, timeouts)
+    expired = live & ((now - flow.ts) > tmo)
+    egen = (kpg >> 9) & GEN_ETERNAL
+    gen_w = jnp.asarray(gen, jnp.int32) % GEN_ETERNAL
+    stale = (
+        live & (egen != GEN_ETERNAL) & (egen != gen_w) & ~expired
+    )
+    keys = jnp.where((expired | stale)[:, None], 0, flow.keys)
+    return (
+        state._replace(flow=flow._replace(keys=keys)),
+        expired.sum(dtype=jnp.int32),
+        stale.sum(dtype=jnp.int32),
+    )
+
+
+maintain_scan = jax.jit(_maintain_scan, static_argnames=("timeouts",))
 
 
 # ---- audit plane transforms (datapath/audit.py) ---------------------------

@@ -58,6 +58,28 @@ ASYNC_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
     ("drain_eviction_scan", pl.PH_ALL),
 )
 
+# Overlapped-regime chain (round 6, ROADMAP item 2): the double-buffered
+# cadence — every timed iteration dispatches the FAST step of window i
+# and the DRAIN of window i-1 (one-step-deferred commit, the two-slot
+# pending-commit staging of datapath/slowpath), with the drain compiled
+# at meta.drain_reclaim=True (the fused eviction+aging commit pass).
+# Because the drain of window i-1 has no data dependency on the fast
+# step of window i's OUTPUTS (only on the carried state), XLA is free to
+# pipeline the two dispatches; the telescoped chain then attributes what
+# the overlap actually hides — if drain phases telescope to ~0 over the
+# async chain's costs, the serialization was removed; if they reappear,
+# it was not.  Same honesty property, same PH_* bit set
+# (tools/check_phases.py gates all three chains).
+OVERLAP_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
+    ("overlap_fast_path", 0),
+    ("overlap_miss_detect", pl.PH_SLOW),
+    ("overlap_service_lb", pl.PH_SLOW | pl.PH_LB),
+    ("overlap_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
+    ("overlap_cache_commit",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+    ("overlap_evict_age", pl.PH_ALL),
+)
+
 
 def _dev_cols(batch) -> tuple:
     """PacketBatch -> the pipeline's flipped/typed device columns."""
@@ -273,6 +295,125 @@ def profile_churn_async(
     total = cumulative[chain[-1][0]]
     return {
         "mode": "async",
+        "batch": B,
+        "fresh_per_step": n_new,
+        "drain_batch": n_new,
+        "phases_s": phases,
+        "cumulative_s": cumulative,
+        "total_s": total,
+        "pps": B / total,
+        "phase_fractions": {k: v / total for k, v in phases.items()},
+    }
+
+
+def profile_churn_overlap(
+    meta: pl.PipelineMeta,
+    state: pl.PipelineState,
+    drs,
+    dsvc,
+    hot: tuple,
+    pool: tuple,
+    *,
+    n_new: Optional[int] = None,
+    now0: int = 1000,
+    gen: int = 0,
+    k_small: int = 2,
+    k_big: int = 8,
+    repeats: int = 2,
+    chain: tuple = OVERLAP_PHASE_CHAIN,
+) -> dict:
+    """Per-phase breakdown of the OVERLAPPED churn regime (round 6).
+
+    Models the double-buffered engine cadence: iteration i dispatches the
+    decoupled FAST step over the mixed batch (phases=0, window i's fresh
+    lanes admitted unclassified) and then the COALESCED drain of window
+    i-1 — the one-step commit deferral of the two-slot pending-commit
+    staging, under which drain i-1's scatters carry no data dependency on
+    fast step i's outputs and XLA can pipeline the dispatches.  The drain
+    runs at meta.drain_reclaim=True (fused eviction+aging accounting).
+    The chain telescopes exactly like the async chain, so diffing the two
+    breakdowns attributes the overlap win phase by phase.
+
+    Semantics note: window i's verdicts land one iteration late (the
+    lost-update guard makes them visible to iteration i+1's lookups via
+    the carried state), which is exactly the engine's staged-commit
+    observable behavior — the profiled program IS the production cadence.
+    """
+    B = int(hot[0].shape[0])
+    if pool is None:
+        raise ValueError("overlap profiling needs a fresh-flow pool "
+                         "(the regime under study is miss handling)")
+    pool_len = int(pool[0].shape[0])
+    if n_new is None:
+        n_new = max(1, B // 8)
+    if n_new > B or n_new >= pool_len:
+        raise ValueError(
+            f"n_new={n_new} must fit the batch ({B}) and pool ({pool_len})"
+        )
+
+    full = meta._replace(phases=pl.PH_ALL)
+    meta_fast = meta._replace(phases=0)
+    st = state
+    for w in range(2):
+        st, _ = pl.pipeline_step(
+            st, drs, dsvc, *hot, jnp.int32(now0 - 2 + w), jnp.int32(gen),
+            meta=full,
+        )
+
+    def timed(mask: int, with_drain: bool) -> float:
+        m_drain = meta._replace(phases=mask, miss_chunk=n_new,
+                                drain_reclaim=True)
+
+        def body(i, carry):
+            acc, cst, drs_, dsvc_, hcols, pcols = carry
+            off = (acc[1] * n_new) % (pool_len - n_new)
+            # Window i-1 (the deferred commit): acc[1] counts completed
+            # iterations, so the "previous" offset trails by one window —
+            # iteration 0 re-drains the warmed hot prefix (same cost
+            # shape, no semantic weight in a timing loop).
+            off_prev = (jnp.maximum(acc[1] - 1, 0) * n_new) % (
+                pool_len - n_new)
+            fresh = tuple(
+                jax.lax.dynamic_slice(pc, (off,), (n_new,)) for pc in pcols
+            )
+            prev = tuple(
+                jax.lax.dynamic_slice(pc, (off_prev,), (n_new,))
+                for pc in pcols
+            )
+            cols = tuple(
+                jnp.concatenate([h[: B - n_new], f])
+                for h, f in zip(hcols, fresh)
+            )
+            cst, o = pl._pipeline_step(
+                cst, drs_, dsvc_, *cols, now0 + i, gen, meta=meta_fast,
+            )
+            acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
+            if with_drain:
+                cst, od = pl._pipeline_step(
+                    cst, drs_, dsvc_, *prev, now0 + i, gen, meta=m_drain,
+                )
+                acc = acc.at[0].add(
+                    od["code"].sum(dtype=jnp.int32) + od["n_miss"]
+                )
+            acc = acc.at[1].add(1)
+            return (acc, cst, drs_, dsvc_, hcols, pcols)
+
+        carry = (jnp.zeros(8, jnp.int32), st, drs, dsvc, hot, pool)
+        return device_loop_time(
+            body, carry, k_small=k_small, k_big=k_big, repeats=repeats
+        )
+
+    cumulative: dict[str, float] = {}
+    phases: dict[str, float] = {}
+    prev = 0.0
+    for j, (name, mask) in enumerate(chain):
+        t = timed(mask, with_drain=j > 0)
+        cumulative[name] = t
+        phases[name] = t - prev  # unclamped (honesty property; see sync)
+        prev = t
+    total = cumulative[chain[-1][0]]
+    return {
+        "mode": "overlap",
         "batch": B,
         "fresh_per_step": n_new,
         "drain_batch": n_new,

@@ -48,6 +48,7 @@ METRICS: dict[str, str] = {
     "antrea_tpu_flow_cache_entries": "gauge",
     "antrea_tpu_flow_cache_slots": "gauge",
     "antrea_tpu_flow_cache_evictions_total": "counter",
+    "antrea_tpu_flow_cache_reclaims_total": "counter",
     "antrea_tpu_datapath_step_seconds": "histogram",
     # async slow-path engine (datapath/slowpath; rendered when the
     # datapath exposes slowpath_stats())
@@ -60,6 +61,13 @@ METRICS: dict[str, str] = {
     "antrea_tpu_slowpath_drain_batch_size": "histogram",
     "antrea_tpu_flow_cache_epoch": "gauge",
     "antrea_tpu_flow_cache_epoch_age_seconds": "gauge",
+    # overlapped drain-commit plane + drain-chunk autotuner (round 6:
+    # double-buffered churn datapath; rendered with slowpath_stats())
+    "antrea_tpu_slowpath_overlap_depth": "gauge",
+    "antrea_tpu_slowpath_deferred_commits_total": "counter",
+    "antrea_tpu_slowpath_deferred_commit_staleness_seconds": "gauge",
+    "antrea_tpu_slowpath_drain_chunk": "gauge",
+    "antrea_tpu_slowpath_autotune_decisions_total": "counter",
     # transactional bundle commit plane (datapath/commit.py; rendered when
     # the datapath exposes commit_stats())
     "antrea_tpu_bundle_commits_total": "counter",
@@ -314,6 +322,9 @@ def render_metrics(datapath, node: str = "") -> str:
             _type_line("antrea_tpu_flow_cache_evictions_total"),
             f"antrea_tpu_flow_cache_evictions_total{_labels(node=node)} "
             f"{c['evictions']}",
+            _type_line("antrea_tpu_flow_cache_reclaims_total"),
+            f"antrea_tpu_flow_cache_reclaims_total{_labels(node=node)} "
+            f"{c.get('reclaims', 0)}",
         ]
     sp = getattr(datapath, "slowpath_stats", None)
     sp = sp() if sp is not None else None
@@ -330,8 +341,25 @@ def render_metrics(datapath, node: str = "") -> str:
              "stale_reclassified_total"),
             ("antrea_tpu_flow_cache_epoch", "epoch"),
             ("antrea_tpu_flow_cache_epoch_age_seconds", "epoch_age_s"),
+            # Overlapped drain-commit plane (two-slot staging) + the
+            # autotuner's current chunk rung (== drain_batch when the
+            # controller is off).
+            ("antrea_tpu_slowpath_overlap_depth", "overlap_depth"),
+            ("antrea_tpu_slowpath_deferred_commits_total",
+             "deferred_commits_total"),
+            ("antrea_tpu_slowpath_deferred_commit_staleness_seconds",
+             "deferred_staleness_s"),
+            ("antrea_tpu_slowpath_drain_chunk", "drain_batch"),
         ):
             lines += [_type_line(fam), f"{fam}{_labels(node=node)} {sp[key]}"]
+        lines.append(_type_line("antrea_tpu_slowpath_autotune_decisions_total"))
+        for direction, key in (("up", "autotune_decisions_up"),
+                               ("down", "autotune_decisions_down")):
+            lines.append(
+                f"antrea_tpu_slowpath_autotune_decisions_total"
+                f"{_labels(direction=direction, node=node)} "
+                f"{sp.get(key, 0)}"
+            )
         dh = sp.get("drain_hist")
         if dh is not None and dh.count:
             lines.extend(_render_histograms(

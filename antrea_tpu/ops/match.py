@@ -584,6 +584,58 @@ def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, i
 # it requires gathering INTO the consumer, which note 1 bounds at
 # 38 GB/s.  The cold ceiling on this chip/toolchain therefore stands at
 # ~4.8-5.4M pps as shipped, with ~7.4M the hard gather-bound limit.
+#
+# Round-6 overlap study (ROADMAP item 2: the churn gap is SERIALIZATION,
+# not kernel speed — BENCH_r05 steady_churn 4.97M pps = 26.4ms per 131k
+# batch, vs the Amdahl prediction of the measured parts: 5.7ms fast step
+# + ~3.4ms for one coalesced 16k drain = 9.1ms, ~14M pps.  The ~17ms gap
+# is the drain pipeline running IN SEQUENCE with the fast path: lookup
+# pass, classify, commit scatters, eviction gather, plus the engine's
+# two separate full-table maintenance scans and the per-call output
+# fetch blocking the next dispatch).  What was restructured, and what
+# was ruled out:
+#   OVERLAPPED (shipped, models/pipeline + datapath/slowpath):
+#   (a) eviction-scan + aging + revalidation folded into the drain's
+#       commit pass (meta.drain_reclaim): the PH_EVICT audit already
+#       gathers each insert target's old key row; reading its ts/conf in
+#       the same pass classifies dead rows (idle-expired / stale-gen) as
+#       reclaims, so the engine's stale-epoch heal needs ONE fused
+#       maintain_scan (age + revalidate in a single keys/meta/ts read)
+#       instead of two full passes over PipelineState — at 2^22 slots
+#       that removes ~150MB of HBM traffic per heal.
+#   (b) the drain dispatched with the STATE DONATED
+#       (pl.pipeline_step_donated): without donation every per-call
+#       drain allocates fresh output buffers for the rewritten cache
+#       columns (~150MB at 2^22 slots) and copies; donation lets XLA
+#       alias the scatters in place — the eager-dispatch analog of the
+#       fori_loop carry aliasing the bench already enjoyed.
+#   (c) one-step commit deferral (two-slot staging): drain of window i-1
+#       dispatches after fast step i with no dependency on its OUTPUTS
+#       (only the carried state), and the host-side materialization of
+#       drain outputs retires two slots later — so the host never blocks
+#       the device pipeline on np.asarray between fast and drain, and
+#       XLA/the runtime can pipeline the dispatch stream.  Verdict
+#       visibility lags exactly one window (the admitted lanes' flows
+#       were pending anyway); state visibility is immediate via the
+#       carried pytree (the lost-update guard).
+#   NOT overlapped, dead by the same walls as rounds 4-5:
+#   (d) lowering the commit scatters into the pallas classify consumer
+#       (one kernel classifying + writing the cache): Mosaic on this
+#       toolchain has no arbitrary-VMEM-scatter path, the same wall as
+#       note 2's intra-vreg-only dynamic_gather — and the flow cache is
+#       64MB+ per column, far beyond VMEM residency anyway.
+#   (e) true cross-op concurrency: a TensorCore runs one XLA op at a
+#       time, so "overlap" here means removing redundant passes, copies
+#       and host round-trips from the serial schedule, not co-executing
+#       fast and drain — the honest mechanism, and why the decomposition
+#       (bench_cold_study.py case 5: fast alone / drain alone /
+#       serialized / overlapped) is the proof obligation: the overlapped
+#       step time must approach max-ish(fast, drain) only through the
+#       removed work, and serialized-minus-overlapped IS the recovered
+#       serialization.  On-chip numbers land with BENCH_r06 /
+#       PROFILE bench_profile.py --mode overlap (the ±15% gate
+#       cross-checks the attribution); this container is CPU-only, so
+#       the r06 record is the bench's to write, not this note's.
 
 
 def _resolve(action: jax.Array, hits, pod_iso: jax.Array):

@@ -228,6 +228,10 @@ class PipelineOracle:
         # within-batch collision accounting is implementation-defined, so
         # this is an operational metric, not a parity field).
         self.evictions = 0
+        # Dead rows (idle-expired / stale-gen) reclaimed by drain inserts
+        # — the scalar twin of the device's n_reclaim split (counted only
+        # when step() runs with reclaim=True, the overlapped drain mode).
+        self.reclaims = 0
 
     def _set_services(self, services):
         self.services = services
@@ -437,12 +441,19 @@ class PipelineOracle:
     def step(
         self, batch: PacketBatch, now: int, gen: int = 0, lane_modes=None,
         no_commit=None, flags=None, lens=None, fast_only=None,
+        reclaim: bool = False,
     ) -> list[ScalarOutcome]:
         """fast_only (async slow-path mode, datapath/slowpath): when set
         to a verdict code, cache MISSES are not classified — they report
         that provisional code with pending=True and touch no state (the
         caller queues them for a later full-mode drain step).  Hits behave
-        exactly as in synchronous mode (refresh/confirm/teardown)."""
+        exactly as in synchronous mode (refresh/confirm/teardown).
+
+        reclaim (the overlapped drain's fused maintenance, device twin
+        meta.drain_reclaim): inserts over DEAD rows — idle-expired per
+        the per-state timeout, or stale-generation denials — count as
+        `reclaims`, not `evictions` (both classes are already invisible
+        to lookups, so overwriting them is reclaimed occupancy)."""
         # The device packs entry generations into GEN_BITS (22) bits, with
         # GEN_ETERNAL reserved for conntrack-committed ALLOW entries; compare
         # against the same wrapped value so spec and device agree across the
@@ -638,7 +649,14 @@ class PipelineOracle:
                 (old["key"], old.get("rpl", False))
                 != (entry["key"], entry.get("rpl", False))
             ):
-                self.evictions += 1
+                old_dead = reclaim and (
+                    (now - old["ts"]) > self.timeout_of(old, old["key"][3])
+                    or (old["gen"] is not None and old["gen"] != gen)
+                )
+                if old_dead:
+                    self.reclaims += 1
+                else:
+                    self.evictions += 1
             self.flow[slot] = entry
         for slot in refreshes:
             if slot in self.flow:

@@ -254,6 +254,7 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
         # scalar per shard -> (D,) vector of per-data-shard counts
         out["n_miss"] = out["n_miss"][None]
         out["n_evict"] = out["n_evict"][None]
+        out["n_reclaim"] = out["n_reclaim"][None]
         return jax.tree.map(lambda x: x[None], local), out
 
     if ft is None:

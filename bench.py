@@ -194,11 +194,15 @@ def _measure_churn_async(cps, svc, pod_ips, services):
     hsp, psp = col(hot.src_port, pool.src_port)
     hdp, pdp = col(hot.dst_port, pool.dst_port)
 
+    # The drain chunk is plumbed through make_pipeline (round-6
+    # satellite): warm steps and the coalesced drain share ONE compiled
+    # miss_chunk == n_new program, instead of compiling a throwaway
+    # 4096-chunk variant and then a second one via meta._replace.
     step, state, (drs, dsvc) = pl.make_pipeline(
-        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=4096, fused=True
+        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=n_new, fused=True
     )
     meta_fast = step.meta._replace(phases=0)
-    meta_drain = step.meta._replace(miss_chunk=n_new)
+    meta_drain = step.meta
     state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
                     jnp.int32(100), jnp.int32(0))
     state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
@@ -255,6 +259,98 @@ def _measure_churn_async(cps, svc, pod_ips, services):
         q.admit(zeros, mask, epoch=t, now=t)
         q.pop(n_new)
     return B / sec, q.overflows_total
+
+
+def measure_churn_overlap(cps, svc, pod_ips, services):
+    """Churn regime under the OVERLAPPED datapath (round-6 tentpole,
+    ROADMAP item 2): the same universe/fresh-fraction shape as
+    measure_churn_async, but double-buffered — iteration i dispatches the
+    decoupled FAST step over window i's mixed batch and then the
+    coalesced drain of window i-1 (the two-slot deferred-commit staging
+    of datapath/slowpath).  The deferred drain has no data dependency on
+    the fast step's outputs, so XLA can pipeline the two dispatches
+    instead of serializing miss-detect -> drain -> commit -> evict behind
+    the fast path (the ~3x gap bench_profile attributed to pure
+    serialization).  The drain runs at drain_reclaim=True, folding the
+    eviction/aging maintenance into the commit pass.  Window i's verdicts
+    become visible to window i+1's lookups via the carried state — the
+    lost-update guard, and exactly the engine's production overlap
+    semantics.  -> steady_churn_overlap_pps, None on failure."""
+    try:
+        return _measure_churn_overlap(cps, svc, pod_ips, services)
+    except Exception as e:  # report, never sink the bench
+        print(f"# overlap churn measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_churn_overlap(cps, svc, pod_ips, services):
+    hot = gen_traffic(pod_ips, B, n_flows=1 << 15, seed=31,
+                      services=services, svc_fraction=0.3)
+    pool = gen_traffic(pod_ips, CHURN_POOL, n_flows=CHURN_POOL, seed=32,
+                       services=services, svc_fraction=0.3,
+                       one_per_flow=True)
+    n_new = B // CHURN_DIV
+
+    def col(hot_c, pool_c):
+        return jnp.asarray(np.ascontiguousarray(hot_c)), jnp.asarray(
+            np.ascontiguousarray(pool_c))
+
+    hs, ps_ = col(iputil.flip_u32(hot.src_ip), iputil.flip_u32(pool.src_ip))
+    hd, pd = col(iputil.flip_u32(hot.dst_ip), iputil.flip_u32(pool.dst_ip))
+    hp, pp = col(hot.proto, pool.proto)
+    hsp, psp = col(hot.src_port, pool.src_port)
+    hdp, pdp = col(hot.dst_port, pool.dst_port)
+
+    step, state, (drs, dsvc) = pl.make_pipeline(
+        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=n_new, fused=True
+    )
+    meta_fast = step.meta._replace(phases=0)
+    meta_drain = step.meta._replace(drain_reclaim=True)
+    state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
+                    jnp.int32(100), jnp.int32(0))
+    state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
+                    jnp.int32(101), jnp.int32(0))
+
+    def body(i, carry):
+        (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_,
+         ps2, pd2, pp2, psp2, pdp2) = carry
+        off = (acc[1] * n_new) % (CHURN_POOL - n_new)
+        # Window i-1 — the one-step commit deferral.  Iteration 0
+        # re-drains window 0 (already-committed lanes re-classify
+        # identically; one warmup-shaped iteration in a 32-step loop).
+        off_prev = (jnp.maximum(acc[1] - 1, 0) * n_new) % (
+            CHURN_POOL - n_new)
+
+        def window(pcol, o):
+            return jax.lax.dynamic_slice(pcol, (o,), (n_new,))
+
+        pcols = (ps2, pd2, pp2, psp2, pdp2)
+        fresh = tuple(window(c, off) for c in pcols)
+        prev = tuple(window(c, off_prev) for c in pcols)
+
+        def mix(hcol, fcol):
+            return jnp.concatenate([hcol[: B - n_new], fcol])
+
+        # Decoupled fast step of window i: hot lanes hit, fresh admitted.
+        st, o = pl._pipeline_step(
+            st, drs_, dsvc_, mix(hs_, fresh[0]), mix(hd_, fresh[1]),
+            mix(hp_, fresh[2]), mix(hsp_, fresh[3]), mix(hdp_, fresh[4]),
+            102 + i, 0, meta=meta_fast,
+        )
+        acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
+        # Deferred drain of window i-1: no dependency on o, only on st.
+        st, od = pl._pipeline_step(
+            st, drs_, dsvc_, *prev, 102 + i, 0, meta=meta_drain,
+        )
+        acc = acc.at[0].add(od["code"].sum(dtype=jnp.int32) + od["n_miss"])
+        acc = acc.at[1].add(1)
+        return (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_,
+                ps2, pd2, pp2, psp2, pdp2)
+
+    carry = (jnp.zeros(8, jnp.int32), state, drs, dsvc, hs, hd, hp, hsp,
+             hdp, ps_, pd, pp, psp, pdp)
+    sec = device_loop_time(body, carry, k_small=4, k_big=32, repeats=2)
+    return B / sec
 
 
 def measure_sharded_cold_fused(cps, src, dst, proto, dport):
@@ -383,12 +479,16 @@ def main():
     async_churn_pps, q_overflows = measure_churn_async(
         cps, svc, cluster.pod_ips, services
     )
+    overlap_churn_pps = measure_churn_overlap(
+        cps, svc, cluster.pod_ips, services
+    )
     sh_cold_pps = measure_sharded_cold_fused(cps, src, dst, proto, dport)
     sh_pps, sh_overhead = measure_shard_overhead(
         cps, svc, src, dst, proto, sport, dport, pps
     )
     _print_and_gate(pps, cold_pps, sh_pps, sh_overhead, churn_pps,
-                    sh_cold_pps, async_churn_pps, q_overflows)
+                    sh_cold_pps, async_churn_pps, q_overflows,
+                    overlap_churn_pps)
 
 
 # Regression floors (round-3 verdict weak #6: a silent 10x perf regression
@@ -407,7 +507,8 @@ CHURN_FLOOR_PPS = 3.5e6
 
 def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     churn_pps=None, sh_cold_pps=None,
-                    async_churn_pps=None, q_overflows=None):
+                    async_churn_pps=None, q_overflows=None,
+                    overlap_churn_pps=None):
     print(json.dumps({
         "metric": f"classified_pkts_per_sec_chip_{N_RULES // 1000}k_rules",
         "value": round(pps, 1),
@@ -428,10 +529,18 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
             else round(churn_pps, 1),
             # The SAME churn regime under the async slow-path engine
             # (datapath/slowpath): decoupled fast step + one coalesced
-            # drain round per step; first measured in this round, no
-            # floor yet (the sync floor still guards the churn path).
+            # drain round per step, SERIALIZED per iteration — kept for
+            # the r05 -> r06 comparison against the overlapped number.
             "async_churn_pps": None if async_churn_pps is None
             else round(async_churn_pps, 1),
+            # Round-6 tentpole: the overlapped (double-buffered) regime —
+            # drain of window i-1 deferred behind fast step i, fused
+            # eviction+aging commit pass (drain_reclaim).  Acceptance
+            # target: >= 10M pps @ churn_frac 0.125 on v5e-1; no floor
+            # yet (the sync churn floor still guards the path) — the r06
+            # verdict calibrates one from the first on-chip measurement.
+            "steady_churn_overlap_pps": None if overlap_churn_pps is None
+            else round(overlap_churn_pps, 1),
             "miss_queue_overflows": q_overflows,
             "async_drain_batch": B // CHURN_DIV,
             "churn_frac": 1 / CHURN_DIV,

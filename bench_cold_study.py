@@ -1,4 +1,4 @@
-"""Cold-path phase-breakdown study (round 5; see the study notes in
+"""Cold-path phase-breakdown study (rounds 5-6; see the study notes in
 antrea_tpu/ops/match.py).
 
 Measures, at the bench's 100k-rule world and B=32k on the real chip:
@@ -6,7 +6,14 @@ Measures, at the bench's 100k-rule world and B=32k on the real chip:
   2. the searchsorted phase alone;
   3. searchsorted + 6 row gathers with a reduction fused into the gather
      loops (the hard gather bound);
-  4. the AND-in-XLA + 2-input consumer variant (measured dead-end (c)).
+  4. the AND-in-XLA + 2-input consumer variant (measured dead-end (c));
+  5. (round 6) the OVERLAP DECOMPOSITION of the churn step — fast step
+     alone, coalesced drain alone, the two serialized per iteration, and
+     the two double-buffered (drain of window i-1 behind fast step i,
+     drain_reclaim=True) — the in-repo methodology behind the
+     steady_churn_overlap_pps bench regime: serialized-minus-overlapped
+     IS the recovered serialization, and fast+drain-minus-overlapped
+     bounds what further overlap could still buy.
 Run directly: python bench_cold_study.py  (several minutes on the
 tunneled platform; numbers jitter ~15% run to run)."""
 import jax, jax.numpy as jnp, numpy as np
@@ -109,3 +116,79 @@ def body_and(i, carry):
         mi.astype(jnp.int32), mo.astype(jnp.int32))
     return (acc.at[:1].add(hits[:, 0].sum()), drs_, s_, d_, p_, dp_)
 t_and = timeit("AND-in-XLA + 2-input consumer", body_and, carry)
+
+# 5) round-6 overlap decomposition: churn-step cadences over the SAME
+# rule world (empty service tables — the overlap under study is the
+# drain/commit pipeline, not ServiceLB).  B-lane hot set, n_new fresh
+# lanes per step from a one-per-flow pool; the drain runs as ONE
+# coalesced round at miss_chunk == n_new with drain_reclaim=True.
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.models import pipeline as pmod
+
+N_NEW = B // 8
+POOL = 1 << 18
+pool_tr = gen_traffic(cluster.pod_ips, POOL, n_flows=POOL, seed=7,
+                      one_per_flow=True)
+p_src = jnp.asarray(iputil.flip_u32(pool_tr.src_ip))
+p_dst = jnp.asarray(iputil.flip_u32(pool_tr.dst_ip))
+p_pro = jnp.asarray(pool_tr.proto)
+p_sp = jnp.asarray(pool_tr.src_port)
+p_dp = jnp.asarray(pool_tr.dst_port)
+pool_cols = (p_src, p_dst, p_pro, p_sp, p_dp)
+hot_cols = (src, dst, proto, jnp.asarray(tr.src_port), dport)
+
+step5, state5, (drs5, dsvc5) = pmod.make_pipeline(
+    cps, compile_services([]), flow_slots=1 << 20, miss_chunk=N_NEW,
+    fused=True,
+)
+meta_fast = step5.meta._replace(phases=0)
+meta_drain = step5.meta._replace(drain_reclaim=True)
+for w in (100, 101):  # warm the hot set
+    state5, _ = step5(state5, drs5, dsvc5, *hot_cols,
+                      jnp.int32(w), jnp.int32(0))
+
+
+def overlap_body(fast, drain, deferred):
+    """One churn iteration: optional fast step over the mixed batch,
+    optional drain of the current (deferred=False) or previous
+    (deferred=True) fresh window."""
+
+    def body(i, carry):
+        acc, st, drs_, dsvc_, hcols, pcols = carry
+        off = (acc[1] * N_NEW) % (POOL - N_NEW)
+        off_p = (jnp.maximum(acc[1] - 1, 0) * N_NEW) % (POOL - N_NEW)
+        fresh = tuple(jax.lax.dynamic_slice(c, (off,), (N_NEW,))
+                      for c in pcols)
+        dwin = (tuple(jax.lax.dynamic_slice(c, (off_p,), (N_NEW,))
+                      for c in pcols) if deferred else fresh)
+        if fast:
+            cols = tuple(jnp.concatenate([h[: B - N_NEW], f])
+                         for h, f in zip(hcols, fresh))
+            st, o = pmod._pipeline_step(st, drs_, dsvc_, *cols, 102 + i, 0,
+                                        meta=meta_fast)
+            acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
+        if drain:
+            st, od = pmod._pipeline_step(st, drs_, dsvc_, *dwin, 102 + i, 0,
+                                         meta=meta_drain)
+            acc = acc.at[0].add(od["code"].sum(dtype=jnp.int32)
+                                + od["n_miss"])
+        acc = acc.at[1].add(1)
+        return (acc, st, drs_, dsvc_, hcols, pcols)
+
+    return body
+
+
+carry5 = (jnp.zeros(8, jnp.int32), state5, drs5, dsvc5, hot_cols, pool_cols)
+t_fast = timeit("churn fast step alone (phases=0)",
+                overlap_body(True, False, False), carry5)
+t_drain = timeit("coalesced drain alone (drain_reclaim)",
+                 overlap_body(False, True, False), carry5)
+t_serial = timeit("fast + drain SERIALIZED (same window)",
+                  overlap_body(True, True, False), carry5)
+t_ovl = timeit("fast + drain OVERLAPPED (window i-1 deferred)",
+               overlap_body(True, True, True), carry5)
+print(f"overlap decomposition: fast {t_fast*1e3:.2f} + drain "
+      f"{t_drain*1e3:.2f} = {1e3*(t_fast+t_drain):.2f} ms predicted; "
+      f"serialized {t_serial*1e3:.2f} ms, overlapped {t_ovl*1e3:.2f} ms "
+      f"-> recovered {1e3*(t_serial-t_ovl):.2f} ms/step "
+      f"({B/t_ovl/1e6:.2f}M pps overlapped)", flush=True)

@@ -33,7 +33,8 @@ import numpy as np
 from antrea_tpu.compiler.compile import compile_policy_set
 from antrea_tpu.compiler.services import compile_services
 from antrea_tpu.models import pipeline as pl
-from antrea_tpu.models.profile import PHASE_CHAIN, profile_churn
+from antrea_tpu.models.profile import (OVERLAP_PHASE_CHAIN, PHASE_CHAIN,
+                                       profile_churn, profile_churn_overlap)
 from antrea_tpu.simulator.genpolicy import gen_cluster
 from antrea_tpu.simulator.genservice import gen_services
 from antrea_tpu.simulator.traffic import gen_traffic
@@ -74,6 +75,13 @@ def main() -> int:
     ap.add_argument("--k-small", type=int, default=4)
     ap.add_argument("--k-big", type=int, default=16)
     ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument(
+        "--mode", choices=("sync", "overlap"), default="sync",
+        help="sync = the inline slow-path chain (PHASE_CHAIN); overlap = "
+             "the round-6 double-buffered regime (OVERLAP_PHASE_CHAIN: "
+             "drain of window i-1 overlapping fast step i) — diff the "
+             "two runs to attribute the overlap win phase by phase",
+    )
     args = ap.parse_args()
     out_path = args.out or _next_out(os.path.dirname(os.path.abspath(__file__)))
 
@@ -96,29 +104,48 @@ def main() -> int:
     hot_c, pool_c = _cols(hot), _cols(pool)
     n_new = B // CHURN_DIV
 
-    prof = profile_churn(
-        step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
-        k_small=args.k_small, k_big=args.k_big, repeats=args.repeats,
-    )
-    # Independent full-step measurement: fresh dispatch chain, different K
-    # values — the cross-check that the masked-chain end is a real
-    # full-step time, not an artifact of its own measurement.
-    indep = profile_churn(
-        step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
-        k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
-        repeats=args.repeats, chain=(("full", pl.PH_ALL),),
-    )
+    if args.mode == "overlap":
+        chain = OVERLAP_PHASE_CHAIN
+        prof = profile_churn_overlap(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=args.k_small, k_big=args.k_big, repeats=args.repeats,
+        )
+        # Independent full-step measurement of the SAME overlapped
+        # cadence: a 2-entry chain whose end is the full (fast + drain
+        # at PH_ALL) step, fresh dispatches, different K values.
+        indep = profile_churn_overlap(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
+            repeats=args.repeats,
+            chain=(("base", 0), ("full", pl.PH_ALL)),
+        )
+    else:
+        chain = PHASE_CHAIN
+        prof = profile_churn(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=args.k_small, k_big=args.k_big, repeats=args.repeats,
+        )
+        # Independent full-step measurement: fresh dispatch chain,
+        # different K values — the cross-check that the masked-chain end
+        # is a real full-step time, not an artifact of its own
+        # measurement.
+        indep = profile_churn(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
+            repeats=args.repeats, chain=(("full", pl.PH_ALL),),
+        )
     sum_phases = sum(prof["phases_s"].values())
     agreement = sum_phases / indep["total_s"]
     bottleneck = max(prof["phases_s"], key=prof["phases_s"].get)
     doc = {
         "metric": f"churn_phase_breakdown_{N_RULES // 1000}k_rules",
         "unit": "s/step",
+        "mode": args.mode,
         "batch": B,
         "fresh_per_step": n_new,
         "churn_universe": CHURN_POOL,
         "flow_slots": FLOW_SLOTS,
-        "phase_chain": [name for name, _m in PHASE_CHAIN],
+        "phase_chain": [name for name, _m in chain],  # PHASE_CHAIN / OVERLAP_PHASE_CHAIN per --mode
         "phases_s": prof["phases_s"],
         "phase_fractions": prof["phase_fractions"],
         "total_s": prof["total_s"],

@@ -154,6 +154,34 @@ def test_oracle_profile_async_mode_names():
                                      "drain_commit_residual"}
 
 
+def test_tpuflow_profile_overlap_mode():
+    """profile(mode="overlap") attributes the double-buffered cadence
+    (OVERLAP_PHASE_CHAIN: drain of window i-1 behind fast step i) with
+    the same telescoped-sum identity, state untouched."""
+    from antrea_tpu.models.profile import OVERLAP_PHASE_CHAIN
+
+    cluster, hot, fresh = _world()
+    dp = TpuflowDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8,
+                         miss_chunk=16)
+    dp.step(hot, now=1)
+    before = dp.cache_stats()
+    prof = dp.profile(hot, fresh, n_new=8, k_small=1, k_big=2, repeats=1,
+                      mode="overlap")
+    assert dp.cache_stats() == before
+    assert list(prof["phases_s"]) == [n for n, _m in OVERLAP_PHASE_CHAIN]
+    assert prof["mode"] == "overlap" and prof["drain_batch"] == 8
+    assert abs(sum(prof["phases_s"].values()) - prof["total_s"]) < 1e-12
+    assert prof["total_s"] > 0 and prof["pps"] > 0
+
+
+def test_oracle_profile_overlap_mode_names():
+    cluster, hot, fresh = _world()
+    dp = OracleDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8)
+    prof = dp.profile(hot, fresh, mode="overlap")
+    assert set(prof["phases_s"]) == {"overlap_fast_path", "overlap_classify",
+                                     "overlap_commit_residual"}
+
+
 def test_check_phases_tool_runs_clean():
     """tools/check_phases.py (satellite: phase-drift CI check) exits 0 —
     pipeline PH_* masks, profile chains, and bench_profile stay in sync."""

@@ -394,6 +394,266 @@ def test_async_mode_matches_reachability_fixtures():
                              list(zip(scenario.probes, got)))
 
 
+# ---- round 6: overlapped drain/commit pipeline + autotuner ----------------
+
+
+def test_overlap_commit_visible_to_next_batch_lost_update_guard():
+    """The lost-update guard: with overlap_commits on, the drain of batch
+    N is dispatched with its host materialization DEFERRED (two-slot
+    staging) — yet batch N+1's lookups must already see N's committed
+    entries, because the state pytree swaps at dispatch time (a data
+    dependency, not a host barrier).  Verified BEFORE any flush, with
+    exact twin parity; the deferred observation settles at flush."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, overlap_commits=True)
+
+    probes = [
+        _fresh_pkt(BLOCKED, SRV),        # denied
+        _fresh_pkt(CLIENT, SRV),         # allowed
+        _fresh_pkt(CLIENT2, "10.96.0.1"),  # via the service (DNAT)
+    ]
+    rt, _ = _step_both(t, o, probes, next(_NOW))
+    assert list(rt.pending) == [1, 1, 1]
+    _drain_both(t, o, next(_NOW))
+    for dp in (t, o):
+        s = dp.slowpath_stats()
+        assert (s["overlap"], s["overlap_depth"],
+                s["deferred_commits_total"]) == (1, 1, 1), s
+    # Batch N+1, BEFORE flushing the staged commit: verdicts and DNAT
+    # resolution must be N's committed values on both engines.
+    rt2, _ = _step_both(t, o, probes, next(_NOW))
+    assert list(rt2.pending) == [0, 0, 0]
+    assert list(rt2.code) == [1, 0, 0]
+    assert rt2.dnat_ip[2] == iputil.ip_to_u32(SRV)
+    assert rt2.dnat_port[2] == 8080
+    # Flush settles the deferred observation; per-rule metrics then agree.
+    assert t.flush_slowpath() == o.flush_slowpath() == 1
+    st, so = t.stats(), o.stats()
+    assert st.ingress == so.ingress and st.egress == so.egress
+    for dp in (t, o):
+        assert dp.slowpath_stats()["overlap_depth"] == 0
+
+
+def test_overlap_reenqueue_of_pending_flow_is_idempotent():
+    """The re-enqueue arm of the guard: a flow whose packets keep
+    arriving while its first classification is staged re-admits and
+    re-classifies — idempotent (deterministic endpoint hash -> identical
+    entry), with exact twin parity on cache state and queue counters."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, overlap_commits=True)
+    p = _fresh_pkt(CLIENT, "10.96.0.1")
+    _step_both(t, o, [p], next(_NOW))            # admitted (pending)
+    _step_both(t, o, [p], next(_NOW))            # re-missed: re-admitted
+    for dp in (t, o):
+        assert dp.slowpath_stats()["depth"] == 2
+    _drain_both(t, o, next(_NOW))                # classifies both copies
+    rt, _ = _step_both(t, o, [p], next(_NOW))
+    assert list(rt.pending) == [0] and list(rt.code) == [0]
+    assert rt.dnat_ip[0] == iputil.ip_to_u32(SRV)
+    t.flush_slowpath(), o.flush_slowpath()
+    ct, co = t.cache_stats(), o.cache_stats()
+    for k in ("occupied", "committed", "denials"):
+        assert ct[k] == co[k], (k, ct, co)
+
+
+def test_overlap_epoch_swap_mid_drain_reclassifies():
+    """A bundle swap landing mid-overlap (between begin_drain and
+    finish_drain, with a commit still staged from an earlier drain): the
+    in-flight batch re-classifies under the NEW tensors, the staged
+    commit's deferred metrics keep their dispatch-time attribution, and
+    both engines converge to the new bundle's verdicts."""
+    ps, svcs = _world()
+    ps2, _ = _world(blocked_ip=CLIENT)
+    t, o = _pair(ps, svcs, overlap_commits=True)
+
+    warm = _fresh_pkt(CLIENT2, SRV)
+    probe = _fresh_pkt(CLIENT, SRV)
+    _step_both(t, o, [warm], next(_NOW))
+    _drain_both(t, o, next(_NOW))      # leaves one staged commit
+    _step_both(t, o, [probe], next(_NOW))
+    for dp in (t, o):
+        assert dp._slowpath.overlap_depth == 1
+        assert dp._slowpath.begin_drain(next(_NOW))
+        dp.install_bundle(ps=ps2)      # mid-drain, mid-overlap swap
+        st = dp._slowpath.finish_drain(next(_NOW))
+        assert st["stale_reclassified"] == 1
+    rt, _ = _step_both(t, o, [probe], next(_NOW))
+    assert list(rt.code) == [1]        # CLIENT now blocked, both engines
+    assert t.flush_slowpath() == o.flush_slowpath() == 2
+    st, so = t.stats(), o.stats()
+    assert st.ingress == so.ingress and st.egress == so.egress
+
+
+def test_fused_maintain_ages_and_revalidates_in_one_pass():
+    """The fused maintenance pass (engine.maintain -> _epoch_maintain):
+    one sweep reclaims BOTH idle-expired entries and stale-generation
+    denials, with identical counts on both engines and established
+    (fresh) entries untouched."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, ct_timeout_s=5)
+
+    old = _fresh_pkt(CLIENT, SRV)       # will idle out
+    early = next(_NOW)
+    _step_both(t, o, [old], early)
+    _drain_both(t, o, early + 1)        # commits fwd+rev (2 entries)
+
+    late = early + 300                  # far past ct_timeout_s=5
+    denied = _fresh_pkt(BLOCKED, SRV)   # fresh denial at `late`
+    keep = _fresh_pkt(CLIENT2, SRV)     # fresh established at `late`
+    for dp in (t, o):
+        dp.step(PacketBatch.from_packets([denied, keep]), late)
+    _drain_both(t, o, late + 1)
+    # Swap the bundle: the denial's generation goes stale.
+    ps2, _ = _world(blocked_ip=CLIENT)
+    t.install_bundle(ps=ps2)
+    o.install_bundle(ps=ps2)
+    for dp in (t, o):
+        aged, revalidated = dp._slowpath.maintain(late + 2)
+        # 2 idle-expired legs of `old`; 1 stale-generation denial.
+        assert (aged, revalidated) == (2, 1), (aged, revalidated)
+        assert not dp._slowpath.stale
+        s = dp.slowpath_stats()
+        assert s["aged_entries_total"] == 2
+        assert s["revalidated_entries_total"] == 1
+    # The established flow survived the fused sweep on both engines.
+    rt, _ = _step_both(t, o, [keep], late + 3)
+    assert list(rt.est) == [1] and list(rt.code) == [0]
+    ct, co = t.cache_stats(), o.cache_stats()
+    assert ct["occupied"] == co["occupied"] == 2  # keep fwd + rev
+
+
+def test_drain_reclaim_splits_dead_rows_from_evictions():
+    """The fused eviction+aging commit pass (meta.drain_reclaim): a drain
+    insert over a DEAD row — idle-expired, or a stale-generation denial —
+    counts as a reclaim, not an eviction; an insert over a LIVE entry
+    still counts as an eviction.  flow_slots=1 forces every flow onto one
+    slot so the collisions are deterministic on both engines."""
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, flow_slots=1, ct_timeout_s=5, drain_batch=4)
+
+    # Expired-row arm: denial A, then (300s later) denial B over it.
+    now0 = next(_NOW)
+    for dp in (t, o):
+        dp.step(PacketBatch.from_packets([_fresh_pkt(BLOCKED, SRV)]), now0)
+        dp.drain_slowpath(now0 + 1)
+    late = now0 + 300
+    for dp in (t, o):
+        dp.step(PacketBatch.from_packets([_fresh_pkt(BLOCKED, SRV)]), late)
+        dp.drain_slowpath(late + 1)
+    for dp in (t, o):
+        c = dp.cache_stats()
+        assert c["reclaims"] == 1, c    # expired denial A reclaimed
+        assert c["evictions"] == 0, c
+    # Live-overwrite arm: a third denial right away evicts the live one.
+    for dp in (t, o):
+        dp.step(PacketBatch.from_packets([_fresh_pkt(BLOCKED, SRV)]),
+                late + 2)
+        dp.drain_slowpath(late + 3)
+        c = dp.cache_stats()
+        assert (c["reclaims"], c["evictions"]) == (1, 1), c
+    # Stale-generation arm: swap the bundle, then drain a fresh denial
+    # over the now-stale one via begin/finish (bypassing drain()'s
+    # maintain pass, which would otherwise clear the slot first).
+    ps2, _ = _world(blocked_ip=CLIENT)
+    for dp in (t, o):
+        dp.install_bundle(ps=ps2)
+        dp.step(PacketBatch.from_packets([_fresh_pkt(CLIENT, SRV)]),
+                late + 4)
+        eng = dp._slowpath
+        assert eng.begin_drain(late + 5)
+        eng.finish_drain(late + 5)
+        dp.flush_slowpath()
+        c = dp.cache_stats()
+        assert (c["reclaims"], c["evictions"]) == (2, 1), c
+
+
+def test_autotuner_hysteresis_no_oscillation():
+    """DrainAutotuner: a step-function arrival rate walks the rung ladder
+    monotonically (one rung per decision, after the hysteresis streak)
+    and holds; in-band depth never moves it; alternating (jittery)
+    signals reset the streak and never move it."""
+    from antrea_tpu.datapath.slowpath import CHUNK_LADDER, DrainAutotuner
+
+    at = DrainAutotuner(4096, 256, 65536)
+    assert at.chunk == 4096
+    # Step up: sustained backlog -> monotonic walk to the top rung.
+    up = [at.observe(depth=10**6, overflow_delta=0) for _ in range(12)]
+    assert all(b >= a for a, b in zip(up, up[1:])), up
+    assert up[-1] == 65536
+    assert at.decisions_up == CHUNK_LADDER.index(65536) - \
+        CHUNK_LADDER.index(4096)
+    # Step down: idle queue -> monotonic walk to the bottom rung.
+    down = [at.observe(depth=0, overflow_delta=0) for _ in range(20)]
+    assert all(b <= a for a, b in zip(down, down[1:])), down
+    assert down[-1] == 256
+    # In-band depth (between chunk/4 and 2*chunk): dead zone, no motion.
+    at2 = DrainAutotuner(4096, 256, 65536)
+    assert all(at2.observe(depth=4096, overflow_delta=0) == 4096
+               for _ in range(10))
+    assert (at2.decisions_up, at2.decisions_down) == (0, 0)
+    # Alternating pressure (jitter): direction flips reset the streak —
+    # the controller never oscillates.
+    at3 = DrainAutotuner(4096, 256, 65536)
+    jitter = [at3.observe(depth=(10**6 if i % 2 == 0 else 0),
+                          overflow_delta=0) for i in range(12)]
+    assert set(jitter) == {4096}, jitter
+    # Overflow pressure counts as an up signal even at low depth.
+    at4 = DrainAutotuner(256, 256, 65536)
+    for _ in range(2):
+        at4.observe(depth=0, overflow_delta=5)
+    assert at4.chunk == 1024
+    # Bounds clamp the ladder.
+    at5 = DrainAutotuner(4096, 1024, 16384)
+    for _ in range(20):
+        at5.observe(depth=10**6, overflow_delta=0)
+    assert at5.chunk == 16384
+    for _ in range(20):
+        at5.observe(depth=0, overflow_delta=0)
+    assert at5.chunk == 1024
+
+
+def test_overlap_knobs_require_async_mode():
+    """overlap_commits / autotune_drain configure the async engine; on a
+    synchronous datapath they would silently do nothing, so both
+    constructors reject them without async_slowpath=True."""
+    ps, svcs = _world()
+    with pytest.raises(ValueError, match="async_slowpath"):
+        TpuflowDatapath(ps, svcs, overlap_commits=True)
+    with pytest.raises(ValueError, match="async_slowpath"):
+        OracleDatapath(ps, svcs, autotune_drain=True)
+
+
+def test_autotuned_engine_steps_chunk_against_queue_pressure():
+    """Engine-level autotuning: the drain chunk follows queue pressure
+    through the pre-compiled rung ladder (engine observes once per
+    drain() call), on both engines with identical decisions, and drains
+    still classify correctly at the retuned chunk."""
+    ps, svcs = _world()
+    # flow_slots sized so the 600-flow storm (fwd+rev entries) commits
+    # without direct-mapped collisions evicting the probed flow.
+    t, o = _pair(ps, svcs, flow_slots=1 << 14, queue=2048, drain_batch=8,
+                 autotune_drain=True, autotune_bounds=(256, 4096))
+    for dp in (t, o):
+        assert dp._slowpath.drain_batch == 256  # seeded to nearest rung
+    # Sustained backlog: admit far more than 2 rungs' worth, drain with
+    # max_batches=0 so only the controller observes (no pops).
+    storm = [_fresh_pkt(CLIENT, SRV) for _ in range(600)]
+    for _ in range(2):
+        now = next(_NOW)
+        for dp in (t, o):
+            dp.step(PacketBatch.from_packets(storm), now)
+            dp.drain_slowpath(now, max_batches=0)
+    for dp in (t, o):
+        s = dp.slowpath_stats()
+        assert s["drain_batch"] == 1024, s   # one rung up after 2 signals
+        assert s["autotune_decisions_up"] == 1
+    # The retuned chunk actually drains (and classifies) the backlog.
+    st = _drain_both(t, o, next(_NOW))
+    assert st["drained"] == 1200
+    rt, _ = _step_both(t, o, [storm[0]], next(_NOW))
+    assert list(rt.pending) == [0] and list(rt.code) == [0]
+
+
 def test_hold_admission_leaves_punt_and_arp_lanes_alone():
     """Regression: lanes handled BEFORE the pipeline (IGMP punt, ARP)
     are not misses — a hold admission policy must not stamp its

@@ -7,8 +7,9 @@ The churn profiler's honesty rests on three surfaces staying in lockstep:
      compile-time phase gates of the slow path), with PH_ALL their OR;
   2. the cumulative chains in antrea_tpu/models/profile.py (PHASE_CHAIN
      for the synchronous regime, ASYNC_PHASE_CHAIN for the decoupled
-     drain regime) — each chain must start at 0, grow by exactly one
-     PH_ bit per entry, end at PH_ALL, and carry unique names;
+     drain regime, OVERLAP_PHASE_CHAIN for the double-buffered overlap
+     regime) — each chain must start at 0, grow by exactly one PH_ bit
+     per entry, end at PH_ALL, and carry unique names;
   3. bench_profile.py, which must report its phase list FROM the chain
      (importing PHASE_CHAIN), not from a hand-copied name list.
 
@@ -34,7 +35,7 @@ BENCH = REPO / "bench_profile.py"
 
 _PH_DEF = re.compile(r"^(PH_[A-Z0-9_]+)\s*=\s*(.+?)\s*(?:#.*)?$", re.M)
 _CHAIN = re.compile(
-    r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
+    r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN|OVERLAP_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
     re.M | re.S,
 )
 _ENTRY = re.compile(r'\(\s*"([a-z0-9_]+)"\s*,\s*([^)]*?)\s*\)', re.S)
@@ -95,7 +96,8 @@ def check() -> list[str]:
                 problems.append(f"{a} and {b} overlap ({va:#x} & {vb:#x})")
 
     chains = parse_chains()
-    for required in ("PHASE_CHAIN", "ASYNC_PHASE_CHAIN"):
+    for required in ("PHASE_CHAIN", "ASYNC_PHASE_CHAIN",
+                     "OVERLAP_PHASE_CHAIN"):
         if required not in chains:
             problems.append(f"profile.py defines no {required}")
     seen_names: set[str] = set()
