@@ -30,7 +30,14 @@ Routes:
                         cursor position, coverage ratio, per-kind
                         divergences, scrub outcomes, last divergence);
                         ?force=1 runs a synchronous full-cache sweep first
-                        (the antctl audit --force path)
+                        (the antctl audit --force path), serialized by the
+                        maintenance scheduler
+  GET /maintenance      unified background-plane scheduler state
+                        (datapath/maintenance.py: tick/blocked counters,
+                        per-task runs/budget-spent/deferrals/shed,
+                        scheduler lag); ?tick=1[&now=N&budget=B] runs one
+                        synchronous scheduler tick first (the antctl
+                        maintenance --tick path)
   GET /memberlist       alive members of the gossip cluster
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
@@ -206,12 +213,30 @@ class AgentApiServer:
                 raise KeyError(route)  # datapath without an audit plane
             if q.get("force", "0") not in ("", "0"):
                 # Operator-triggered full sweep (antctl audit --force):
-                # run it synchronously, then report the refreshed status
-                # with the sweep's own findings attached.
-                scan = self._dp.audit_scan(now=int(q.get("now", 0)),
-                                           full=True)
+                # run it synchronously THROUGH the maintenance scheduler
+                # (the one serialization point against drains/overlap —
+                # tools/check_maintenance.py forbids a direct audit_scan
+                # call site here), then report the refreshed status with
+                # the sweep's own findings attached.
+                scan = self._dp.maintenance_force_audit(
+                    now=int(q.get("now", 0)))
                 body = self._dp.audit_stats()
                 body["last_scan"] = scan
+            return body
+        if route == "/maintenance":
+            ms = getattr(self._dp, "maintenance_stats", None)
+            body = ms() if ms is not None else None
+            if body is None:
+                raise KeyError(route)  # datapath without a scheduler
+            if q.get("tick", "0") not in ("", "0"):
+                # Operator-triggered synchronous tick (antctl maintenance
+                # --tick): run one budgeted round, then report refreshed
+                # state with the tick's own outcome attached.
+                now = int(q["now"]) if "now" in q else None
+                budget = int(q["budget"]) if "budget" in q else None
+                tick = self._dp.maintenance_tick(now=now, budget=budget)
+                body = self._dp.maintenance_stats()
+                body["last_tick"] = tick
             return body
         if route == "/memberlist":
             if self._memberlist is None:

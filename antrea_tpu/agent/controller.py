@@ -238,6 +238,14 @@ class AgentPolicyController:
             self.permanent_failure = f"{type(e).__name__}: {e}"
         else:
             self._retry_at = self._clock() + self._retry_backoff.next_delay()
+            # The maintenance scheduler's degraded-recompile task shares
+            # this backoff (maintenance_recovery_due); a failed install
+            # must open ITS window too, or the next tick double-hammers
+            # run_bundle right behind us.
+            failed = getattr(self.datapath, "maintenance_recovery_failed",
+                             None)
+            if failed is not None:
+                failed()
         self._report_status(failure=str(e))
 
     def _observe_synced(self, t0: float) -> None:
@@ -268,8 +276,22 @@ class AgentPolicyController:
             # incremental deltas until a full-bundle recompile passes its
             # canary.  The agent holds the authoritative PolicySet, so
             # force the bundle path — even with nothing locally pending —
-            # and let the existing retry/backoff discipline pace the
-            # recovery attempts.
+            # paced by the existing retry/backoff discipline AND the
+            # maintenance scheduler's shared recompile backoff
+            # (datapath/maintenance.py maintenance_recovery_due: the
+            # degraded-recompile task and this forced bundle must never
+            # double-hammer run_bundle inside one backoff window).
+            if self._deltas:
+                # Deltas cannot apply while quarantined (they raise
+                # BundleQuarantinedError immediately); fold them into the
+                # full-bundle recovery — the local PolicySet already
+                # reflects the membership — instead of burning a doomed
+                # attempt that would bypass the shared backoff below.
+                self._deltas.clear()
+                self._rules_dirty = True
+            due = getattr(self.datapath, "maintenance_recovery_due", None)
+            if due is not None and not due():
+                return  # shared backoff window still open; state pends
             self._rules_dirty = True
         if not self._rules_dirty and not self._deltas:
             return

@@ -17,6 +17,7 @@ Matching (fqdn.go semantics): exact names case-insensitively; a leading
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 from ..compiler.ir import PolicySet
 from ..datapath.interface import Datapath
@@ -48,13 +49,33 @@ class _Learned:
 
 
 class FqdnController:
-    """Per-node DNS-learned membership for fqdn-- groups."""
+    """Per-node DNS-learned membership for fqdn-- groups.
+
+    TTL GC runs as the `fqdn-ttl` task of the datapath's maintenance
+    scheduler (datapath/maintenance.py): `register_maintenance()` wires
+    `tick()` in, and expiry then consults the SCHEDULER'S monotonic tick
+    clock — one notion of `now` across every background plane, so
+    fault-injected time (dissemination/faults.FaultClock) drives FQDN
+    expiry as deterministically as the other loops."""
 
     def __init__(self, datapath: Datapath):
         self.datapath = datapath
         self._patterns: dict[str, str] = {}  # group key -> pattern
         # (group, ip) -> expiry bookkeeping for TTL-based removal.
         self._learned: dict[tuple[str, str], _Learned] = {}
+        self._sched = None  # maintenance scheduler once registered
+
+    def register_maintenance(self, scheduler, budget: int = 256) -> None:
+        """Register the TTL GC as the scheduler's `fqdn-ttl` task (budget
+        = expired learns processed per tick).  From then on `tick()` with
+        no explicit `now` reads the scheduler's clock."""
+        from ..datapath.maintenance import MaintenanceTask
+
+        self._sched = scheduler
+        scheduler.register(MaintenanceTask(
+            "fqdn-ttl",
+            lambda now, grant: self.tick(now, limit=grant),
+            budget=budget, priority=3))
 
     def configure(self, ps: PolicySet) -> None:
         """(Re)derive the watched patterns AND restore learned membership.
@@ -119,18 +140,32 @@ class FqdnController:
                         self._learned.pop((group, ip), None)
         return updates
 
-    def tick(self, now: int) -> int:
+    def tick(self, now: Optional[int] = None, limit: Optional[int] = None) -> int:
         """Expire TTL-stale learned addresses (fqdn.go's TTL GC); returns
-        the number of datapath group updates applied."""
+        the number of expired learns removed.  `now=None` reads the
+        maintenance scheduler's tick clock (register_maintenance);
+        `limit` caps the expiries processed this tick (the scheduler's
+        budget unit) — the rest stay learned until a later tick, which is
+        safe: deny rules fail CLOSED, never open."""
+        if now is None:
+            if self._sched is None:
+                raise ValueError(
+                    "FqdnController.tick() needs an explicit now= until "
+                    "register_maintenance() wires the scheduler clock")
+            now = self._sched.clock()
         by_group: dict[str, list[str]] = {}
+        expired = 0
         for (group, ip), st in list(self._learned.items()):
+            if limit is not None and expired >= limit:
+                break
             if st.expires <= now:
                 by_group.setdefault(group, []).append(ip)
                 del self._learned[(group, ip)]
+                expired += 1
         for group, ips in by_group.items():
             # A quarantine here leaves the expired members installed a
             # little longer (deny rules fail CLOSED, never open); the
             # post-recovery bundle + configure() rebuilds membership from
             # _learned, which already dropped them.
             self._apply_delta(group, [], ips)
-        return len(by_group)
+        return expired

@@ -29,6 +29,10 @@ API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
         continuous-revalidator status (GET /audit: cursor position,
         coverage ratio, last divergence); --force triggers a synchronous
         full-cache sweep on the agent before reporting
+  maintenance --server URL [--tick] [--now N] [--budget B]
+        unified background-plane scheduler state (GET /maintenance:
+        per-task runs/budget-spent/deferrals/shed, scheduler lag);
+        --tick runs one synchronous budgeted scheduler round first
 """
 
 from __future__ import annotations
@@ -272,6 +276,27 @@ def _cmd_audit(args) -> int:
     return 0
 
 
+def _cmd_maintenance(args) -> int:
+    """Unified maintenance-scheduler status / forced synchronous tick
+    over the live agent API (datapath/maintenance.py; route
+    GET /maintenance on agent/apiserver)."""
+    path = "/maintenance"
+    if not args.tick and (args.budget is not None or args.now):
+        # A budget/now with no tick would be dropped on the floor while
+        # the command prints plain status as if it took effect.
+        print("antctl maintenance: --budget/--now require --tick",
+              file=sys.stderr)
+        return 2
+    if args.tick:
+        path += "?tick=1"
+        if args.now:
+            path += f"&now={args.now}"  # 0/unset: the scheduler clock advances itself
+        if args.budget is not None:
+            path += f"&budget={args.budget}"
+    print(json.dumps(json.loads(_fetch(args.server, path)), indent=2))
+    return 0
+
+
 def _cmd_query_endpoint(args) -> int:
     """Snapshot-based endpoint query: membership sets computed by pod IP,
     then the shared policy scan (controller/endpoint_querier.scan_policies
@@ -362,6 +387,19 @@ def main(argv=None) -> int:
     au.add_argument("--now", type=int, default=0,
                     help="packet-clock seconds for the forced sweep")
     au.set_defaults(fn=_cmd_audit)
+
+    mt = sub.add_parser(
+        "maintenance",
+        help="background-plane scheduler status / forced tick",
+    )
+    mt.add_argument("--server", required=True, help="live agent API base URL")
+    mt.add_argument("--tick", action="store_true",
+                    help="run one synchronous scheduler tick first")
+    mt.add_argument("--now", type=int, default=0,
+                    help="tick-clock seconds for the forced tick")
+    mt.add_argument("--budget", type=int, default=None,
+                    help="total budget units for the forced tick")
+    mt.set_defaults(fn=_cmd_maintenance)
 
     c = sub.add_parser("check", help="installation self-diagnostics")
     c.set_defaults(fn=_cmd_check)

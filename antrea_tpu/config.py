@@ -15,6 +15,13 @@ from typing import Optional
 from .features import FeatureGates
 
 
+class ConfigError(ValueError):
+    """A configuration knob combination that cannot work was rejected at
+    CONSTRUCTION time, with the reason — instead of failing deep inside
+    the first drain/scan it would have broken.  Subclasses ValueError so
+    pre-existing callers that catch/raise ValueError keep working."""
+
+
 @dataclass
 class AgentConfig:
     """antrea-agent.conf analog (the subset this build consumes)."""
@@ -27,6 +34,10 @@ class AgentConfig:
     ct_timeout_s: int = 3600
     miss_chunk: int = 4096
     delta_slots: int = 128
+    # Unified maintenance scheduler (datapath/maintenance.py): total
+    # budget units per tick across every registered background task
+    # (None = unlimited; per-task quanta still clamp each task).
+    maint_budget: Optional[int] = None
     datapath_type: str = "tpuflow"  # ovsconfig.OVSDatapathType analog
     persist_dir: Optional[str] = None
     filestore_dir: Optional[str] = None
@@ -42,6 +53,11 @@ class AgentConfig:
             raise ValueError(f"unknown datapathType {self.datapath_type!r}")
         if self.miss_chunk < 1:
             raise ValueError("missChunk must be >= 1")
+        if self.maint_budget is not None and self.maint_budget <= 0:
+            raise ConfigError(
+                f"maintBudget must be positive (or unset for unlimited), "
+                f"got {self.maint_budget}"
+            )
 
 
 @dataclass
@@ -59,6 +75,7 @@ _AGENT_KEYS = {
     "ctTimeoutSeconds": "ct_timeout_s",
     "missChunk": "miss_chunk",
     "deltaSlots": "delta_slots",
+    "maintBudget": "maint_budget",
     "datapathType": "datapath_type",
     "persistDir": "persist_dir",
     "filestoreDir": "filestore_dir",
@@ -113,6 +130,7 @@ def build_datapath(cfg: AgentConfig):
         node_ips=list(cfg.node_ips), node_name=cfg.node_name,
         persist_dir=cfg.persist_dir,
         feature_gates=cfg.feature_gates,
+        maint_budget=cfg.maint_budget,
     )
     if cls is TpuflowDatapath:
         kw.update(miss_chunk=cfg.miss_chunk, delta_slots=cfg.delta_slots)

@@ -300,28 +300,45 @@ class AuditPlane:
                              f"re-proof"))
         return findings
 
-    def scan(self, now: int = 0, full: bool = False) -> dict:
+    def scan(self, now: int = 0, full: bool = False, *,
+             rows: Optional[int] = None, scrub: bool = True) -> dict:
         """One audit step: scripted injection -> tensor scrub -> cursor
-        (or full) cache revalidation -> repair -> divergence policy."""
+        (or full) cache revalidation -> repair -> divergence policy.
+
+        The maintenance scheduler (datapath/maintenance.py) budgets the
+        two mechanisms as separate tasks: `rows` clamps the cursor window
+        (rows=0 skips the cache walk entirely — no cursor movement, no
+        sweep accounting), `scrub=False` skips the checksum scrub.  The
+        default call (rows=None, scrub=True) is the historical full step
+        the /audit?force=1 path and the chaos tier drive."""
         o = self.owner
         self.scans_total += 1
         out = {"scanned": 0, "audited": 0, "divergences": 0, "repaired": 0,
                "recovered": False}
-        # Scripted corruption (chaos site {name}.cache): REAL damage the
-        # rest of this very scan must detect and repair.
-        if self._plan is not None:
-            rule = self._plan.fire(f"{self._site}.cache")
-            if rule is not None and rule.kind != "delay":
-                out["injected_corruption"] = o._audit_corrupt(
-                    "tensor" if rule.kind == "partial" else "verdict",
-                    now=now)
-        corrupt = self._scrub(out)
+        corrupt = False
+        if scrub:
+            # Scripted corruption (chaos site {name}.cache): REAL damage
+            # the rest of this very scan must detect and repair.
+            if self._plan is not None:
+                rule = self._plan.fire(f"{self._site}.cache")
+                if rule is not None and rule.kind != "delay":
+                    out["injected_corruption"] = o._audit_corrupt(
+                        "tensor" if rule.kind == "partial" else "verdict",
+                        now=now)
+            corrupt = self._scrub(out)
+            out["scrubbed"] = len(self._golden or {}) + 1
         state_corrupt = bool(out.get("state_corrupt"))
         full = bool(full or corrupt)
         out["full"] = full
 
         slots = int(o._audit_slots())
-        k = slots if full else min(self.window, slots)
+        k = slots if full else min(
+            self.window if rows is None else max(0, int(rows)), slots)
+        if k == 0 and not full:
+            # Scrub-only step (a clean scrub, else `corrupt` forced the
+            # full sweep): the cursor mechanism did not run.  Scrub
+            # findings surface via stats()/"healed", like every scan.
+            return out
         start = 0 if full else self.cursor
         entries = o._audit_window(start, k, now)
         if full:

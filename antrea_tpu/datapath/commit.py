@@ -353,13 +353,17 @@ class CommitPlane:
         self.canary_mismatches_total += len(mism)
         return mism
 
-    def canary_scan(self, now: int = 0) -> dict:
+    def canary_scan(self, now: int = 0, recover: bool = True) -> dict:
         """Runtime watchdog (off-hot-step, the age_scan cadence): re-run
         the canary against the LIVE bundle so silent corruption is caught
         between installs.  On mismatch the datapath degrades and a
         full-bundle recompile is attempted immediately (run_bundle's own
         canary certifies it); while degraded, every scan retries the
-        recompile.  -> {probes, mismatches, recovered, degraded}."""
+        recompile.  `recover=False` skips the recompile attempt — the
+        maintenance scheduler's degraded-recompile task owns recovery
+        pacing (backoff on the tick clock), so its canary ticks must
+        detect without double-driving run_bundle.
+        -> {probes, mismatches, recovered, degraded}."""
         del now  # probes use the plane's own fresh clock
         before = self.canary_probes_total
         try:
@@ -379,7 +383,7 @@ class CommitPlane:
             "mismatches": len(mism),
             "recovered": False,
         }
-        if self.degraded:
+        if self.degraded and recover:
             try:
                 self.run_bundle(None, None)
                 out["recovered"] = True
@@ -442,9 +446,9 @@ class TransactionalDatapath:
     def apply_group_delta(self, group_name, added_ips, removed_ips) -> int:
         return self._commit.run_delta(group_name, added_ips, removed_ips)
 
-    def canary_scan(self, now: int = 0) -> dict:
+    def canary_scan(self, now: int = 0, recover: bool = True) -> dict:
         """Off-hot-step live-bundle canary watchdog (CommitPlane.canary_scan)."""
-        return self._commit.canary_scan(now)
+        return self._commit.canary_scan(now, recover=recover)
 
     def commit_stats(self) -> dict:
         """Commit-plane counters for the metrics/API planes."""

@@ -200,6 +200,26 @@ class Datapath(ABC):
         outcomes, repairs) — None without a plane."""
         return None
 
+    # -- unified maintenance surface (datapath/maintenance.py; both engines
+    # override via the MaintainableDatapath mixin — inert default for test
+    # doubles without a scheduler) ------------------------------------------
+
+    def maintenance_stats(self) -> Optional[dict]:
+        """Maintenance-scheduler counters (per-task runs/budget-spent/
+        deferrals/shed, scheduler lag) — None without a scheduler."""
+        return None
+
+    def maintenance_force_audit(self, now: int = 0) -> Optional[dict]:
+        """Operator-forced full audit sweep (the agent API's /audit
+        ?force=1 path).  Engines override via the MaintainableDatapath
+        mixin, which serializes the sweep through the scheduler; this
+        default serves audit-capable datapaths WITHOUT a scheduler by a
+        direct sweep (nothing to serialize against), and returns None
+        without an audit plane."""
+        if self.audit_stats() is None:
+            return None
+        return self.audit_scan(now, full=True)
+
     # -- async slow-path surface (datapath/slowpath; both engines) ----------
     # Shared plumbing: each engine implements the CLASSIFY callbacks
     # (_drain_classify/_epoch_revalidate/_epoch_age_scan) and calls
@@ -223,13 +243,15 @@ class Datapath(ABC):
         queue-pressure hysteresis controller (drain_batch seeds the
         starting rung); overlap_commits enables the two-slot deferred
         drain-commit staging (the double-buffered churn datapath)."""
+        from ..config import ConfigError
+
         if async_slowpath and dual_stack:
-            raise ValueError(
+            raise ConfigError(
                 "async slow-path mode is v4-only; dual-stack instances "
                 "use the synchronous slow path"
             )
         if (overlap_commits or autotune_drain) and not async_slowpath:
-            raise ValueError(
+            raise ConfigError(
                 "overlap_commits/autotune_drain configure the async "
                 "slow-path engine; pass async_slowpath=True (a "
                 "synchronous datapath has no drain pipeline to overlap "

@@ -47,10 +47,12 @@ from ..oracle.interpreter import Oracle
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..utils import ip as iputil
 from ..packet import Packet, PacketBatch
+from ..config import ConfigError
 from . import persist
 from .audit import AuditableDatapath
 from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
+from .maintenance import MaintainableDatapath
 from .slowpath import ADMIT_HOLD
 
 
@@ -65,8 +67,9 @@ def _group_ranges(g) -> set:
     return set(iputil.merge_ranges(rs))
 
 
-class OracleDatapath(TransactionalDatapath, AuditableDatapath,
-                     persist.PersistableDatapath, Datapath):
+class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
+                     AuditableDatapath, persist.PersistableDatapath,
+                     Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -93,10 +96,23 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         overlap_commits: bool = False,
         canary_probes: int = 64,
         audit_window: int = 64,
-        audit_divergence_trip: int = 8,
+        audit_divergence_trip: Optional[int] = None,
+        maint_budget: Optional[int] = None,
+        maint_clock=None,
     ):
         from ..features import DEFAULT_GATES
 
+        # Same construction-time knob-combo validation as the kernel twin
+        # (one typed ConfigError; see TpuflowDatapath.__init__).
+        if canary_probes == 0 and audit_divergence_trip is not None:
+            raise ConfigError(
+                "canary_probes=0 disables the canary, but "
+                "audit_divergence_trip escalation recovers through a "
+                "canary-gated recompile — enable probes or drop the "
+                "explicit trip"
+            )
+        audit_divergence_trip = (8 if audit_divergence_trip is None
+                                 else audit_divergence_trip)
         self._gates = feature_gates or DEFAULT_GATES
         self._dual_stack = dual_stack
         self._node_ips = list(node_ips or [])
@@ -145,6 +161,11 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         # interpreter/program tables anchor the scrub's golden digests.
         self._init_audit_plane(audit_window=audit_window,
                                audit_divergence_trip=audit_divergence_trip)
+        # Maintenance scheduler LAST — same task set, budgets and tick
+        # semantics as the kernel twin (datapath/maintenance.py), so the
+        # differential harness diffs the background plane tick-for-tick.
+        self._init_maintenance(maint_budget=maint_budget,
+                               maint_clock=maint_clock)
 
     def _rebuild_l7_ids(self) -> None:
         """Stable ids of rules carrying L7 protocols in the CURRENT policy
@@ -639,8 +660,12 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
         overlapped-regime names over the identical split: the scalar
         engine is host-sequential, so its overlap numbers ARE its async
         numbers — the honest statement that there is nothing to overlap
-        here, kept mode-for-mode so harnesses can call either twin."""
-        if mode not in ("sync", "async", "overlap"):
+        here, kept mode-for-mode so harnesses can call either twin.
+        mode="maintenance" additionally times one fused maintenance pass
+        (_epoch_maintain, the cache-maintain task of the unified
+        scheduler) as `maint_sweep` / `maintenance_s` — the scalar twin
+        of MAINT_PHASE_CHAIN's rider."""
+        if mode not in ("sync", "async", "overlap", "maintenance"):
             raise ValueError(f"unknown profile mode {mode!r}")
         from ..models.pipeline import GEN_ETERNAL
 
@@ -666,11 +691,20 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
                 self._default_allow, self._default_deny)
         hist_snap = (list(self.step_hist._counts), self.step_hist.sum,
                      self.step_hist.count)
+        muts0 = self._state_mutations
+        t_maint = 0.0
         try:
             t0 = time.perf_counter()
             for b in probes:
                 self.step(b, now)
             total = time.perf_counter() - t0
+            if mode == "maintenance":
+                # The maintenance rider, inside the snapshot/restore
+                # bracket like the steps: state-neutral to the caller.
+                t0 = time.perf_counter()
+                self._epoch_maintain(now)
+                t_maint = time.perf_counter() - t0
+                total += t_maint
         finally:
             (o.flow, o.aff, o.evictions, si, so, bi, bo,
              self._default_allow, self._default_deny) = (
@@ -682,6 +716,7 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
             self._bytes_out = Counter(bo)
             (self.step_hist._counts, self.step_hist.sum,
              self.step_hist.count) = hist_snap
+            self._state_mutations = muts0
         n = len(packets)
         if mode == "async":
             phases = {
@@ -695,13 +730,21 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
                 "overlap_classify": t_cls,
                 "overlap_commit_residual": max(total - t_fast - t_cls, 0.0),
             }
+        elif mode == "maintenance":
+            phases = {
+                "maint_fast_path": t_fast,
+                "maint_classify": t_cls,
+                "maint_commit_residual": max(
+                    total - t_fast - t_cls - t_maint, 0.0),
+                "maint_sweep": t_maint,
+            }
         else:
             phases = {
                 "fast_path": t_fast,
                 "classify": t_cls,
                 "commit_residual": max(total - t_fast - t_cls, 0.0),
             }
-        return {
+        out = {
             "batch": n,
             "fresh_per_step": 0 if fresh is None else fresh.size,
             "misses": len(misses),
@@ -711,6 +754,11 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
             "phase_fractions": {k: v / max(total, 1e-9)
                                 for k, v in phases.items()},
         }
+        if mode == "maintenance":
+            out["mode"] = "maintenance"
+            out["maintenance_s"] = t_maint
+            out["maintenance_fraction"] = t_maint / max(total, 1e-9)
+        return out
 
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         """Read-only per-packet trace, same semantics as TpuflowDatapath:
@@ -786,6 +834,9 @@ class OracleDatapath(TransactionalDatapath, AuditableDatapath,
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
         t0 = time.perf_counter()
+        # Traffic time drives the maintenance tick clock (one clock
+        # domain: flow-cache aging and FQDN expiry stamp with THIS now).
+        self._maintenance.observe(now)
         try:
             return self._step(batch, now)
         finally:

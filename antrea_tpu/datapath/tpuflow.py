@@ -49,10 +49,12 @@ from ..observability.metrics import Histogram
 from ..ops.match import DeltaTable, to_device
 from ..packet import Packet, PacketBatch
 from ..utils import ip as iputil
+from ..config import ConfigError
 from . import persist
 from .audit import AuditableDatapath
 from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
+from .maintenance import MaintainableDatapath
 from .slowpath import ADMIT_HOLD
 
 
@@ -62,8 +64,9 @@ def _rid(ids: list, idx: int):
     return ids[idx] if 0 <= idx < len(ids) and ids[idx] else None
 
 
-class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
-                      persist.PersistableDatapath, Datapath):
+class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
+                      AuditableDatapath, persist.PersistableDatapath,
+                      Datapath):
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -93,10 +96,29 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         overlap_commits: bool = False,
         canary_probes: int = 64,
         audit_window: int = 64,
-        audit_divergence_trip: int = 8,
+        audit_divergence_trip: Optional[int] = None,
+        maint_budget: Optional[int] = None,
+        maint_clock=None,
     ):
         from ..features import DEFAULT_GATES
 
+        # Knob-combo validation up front (one typed ConfigError at
+        # construction, not a failure deep in the first drain/scan): the
+        # audit divergence trip escalates through a CANARY-GATED full
+        # recompile — with probing disabled that recovery could never
+        # certify, so an explicit trip alongside canary_probes=0 is a
+        # contradiction.  (canary_probes=0 with the trip left default
+        # stays legal: the default plane simply never trips without
+        # probes to disagree with.)
+        if canary_probes == 0 and audit_divergence_trip is not None:
+            raise ConfigError(
+                "canary_probes=0 disables the canary, but "
+                "audit_divergence_trip escalation recovers through a "
+                "canary-gated recompile — enable probes or drop the "
+                "explicit trip"
+            )
+        audit_divergence_trip = (8 if audit_divergence_trip is None
+                                 else audit_divergence_trip)
         self._gates = feature_gates or DEFAULT_GATES
         # Per-entry traffic counters ride the FlowExporter gate: volumes
         # cost a hit-path column gather+scatter, paid only when the
@@ -181,6 +203,11 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
         # checksum scrub's golden digests (datapath/audit.py).
         self._init_audit_plane(audit_window=audit_window,
                                audit_divergence_trip=audit_divergence_trip)
+        # Maintenance scheduler LAST: its default tasks close over the
+        # slow-path engine, commit plane and audit plane above
+        # (datapath/maintenance.py — the ONE background plane).
+        self._init_maintenance(maint_budget=maint_budget,
+                               maint_clock=maint_clock)
 
     # -- Datapath ------------------------------------------------------------
 
@@ -384,6 +411,9 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
 
     def step(self, batch: PacketBatch, now: int) -> StepResult:
         t0 = time.perf_counter()
+        # Traffic time drives the maintenance tick clock (one clock
+        # domain: flow-cache aging and FQDN expiry stamp with THIS now).
+        self._maintenance.observe(now)
         try:
             return self._step(batch, now)
         finally:
@@ -1109,6 +1139,16 @@ class TpuflowDatapath(TransactionalDatapath, AuditableDatapath,
             )
         if mode == "overlap":
             return prof.profile_churn_overlap(
+                self._meta, self._state, self._drs, self._dsvc, hot, pool,
+                n_new=n_new, now0=now, gen=self._gen,
+                k_small=k_small, k_big=k_big, repeats=repeats,
+            )
+        if mode == "maintenance":
+            # The unified background plane's cadence (MAINT_PHASE_CHAIN):
+            # async churn with the scheduler's fused maintenance pass
+            # riding every step; `maintenance_s` is the plane's own
+            # attributed cost.
+            return prof.profile_churn_maintenance(
                 self._meta, self._state, self._drs, self._dsvc, hot, pool,
                 n_new=n_new, now0=now, gen=self._gen,
                 k_small=k_small, k_big=k_big, repeats=repeats,

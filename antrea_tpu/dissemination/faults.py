@@ -22,6 +22,30 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 
+class FaultClock:
+    """Deterministic steppable clock for the chaos tier.
+
+    Passed as the maintenance scheduler's clock
+    (`TpuflowDatapath(..., maint_clock=FaultClock())` /
+    `MaintenanceScheduler(clock=...)`), it becomes the ONE notion of
+    `now` every consolidated background plane consults — FQDN TTL
+    expiry, the degraded-recompile backoff, aging cadence — so a chaos
+    test advances time explicitly instead of sleeping, and every
+    time-driven plane behavior replays deterministically."""
+
+    def __init__(self, start: int = 0):
+        self.now = int(start)
+
+    def advance(self, dt: int = 1) -> int:
+        if dt < 0:
+            raise ValueError(f"FaultClock is monotonic; got dt={dt}")
+        self.now += int(dt)
+        return self.now
+
+    def __call__(self) -> int:
+        return self.now
+
+
 class InjectedInstallError(RuntimeError):
     """Raised by FlakyDatapath.install_bundle when the plan fires — a
     stand-in for a real datapath rejecting/timing out a rule install.

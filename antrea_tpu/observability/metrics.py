@@ -85,6 +85,15 @@ METRICS: dict[str, str] = {
     "antrea_tpu_cache_audit_repairs_total": "counter",
     "antrea_tpu_tensor_scrub_total": "counter",
     "antrea_tpu_audit_cursor_coverage_ratio": "gauge",
+    # unified maintenance scheduler (datapath/maintenance.py; rendered
+    # when the datapath exposes maintenance_stats())
+    "antrea_tpu_maintenance_ticks_total": "counter",
+    "antrea_tpu_maintenance_blocked_ticks_total": "counter",
+    "antrea_tpu_maintenance_task_runs_total": "counter",
+    "antrea_tpu_maintenance_budget_spent_total": "counter",
+    "antrea_tpu_maintenance_deferrals_total": "counter",
+    "antrea_tpu_maintenance_shed_total": "counter",
+    "antrea_tpu_maintenance_scheduler_lag": "gauge",
 }
 
 
@@ -421,6 +430,30 @@ def render_metrics(datapath, node: str = "") -> str:
             f"antrea_tpu_audit_cursor_coverage_ratio{_labels(node=node)} "
             f"{_num(au['coverage_ratio'])}",
         ]
+    mt = getattr(datapath, "maintenance_stats", None)
+    mt = mt() if mt is not None else None
+    if mt is not None:
+        # Unified maintenance scheduler (datapath/maintenance.py): tick/
+        # blocked-tick counters, per-task run/spent/deferral/shed
+        # accounting, and the starvation lag gauge.
+        for fam, key in (
+            ("antrea_tpu_maintenance_ticks_total", "ticks_total"),
+            ("antrea_tpu_maintenance_blocked_ticks_total",
+             "blocked_ticks_total"),
+            ("antrea_tpu_maintenance_scheduler_lag", "scheduler_lag"),
+        ):
+            lines += [_type_line(fam), f"{fam}{_labels(node=node)} {mt[key]}"]
+        for fam, key in (
+            ("antrea_tpu_maintenance_task_runs_total", "runs_total"),
+            ("antrea_tpu_maintenance_budget_spent_total", "spent_total"),
+            ("antrea_tpu_maintenance_deferrals_total", "deferrals_total"),
+            ("antrea_tpu_maintenance_shed_total", "shed_total"),
+        ):
+            lines.append(_type_line(fam))
+            for task, row in sorted(mt["tasks"].items()):
+                lines.append(
+                    f"{fam}{_labels(task=task, node=node)} {row[key]}"
+                )
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

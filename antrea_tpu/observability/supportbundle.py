@@ -57,10 +57,18 @@ def collect_bundle(
             "default_deny": s.default_deny,
         }
 
+    def _maintenance():
+        ms = getattr(datapath, "maintenance_stats", None)
+        body = ms() if ms is not None else None
+        if body is None:
+            raise ValueError("datapath has no maintenance scheduler")
+        return body
+
     for name, fn in (
         ("stats.json", _stats),
         ("cache_stats.json", datapath.cache_stats),
         ("flows.json", lambda: datapath.dump_flows(now)),
+        ("maintenance.json", _maintenance),
         ("metrics.prom", lambda: render_metrics(datapath, node=node)),
     ):
         try:

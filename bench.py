@@ -22,6 +22,7 @@ vs_baseline is value / 10e6 (the BASELINE.json north-star target:
 """
 
 import json
+from collections import namedtuple
 
 import jax
 import jax.numpy as jnp
@@ -176,7 +177,30 @@ def measure_churn_async(cps, svc, pod_ips, services):
         return None, None
 
 
-def _measure_churn_async(cps, svc, pod_ips, services):
+# --- the async-cadence churn regimes: one scaffold, three bodies -----------
+
+# Traced per-iteration context handed to a regime body: the device rule
+# tables, the loop index, the completed-iteration counter (acc[1]),
+# window i's fresh columns (and the hot batch with them spliced into its
+# tail), and the window() maker for regimes that need a second offset
+# (overlap's window i-1).
+_ChurnIter = namedtuple(
+    "_ChurnIter", ["drs", "dsvc", "i", "n", "fresh", "mixed", "window"])
+
+
+def _count(acc, out):
+    return acc.at[0].add(out["code"].sum(dtype=jnp.int32) + out["n_miss"])
+
+
+def _churn_regime_pps(cps, svc, pod_ips, services, make_body):
+    """Shared scaffold of the three async-cadence churn regimes
+    (_measure_churn_async / _measure_churn_overlap /
+    _measure_churn_maintenance): hot+pool column prep, the
+    single-compile pipeline, two cache-warm steps, the rolling
+    fresh-flow window, and the timed device loop.  `make_body(meta)`
+    returns the regime's per-iteration body
+    `run(st, acc, it: _ChurnIter) -> (st, acc)` — the regimes differ
+    ONLY in that body; change the scaffold here, never by copying it."""
     hot = gen_traffic(pod_ips, B, n_flows=1 << 15, seed=31,
                       services=services, svc_fraction=0.3)
     pool = gen_traffic(pod_ips, CHURN_POOL, n_flows=CHURN_POOL, seed=32,
@@ -201,8 +225,7 @@ def _measure_churn_async(cps, svc, pod_ips, services):
     step, state, (drs, dsvc) = pl.make_pipeline(
         cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=n_new, fused=True
     )
-    meta_fast = step.meta._replace(phases=0)
-    meta_drain = step.meta
+    run = make_body(step.meta)
     state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
                     jnp.int32(100), jnp.int32(0))
     state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
@@ -211,35 +234,48 @@ def _measure_churn_async(cps, svc, pod_ips, services):
     def body(i, carry):
         (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_,
          ps2, pd2, pp2, psp2, pdp2) = carry
-        off = (acc[1] * n_new) % (CHURN_POOL - n_new)
+        pcols = (ps2, pd2, pp2, psp2, pdp2)
 
-        def window(pcol):
-            return jax.lax.dynamic_slice(pcol, (off,), (n_new,))
+        def window(off):
+            return tuple(jax.lax.dynamic_slice(c, (off,), (n_new,))
+                         for c in pcols)
 
-        fresh = tuple(window(c) for c in (ps2, pd2, pp2, psp2, pdp2))
-
-        def mix(hcol, fcol):
-            return jnp.concatenate([hcol[: B - n_new], fcol])
-
-        # Decoupled fast step: hot lanes hit, fresh lanes admitted.
-        st, o = pl._pipeline_step(
-            st, drs_, dsvc_, mix(hs_, fresh[0]), mix(hd_, fresh[1]),
-            mix(hp_, fresh[2]), mix(hsp_, fresh[3]), mix(hdp_, fresh[4]),
-            102 + i, 0, meta=meta_fast,
-        )
-        acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
-        # Coalesced drain of exactly this step's admissions.
-        st, od = pl._pipeline_step(
-            st, drs_, dsvc_, *fresh, 102 + i, 0, meta=meta_drain,
-        )
-        acc = acc.at[0].add(od["code"].sum(dtype=jnp.int32) + od["n_miss"])
+        # Rolling fresh-flow window: each step consumes the next n_new
+        # pool flows (wraps after CHURN_POOL / n_new steps — far beyond
+        # the measurement horizon).
+        fresh = window((acc[1] * n_new) % (CHURN_POOL - n_new))
+        mixed = tuple(jnp.concatenate([h[: B - n_new], f]) for h, f in
+                      zip((hs_, hd_, hp_, hsp_, hdp_), fresh))
+        st, acc = run(st, acc, _ChurnIter(drs_, dsvc_, i, acc[1], fresh,
+                                          mixed, window))
         acc = acc.at[1].add(1)
-        return (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_,
-                ps2, pd2, pp2, psp2, pdp2)
+        return (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_, *pcols)
 
     carry = (jnp.zeros(8, jnp.int32), state, drs, dsvc, hs, hd, hp, hsp,
              hdp, ps_, pd, pp, psp, pdp)
     sec = device_loop_time(body, carry, k_small=4, k_big=32, repeats=2)
+    return B / sec
+
+
+def _measure_churn_async(cps, svc, pod_ips, services):
+    def make_body(meta):
+        meta_fast = meta._replace(phases=0)
+
+        def run(st, acc, it):
+            # Decoupled fast step: hot lanes hit, fresh lanes admitted.
+            st, o = pl._pipeline_step(
+                st, it.drs, it.dsvc, *it.mixed, 102 + it.i, 0,
+                meta=meta_fast,
+            )
+            # Coalesced drain of exactly this step's admissions.
+            st, od = pl._pipeline_step(
+                st, it.drs, it.dsvc, *it.fresh, 102 + it.i, 0, meta=meta,
+            )
+            return st, _count(_count(acc, o), od)
+
+        return run
+
+    pps = _churn_regime_pps(cps, svc, pod_ips, services, make_body)
 
     # Bounded-queue accounting at the BENCHED cadence, run through the
     # real MissQueue (default capacity 2^16): n_new arrivals + one
@@ -250,6 +286,7 @@ def _measure_churn_async(cps, svc, pod_ips, services):
     # silently claiming zero pressure.
     from antrea_tpu.datapath.slowpath import MissQueue
 
+    n_new = B // CHURN_DIV
     q = MissQueue(1 << 16)
     zeros = {k: np.zeros(n_new, np.int64) for k in
              ("src_ip", "dst_ip", "proto", "src_port", "dst_port",
@@ -258,7 +295,52 @@ def _measure_churn_async(cps, svc, pod_ips, services):
     for t in range(64):
         q.admit(zeros, mask, epoch=t, now=t)
         q.pop(n_new)
-    return B / sec, q.overflows_total
+    return pps, q.overflows_total
+
+
+def measure_churn_maintenance(cps, svc, pod_ips, services):
+    """Churn regime with the unified maintenance scheduler's cadence
+    riding it (datapath/maintenance.py, ROADMAP item 5): the async
+    fast+drain cadence of measure_churn_async plus ONE fused full-table
+    maintenance pass (pl.maintain_scan — the cache-maintain task) per
+    step.  Diffed against async_churn_pps this prices the consolidated
+    background plane at its most aggressive cadence (every step; the
+    scheduler's default runs it far less often), so the reported
+    maintenance_overhead_pct is an UPPER bound — r07's "the
+    consolidation is free" claim.  -> steady_churn_maint_pps, None on
+    failure."""
+    try:
+        return _measure_churn_maintenance(cps, svc, pod_ips, services)
+    except Exception as e:  # report, never sink the bench
+        print(f"# maintenance churn measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_churn_maintenance(cps, svc, pod_ips, services):
+    def make_body(meta):
+        meta_fast = meta._replace(phases=0)
+
+        def run(st, acc, it):
+            st, o = pl._pipeline_step(
+                st, it.drs, it.dsvc, *it.mixed, 102 + it.i, 0,
+                meta=meta_fast,
+            )
+            st, od = pl._pipeline_step(
+                st, it.drs, it.dsvc, *it.fresh, 102 + it.i, 0, meta=meta,
+            )
+            acc = _count(_count(acc, o), od)
+            # The maintenance rider: the scheduler's fused aging +
+            # stale-generation revalidation pass (cost-only here: gen is
+            # constant and `now` advances 1/step against hour timeouts).
+            st, n_aged, n_stale = pl._maintain_scan(
+                st, jnp.int32(102 + it.i), jnp.int32(0),
+                timeouts=meta.timeouts,
+            )
+            return st, acc.at[0].add(n_aged + n_stale)
+
+        return run
+
+    return _churn_regime_pps(cps, svc, pod_ips, services, make_body)
 
 
 def measure_churn_overlap(cps, svc, pod_ips, services):
@@ -284,73 +366,35 @@ def measure_churn_overlap(cps, svc, pod_ips, services):
 
 
 def _measure_churn_overlap(cps, svc, pod_ips, services):
-    hot = gen_traffic(pod_ips, B, n_flows=1 << 15, seed=31,
-                      services=services, svc_fraction=0.3)
-    pool = gen_traffic(pod_ips, CHURN_POOL, n_flows=CHURN_POOL, seed=32,
-                       services=services, svc_fraction=0.3,
-                       one_per_flow=True)
     n_new = B // CHURN_DIV
 
-    def col(hot_c, pool_c):
-        return jnp.asarray(np.ascontiguousarray(hot_c)), jnp.asarray(
-            np.ascontiguousarray(pool_c))
+    def make_body(meta):
+        meta_fast = meta._replace(phases=0)
+        meta_drain = meta._replace(drain_reclaim=True)
 
-    hs, ps_ = col(iputil.flip_u32(hot.src_ip), iputil.flip_u32(pool.src_ip))
-    hd, pd = col(iputil.flip_u32(hot.dst_ip), iputil.flip_u32(pool.dst_ip))
-    hp, pp = col(hot.proto, pool.proto)
-    hsp, psp = col(hot.src_port, pool.src_port)
-    hdp, pdp = col(hot.dst_port, pool.dst_port)
+        def run(st, acc, it):
+            # Decoupled fast step of window i: hot lanes hit, fresh
+            # admitted.
+            st, o = pl._pipeline_step(
+                st, it.drs, it.dsvc, *it.mixed, 102 + it.i, 0,
+                meta=meta_fast,
+            )
+            # Deferred drain of window i-1 — the one-step commit
+            # deferral: no dependency on o, only on st.  Iteration 0
+            # re-drains window 0 (already-committed lanes re-classify
+            # identically; one warmup-shaped iteration in a 32-step
+            # loop).
+            prev = it.window(
+                (jnp.maximum(it.n - 1, 0) * n_new) % (CHURN_POOL - n_new))
+            st, od = pl._pipeline_step(
+                st, it.drs, it.dsvc, *prev, 102 + it.i, 0,
+                meta=meta_drain,
+            )
+            return st, _count(_count(acc, o), od)
 
-    step, state, (drs, dsvc) = pl.make_pipeline(
-        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=n_new, fused=True
-    )
-    meta_fast = step.meta._replace(phases=0)
-    meta_drain = step.meta._replace(drain_reclaim=True)
-    state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
-                    jnp.int32(100), jnp.int32(0))
-    state, _ = step(state, drs, dsvc, hs, hd, hp, hsp, hdp,
-                    jnp.int32(101), jnp.int32(0))
+        return run
 
-    def body(i, carry):
-        (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_,
-         ps2, pd2, pp2, psp2, pdp2) = carry
-        off = (acc[1] * n_new) % (CHURN_POOL - n_new)
-        # Window i-1 — the one-step commit deferral.  Iteration 0
-        # re-drains window 0 (already-committed lanes re-classify
-        # identically; one warmup-shaped iteration in a 32-step loop).
-        off_prev = (jnp.maximum(acc[1] - 1, 0) * n_new) % (
-            CHURN_POOL - n_new)
-
-        def window(pcol, o):
-            return jax.lax.dynamic_slice(pcol, (o,), (n_new,))
-
-        pcols = (ps2, pd2, pp2, psp2, pdp2)
-        fresh = tuple(window(c, off) for c in pcols)
-        prev = tuple(window(c, off_prev) for c in pcols)
-
-        def mix(hcol, fcol):
-            return jnp.concatenate([hcol[: B - n_new], fcol])
-
-        # Decoupled fast step of window i: hot lanes hit, fresh admitted.
-        st, o = pl._pipeline_step(
-            st, drs_, dsvc_, mix(hs_, fresh[0]), mix(hd_, fresh[1]),
-            mix(hp_, fresh[2]), mix(hsp_, fresh[3]), mix(hdp_, fresh[4]),
-            102 + i, 0, meta=meta_fast,
-        )
-        acc = acc.at[0].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
-        # Deferred drain of window i-1: no dependency on o, only on st.
-        st, od = pl._pipeline_step(
-            st, drs_, dsvc_, *prev, 102 + i, 0, meta=meta_drain,
-        )
-        acc = acc.at[0].add(od["code"].sum(dtype=jnp.int32) + od["n_miss"])
-        acc = acc.at[1].add(1)
-        return (acc, st, drs_, dsvc_, hs_, hd_, hp_, hsp_, hdp_,
-                ps2, pd2, pp2, psp2, pdp2)
-
-    carry = (jnp.zeros(8, jnp.int32), state, drs, dsvc, hs, hd, hp, hsp,
-             hdp, ps_, pd, pp, psp, pdp)
-    sec = device_loop_time(body, carry, k_small=4, k_big=32, repeats=2)
-    return B / sec
+    return _churn_regime_pps(cps, svc, pod_ips, services, make_body)
 
 
 def measure_sharded_cold_fused(cps, src, dst, proto, dport):
@@ -482,13 +526,16 @@ def main():
     overlap_churn_pps = measure_churn_overlap(
         cps, svc, cluster.pod_ips, services
     )
+    maint_churn_pps = measure_churn_maintenance(
+        cps, svc, cluster.pod_ips, services
+    )
     sh_cold_pps = measure_sharded_cold_fused(cps, src, dst, proto, dport)
     sh_pps, sh_overhead = measure_shard_overhead(
         cps, svc, src, dst, proto, sport, dport, pps
     )
     _print_and_gate(pps, cold_pps, sh_pps, sh_overhead, churn_pps,
                     sh_cold_pps, async_churn_pps, q_overflows,
-                    overlap_churn_pps)
+                    overlap_churn_pps, maint_churn_pps)
 
 
 # Regression floors (round-3 verdict weak #6: a silent 10x perf regression
@@ -508,7 +555,11 @@ CHURN_FLOOR_PPS = 3.5e6
 def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     churn_pps=None, sh_cold_pps=None,
                     async_churn_pps=None, q_overflows=None,
-                    overlap_churn_pps=None):
+                    overlap_churn_pps=None, maint_churn_pps=None):
+    maint_overhead_pct = None
+    if maint_churn_pps and async_churn_pps:
+        maint_overhead_pct = round(
+            (async_churn_pps - maint_churn_pps) / async_churn_pps * 100, 2)
     print(json.dumps({
         "metric": f"classified_pkts_per_sec_chip_{N_RULES // 1000}k_rules",
         "value": round(pps, 1),
@@ -541,6 +592,15 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
             # verdict calibrates one from the first on-chip measurement.
             "steady_churn_overlap_pps": None if overlap_churn_pps is None
             else round(overlap_churn_pps, 1),
+            # ROADMAP item 5 (the unified maintenance scheduler): the
+            # async churn cadence with the fused maintenance pass riding
+            # EVERY step — an upper bound on what the consolidated
+            # background plane costs, reported as a % of the async
+            # steady-churn regime so r07 can show the consolidation is
+            # free at its real (far sparser) cadence.
+            "steady_churn_maint_pps": None if maint_churn_pps is None
+            else round(maint_churn_pps, 1),
+            "maintenance_overhead_pct": maint_overhead_pct,
             "miss_queue_overflows": q_overflows,
             "async_drain_batch": B // CHURN_DIV,
             "churn_frac": 1 / CHURN_DIV,

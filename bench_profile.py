@@ -33,8 +33,11 @@ import numpy as np
 from antrea_tpu.compiler.compile import compile_policy_set
 from antrea_tpu.compiler.services import compile_services
 from antrea_tpu.models import pipeline as pl
-from antrea_tpu.models.profile import (OVERLAP_PHASE_CHAIN, PHASE_CHAIN,
-                                       profile_churn, profile_churn_overlap)
+from antrea_tpu.models.profile import (MAINT_PHASE_CHAIN,
+                                       OVERLAP_PHASE_CHAIN, PHASE_CHAIN,
+                                       profile_churn,
+                                       profile_churn_maintenance,
+                                       profile_churn_overlap)
 from antrea_tpu.simulator.genpolicy import gen_cluster
 from antrea_tpu.simulator.genservice import gen_services
 from antrea_tpu.simulator.traffic import gen_traffic
@@ -76,11 +79,15 @@ def main() -> int:
     ap.add_argument("--k-big", type=int, default=16)
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument(
-        "--mode", choices=("sync", "overlap"), default="sync",
+        "--mode", choices=("sync", "overlap", "maintenance"), default="sync",
         help="sync = the inline slow-path chain (PHASE_CHAIN); overlap = "
              "the round-6 double-buffered regime (OVERLAP_PHASE_CHAIN: "
              "drain of window i-1 overlapping fast step i) — diff the "
-             "two runs to attribute the overlap win phase by phase",
+             "two runs to attribute the overlap win phase by phase; "
+             "maintenance = the unified background plane's cadence "
+             "(MAINT_PHASE_CHAIN: the scheduler's fused maintenance pass "
+             "riding every step) — maintenance_s is the plane's own "
+             "attributed cost",
     )
     args = ap.parse_args()
     out_path = args.out or _next_out(os.path.dirname(os.path.abspath(__file__)))
@@ -119,6 +126,20 @@ def main() -> int:
             repeats=args.repeats,
             chain=(("base", 0), ("full", pl.PH_ALL)),
         )
+    elif args.mode == "maintenance":
+        chain = MAINT_PHASE_CHAIN
+        prof = profile_churn_maintenance(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=args.k_small, k_big=args.k_big, repeats=args.repeats,
+        )
+        # Independent full-step measurement of the SAME maintenance
+        # cadence (rider included): fresh dispatches, different K values.
+        indep = profile_churn_maintenance(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
+            repeats=args.repeats,
+            chain=(("base", 0), ("full", pl.PH_ALL)),
+        )
     else:
         chain = PHASE_CHAIN
         prof = profile_churn(
@@ -151,6 +172,10 @@ def main() -> int:
         "total_s": prof["total_s"],
         "churn_pps": prof["pps"],
         "bottleneck": bottleneck,
+        # Maintenance mode only: the background plane's own attributed
+        # per-step cost (maint_fast_path minus a rider-free fast step).
+        "maintenance_s": prof.get("maintenance_s"),
+        "maintenance_fraction": prof.get("maintenance_fraction"),
         "check": {
             "sum_phases_s": sum_phases,
             "independent_step_s": indep["total_s"],

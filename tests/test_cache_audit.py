@@ -19,6 +19,7 @@ explicitly.
 
 import itertools
 import json
+import random
 import subprocess
 import sys
 from pathlib import Path
@@ -302,56 +303,89 @@ def test_mode_for_mode_plane_parity():
 
 
 # ---------------------------------------------------------------------------
-# Interleavings: audits racing an in-flight drain and an epoch swap
+# Interleavings: the unified scheduler racing drains and epoch swaps.
+# (PR 7 replaced the hand-enumerated pairwise interleaving cases with the
+# scheduler-driven randomized-schedule property test below — the scheduler
+# is now the ONLY way the background loops interleave in production, so
+# the property is over ALL registered tasks at once, not plane pairs.)
 # ---------------------------------------------------------------------------
 
 
 @pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
-def test_audit_racing_inflight_drain_and_epoch_swap(dp_cls):
-    """A full audit sweep between begin_drain and finish_drain must not
-    corrupt the drain (the popped block classifies and commits normally),
-    and an audit racing a bundle swap (stale epoch) must not evict
-    anything a lazy revalidation owns — parity holds throughout."""
-    ps, svcs = _world()
-    dp = _dp(dp_cls, ps, svcs, async_slowpath=True, miss_queue_slots=64,
-             drain_batch=16)
-    eng = dp._slowpath
-
-    now = next(_NOW)
-    pkts = [_fresh(BLOCKED), _fresh("192.0.2.9")]
-    r = dp.step(PacketBatch.from_packets(pkts), now)
-    assert int(np.asarray(r.pending).sum()) == 2
-    # Audit racing the in-flight drain.
-    assert eng.begin_drain(now)
-    out = dp.audit_scan(now=next(_NOW), full=True)
-    assert out["divergences"] == 0
-    one = eng.finish_drain(next(_NOW))
-    assert one["drained"] == 2
-    got = _step_codes(dp, pkts)
-    oracle = Oracle(ps)
-    assert got == [int(oracle.classify(p).code) for p in pkts]
-
-    # Audit racing an epoch swap: install marks the epoch stale; the scan
-    # must neither heal it behind the engine's back nor find divergence
-    # (stale-generation denials are dead to lookups, hence not audited).
+def test_scheduler_randomized_schedule_racing_drain_and_epoch_swap(dp_cls):
+    """Seeded randomized-schedule property test: interleave maintenance
+    ticks (all registered tasks — canary, audit cursor, scrub, fused
+    cache-maintain, recompile) with traffic steps, split in-flight drains
+    (begin/finish), and bundle installs (epoch swaps).  Invariants held
+    at every point: a tick landing inside begin_drain..finish_drain
+    defers WHOLE (the serialization point — the pinned block is never
+    audited/aged under an in-flight drain); a budgeted tick never spends
+    past its budget; and after the storm the engine reconverges — drains
+    classify to exact oracle parity, a forced full audit sweep is quiet,
+    and nothing is degraded."""
     import copy
 
-    dp.install_bundle(ps=copy.deepcopy(ps))
-    assert eng.stale
-    out = dp.audit_scan(now=next(_NOW), full=True)
-    assert out["divergences"] == 0
-    assert eng.stale  # lazy revalidation still owns the stale epoch
+    rng = random.Random(0xA11CE)
+    ps, svcs = _world()
+    dp = _dp(dp_cls, ps, svcs, async_slowpath=True, miss_queue_slots=64,
+             drain_batch=16, canary_probes=8, audit_window=32)
+    eng = dp._slowpath
+    oracle = Oracle(ps)
+    inflight = False
+    stepped: list = []
+    for _op in range(40):
+        now = next(_NOW)
+        op = rng.choice(["tick", "tick", "budget_tick", "step", "begin",
+                         "finish", "install"])
+        if op in ("tick", "budget_tick"):
+            budget = rng.choice([8, 16, 64]) if op == "budget_tick" else None
+            out = dp.maintenance_tick(now=now, budget=budget)
+            if inflight:
+                assert out["blocked"] == "inflight-drain", out
+                assert not out["ran"] and out["spent"] == 0
+            else:
+                assert out["blocked"] is None
+            if budget is not None:
+                assert out["spent"] <= budget, out
+        elif op == "step":
+            pkts = [_fresh(rng.choice([BLOCKED, CLIENT, "192.0.2.7",
+                                       "198.51.100.9"]))
+                    for _ in range(2)]
+            stepped.extend(pkts)
+            dp.step(PacketBatch.from_packets(pkts), now)
+        elif op == "begin":
+            if not inflight:
+                inflight = eng.begin_drain(now)
+        elif op == "finish":
+            if inflight:
+                eng.finish_drain(now)
+                inflight = False
+        elif op == "install":
+            # An epoch swap mid-storm (and legitimately mid-drain: the
+            # stale-reclassify path) — the scheduler's next unblocked
+            # tick promotes the fused heal.
+            dp.install_bundle(ps=copy.deepcopy(ps))
+    if inflight:
+        eng.finish_drain(next(_NOW))
+    # Reconvergence: settle the queue (drain() heals any stale epoch with
+    # the fused maintenance pass), then every invariant at once.  Parity
+    # probes on the async engine go admit -> drain -> cached re-step
+    # (fresh misses are provisional until drained).
     dp.drain_slowpath(next(_NOW))
     assert not eng.stale
-    # Async parity: fresh misses are provisional until drained — step,
-    # drain, and compare the CACHED verdicts on a re-step.
+    probe = stepped[-2:] or [_fresh(BLOCKED)]
     now = next(_NOW)
-    fresh = [_fresh(BLOCKED), _fresh("198.51.100.9")]
-    dp.step(PacketBatch.from_packets(fresh), now)
+    dp.step(PacketBatch.from_packets(probe), now)
     dp.drain_slowpath(now)
-    got = _step_codes(dp, fresh)
-    oracle = Oracle(ps)
-    assert got == [int(oracle.classify(p).code) for p in fresh]
+    got = _step_codes(dp, probe)
+    assert got == [int(oracle.classify(p).code) for p in probe]
+    quiet = dp.maintenance_force_audit(now=next(_NOW))
+    assert quiet["divergences"] == 0, quiet
+    assert not dp.degraded
+    st = dp.maintenance_stats()
+    # The storm exercised both sides of the serialization point.
+    assert st["ticks_total"] > 0
+    assert all(row["overruns_total"] == 0 for row in st["tasks"].values())
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,745 @@
+"""Unified maintenance scheduler (ISSUE 7 tentpole): ONE budgeted
+background plane for canary, audit, aging, FQDN, and recompile loops.
+
+The acceptance bar: all five pre-existing loops run only via
+`MaintenanceScheduler.tick()` (tools/check_maintenance.py green), the
+hot-step HLO is bit-identical with the scheduler enabled, per-tick
+budgets are never exceeded and no task starves across 1k randomized
+ticks, priority inverts under degradation (recompile + canary preempt,
+cosmetic scrubs shed, nothing starves after recovery), and the whole
+plane serializes against in-flight drains / overlap finalizers / epoch
+swaps behind one point.
+"""
+
+import itertools
+import json
+import random
+import subprocess
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.config import ConfigError
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.datapath.maintenance import (MAINT_TASKS,
+                                             MaintenanceScheduler,
+                                             MaintenanceTask)
+from antrea_tpu.dissemination import FaultPlan
+from antrea_tpu.dissemination.faults import FaultClock
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+CLIENT, SRV, BLOCKED = "10.0.1.1", "10.0.0.10", "10.0.9.9"
+VIP = "10.96.0.1"
+
+_NOW = itertools.count(1000)
+_SPORT = itertools.count(30000)
+
+SMALL = dict(flow_slots=1 << 8, aff_slots=1 << 4)
+
+
+def _world():
+    ps = PolicySet(
+        policies=[cp.NetworkPolicy(
+            uid="p1", name="p1", type=cp.NetworkPolicyType.ACNP,
+            rules=[cp.NetworkPolicyRule(
+                direction=cp.Direction.IN,
+                from_peer=cp.NetworkPolicyPeer(address_groups=["blocked"]),
+                action=cp.RuleAction.DROP, priority=0)],
+            applied_to_groups=["web"], tier_priority=250, priority=1.0)],
+        address_groups={"blocked": cp.AddressGroup(
+            name="blocked", members=[cp.GroupMember(ip=BLOCKED)])},
+        applied_to_groups={"web": cp.AppliedToGroup(
+            name="web", members=[cp.GroupMember(ip=SRV)])},
+    )
+    svcs = [ServiceEntry(cluster_ip=VIP, port=80, protocol=6, name="web",
+                         namespace="default",
+                         endpoints=[Endpoint(ip=SRV, port=8080)])]
+    return ps, svcs
+
+
+def _dp(dp_cls, ps, svcs, **kw):
+    if dp_cls is TpuflowDatapath:
+        kw.setdefault("miss_chunk", 16)
+    return dp_cls(ps, svcs, **SMALL, **kw)
+
+
+def _fresh(src, dst=SRV, dport=80):
+    return Packet(src_ip=iputil.ip_to_u32(src), dst_ip=iputil.ip_to_u32(dst),
+                  proto=6, src_port=next(_SPORT), dst_port=dport)
+
+
+def _stub_owner(degraded=False):
+    return SimpleNamespace(degraded=degraded, _slowpath=None)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler semantics (stub-owner level): DRR, budgets, starvation, shed
+# ---------------------------------------------------------------------------
+
+
+def test_registration_and_typed_budget_errors():
+    sched = MaintenanceScheduler(_stub_owner())
+    sched.register(MaintenanceTask("a", lambda n, b: 1, budget=4))
+    with pytest.raises(ValueError, match="already registered"):
+        sched.register(MaintenanceTask("a", lambda n, b: 1, budget=4))
+    with pytest.raises(ConfigError, match="budget must be positive"):
+        MaintenanceTask("bad", lambda n, b: 1, budget=0)
+    with pytest.raises(ConfigError, match="min_cost must be positive"):
+        MaintenanceTask("bad", lambda n, b: 1, budget=4, min_cost=-1)
+    with pytest.raises(ConfigError, match="tick_budget must be positive"):
+        MaintenanceScheduler(_stub_owner(), tick_budget=0)
+
+
+def test_min_cost_exceeding_tick_budget_is_a_config_error():
+    """A task whose min_cost exceeds a finite global tick budget could
+    never be granted (give is clamped to the remaining budget, so deficit
+    banking cannot help): registration fails loudly instead of the task
+    starving silently forever."""
+    sched = MaintenanceScheduler(_stub_owner(), tick_budget=8)
+    with pytest.raises(ConfigError, match="starve"):
+        sched.register(MaintenanceTask("big", lambda n, b: b, budget=16,
+                                       min_cost=16))
+    # Engine level: default canary_probes (64) over a tighter maint_budget.
+    ps, svcs = _world()
+    with pytest.raises(ConfigError, match="canary"):
+        _dp(TpuflowDatapath, ps, svcs, maint_budget=8)
+    # Shrinking the probe batch to fit is the documented fix.
+    dp = _dp(OracleDatapath, ps, svcs, maint_budget=8, canary_probes=4)
+    assert dp.maintenance is not None
+
+
+def test_scheduler_lag_ignores_shed_and_pre_tick_time():
+    """The lag gauge measures DENIED opportunity only: deliberately-shed
+    tasks had their turn (the scheduler chose), and before the first real
+    round nothing has been denied — even if observe() already folded a
+    large packet-clock now into the tick clock."""
+    sched = MaintenanceScheduler(_stub_owner(degraded=True))
+    sched.register(MaintenanceTask("work", lambda n, b: 1, budget=4))
+    sched.register(MaintenanceTask("cosmetic", lambda n, b: 1, budget=4,
+                                   shed_when_degraded=True))
+    sched.observe(1000)  # traffic time arrives before any round
+    assert sched.scheduler_lag() == 0
+    for t in range(1001, 1031):
+        sched.tick(now=t)
+    assert sched.stats()["tasks"]["cosmetic"]["shed_total"] == 30
+    assert sched.scheduler_lag() == 0  # shedding is a decision, not lag
+
+
+def test_corruption_escalated_scrub_cost_is_metered(monkeypatch):
+    """A scrub that detects corruption escalates to a full-cache sweep
+    inside the same scan; the task must report that TRUE cost so tick()
+    clamps the accounting and meters the overrun, instead of a
+    full-table pass hiding inside a tiny scrub grant."""
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, canary_probes=0)
+    real = dp._audit.scan
+
+    def corrupted(now=0, full=False, **kw):
+        out = real(now, full, **kw)
+        if kw.get("scrub"):
+            out = dict(out, scanned=out.get("scanned", 0) + 500)
+        return out
+
+    monkeypatch.setattr(dp._audit, "scan", corrupted)
+    out = dp.maintenance_tick(now=5)
+    grant = out["ran"]["tensor-scrub"]
+    assert grant <= dp.maintenance.stats()["tasks"]["tensor-scrub"]["budget"]
+    st = dp.maintenance_stats()["tasks"]["tensor-scrub"]
+    assert st["overruns_total"] == 1
+    assert st["spent_total"] == grant  # clamped, not the 500-row sweep
+
+
+def test_per_call_tick_budget_must_be_positive():
+    """GET /maintenance?tick=1&budget=0 must be rejected like the
+    construction-time tick_budget=0, not count a tick that defers every
+    task and distorts starvation counters."""
+    sched = MaintenanceScheduler(_stub_owner())
+    sched.register(MaintenanceTask("a", lambda n, b: 1, budget=4))
+    for bad in (0, -3):
+        with pytest.raises(ConfigError, match="budget must be positive"):
+            sched.tick(budget=bad)
+    assert sched.ticks_total == 0
+    assert sched.stats()["tasks"]["a"]["deferrals_total"] == 0
+
+
+def test_drr_budget_clamp_deficit_and_min_cost():
+    """Per-task grants honor the global budget; a task whose min cost
+    exceeds one tick's grant defers, banks deficit, and runs once it can
+    afford it — budget-clamped, never budget-exceeding."""
+    sched = MaintenanceScheduler(_stub_owner(), tick_budget=16)
+    spent_log = []
+    sched.register(MaintenanceTask(
+        "greedy", lambda n, b: spent_log.append(("greedy", b)) or b,
+        budget=6, priority=1))
+    # min_cost 12 > the 6/tick quantum: must wait for the deficit.
+    sched.register(MaintenanceTask(
+        "expensive", lambda n, b: spent_log.append(("expensive", b)) or 12,
+        budget=6, min_cost=12, priority=2))
+    out1 = sched.tick()
+    assert out1["ran"] == {"greedy": 6}
+    assert "expensive" in out1["deferred"]
+    assert out1["spent"] <= 16
+    # Tick 2: greedy banks+spends its quantum first, leaving 16-6=10 of
+    # the global budget: under the expensive task's min cost, so it is
+    # still deferred even though its banked deficit (12) could afford it.
+    out2 = sched.tick()
+    assert out2["spent"] <= 16 and "expensive" in out2["deferred"]
+    # A roomier tick lets the banked deficit pay the full min cost.
+    out3 = sched.tick(budget=32)
+    assert out3["ran"].get("expensive") == 12
+    assert out3["spent"] <= 32
+
+
+def test_overrun_is_clamped_and_metered():
+    sched = MaintenanceScheduler(_stub_owner())
+    sched.register(MaintenanceTask("rogue", lambda n, b: b + 99, budget=4))
+    out = sched.tick(budget=4)
+    assert out["ran"]["rogue"] == 4  # clamped to the grant
+    st = sched.stats()["tasks"]["rogue"]
+    assert st["overruns_total"] == 1 and st["spent_total"] == 4
+
+
+def test_no_starvation_across_1k_randomized_ticks():
+    """The acceptance property: random per-tick global budgets over a
+    diverse task set — per-tick budgets are NEVER exceeded, and no task
+    starves (every task keeps running throughout; the starvation boost
+    guarantees progress even for the most expensive, lowest-priority
+    task under tight budgets).  Seeded and deterministic."""
+    rng = random.Random(7)
+    sched = MaintenanceScheduler(_stub_owner())
+    names = []
+    for i in range(6):
+        name = f"t{i}"
+        names.append(name)
+        sched.register(MaintenanceTask(
+            name, (lambda nm: lambda n, b: min(b, rng.randint(1, b)))(name),
+            budget=rng.randint(1, 16),
+            min_cost=rng.randint(1, 8),
+            priority=rng.randint(0, 5)))
+    last_ran = {n: 0 for n in names}
+    gaps = {n: 0 for n in names}
+    for t in range(1, 1001):
+        budget = rng.choice([4, 8, 16, 64, None])
+        out = sched.tick(budget=budget)
+        if budget is not None:
+            assert out["spent"] <= budget, (t, out)
+        for n in out["ran"]:
+            gaps[n] = max(gaps[n], t - last_ran[n])
+            last_ran[n] = t
+    st = sched.stats()
+    for n in names:
+        assert st["tasks"][n]["runs_total"] > 0, f"{n} never ran"
+        gaps[n] = max(gaps[n], 1000 - last_ran[n])
+        # Progress bound: the starvation boost fires after 8 deferred
+        # ticks, so no task should ever wait ~an order beyond that.
+        assert gaps[n] <= 64, f"{n} starved for {gaps[n]} ticks"
+    assert st["scheduler_lag"] <= 64
+
+
+def test_priority_inversion_and_shed_under_degradation():
+    """While degraded: degraded_priority reorders (recompile first) and
+    shed_when_degraded tasks are shed, metered; recovery restores the
+    normal order and shed tasks resume — nothing starves after."""
+    owner = _stub_owner(degraded=True)
+    order = []
+    sched = MaintenanceScheduler(owner)
+    sched.register(MaintenanceTask(
+        "recompile", lambda n, b: order.append("recompile") or 1,
+        budget=1, priority=6, degraded_priority=0))
+    sched.register(MaintenanceTask(
+        "canary", lambda n, b: order.append("canary") or 1,
+        budget=1, priority=2, degraded_priority=1))
+    sched.register(MaintenanceTask(
+        "scrub", lambda n, b: order.append("scrub") or 1,
+        budget=1, priority=4, shed_when_degraded=True))
+    out = sched.tick()
+    assert order == ["recompile", "canary"]
+    assert out["shed"] == ["scrub"]
+    assert sched.stats()["tasks"]["scrub"]["shed_total"] == 1
+    # Recovery: normal priorities, scrub resumes.
+    owner.degraded = False
+    order.clear()
+    out = sched.tick()
+    assert order == ["canary", "scrub", "recompile"]
+    assert not out["shed"]
+
+
+def test_fault_clock_drives_the_tick_clock():
+    clk = FaultClock(start=100)
+    sched = MaintenanceScheduler(_stub_owner(), clock=clk)
+    seen = []
+    sched.register(MaintenanceTask("t", lambda n, b: seen.append(n) or 1,
+                                   budget=1))
+    sched.tick()
+    clk.advance(41)
+    sched.tick()
+    assert seen == [100, 141]  # the injected clock, monotonic
+    assert sched.clock() == 141
+    with pytest.raises(ValueError, match="monotonic"):
+        clk.advance(-1)
+
+
+def test_held_fault_clock_is_never_outrun():
+    """tick() with now=None must not self-advance past an injected
+    clock: a FaultClock held still IS time standing still, so backoff
+    windows and TTL expiries cannot elapse by counting ticks."""
+    clk = FaultClock(start=100)
+    sched = MaintenanceScheduler(_stub_owner(), clock=clk)
+    seen = []
+    sched.register(MaintenanceTask("t", lambda n, b: seen.append(n) or 1,
+                                   budget=1))
+    for _ in range(5):
+        sched.tick()
+    assert seen == [100] * 5
+    assert sched.clock() == 100
+    clk.advance(7)
+    sched.tick()
+    assert seen[-1] == 107
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: task set, serialization, HLO identity, clocks
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_engines_register_the_consolidated_task_set(dp_cls):
+    """Both twins register the same inventory (cache-maintain rides the
+    async engine only; fqdn-ttl is the agent-side registration)."""
+    ps, svcs = _world()
+    dp = _dp(dp_cls, ps, svcs)
+    assert set(dp.maintenance.task_names) == {
+        "canary", "audit-cursor", "tensor-scrub", "degraded-recompile"}
+    dpa = _dp(dp_cls, ps, svcs, async_slowpath=True, miss_queue_slots=32,
+              drain_batch=16)
+    assert set(dpa.maintenance.task_names) == {
+        "canary", "audit-cursor", "tensor-scrub", "degraded-recompile",
+        "cache-maintain"}
+    # Every name is in the parseable inventory (tools/check_maintenance).
+    assert set(dpa.maintenance.task_names) | {"fqdn-ttl"} == set(MAINT_TASKS)
+    out = dpa.maintenance_tick(now=next(_NOW))
+    assert set(out["ran"]) >= {"canary", "audit-cursor", "tensor-scrub",
+                               "cache-maintain"}
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_tick_serializes_against_inflight_drain(dp_cls):
+    """The ONE serialization point: a tick between begin_drain and
+    finish_drain defers WHOLE (blocked, metered) — the popped block's
+    pinned cache state is never mutated under it — and the forced-audit
+    path refuses outright."""
+    ps, svcs = _world()
+    dp = _dp(dp_cls, ps, svcs, async_slowpath=True, miss_queue_slots=32,
+             drain_batch=16)
+    eng = dp._slowpath
+    now = next(_NOW)
+    dp.step(PacketBatch.from_packets([_fresh(BLOCKED), _fresh(CLIENT)]), now)
+    assert eng.begin_drain(now)
+    out = dp.maintenance_tick(now=next(_NOW))
+    assert out["blocked"] == "inflight-drain" and not out["ran"]
+    assert dp.maintenance_stats()["blocked_ticks_total"] == 1
+    with pytest.raises(RuntimeError, match="inflight-drain"):
+        dp.maintenance_force_audit(now=next(_NOW))
+    one = eng.finish_drain(next(_NOW))
+    assert one["drained"] == 2
+    out = dp.maintenance_tick(now=next(_NOW))
+    assert out["blocked"] is None and out["ran"]
+    # Post-storm parity: the blocked tick protected the drain.
+    oracle = Oracle(ps)
+    pkts = [_fresh(BLOCKED), _fresh("192.0.2.9")]
+    now = next(_NOW)
+    dp.step(PacketBatch.from_packets(pkts), now)
+    dp.drain_slowpath(now)
+    got = [int(c) for c in np.asarray(
+        dp.step(PacketBatch.from_packets(pkts), next(_NOW)).code)]
+    assert got == [int(oracle.classify(p).code) for p in pkts]
+
+
+def test_stale_epoch_promotes_cache_maintain_and_overlap_flushes():
+    """An epoch swap (bundle install) promotes cache-maintain to the
+    front of the next tick (the fused heal lands before audits walk the
+    cache), and staged overlapped drain commits retire at tick start."""
+    import copy
+
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, async_slowpath=True,
+             miss_queue_slots=32, drain_batch=16, overlap_commits=True)
+    eng = dp._slowpath
+    now = next(_NOW)
+    dp.step(PacketBatch.from_packets([_fresh(BLOCKED)]), now)
+    assert eng.begin_drain(now)
+    eng.finish_drain(now)  # overlap mode: finalizer staged
+    assert eng.overlap_depth == 1
+    dp.install_bundle(ps=copy.deepcopy(ps))
+    assert eng.stale
+    out = dp.maintenance_tick(now=next(_NOW))
+    assert out["overlap_flushed"] == 1 and eng.overlap_depth == 0
+    assert out["ran"].get("cache-maintain") == 1
+    assert not eng.stale  # the promoted task healed the epoch
+    # cache-maintain ran BEFORE the audit cursor walked the cache.
+    ran_order = list(out["ran"])
+    assert ran_order.index("cache-maintain") < ran_order.index("audit-cursor")
+
+
+def test_step_hlo_bit_identical_with_scheduler_enabled():
+    """The scheduler lives entirely off the hot step: a
+    maintenance-configured kernel twin lowers the compiled step to
+    byte-identical HLO vs a default twin, before AND after ticks."""
+    from antrea_tpu.models import pipeline as pl
+    import jax.numpy as jnp
+
+    ps, svcs = _world()
+    a = _dp(TpuflowDatapath, ps, svcs, maint_budget=64)
+    b = _dp(TpuflowDatapath, ps, svcs)
+    assert a._meta_step == b._meta_step
+
+    def lower_text(dp):
+        z = np.zeros(4, np.int32)
+        return pl.pipeline_step.lower(
+            dp._state, dp._drs, dp._dsvc,
+            jnp.asarray(z), jnp.asarray(z), jnp.asarray(z),
+            jnp.asarray(z), jnp.asarray(z),
+            jnp.int32(0), jnp.int32(0), meta=dp._meta_step,
+        ).as_text()
+
+    before = lower_text(a)
+    assert before == lower_text(b)
+    a.maintenance_tick(now=next(_NOW))
+    a.maintenance_tick(now=next(_NOW))
+    assert lower_text(a) == before
+
+
+def test_fqdn_ttl_runs_as_scheduler_task_on_the_tick_clock():
+    """Satellite: FQDN TTL expiry consults the scheduler's monotonic
+    tick clock (FaultClock-driven here), runs as the fqdn-ttl task, and
+    honors the per-tick expiry budget."""
+    from antrea_tpu.agent.fqdn import FqdnController
+
+    ps, svcs = _world()
+    ps.address_groups["fqdn--*.bad.example"] = cp.AddressGroup(
+        name="fqdn--*.bad.example", members=[])
+    clk = FaultClock(start=0)
+    dp = _dp(OracleDatapath, ps, svcs, maint_clock=clk)
+    fq = FqdnController(dp)
+    fq.register_maintenance(dp.maintenance, budget=1)
+    assert "fqdn-ttl" in dp.maintenance.task_names
+    fq.configure(ps)
+    fq.observe_dns("evil.bad.example", ["203.0.113.7", "203.0.113.8"],
+                   ttl_s=50, now=clk.now)
+    # Before expiry: a tick expires nothing.
+    clk.advance(10)
+    out = dp.maintenance_tick()
+    assert "fqdn-ttl" not in out["ran"]
+    assert len(fq._learned) == 2
+    # Past the TTL on the INJECTED clock: expiry honors the 1-learn/tick
+    # quantum — direct limit semantics first, then the scheduler's grant.
+    clk.advance(100)
+    assert fq.tick(limit=1) == 1 and len(fq._learned) == 1
+    out = dp.maintenance_tick()
+    assert out["ran"].get("fqdn-ttl", 0) >= 1 and not fq._learned
+    # tick() without a now and without a scheduler is a hard error.
+    with pytest.raises(ValueError, match="explicit now"):
+        FqdnController(dp).tick()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: priority inversion end to end (recompile preempts, scrub sheds)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_priority_inversion_under_degraded_mode():
+    """Degrade the commit plane via an injected canary failure: while
+    degraded, degraded-recompile ticks run FIRST and tensor-scrub ticks
+    are shed; the recompile backoff paces attempts on the tick clock;
+    once the fault exhausts, recovery restores normal order, shed tasks
+    resume, and fresh parity holds — nothing starves after recovery."""
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, canary_probes=8)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+    # Fail the NEXT two canary gates: the install degrades the plane,
+    # and the first recompile attempt fails too (stays degraded).
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=2)
+    with pytest.raises(Exception):
+        dp.install_bundle(ps=ps)
+    assert dp.degraded
+
+    out1 = dp.maintenance_tick(now=next(_NOW))
+    ran = list(out1["ran"])
+    assert ran and ran[0] == "degraded-recompile"
+    assert "tensor-scrub" in out1["shed"]
+    assert dp.degraded  # first retry burned the second injected failure
+
+    # Backoff on the tick clock: the immediate next tick must NOT burn
+    # another recompile attempt (retry_at = now + backoff).
+    out2 = dp.maintenance_tick(now=out1["now"])
+    assert "degraded-recompile" not in out2["ran"]
+    assert dp.degraded
+
+    # Advance past the backoff: recovery succeeds (fault exhausted).
+    out3 = dp.maintenance_tick(now=out1["now"] + 10)
+    assert not dp.degraded
+    # Post-recovery: normal order, scrub resumes, nothing starved.
+    out4 = dp.maintenance_tick(now=next(_NOW))
+    assert "tensor-scrub" in out4["ran"] and not out4["shed"]
+    sched = dp.maintenance_stats()
+    assert sched["tasks"]["tensor-scrub"]["shed_total"] >= 1
+    # Fresh parity after the storm.
+    oracle = Oracle(ps)
+    pkts = [_fresh(BLOCKED), _fresh(CLIENT)]
+    got = [int(c) for c in np.asarray(
+        dp.step(PacketBatch.from_packets(pkts), next(_NOW)).code)]
+    assert got == [int(oracle.classify(p).code) for p in pkts]
+
+
+def test_agent_sync_shares_the_scheduler_recompile_backoff():
+    """agent/controller.py's degraded-mode forced recompile consults the
+    scheduler's shared backoff (maintenance_recovery_due): inside the
+    window opened by a failed scheduler recompile attempt, sync() does
+    NOT burn another run_bundle; once due (or on non-scheduler
+    datapaths), the pre-existing discipline is unchanged."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+    from antrea_tpu.datapath.commit import STAGE_COMPILE
+
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, canary_probes=8)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=2)
+    with pytest.raises(Exception):
+        dp.install_bundle(ps=ps)
+    assert dp.degraded
+    out1 = dp.maintenance_tick(now=next(_NOW))  # failed retry opens backoff
+    assert dp.degraded and not dp.maintenance_recovery_due()
+
+    agent = AgentPolicyController("n1", dp, clock=lambda: 1e9)
+    compiles0 = dp.commit_stats()["commits"].get(f"{STAGE_COMPILE}/ok", 0)
+    agent.sync()  # inside the scheduler's backoff window: no attempt
+    assert dp.degraded
+    assert dp.commit_stats()["commits"].get(
+        f"{STAGE_COMPILE}/ok", 0) == compiles0
+    # Past the window the scheduler task recovers (fault exhausted)...
+    dp.maintenance_tick(now=out1["now"] + 10)
+    assert not dp.degraded and dp.maintenance_recovery_due()
+    # ...and a healthy datapath never gates sync.
+    agent.sync()
+    assert not dp.degraded
+
+
+def test_failed_sync_recovery_opens_the_scheduler_backoff_window():
+    """The sharing is bidirectional: a FAILED sync()-driven recovery
+    install opens the scheduler's backoff window too, so the
+    degraded-recompile task does not fire a second full compile+canary
+    run_bundle right behind the failure."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+    from antrea_tpu.datapath.commit import STAGE_COMPILE
+
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, canary_probes=8)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=2)
+    with pytest.raises(Exception):
+        dp.install_bundle(ps=ps)
+    # Degraded, scheduler window still closed: sync is the first driver.
+    assert dp.degraded and dp.maintenance_recovery_due()
+
+    agent = AgentPolicyController("n1", dp, clock=lambda: 1e9)
+    agent.sync()  # due -> attempts -> the armed canary fails the install
+    assert dp.degraded
+    # Sync paces its own retries on the AGENT clock; the scheduler-facing
+    # window is what the failure must open.
+    assert dp.maintenance_recovery_due()
+    assert dp._maint_retry_at > 0
+    compiles0 = dp.commit_stats()["commits"].get(f"{STAGE_COMPILE}/ok", 0)
+    out = dp.maintenance_tick(now=0)  # same tick-instant: inside window
+    assert "degraded-recompile" not in out["ran"]
+    assert dp.commit_stats()["commits"].get(
+        f"{STAGE_COMPILE}/ok", 0) == compiles0
+    # Past the window (faults exhausted) the scheduler task recovers.
+    for t in (10, 30, 70):
+        if not dp.degraded:
+            break
+        dp.maintenance_tick(now=t)
+    assert not dp.degraded
+
+
+# ---------------------------------------------------------------------------
+# Typed config validation (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_config_error_knob_combos(dp_cls):
+    ps, svcs = _world()
+    with pytest.raises(ConfigError, match="async_slowpath"):
+        _dp(dp_cls, ps, svcs, overlap_commits=True)
+    with pytest.raises(ConfigError, match="async_slowpath"):
+        _dp(dp_cls, ps, svcs, autotune_drain=True)
+    with pytest.raises(ConfigError, match="canary_probes=0"):
+        _dp(dp_cls, ps, svcs, canary_probes=0, audit_divergence_trip=2)
+    with pytest.raises(ConfigError, match="maint"):
+        _dp(dp_cls, ps, svcs, maint_budget=0)
+    # Still a ValueError for pre-existing callers, and the legal
+    # canary_probes=0 default-trip combination keeps working.
+    assert issubclass(ConfigError, ValueError)
+    dp = _dp(dp_cls, ps, svcs, canary_probes=0)
+    assert dp.maintenance is not None
+
+
+def test_agent_config_maint_budget_key(tmp_path):
+    from antrea_tpu.config import AgentConfig, load_agent_config
+
+    p = tmp_path / "agent.conf"
+    p.write_text("maintBudget: 128\n")
+    assert load_agent_config(str(p)).maint_budget == 128
+    p.write_text("maintBudget: 0\n")
+    with pytest.raises(ConfigError):
+        load_agent_config(str(p))
+    assert AgentConfig().maint_budget is None
+
+
+# ---------------------------------------------------------------------------
+# Tooling + API + metrics + supportbundle surface
+# ---------------------------------------------------------------------------
+
+
+def test_check_maintenance_tool_runs_clean():
+    """tools/check_maintenance.py (satellite: loop-discipline gate, tier-1
+    wired here like check_audit_plane.py) exits 0 — every off-hot-step
+    loop registers a MaintenanceTask and no rogue call site exists."""
+    tool = (Path(__file__).resolve().parent.parent / "tools"
+            / "check_maintenance.py")
+    res = subprocess.run([sys.executable, str(tool)], capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "maintenance plane disciplined" in res.stdout
+
+
+def test_force_audit_base_default_without_a_scheduler():
+    """A Datapath subclass with an audit surface but no maintenance
+    mixin still serves the /audit?force=1 path: the base-class
+    maintenance_force_audit default falls back to a direct full sweep
+    (nothing to serialize against without a scheduler)."""
+    from antrea_tpu.datapath.interface import Datapath, DatapathType
+
+    class _AuditOnly(Datapath):
+        calls: list = []
+
+        @property
+        def datapath_type(self):
+            return DatapathType.ORACLE
+
+        @property
+        def generation(self):
+            return 0
+
+        def install_bundle(self, ps=None, services=None):
+            return None
+
+        def apply_group_delta(self, name, added, removed):
+            return None
+
+        def install_topology(self, topo):
+            return None
+
+        def step(self, batch, now=0.0):
+            return None
+
+        def stats(self):
+            return None
+
+        def trace(self, batch, now=0.0):
+            return []
+
+        def audit_stats(self):
+            return {"scans_total": len(self.calls)}
+
+        def audit_scan(self, now=0, full=False):
+            self.calls.append((now, full))
+            return {"scanned": 0, "full": full}
+
+    dp = _AuditOnly()
+    out = dp.maintenance_force_audit(now=7)
+    assert out == {"scanned": 0, "full": True}
+    assert dp.calls == [(7, True)]
+    # Without an audit plane the default stays inert (None), matching
+    # the route's 404 discipline.
+    assert Datapath.maintenance_force_audit(_stub_owner_dp()) is None
+
+
+def _stub_owner_dp():
+    return SimpleNamespace(audit_stats=lambda: None)
+
+
+def test_maintenance_api_route_antctl_metrics_bundle(capsys, tmp_path):
+    """GET /maintenance serves scheduler state; ?tick=1 runs one
+    synchronous round; `antctl maintenance --server URL --tick` drives it
+    end to end; the metric families render; the support bundle carries
+    maintenance.json."""
+    import tarfile
+    import urllib.request
+
+    from antrea_tpu.agent.apiserver import AgentApiServer
+    from antrea_tpu.antctl import main as antctl_main
+    from antrea_tpu.observability.metrics import render_metrics
+    from antrea_tpu.observability.supportbundle import collect_bundle
+
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs)
+    srv = AgentApiServer(dp, node="n1").start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            srv.address + "/maintenance").read())
+        assert {"ticks_total", "scheduler_lag", "tasks"} <= set(body)
+        assert set(body["tasks"]) == set(dp.maintenance.task_names)
+        ticked = json.loads(urllib.request.urlopen(
+            srv.address + "/maintenance?tick=1&budget=256").read())
+        assert ticked["ticks_total"] == body["ticks_total"] + 1
+        assert ticked["last_tick"]["spent"] <= 256
+
+        rc = antctl_main(["maintenance", "--server", srv.address, "--tick"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ticks_total"] >= 2 and "last_tick" in out
+
+        # --budget/--now without --tick would be silently dropped: reject.
+        rc = antctl_main(["maintenance", "--server", srv.address,
+                          "--budget", "4"])
+        assert rc == 2
+        assert "--tick" in capsys.readouterr().err
+
+        # The forced audit sweep rides the scheduler's serialization.
+        forced = json.loads(urllib.request.urlopen(
+            srv.address + "/audit?force=1&now=9").read())
+        assert forced["last_scan"]["full"] is True
+        assert json.loads(urllib.request.urlopen(
+            srv.address + "/maintenance").read())["forced_total"] == 1
+    finally:
+        srv.close()
+
+    text = render_metrics(dp, node="n1")
+    for fam in ("antrea_tpu_maintenance_ticks_total",
+                "antrea_tpu_maintenance_task_runs_total",
+                "antrea_tpu_maintenance_budget_spent_total",
+                "antrea_tpu_maintenance_deferrals_total",
+                "antrea_tpu_maintenance_shed_total",
+                "antrea_tpu_maintenance_scheduler_lag"):
+        assert fam in text, fam
+    assert 'task="canary"' in text
+
+    out_tar = tmp_path / "bundle.tar.gz"
+    members = collect_bundle(dp, str(out_tar), node="n1")
+    assert "maintenance.json" in members
+    with tarfile.open(out_tar) as tar:
+        got = json.load(tar.extractfile("maintenance.json"))
+    assert got["ticks_total"] == dp.maintenance_stats()["ticks_total"]

@@ -182,6 +182,42 @@ def test_oracle_profile_overlap_mode_names():
                                      "overlap_commit_residual"}
 
 
+def test_tpuflow_profile_maintenance_mode():
+    """profile(mode="maintenance") attributes the unified background
+    plane's cadence (MAINT_PHASE_CHAIN: the scheduler's fused
+    maintenance pass riding every step) with the telescoped-sum
+    identity, state untouched, and reports the plane's own attributed
+    cost as maintenance_s."""
+    from antrea_tpu.models.profile import MAINT_PHASE_CHAIN
+
+    cluster, hot, fresh = _world()
+    dp = TpuflowDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8,
+                         miss_chunk=16)
+    dp.step(hot, now=1)
+    before = dp.cache_stats()
+    prof = dp.profile(hot, fresh, n_new=8, k_small=1, k_big=2, repeats=1,
+                      mode="maintenance")
+    assert dp.cache_stats() == before
+    assert list(prof["phases_s"]) == [n for n, _m in MAINT_PHASE_CHAIN]
+    assert prof["mode"] == "maintenance" and prof["drain_batch"] == 8
+    assert abs(sum(prof["phases_s"].values()) - prof["total_s"]) < 1e-12
+    assert "maintenance_s" in prof and "maintenance_fraction" in prof
+    assert prof["total_s"] > 0 and prof["pps"] > 0
+
+
+def test_oracle_profile_maintenance_mode_names():
+    cluster, hot, fresh = _world()
+    dp = OracleDatapath(cluster.ps, flow_slots=SLOTS, aff_slots=1 << 8)
+    muts0 = dp._state_mutations
+    prof = dp.profile(hot, fresh, mode="maintenance")
+    assert set(prof["phases_s"]) == {"maint_fast_path", "maint_classify",
+                                     "maint_commit_residual", "maint_sweep"}
+    assert prof["maintenance_s"] == prof["phases_s"]["maint_sweep"]
+    # Observable-state-neutral including the accounted-mutation counter
+    # (the maintenance rider's eviction pass restores with the snapshot).
+    assert dp._state_mutations == muts0
+
+
 def test_check_phases_tool_runs_clean():
     """tools/check_phases.py (satellite: phase-drift CI check) exits 0 —
     pipeline PH_* masks, profile chains, and bench_profile stay in sync."""

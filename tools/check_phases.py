@@ -8,7 +8,8 @@ The churn profiler's honesty rests on three surfaces staying in lockstep:
   2. the cumulative chains in antrea_tpu/models/profile.py (PHASE_CHAIN
      for the synchronous regime, ASYNC_PHASE_CHAIN for the decoupled
      drain regime, OVERLAP_PHASE_CHAIN for the double-buffered overlap
-     regime) — each chain must start at 0, grow by exactly one PH_ bit
+     regime, MAINT_PHASE_CHAIN for the unified maintenance-scheduler
+     cadence) — each chain must start at 0, grow by exactly one PH_ bit
      per entry, end at PH_ALL, and carry unique names;
   3. bench_profile.py, which must report its phase list FROM the chain
      (importing PHASE_CHAIN), not from a hand-copied name list.
@@ -35,7 +36,8 @@ BENCH = REPO / "bench_profile.py"
 
 _PH_DEF = re.compile(r"^(PH_[A-Z0-9_]+)\s*=\s*(.+?)\s*(?:#.*)?$", re.M)
 _CHAIN = re.compile(
-    r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN|OVERLAP_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
+    r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN|OVERLAP_PHASE_CHAIN"
+    r"|MAINT_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
     re.M | re.S,
 )
 _ENTRY = re.compile(r'\(\s*"([a-z0-9_]+)"\s*,\s*([^)]*?)\s*\)', re.S)
@@ -97,7 +99,7 @@ def check() -> list[str]:
 
     chains = parse_chains()
     for required in ("PHASE_CHAIN", "ASYNC_PHASE_CHAIN",
-                     "OVERLAP_PHASE_CHAIN"):
+                     "OVERLAP_PHASE_CHAIN", "MAINT_PHASE_CHAIN"):
         if required not in chains:
             problems.append(f"profile.py defines no {required}")
     seen_names: set[str] = set()
