@@ -38,6 +38,14 @@ Routes:
                         scheduler lag); ?tick=1[&now=N&budget=B] runs one
                         synchronous scheduler tick first (the antctl
                         maintenance --tick path)
+  GET /realization      realization-tracing span table (observability/
+                        tracing.py: per-policy stage timelines controller
+                        commit -> first live hit, plus tracer occupancy/
+                        drop meters); ?uid= filters to one policy
+  GET /flightrecorder   post-mortem event journal (observability/
+                        flightrec.py: ring stats + events in sequence
+                        order); ?tail=N keeps the last N, ?kind= filters
+                        by event kind
   GET /memberlist       alive members of the gossip cluster
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
@@ -237,6 +245,31 @@ class AgentApiServer:
                 tick = self._dp.maintenance_tick(now=now, budget=budget)
                 body = self._dp.maintenance_stats()
                 body["last_tick"] = tick
+            return body
+        if route == "/realization":
+            rz = getattr(self._dp, "realization_stats", None)
+            body = rz() if rz is not None else None
+            if body is None:
+                raise KeyError(route)  # datapath without the tracer
+            tracer = self._dp.realization_tracer
+            body["spans"] = tracer.spans(uid=q.get("uid") or None)
+            return body
+        if route == "/flightrecorder":
+            fr = getattr(self._dp, "flightrecorder_stats", None)
+            body = fr() if fr is not None else None
+            if body is None:
+                raise KeyError(route)  # datapath without a recorder
+            tail = int(q["tail"]) if "tail" in q else None
+            kind = q.get("kind") or None
+            if kind is not None:
+                from ..observability.flightrec import EVENT_KINDS
+
+                if kind not in EVENT_KINDS:
+                    raise ValueError(
+                        f"unknown event kind {kind!r} (declared kinds: "
+                        f"{', '.join(sorted(EVENT_KINDS))})")
+            body["events"] = self._dp.flightrecorder_events(tail=tail,
+                                                            kind=kind)
             return body
         if route == "/memberlist":
             if self._memberlist is None:

@@ -84,6 +84,11 @@ class AgentPolicyController:
         # they carry the worst-case latencies the histogram exists to show.
         self._pending_ts: list[float] = []
         self._pending_ts_cap = 4096
+        # Satellite meter (PR 8): stamps truncated at the cap used to
+        # vanish silently — understating p99 during exactly the install
+        # outages the histogram exists to show.  Scraped as
+        # antrea_tpu_realization_stamps_dropped_total.
+        self.realization_stamps_dropped_total = 0
         # What the datapath actually enforces: refreshed ONLY on a
         # successful apply, so a failed install can never report upstream
         # as realized (the status plane would mark a generation Realized
@@ -161,9 +166,25 @@ class AgentPolicyController:
         # settled by the next successful sync().  Unstamped events
         # (resync replays — reconnect catch-up, not live dissemination)
         # are not measured.
-        if (ev.ts and (self._rules_dirty or self._deltas)
-                and len(self._pending_ts) < self._pending_ts_cap):
-            self._pending_ts.append(ev.ts)
+        stamped_pending = bool(ev.ts and (self._rules_dirty or self._deltas))
+        if stamped_pending:
+            if len(self._pending_ts) < self._pending_ts_cap:
+                self._pending_ts.append(ev.ts)
+            else:
+                # Bounded-memory guarantee kept, loss now METERED: the
+                # histogram's p99 understates by exactly this count.
+                self.realization_stamps_dropped_total += 1
+        # Realization tracing (observability/tracing.py): per-policy
+        # spans open at the wire-receipt stamp; unstamped events are
+        # excluded and counted, never guessed into the histograms.
+        tr = getattr(self.datapath, "realization_tracer", None)
+        if (tr is not None and ev.obj_type == "NetworkPolicy"
+                and ev.kind != "DELETED" and ev.obj is not None):
+            if stamped_pending:
+                tr.policy_event(ev.name, getattr(ev.obj, "generation", 0),
+                                ev.ts)
+            elif not ev.ts:
+                tr.note_unstamped()
 
     def _handle_event(self, ev: WatchEvent) -> None:
         if self._in_resync:
@@ -225,6 +246,13 @@ class AgentPolicyController:
 
         return isinstance(e, PolicyCapacityError)
 
+    def _emit(self, kind: str, **fields) -> None:
+        """Journal an agent-plane transition into the datapath's flight
+        recorder (observability/flightrec.py) when it has one."""
+        from ..observability.flightrec import emit_into
+
+        emit_into(self.datapath, kind, **fields)
+
     def _install_failed(self, e: Exception) -> None:
         """Record a failed datapath install: the dirty flag STAYS set (the
         state is still pending, exactly the reference reconciler's requeue)
@@ -234,8 +262,12 @@ class AgentPolicyController:
         burning the backoff loop forever on a poison bundle."""
         self.sync_failures_total += 1
         self.last_sync_error = str(e)
+        self._emit("agent-sync", outcome="error", node=self.node,
+                   error=f"{type(e).__name__}: {e}"[:200])
         if self._is_permanent(e):
             self.permanent_failure = f"{type(e).__name__}: {e}"
+            self._emit("agent-quarantine", node=self.node,
+                       reason=self.permanent_failure[:200])
         else:
             self._retry_at = self._clock() + self._retry_backoff.next_delay()
             # The maintenance scheduler's degraded-recompile task shares
@@ -258,6 +290,14 @@ class AgentPolicyController:
             # comparable to the store's monotonic stamps.
             self.dissemination_hist.observe(max(t - ts, 0.0))
         self._pending_ts.clear()
+        self._emit("agent-sync", outcome="ok", node=self.node,
+                   generation=int(self.datapath.generation))
+        # Realization spans: every pending span rode the commit this
+        # sync just drove — bind them to its stage stamps; the span
+        # closes at the first live packet hit on the new generation.
+        tr = getattr(self.datapath, "realization_tracer", None)
+        if tr is not None:
+            tr.realized()
 
     def sync(self) -> None:
         """Apply pending changes to the datapath: one bundle for structural

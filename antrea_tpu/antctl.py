@@ -33,6 +33,14 @@ API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
         unified background-plane scheduler state (GET /maintenance:
         per-task runs/budget-spent/deferrals/shed, scheduler lag);
         --tick runs one synchronous budgeted scheduler round first
+  realization --server URL [--uid POLICY] [--json]
+        realization-tracing span table (GET /realization: per-policy
+        stage timelines controller-commit -> first live hit); default
+        output is a per-span stage table, --json the raw body
+  flightrecorder --server URL [--tail N] [--kind EVENT] [--json]
+        post-mortem event journal (GET /flightrecorder: drop-oldest ring,
+        monotonic seq, tick-clock timestamps); default output is one
+        line per event in sequence order, --json the raw body
 """
 
 from __future__ import annotations
@@ -297,6 +305,77 @@ def _cmd_maintenance(args) -> int:
     return 0
 
 
+def _cmd_realization(args) -> int:
+    """Realization span timelines over the live agent API
+    (observability/tracing.py; route GET /realization)."""
+    path = "/realization"
+    if args.uid:
+        from urllib.parse import quote
+        path += f"?uid={quote(args.uid, safe='')}"
+    body = json.loads(_fetch(args.server, path))
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    print(f"spans: pending={body['pending']} "
+          f"awaiting_first_hit={body['awaiting_first_hit']} "
+          f"closed={body['closed']} "
+          f"dropped={body['spans_dropped_total']} "
+          f"unstamped={body['unstamped_total']} "
+          f"p99_s={body['p99_s']}")
+    stages = body["stages"]
+    hdr = ["UID", "GEN", "BUNDLE", "STATE", *[s.upper() for s in stages],
+           "TOTAL_S"]
+    rows = []
+    for sp in body["spans"]:
+        st = sp.get("stages_s") or {}
+        rows.append([
+            sp["uid"], str(sp["generation"]),
+            str(sp.get("bundle_generation", "-")), sp["state"],
+            *[f"{st[s]:.6f}" if s in st else "-" for s in stages],
+            f"{sp['total_s']:.6f}" if "total_s" in sp else "-",
+        ])
+    _print_table(hdr, rows)
+    return 0
+
+
+def _cmd_flightrecorder(args) -> int:
+    """Flight-recorder journal over the live agent API
+    (observability/flightrec.py; route GET /flightrecorder)."""
+    path = "/flightrecorder"
+    q = []
+    if args.tail is not None:
+        q.append(f"tail={args.tail}")
+    if args.kind:
+        from urllib.parse import quote
+        q.append(f"kind={quote(args.kind, safe='')}")
+    if q:
+        path += "?" + "&".join(q)
+    body = json.loads(_fetch(args.server, path))
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    print(f"journal: seq={body['seq']} retained={body['retained']}/"
+          f"{body['capacity']} dropped={body['dropped_total']}")
+    rows = []
+    for e in body["events"]:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("seq", "ts", "kind")}
+        rows.append([str(e["seq"]), str(e["ts"]), e["kind"],
+                     " ".join(f"{k}={v}" for k, v in extra.items())])
+    _print_table(["SEQ", "TS", "KIND", "FIELDS"], rows)
+    return 0
+
+
+def _print_table(header: list, rows: list) -> None:
+    """Fixed-width column table (the reference antctl's output shape)."""
+    widths = [len(h) for h in header]
+    for r in rows:
+        for i, cell in enumerate(r):
+            widths[i] = max(widths[i], len(cell))
+    for r in [header] + rows:
+        print("  ".join(cell.ljust(w) for cell, w in zip(r, widths)).rstrip())
+
+
 def _cmd_query_endpoint(args) -> int:
     """Snapshot-based endpoint query: membership sets computed by pod IP,
     then the shared policy scan (controller/endpoint_querier.scan_policies
@@ -400,6 +479,27 @@ def main(argv=None) -> int:
     mt.add_argument("--budget", type=int, default=None,
                     help="total budget units for the forced tick")
     mt.set_defaults(fn=_cmd_maintenance)
+
+    rz = sub.add_parser(
+        "realization",
+        help="per-policy realization span timelines (tracing plane)",
+    )
+    rz.add_argument("--server", required=True, help="live agent API base URL")
+    rz.add_argument("--uid", default="", help="filter to one policy uid")
+    rz.add_argument("--json", action="store_true", help="raw JSON body")
+    rz.set_defaults(fn=_cmd_realization)
+
+    fr = sub.add_parser(
+        "flightrecorder",
+        help="post-mortem event journal (flight-recorder plane)",
+    )
+    fr.add_argument("--server", required=True, help="live agent API base URL")
+    fr.add_argument("--tail", type=int, default=None,
+                    help="keep only the last N events (after filtering)")
+    fr.add_argument("--kind", default="",
+                    help="filter by event kind (see EVENT_KINDS)")
+    fr.add_argument("--json", action="store_true", help="raw JSON body")
+    fr.set_defaults(fn=_cmd_flightrecorder)
 
     c = sub.add_parser("check", help="installation self-diagnostics")
     c.set_defaults(fn=_cmd_check)

@@ -170,9 +170,17 @@ class AuditPlane:
     def arm_faults(self, plan, name: str) -> None:
         """Consult `plan` at sites f"{name}.cache" (real injected
         corruption) and f"{name}.audit" (forced false positive) on every
-        scan — the chaos tier's deterministic corruption trigger."""
+        scan — the chaos tier's deterministic corruption trigger.  The
+        plan journals every firing into the owner's flight recorder, so
+        a chaos post-mortem reads cause beside effect."""
         self._plan = plan
         self._site = name
+        plan.bind_recorder(getattr(self.owner, "_flightrec", None))
+
+    def _emit(self, kind: str, **fields) -> None:
+        from ..observability.flightrec import emit_into
+
+        emit_into(self.owner, kind, **fields)
 
     # -- golden digests (commit/settle-time anchors) -------------------------
 
@@ -209,11 +217,13 @@ class AuditPlane:
                     f"tensor scrub: {', '.join(bad)} diverged from the "
                     f"golden digest"
                 )
+                self._emit("audit-finding", source="scrub", tensors=bad)
                 # Self-heal: rebuild from the host mirror — no recompile.
                 o._audit_reupload()
                 self._golden = o._audit_rule_digests()
                 self.scrubs["healed"] += len(bad)
                 out["healed"] = bad
+                self._emit("audit-repair", source="scrub", tensors=bad)
         # State-side: the digest is pinned to the accounted-mutation
         # counter — an unchanged counter with a changed digest is silent
         # corruption (every legitimate write path counts itself).
@@ -229,6 +239,7 @@ class AuditPlane:
                 "accounted mutation; forcing full-cache revalidation"
             )
             out["state_corrupt"] = True
+            self._emit("audit-finding", source="scrub", tensors=["state"])
         else:
             self.scrubs["clean"] += 1
         self._state_ref = (digest, muts)
@@ -370,6 +381,11 @@ class AuditPlane:
             self.divergences[kind] += 1
             self.last_divergence = desc
         out["divergences"] = len(findings) + n_injected
+        if findings or n_injected:
+            self._emit("audit-finding", source="rows",
+                       rows=len(findings), injected=n_injected,
+                       kinds=sorted({k for _s, k, _d in findings}),
+                       last=self.last_divergence[:200])
         # The degrade trip counts only PROVEN-corruption kinds: affinity
         # drift (see _check_rows) repairs silently with metrics, so a
         # burst of expired affinity learns can never quarantine a node.
@@ -382,6 +398,7 @@ class AuditPlane:
             o._audit_evict(bad_slots)
             self.repairs_total += len(bad_slots)
             out["repaired"] = len(bad_slots)
+            self._emit("audit-repair", source="rows", rows=len(bad_slots))
         if state_corrupt and full:
             # The forced full revalidation IS the state-side heal.
             self.scrubs["healed"] += 1
@@ -400,6 +417,10 @@ class AuditPlane:
         # further attempts with its install backoff).
         cp = getattr(o, "_commit", None)
         if cp is not None and trip_count >= self.divergence_trip:
+            if not cp.degraded:
+                self._emit("degrade",
+                           reason=f"audit divergence rate: {trip_count} "
+                                  f"in one scan"[:200])
             cp.degraded = True
             cp.last_error = (
                 f"audit divergence rate: {trip_count} in one scan "

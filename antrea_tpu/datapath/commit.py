@@ -122,13 +122,29 @@ class CommitPlane:
         self._plan = None
         self._site = ""
 
+    # -- observability (flight recorder + realization tracer) ----------------
+
+    def _tracer(self):
+        """The owner's realization tracer (observability/tracing.py) —
+        the commit plane stamps the compile/canary/swap/settle stage
+        boundaries of every realization span."""
+        return getattr(self.owner, "_realization", None)
+
+    def _emit(self, kind: str, **fields) -> None:
+        from ..observability.flightrec import emit_into
+
+        emit_into(self.owner, kind, **fields)
+
     # -- fault injection (dissemination/faults.py sites) ---------------------
 
     def arm_faults(self, plan, name: str) -> None:
         """Consult `plan` at sites f"{name}.compile" / f"{name}.canary" on
-        every commit — the chaos tier's deterministic rollback trigger."""
+        every commit — the chaos tier's deterministic rollback trigger.
+        The plan also journals every firing into the owner's flight
+        recorder, so a post-mortem reads cause and effect in one place."""
         self._plan = plan
         self._site = name
+        plan.bind_recorder(getattr(self.owner, "_flightrec", None))
 
     def _fire_compile_fault(self) -> None:
         if self._plan is None:
@@ -161,17 +177,26 @@ class CommitPlane:
             # services-only (or no-op) bundle re-lowers the held rule set
             # too, so a passing canary re-certifies the whole bundle.
             ps = o._ps
+        tr = self._tracer()
+        if tr is not None:
+            tr.commit_begin()  # queue_wait ends; the compile stage starts
         snap = self._take_snapshot()
         try:
             self._fire_compile_fault()
             gen = o._install_bundle_impl(ps, services)
             self.commits[(STAGE_COMPILE, "ok")] += 1
+            if tr is not None:
+                tr.commit_stage(STAGE_COMPILE)
         except Exception as e:
             self.commits[(STAGE_COMPILE, "error")] += 1
+            self._emit("commit", stage=STAGE_COMPILE, outcome="error",
+                       error=f"{type(e).__name__}: {e}"[:200])
             self._rollback(snap, e)
             raise
         self._canary_gate(snap)
         self.commits[(STAGE_SWAP, "ok")] += 1
+        if tr is not None:
+            tr.commit_stage(STAGE_SWAP)
         self._settle(gen, delta=False)
         return gen
 
@@ -185,22 +210,33 @@ class CommitPlane:
                 f"— incremental deltas are quarantined until a full-bundle "
                 f"recompile passes its canary"
             )
+        tr = self._tracer()
+        if tr is not None:
+            tr.commit_begin()
         snap = self._take_snapshot(group=group_name)
         gen0 = int(o._gen)
         try:
             self._fire_compile_fault()
             gen = o._apply_group_delta_impl(group_name, added_ips, removed_ips)
             self.commits[(STAGE_COMPILE, "ok")] += 1
+            if tr is not None:
+                tr.commit_stage(STAGE_COMPILE)
         except KeyError:
             # Unknown group: the impls validate before mutating anything,
             # and the agent's sync path folds this into a full bundle —
             # not a commit fault, no rollback bookkeeping.
+            if tr is not None:
+                tr.commit_abort()
             raise
         except Exception as e:
             self.commits[(STAGE_COMPILE, "error")] += 1
+            self._emit("commit", stage=STAGE_COMPILE, outcome="error",
+                       delta=True, error=f"{type(e).__name__}: {e}"[:200])
             self._rollback(snap, e)
             raise
         if gen == gen0:
+            if tr is not None:
+                tr.commit_abort()  # no-op: nothing realized by this call
             return gen  # no-op delta: nothing swapped, nothing to certify
         # Delta canary scoped to the touched group's blast radius (plus
         # the delta'd addresses themselves — removals probe as
@@ -208,24 +244,33 @@ class CommitPlane:
         self._canary_gate(snap, scope={group_name},
                           extra=[*added_ips, *removed_ips])
         self.commits[(STAGE_SWAP, "ok")] += 1
+        if tr is not None:
+            tr.commit_stage(STAGE_SWAP)
         self._settle(gen, delta=True)
         return gen
 
     def _canary_gate(self, snap, scope=None, extra=()) -> None:
         """Run the canary against the candidate; mismatch or probe-path
         exception rolls back to `snap` and raises."""
+        tr = self._tracer()
         try:
             mism = self._canary(scope=scope, extra=extra)
         except Exception as e:
             self.commits[(STAGE_CANARY, "error")] += 1
+            self._emit("commit", stage=STAGE_CANARY, outcome="error",
+                       error=f"{type(e).__name__}: {e}"[:200])
             self._rollback(snap, e)
             raise
         if mism:
             self.commits[(STAGE_CANARY, "mismatch")] += 1
             err = CanaryMismatchError(mism)
+            self._emit("commit", stage=STAGE_CANARY, outcome="mismatch",
+                       mismatches=len(mism))
             self._rollback(snap, err)
             raise err
         self.commits[(STAGE_CANARY, "ok")] += 1
+        if tr is not None:
+            tr.commit_stage(STAGE_CANARY)
 
     def _take_snapshot(self, group=None):
         """Engine snapshot + the slow-path engine's epoch-stale flag (the
@@ -239,13 +284,21 @@ class CommitPlane:
 
     def _rollback(self, snap, err: Exception) -> None:
         state, stale0 = snap
+        tr = self._tracer()
+        if tr is not None:
+            tr.commit_abort()  # nothing realized; the retry re-stamps
         self.owner._commit_restore(state)
         sp = getattr(self.owner, "_slowpath", None)
         if sp is not None and stale0 is not None:
             sp.stale = stale0
         self.rollbacks_total += 1
+        was_degraded = self.degraded
         self.degraded = True
         self.last_error = f"{type(err).__name__}: {err}"
+        self._emit("rollback", lkg_generation=int(self.lkg_generation),
+                   error=self.last_error[:200])
+        if not was_degraded:
+            self._emit("degrade", reason=self.last_error[:200])
         self._refresh_audit_golden()
 
     def _refresh_audit_golden(self) -> None:
@@ -271,14 +324,29 @@ class CommitPlane:
                 o._record_round()
             else:
                 o._persist()
-        except Exception:
+        except Exception as e:
             self.commits[(STAGE_SETTLE, "error")] += 1
+            self._emit("commit", stage=STAGE_SETTLE, outcome="error",
+                       error=f"{type(e).__name__}: {e}"[:200])
+            tr = self._tracer()
+            if tr is not None:
+                tr.commit_abort()  # durability pending: the agent's
+                # retry re-drives the commit, whose stamps then bind
             raise
         self.commits[(STAGE_SETTLE, "ok")] += 1
+        was_degraded = self.degraded
         self.degraded = False
         self.last_error = ""
         self.lkg_generation = int(gen)
         self.lkg_at = self._clock()
+        tr = self._tracer()
+        if tr is not None:
+            tr.commit_stage(STAGE_SETTLE)
+            tr.commit_done(gen)
+        self._emit("commit", stage=STAGE_SETTLE, outcome="ok",
+                   gen=int(gen), delta=delta)
+        if was_degraded:
+            self._emit("recover", gen=int(gen))
         self._refresh_audit_golden()
 
     # -- canary ---------------------------------------------------------------
@@ -351,6 +419,10 @@ class CommitPlane:
         if forced is not None:
             mism.append({"injected": forced})
         self.canary_mismatches_total += len(mism)
+        if mism:
+            self._emit("canary-mismatch", probes=n_real,
+                       mismatches=len(mism),
+                       first=str(mism[0])[:200])
         return mism
 
     def canary_scan(self, now: int = 0, recover: bool = True) -> dict:
@@ -376,6 +448,9 @@ class CommitPlane:
             self.canary_mismatches_total += 1
         self.commits[(STAGE_WATCHDOG, "mismatch" if mism else "ok")] += 1
         if mism:
+            if not self.degraded:
+                self._emit("degrade",
+                           reason=f"live canary mismatch: {mism[0]}"[:200])
             self.degraded = True
             self.last_error = f"live canary mismatch: {mism[0]}"
         out = {

@@ -220,6 +220,59 @@ class Datapath(ABC):
             return None
         return self.audit_scan(now, full=True)
 
+    # -- observability plane (PR 8: flight recorder + realization tracing;
+    # both engines construct the objects in their constructors — these are
+    # the inert defaults for test doubles without the plane) -----------------
+
+    _flightrec = None  # observability/flightrec.FlightRecorder
+    _realization = None  # observability/tracing.RealizationTracer
+
+    def _init_observability(self, flightrec_slots: int,
+                            realization_slots: int) -> None:
+        """Constructor hook (both engines, before the commit plane):
+        build the flight recorder + realization tracer.  Zero slots
+        disable the respective surface — both are host-side only, so
+        disabling changes no compiled step HLO."""
+        if flightrec_slots < 0 or realization_slots < 0:
+            from ..config import ConfigError
+
+            raise ConfigError(
+                f"flightrec_slots/realization_slots must be >= 0, got "
+                f"{flightrec_slots}/{realization_slots}")
+        from ..observability.flightrec import FlightRecorder
+        from ..observability.tracing import RealizationTracer
+
+        self._flightrec = (FlightRecorder(capacity=flightrec_slots)
+                           if flightrec_slots else None)
+        self._realization = (
+            RealizationTracer(span_slots=realization_slots,
+                              recorder=self._flightrec)
+            if realization_slots else None)
+
+    @property
+    def realization_tracer(self):
+        """The realization-span tracer (None when tracing is disabled):
+        the agent controller, commit plane and step latch stamp spans
+        through this one object."""
+        return self._realization
+
+    def realization_stats(self) -> Optional[dict]:
+        """Span-table occupancy + drop meters for the metrics/API planes
+        — None when tracing is disabled."""
+        return None if self._realization is None else self._realization.stats()
+
+    def flightrecorder_stats(self) -> Optional[dict]:
+        """Ring-journal counters (seq head, drops, per-kind volumes) —
+        None when the datapath has no recorder."""
+        return None if self._flightrec is None else self._flightrec.stats()
+
+    def flightrecorder_events(self, tail: Optional[int] = None,
+                              kind: Optional[str] = None) -> list[dict]:
+        """Journal contents in sequence order (the post-mortem read path:
+        GET /flightrecorder, antctl, support bundle)."""
+        return ([] if self._flightrec is None
+                else self._flightrec.events(tail=tail, kind=kind))
+
     # -- async slow-path surface (datapath/slowpath; both engines) ----------
     # Shared plumbing: each engine implements the CLASSIFY callbacks
     # (_drain_classify/_epoch_revalidate/_epoch_age_scan) and calls

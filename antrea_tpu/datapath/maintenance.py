@@ -68,6 +68,8 @@ MAINT_TASKS = {
     "audit-cursor": "datapath/audit.py (cursor cache revalidation)",
     "tensor-scrub": "datapath/audit.py (device-tensor checksum scrub)",
     "fqdn-ttl": "agent/fqdn.py (DNS-learned membership TTL GC)",
+    "observability": "observability/flightrec.py + tracing.py (journal/"
+                     "span bookkeeping, cost-accounted not smeared)",
 }
 
 # A starved task's deficit keeps accumulating so it can eventually afford
@@ -293,6 +295,7 @@ class MaintenanceScheduler:
         t = self._advance(now)
         out: dict = {"now": t, "ran": {}, "deferred": [], "shed": [],
                      "spent": 0, "blocked": None, "overlap_flushed": 0}
+        rec = getattr(self.owner, "_flightrec", None)
         blocked = self._blocked()
         if blocked is not None:
             self.blocked_ticks_total += 1
@@ -301,6 +304,8 @@ class MaintenanceScheduler:
                 st.deferrals_total += 1
                 st.starved += 1
                 out["deferred"].append(st.task.name)
+            if rec is not None:
+                rec.emit(kind="maint-blocked", reason=blocked, at=t)
             return out
         self.ticks_total += 1
         if self._first_tick_at is None:
@@ -352,6 +357,10 @@ class MaintenanceScheduler:
                 if remaining is not None:
                     remaining -= spent
             st.starved = 0  # it got a real grant, whether or not it acted
+        if rec is not None:
+            rec.emit(kind="maint-tick", at=t, ran=dict(out["ran"]),
+                     deferred=list(out["deferred"]),
+                     shed=list(out["shed"]), spent=int(out["spent"]))
         return out
 
     def force(self, fn: Callable[[int], dict],
@@ -475,6 +484,25 @@ class MaintainableDatapath:
         if self._slowpath is not None:
             sched.register(MaintenanceTask(
                 "cache-maintain", self._maint_cache, budget=1, priority=1))
+        # Observability bookkeeping (PR 8): the flight recorder and the
+        # realization tracer account their recording cost HERE — one
+        # budgeted task whose spend is the stamps/events recorded since
+        # its last grant — instead of smearing it invisibly across
+        # whichever plane happened to emit.  A burst larger than one
+        # grant carries over as backlog (not an overrun: emit itself is
+        # never deferred, only its accounting is spread).
+        self._obs_cost_backlog = 0
+        self._obs_rec_taken = 0
+        rec = getattr(self, "_flightrec", None)
+        if rec is not None:
+            # The journal's timebase IS the scheduler's tick clock — one
+            # notion of now across ticks, backoffs, TTLs and the journal,
+            # fault-injectable via faults.FaultClock.
+            rec.set_clock(sched.clock)
+        if rec is not None or getattr(self, "_realization", None) is not None:
+            sched.register(MaintenanceTask(
+                "observability", self._maint_observability, budget=64,
+                priority=5))
 
     # -- public surface ------------------------------------------------------
 
@@ -559,6 +587,23 @@ class MaintainableDatapath:
             self._maint_last_age = now
             return 1
         return 0
+
+    def _maint_observability(self, now: int, budget: int) -> int:
+        """Recording-cost accounting: spend = flight-recorder events +
+        tracer stamp ops since the last grant, spread across ticks when a
+        burst exceeds one grant (backlog, not overrun — the emits already
+        happened; only their ACCOUNTING waits for budget)."""
+        backlog = self._obs_cost_backlog
+        rec = getattr(self, "_flightrec", None)
+        if rec is not None:
+            backlog += rec.seq - self._obs_rec_taken
+            self._obs_rec_taken = rec.seq
+        tr = getattr(self, "_realization", None)
+        if tr is not None:
+            backlog += tr.take_cost()
+        spent = min(backlog, int(budget))
+        self._obs_cost_backlog = backlog - spent
+        return spent
 
     def _maint_recompile(self, now: int, budget: int) -> int:
         """Degraded-mode recovery, paced by a capped exponential backoff

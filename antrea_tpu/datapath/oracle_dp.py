@@ -99,6 +99,8 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         audit_divergence_trip: Optional[int] = None,
         maint_budget: Optional[int] = None,
         maint_clock=None,
+        flightrec_slots: int = 1024,
+        realization_slots: int = 256,
     ):
         from ..features import DEFAULT_GATES
 
@@ -154,6 +156,9 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         # kernel twin (antrea_tpu_datapath_step_seconds).
         self.step_hist = Histogram()
         self._rebuild_l7_ids()
+        # Observability plane BEFORE the commit/audit planes — same
+        # contract as the kernel twin (flight recorder + span tracer).
+        self._init_observability(flightrec_slots, realization_slots)
         # Commit plane LAST (datapath/commit.py): boot state is the LKG
         # baseline — same contract as the kernel twin.
         self._init_commit_plane(canary_probes=canary_probes)
@@ -837,6 +842,10 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         # Traffic time drives the maintenance tick clock (one clock
         # domain: flow-cache aging and FQDN expiry stamp with THIS now).
         self._maintenance.observe(now)
+        if self._realization is not None:
+            # First-hit latch (realization tracing) — the scalar twin of
+            # the tpuflow step latch, so span STRUCTURE is oracle-parity.
+            self._realization.first_hit(self._gen, batch.size)
         try:
             return self._step(batch, now)
         finally:

@@ -199,6 +199,13 @@ class SlowPathEngine:
         self._published_at = 0
         self._seen_now = 0
 
+    # -- flight recorder (the owner datapath's journal) ----------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        from ...observability.flightrec import emit_into
+
+        emit_into(self.owner, kind, **fields)
+
     # -- admission (fast-step side) ------------------------------------------
 
     def admit(self, cols: dict, miss_mask, now: int) -> tuple[int, int]:
@@ -209,7 +216,12 @@ class SlowPathEngine:
             # first one, anchor to the first traffic the engine sees so
             # the gauge reports time-since-birth, not the raw clock.
             self._published_at = int(now)
-        return self.queue.admit(cols, miss_mask, self.epoch, int(now))
+        admitted, dropped = self.queue.admit(cols, miss_mask, self.epoch,
+                                             int(now))
+        if dropped:
+            self._emit("queue-overflow", dropped=int(dropped),
+                       depth=int(self.queue.depth), at=int(now))
+        return admitted, dropped
 
     # -- epoch plane ---------------------------------------------------------
 
@@ -217,6 +229,7 @@ class SlowPathEngine:
         self.epoch += 1
         self._published_at = int(now)
         self._seen_now = max(self._seen_now, int(now))
+        self._emit("epoch-swap", epoch=int(self.epoch), at=int(now))
 
     def mark_stale(self, gen: int) -> None:
         """A bundle swap invalidated the current epoch: denials of older
@@ -278,6 +291,8 @@ class SlowPathEngine:
             return False
         self._inflight = (block, self.epoch, int(self.owner.generation))
         self._seen_now = max(self._seen_now, int(now))
+        self._emit("drain-begin", n=int(len(block["src_ip"])),
+                   epoch=int(self.epoch), gen=int(self.owner.generation))
         return True
 
     def finish_drain(self, now: int) -> dict:
@@ -309,6 +324,9 @@ class SlowPathEngine:
             self.deferred_commits_total += 1
         self.drains_total += 1
         self.drain_hist.observe(k)
+        self._emit("drain-finish", drained=k,
+                   stale_reclassified=k if stale else 0,
+                   deferred=int(fin is not None))
         self._publish(now)
         return {"drained": k, "stale_reclassified": k if stale else 0}
 
@@ -344,7 +362,13 @@ class SlowPathEngine:
             return
         delta = self.queue.overflows_total - self._overflows_seen
         self._overflows_seen = self.queue.overflows_total
+        before = self.drain_batch
         self.drain_batch = self.autotuner.observe(self.queue.depth, delta)
+        if self.drain_batch != before:
+            self._emit("autotune", chunk_from=int(before),
+                       chunk_to=int(self.drain_batch),
+                       depth=int(self.queue.depth),
+                       overflow_delta=int(delta))
 
     def drain(self, now: int, max_batches: Optional[int] = None) -> dict:
         """Drain the queue: heal a stale epoch first — ONE fused

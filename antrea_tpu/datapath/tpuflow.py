@@ -99,6 +99,8 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         audit_divergence_trip: Optional[int] = None,
         maint_budget: Optional[int] = None,
         maint_clock=None,
+        flightrec_slots: int = 1024,
+        realization_slots: int = 256,
     ):
         from ..features import DEFAULT_GATES
 
@@ -196,6 +198,13 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._compile_rules()
         self._compile_services()
         self._compile_topology()
+        # Observability plane BEFORE the commit/audit planes: they journal
+        # transitions and stamp realization spans through these objects
+        # from their very first transaction (observability/flightrec.py +
+        # tracing.py).  flightrec_slots=0 / realization_slots=0 disable —
+        # both are pure host-side state, so the compiled step HLO is
+        # bit-identical either way (latch = one int compare per step).
+        self._init_observability(flightrec_slots, realization_slots)
         # Commit plane LAST: the boot state (possibly persistence-restored)
         # is the last-known-good baseline every later commit retains.
         self._init_commit_plane(canary_probes=canary_probes)
@@ -414,6 +423,12 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         # Traffic time drives the maintenance tick clock (one clock
         # domain: flow-cache aging and FQDN expiry stamp with THIS now).
         self._maintenance.observe(now)
+        if self._realization is not None:
+            # First-hit latch (realization tracing): the first LIVE batch
+            # classified under a new bundle generation closes its spans.
+            # One int compare per step after the latch; host-side only,
+            # so the compiled step HLO is bit-identical with tracing off.
+            self._realization.first_hit(self._gen, batch.size)
         try:
             return self._step(batch, now)
         finally:

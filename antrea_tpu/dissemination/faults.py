@@ -103,6 +103,20 @@ class FaultPlan:
         self._rules: dict[str, list[_Rule]] = {}
         self._hits: dict[str, int] = {}
         self.injected: list[_Injection] = []
+        # Flight recorders (observability/flightrec.py) every injection
+        # journals itself into — the chaos tier's cause-beside-effect
+        # guarantee: a post-mortem reads "fault-injected" in the same
+        # sequence-ordered journal as the rollbacks/repairs it caused.
+        self._recorders: list = []
+
+    def bind_recorder(self, recorder) -> "FaultPlan":
+        """Attach a FlightRecorder (None is a no-op; duplicates are
+        collapsed — a plan arming several planes of ONE datapath must
+        journal each injection once)."""
+        if recorder is not None and all(r is not recorder
+                                        for r in self._recorders):
+            self._recorders.append(recorder)
+        return self
 
     def _add(self, site: str, rule: _Rule) -> "FaultPlan":
         self._rules.setdefault(site, []).append(rule)
@@ -139,6 +153,9 @@ class FaultPlan:
                 if rule.times > 0:
                     rule.times -= 1
                 self.injected.append(_Injection(site, rule.kind, hit))
+                for rec in self._recorders:
+                    rec.emit(kind="fault-injected", site=site,
+                             fault=rule.kind, hit=hit)
                 return rule
         return None
 
@@ -280,6 +297,10 @@ class FlakyDatapath:
             arm = getattr(inner, arm_name, None)
             if arm is not None:
                 arm(plan, name)
+        # Chaos post-mortems: injections at the wrapper's OWN site
+        # ({name}.install) journal into the inner datapath's recorder
+        # too, not only the in-plane compile/canary/cache/audit sites.
+        plan.bind_recorder(getattr(inner, "_flightrec", None))
 
     def install_bundle(self, *a, **kw):
         rule = self._plan.fire(f"{self._name}.install")

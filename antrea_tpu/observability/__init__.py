@@ -1,17 +1,24 @@
-"""Observability plane: NP audit logging + metrics surface (SURVEY §5)."""
+"""Observability plane: NP audit logging, metrics surface (SURVEY §5),
+realization tracing + the flight-recorder event journal (PR 8)."""
 
 from .audit import AuditLogger
+from .flightrec import EVENT_KINDS, FlightRecorder
 from .metrics import (
     METRICS,
     Histogram,
     render_dissemination_metrics,
     render_metrics,
 )
+from .tracing import REALIZATION_STAGES, RealizationTracer
 
 __all__ = [
     "AuditLogger",
+    "EVENT_KINDS",
+    "FlightRecorder",
     "Histogram",
     "METRICS",
+    "REALIZATION_STAGES",
+    "RealizationTracer",
     "render_dissemination_metrics",
     "render_metrics",
 ]

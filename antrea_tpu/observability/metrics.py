@@ -94,6 +94,18 @@ METRICS: dict[str, str] = {
     "antrea_tpu_maintenance_deferrals_total": "counter",
     "antrea_tpu_maintenance_shed_total": "counter",
     "antrea_tpu_maintenance_scheduler_lag": "gauge",
+    # realization tracing (observability/tracing.py; rendered when the
+    # datapath exposes realization_stats()) + the agent-side pending-stamp
+    # truncation meter (render_dissemination_metrics)
+    "antrea_tpu_policy_realization_seconds": "histogram",
+    "antrea_tpu_realization_spans": "gauge",
+    "antrea_tpu_realization_spans_dropped_total": "counter",
+    "antrea_tpu_realization_stamps_dropped_total": "counter",
+    # flight recorder (observability/flightrec.py; rendered when the
+    # datapath exposes flightrecorder_stats())
+    "antrea_tpu_flightrecorder_events_total": "counter",
+    "antrea_tpu_flightrecorder_dropped_total": "counter",
+    "antrea_tpu_flightrecorder_seq": "gauge",
 }
 
 
@@ -152,6 +164,34 @@ class Histogram:
         self._counts[bisect.bisect_left(self.bounds, v)] += 1
         self.sum += v
         self.count += 1
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold another histogram's observations into this one (fleet
+        aggregation: a cluster-wide p99 needs ONE bucket space).  Bounds
+        must match — merging across bucket layouts would misbin."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different bounds")
+        for i, c in enumerate(other._counts):
+            self._counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+        return self
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound quantile estimate from the bucket bounds (the
+        Prometheus histogram_quantile shape): the smallest bound whose
+        cumulative count reaches q*count.  Observations past the last
+        finite bound report that bound (the estimate saturates, exactly
+        like a scrape-side histogram_quantile would).  0.0 when empty."""
+        if self.count == 0:
+            return 0.0
+        need = max(0.0, min(1.0, float(q))) * self.count
+        acc = 0
+        for bound, c in zip(self.bounds, self._counts):
+            acc += c
+            if acc >= need:
+                return bound
+        return self.bounds[-1]
 
     def bucket_counts(self) -> list[int]:
         """CUMULATIVE per-bound counts (le semantics), +Inf last."""
@@ -269,6 +309,12 @@ def render_dissemination_metrics(server=None, agents=()) -> str:
          lambda a: getattr(a, "resyncs_total", None)),
         ("antrea_tpu_agent_sync_failures_total",
          lambda a: getattr(ctl(a), "sync_failures_total", None)),
+        # Satellite meter: dissemination-latency stamps truncated at the
+        # bounded _pending_ts cap — during exactly the install outages the
+        # latency histogram exists to show, dropped stamps understate p99;
+        # this counter keeps the understatement visible instead of silent.
+        ("antrea_tpu_realization_stamps_dropped_total",
+         lambda a: getattr(ctl(a), "realization_stamps_dropped_total", None)),
     ):
         rows = [(a.node, read(a)) for a in agents if read(a) is not None]
         if rows:
@@ -454,6 +500,45 @@ def render_metrics(datapath, node: str = "") -> str:
                 lines.append(
                     f"{fam}{_labels(task=task, node=node)} {row[key]}"
                 )
+    rz = getattr(datapath, "realization_stats", None)
+    rz = rz() if rz is not None else None
+    if rz is not None:
+        # Realization tracing plane (observability/tracing.py): span-table
+        # occupancy by lifecycle state, drop meter, per-stage latency.
+        lines.append(_type_line("antrea_tpu_realization_spans"))
+        for state in ("pending", "awaiting_first_hit", "closed"):
+            lines.append(
+                f"antrea_tpu_realization_spans"
+                f"{_labels(state=state, node=node)} {rz[state]}"
+            )
+        lines += [
+            _type_line("antrea_tpu_realization_spans_dropped_total"),
+            f"antrea_tpu_realization_spans_dropped_total{_labels(node=node)} "
+            f"{rz['spans_dropped_total']}",
+        ]
+        tracer = getattr(datapath, "realization_tracer", None)
+        if tracer is not None:
+            rows = [("antrea_tpu_policy_realization_seconds",
+                     {"stage": stage, "node": node}, h)
+                    for stage, h in tracer.hist.items() if h.count]
+            lines.extend(_render_histograms(rows))
+    fr = getattr(datapath, "flightrecorder_stats", None)
+    fr = fr() if fr is not None else None
+    if fr is not None:
+        # Flight recorder (observability/flightrec.py): per-kind volumes,
+        # drop-oldest losses, and the monotonic sequence head.
+        if fr["kinds"]:
+            lines.append(_type_line("antrea_tpu_flightrecorder_events_total"))
+            for kind, n in sorted(fr["kinds"].items()):
+                lines.append(
+                    f"antrea_tpu_flightrecorder_events_total"
+                    f"{_labels(kind=kind, node=node)} {n}"
+                )
+        for fam, key in (
+            ("antrea_tpu_flightrecorder_dropped_total", "dropped_total"),
+            ("antrea_tpu_flightrecorder_seq", "seq"),
+        ):
+            lines += [_type_line(fam), f"{fam}{_labels(node=node)} {fr[key]}"]
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

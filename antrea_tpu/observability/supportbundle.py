@@ -64,11 +64,31 @@ def collect_bundle(
             raise ValueError("datapath has no maintenance scheduler")
         return body
 
+    def _flightrecorder():
+        # The whole retained journal: a support bundle IS the post-mortem
+        # artifact, so it carries every event the ring still holds.
+        fr = getattr(datapath, "flightrecorder_stats", None)
+        body = fr() if fr is not None else None
+        if body is None:
+            raise ValueError("datapath has no flight recorder")
+        body["events"] = datapath.flightrecorder_events()
+        return body
+
+    def _realization():
+        rz = getattr(datapath, "realization_stats", None)
+        body = rz() if rz is not None else None
+        if body is None:
+            raise ValueError("datapath has no realization tracer")
+        body["spans"] = datapath.realization_tracer.spans()
+        return body
+
     for name, fn in (
         ("stats.json", _stats),
         ("cache_stats.json", datapath.cache_stats),
         ("flows.json", lambda: datapath.dump_flows(now)),
         ("maintenance.json", _maintenance),
+        ("flightrecorder.json", _flightrecorder),
+        ("realization.json", _realization),
         ("metrics.prom", lambda: render_metrics(datapath, node=node)),
     ):
         try:

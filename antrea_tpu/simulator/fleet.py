@@ -25,8 +25,11 @@ primary mode mirrors it:
 
 from __future__ import annotations
 
+import time
+
 from ..controller.networkpolicy import WatchEvent
 from ..dissemination.netwire import ReconnectingClient
+from ..observability.metrics import Histogram
 
 
 class _AgentTables:
@@ -42,6 +45,22 @@ class _AgentTables:
         self.resyncs_seen = 0
         self._in_resync = False
         self._resync_seen: set = set()
+        # Realization latency (PR 8 span plumbing, the fleet's half of
+        # ROADMAP item 3's "p99 < 1s at 10k agents" target): a fake agent
+        # realizes an object the moment it lands in its table, so the
+        # span is controller-commit (WatchEvent.ts, stamped by
+        # RamStore.apply / carried over the wire) -> table apply.
+        # Unstamped events (resync replays) are excluded and METERED,
+        # never guessed into the histogram.
+        self.realization_hist = Histogram()
+        self.realization_unstamped = 0
+
+    def _observe_realization(self, ev: WatchEvent) -> None:
+        if ev.ts:
+            self.realization_hist.observe(
+                max(0.0, time.monotonic() - ev.ts))
+        else:
+            self.realization_unstamped += 1
 
     def realized_generations(self) -> dict:
         return {
@@ -57,6 +76,7 @@ class _AgentTables:
         )
 
     def _apply(self, ev: WatchEvent) -> None:
+        self._observe_realization(ev)
         table = dict(self._tables())[ev.obj_type]
         if ev.kind == "DELETED":
             table.pop(ev.name, None)
@@ -246,6 +266,23 @@ class FakeAgentFleet:
 
     def total_events(self) -> int:
         return sum(a.events_seen for a in self.agents.values())
+
+    def realization_hist(self) -> Histogram:
+        """Fleet-wide realization-latency histogram (per-agent bucket
+        counts folded into one bucket space)."""
+        merged = Histogram()
+        for a in self.agents.values():
+            merged.merge(a.realization_hist)
+        return merged
+
+    def realization_p99_s(self) -> float:
+        """Fleet-wide p99 of controller-commit -> agent-realized latency
+        — the measurable form of ROADMAP item 3's soak target (upper-
+        bound bucket estimate; 0.0 before any stamped event)."""
+        return self.realization_hist().quantile(0.99)
+
+    def realization_unstamped_total(self) -> int:
+        return sum(a.realization_unstamped for a in self.agents.values())
 
     def policies_on(self, node: str) -> set:
         return set(self.agents[node].policies)

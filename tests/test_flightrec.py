@@ -1,0 +1,731 @@
+"""Realization tracing + flight recorder (ISSUE 8 tentpole).
+
+The acceptance bar: an end-to-end realization span for a policy churn
+event covers controller-commit -> first live hit with every stage >= 0
+and the stages summing EXACTLY to the end-to-end latency, on both
+engines (oracle parity of the span STRUCTURE); the PR 4
+miscompile-rollback and PR 5 cache-corruption chaos cases are
+reconstructable from the flight recorder ALONE (full causal chain in
+sequence order: injected fault -> canary mismatch -> rollback ->
+degrade -> recompile -> recover); the ring drops OLDEST under overflow
+and never blocks; fast-path step HLO is bit-identical with the plane
+enabled; the API/antctl/supportbundle surfaces serve it; and
+tools/check_events.py + tools/check_metrics.py hold the schema, the
+emit sites, the stage labels and the README tables together.
+"""
+
+import itertools
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from antrea_tpu.apis import controlplane as cp
+from antrea_tpu.apis.service import Endpoint, ServiceEntry
+from antrea_tpu.compiler.ir import PolicySet
+from antrea_tpu.controller.networkpolicy import WatchEvent
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.datapath.commit import CanaryMismatchError
+from antrea_tpu.dissemination import FaultPlan
+from antrea_tpu.dissemination.faults import FlakyDatapath
+from antrea_tpu.dissemination.store import RamStore
+from antrea_tpu.observability.flightrec import EVENT_KINDS, FlightRecorder
+from antrea_tpu.observability.metrics import (render_dissemination_metrics,
+                                              render_metrics)
+from antrea_tpu.observability.tracing import (REALIZATION_STAGES,
+                                              RealizationTracer)
+from antrea_tpu.oracle import Oracle
+from antrea_tpu.packet import Packet, PacketBatch
+from antrea_tpu.utils import ip as iputil
+
+CLIENT, SRV, BLOCKED = "10.0.1.1", "10.0.0.10", "10.0.9.9"
+VIP = "10.96.0.1"
+
+_NOW = itertools.count(1000)
+_SPORT = itertools.count(42000)
+
+SMALL = dict(flow_slots=1 << 8, aff_slots=1 << 4)
+
+
+def _world(cidr: str = "192.0.2.0/24", uid: str = "p1", gen: int = 1):
+    ps = PolicySet(
+        policies=[cp.NetworkPolicy(
+            uid=uid, name=uid, type=cp.NetworkPolicyType.ACNP,
+            generation=gen,
+            rules=[cp.NetworkPolicyRule(
+                direction=cp.Direction.IN,
+                from_peer=cp.NetworkPolicyPeer(
+                    address_groups=["blocked"],
+                    ip_blocks=[cp.IPBlock(cidr=cidr)]),
+                action=cp.RuleAction.DROP, priority=0)],
+            applied_to_groups=["web"], tier_priority=250, priority=1.0)],
+        address_groups={"blocked": cp.AddressGroup(
+            name="blocked", members=[cp.GroupMember(ip=BLOCKED)])},
+        applied_to_groups={"web": cp.AppliedToGroup(
+            name="web", members=[cp.GroupMember(ip=SRV)])},
+    )
+    svcs = [ServiceEntry(cluster_ip=VIP, port=80, protocol=6, name="web",
+                         namespace="default",
+                         endpoints=[Endpoint(ip=SRV, port=8080)])]
+    return ps, svcs
+
+
+def _dp(dp_cls, ps=None, svcs=None, **kw):
+    if dp_cls is TpuflowDatapath:
+        kw.setdefault("miss_chunk", 16)
+    return dp_cls(ps, svcs, **SMALL, **kw)
+
+
+def _fresh(src, dst=SRV, dport=80):
+    return Packet(src_ip=iputil.ip_to_u32(src), dst_ip=iputil.ip_to_u32(dst),
+                  proto=6, src_port=next(_SPORT), dst_port=dport)
+
+
+def _fresh_parity(dp, ps, srcs=(BLOCKED, "192.0.2.7", CLIENT)) -> int:
+    now = next(_NOW)
+    pkts = [_fresh(s) for s in srcs]
+    got = dp.step(PacketBatch.from_packets(pkts), now).code
+    oracle = Oracle(ps)
+    return sum(int(got[i]) != int(oracle.classify(p).code)
+               for i, p in enumerate(pkts))
+
+
+def _assert_chain(events: list, chain: list) -> list:
+    """Assert `chain` — [(label, predicate)] — is a SUBSEQUENCE of the
+    journal in sequence order; returns the matched events."""
+    assert events == sorted(events, key=lambda e: e["seq"])
+    matched, i = [], 0
+    for label, pred in chain:
+        while i < len(events) and not pred(events[i]):
+            i += 1
+        assert i < len(events), (
+            f"causal chain broken: no {label!r} after "
+            f"{[m['kind'] for m in matched]} in "
+            f"{[(e['seq'], e['kind']) for e in events]}")
+        matched.append(events[i])
+        i += 1
+    return matched
+
+
+# ---------------------------------------------------------------------------
+# Ring journal semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_drop_oldest_under_overflow():
+    """Overflow loses the OLDEST telemetry (drop-oldest, metered), never
+    the newest, never blocking; seq stays monotonic across the wrap and
+    per-kind counters survive it."""
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.emit(kind="epoch-swap", epoch=i)
+    ev = rec.events()
+    assert [e["ts"] for e in ev] == [0] * 8  # no clock wired yet
+    assert [e["seq"] for e in ev] == list(range(12, 20))  # newest 8 kept
+    assert [e["epoch"] for e in ev] == list(range(12, 20))
+    st = rec.stats()
+    assert st["dropped_total"] == 12 and st["seq"] == 20
+    assert st["retained"] == 8
+    assert st["kinds"]["epoch-swap"] == 20  # counter survives the wrap
+    # tail/kind filters compose; tail=0 means NO events, not all of them
+    # (a stats-only probe must not pull a full journal dump).
+    assert [e["seq"] for e in rec.events(tail=3)] == [17, 18, 19]
+    assert rec.events(tail=0) == [] and rec.events(tail=-2) == []
+    assert rec.events(kind="rollback") == []
+
+
+def test_emit_rejects_undeclared_kind_and_disabled_capacity():
+    rec = FlightRecorder(capacity=4)
+    with pytest.raises(ValueError, match="undeclared"):
+        rec.emit(kind="not-a-kind")
+    off = FlightRecorder(capacity=0)
+    off.emit(kind="rollback")
+    assert off.events() == [] and off.stats()["seq"] == 1
+
+
+def test_recorder_timebase_is_the_maintenance_tick_clock():
+    """Events stamp with the scheduler's tick clock — fault-injected
+    time (FaultClock) drives the journal deterministically."""
+    from antrea_tpu.dissemination.faults import FaultClock
+
+    clk = FaultClock(start=50)
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, maint_clock=clk)
+    dp.maintenance_tick()
+    clk.advance(25)
+    dp.maintenance_tick()
+    ticks = dp.flightrecorder_events(kind="maint-tick")
+    assert [e["ts"] for e in ticks] == [50, 75]
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end realization span (acceptance: stages >= 0, exact sum,
+# span-structure parity across engines)
+# ---------------------------------------------------------------------------
+
+
+def _drive_realization(dp_cls):
+    """One policy churn event through store -> agent -> datapath -> live
+    traffic; returns the closed span."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+
+    store = RamStore()
+    dp = _dp(dp_cls)
+    agent = AgentPolicyController("n1", dp, store)
+    ps, svcs = _world()
+    dp.install_bundle(services=svcs)
+    store.apply(WatchEvent(
+        kind="ADDED", obj_type="AppliedToGroup", name="web",
+        obj=ps.applied_to_groups["web"], span={"n1"}))
+    store.apply(WatchEvent(
+        kind="ADDED", obj_type="AddressGroup", name="blocked",
+        obj=ps.address_groups["blocked"], span={"n1"}))
+    store.apply(WatchEvent(
+        kind="ADDED", obj_type="NetworkPolicy", name="p1",
+        obj=ps.policies[0], span={"n1"}))
+    agent.sync()
+    tr = dp.realization_tracer
+    assert tr.stats()["awaiting_first_hit"] == 1  # bound, not yet hit
+    # First LIVE packet on the new generation closes the span.
+    out = dp.step(PacketBatch.from_packets([_fresh(BLOCKED)]), next(_NOW))
+    assert int(out.code[0]) == 1  # the policy is really enforced
+    spans = tr.spans(uid="p1")
+    assert len(spans) == 1 and spans[0]["state"] == "closed"
+    return spans[0], tr, dp
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_realization_span_end_to_end(dp_cls):
+    span, tr, dp = _drive_realization(dp_cls)
+    assert span["generation"] == 1  # the spec generation the event carried
+    assert span["bundle_generation"] == dp.generation
+    stages = span["stages_s"]
+    assert tuple(stages) == REALIZATION_STAGES  # order AND completeness
+    assert all(v >= 0.0 for v in stages.values())
+    # The telescoping invariant: stages sum EXACTLY to the end-to-end
+    # latency (monotonic clamping happens at stamp time, not at diff
+    # time, so no tolerance beyond float addition is needed).
+    assert sum(stages.values()) == pytest.approx(span["total_s"], abs=1e-9)
+    st = tr.stats()
+    assert st["spans_closed_total"] == 1 and st["p99_s"] is not None
+    # Every stage observed into the histograms exactly once.
+    for s in (*REALIZATION_STAGES, "total"):
+        assert tr.hist[s].count == 1
+    # The journal closes the loop: one `realization` event, after the
+    # commit's settle event, in sequence order.
+    _assert_chain(dp.flightrecorder_events(), [
+        ("commit", lambda e: e["kind"] == "commit"
+         and e["outcome"] == "ok"),
+        ("agent-sync", lambda e: e["kind"] == "agent-sync"
+         and e["outcome"] == "ok"),
+        ("realization", lambda e: e["kind"] == "realization"
+         and e["uid"] == "p1"),
+    ])
+
+
+def test_span_structure_oracle_parity():
+    """The span STRUCTURE — stage names, order, lifecycle states — is
+    identical across the two engines (acceptance criterion)."""
+    span_o, _tr, _dp = _drive_realization(OracleDatapath)
+    span_t, _tr2, _dp2 = _drive_realization(TpuflowDatapath)
+    assert list(span_o["stages_s"]) == list(span_t["stages_s"])
+    assert (set(span_o) - {"closed_at"}) == (set(span_t) - {"closed_at"})
+    assert span_o["state"] == span_t["state"] == "closed"
+
+
+def test_retries_extend_queue_wait_not_restart_it():
+    """An install that fails and retries must LENGTHEN the span (earliest
+    controller stamp wins; the successful commit's stamps bind) — the
+    honest realization latency the histogram contract promises."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+
+    dp = _dp(OracleDatapath)
+    plan = FaultPlan()
+    flaky = FlakyDatapath(dp, plan, "n1")
+    agent = AgentPolicyController("n1", flaky, None,
+                                  retry_backoff_base=0.0)
+    ps, _svcs = _world()
+    plan.after("n1.install", 0, "fail", times=1)
+    t0 = dp.realization_tracer.now()
+    agent.handle_event(WatchEvent(
+        kind="ADDED", obj_type="NetworkPolicy", name="p1",
+        obj=ps.policies[0], span={"n1"}, ts=t0))
+    agent.sync()  # injected failure: span stays pending
+    assert agent.sync_failures_total == 1
+    assert dp.realization_tracer.stats()["pending"] == 1
+    agent.sync()  # retry succeeds
+    dp.step(PacketBatch.from_packets([_fresh(CLIENT)]), next(_NOW))
+    [span] = dp.realization_tracer.spans(uid="p1")
+    assert span["state"] == "closed"
+    assert span["controller_ts"] == t0  # the ORIGINAL commit stamp held
+
+
+def test_unstamped_events_metered_not_guessed():
+    """ts=0 events (resync replays) never open spans or observe into the
+    histograms — they are counted (the README failure-model row)."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+
+    dp = _dp(OracleDatapath)
+    agent = AgentPolicyController("n1", dp, None)
+    ps, _svcs = _world()
+    agent.handle_event(WatchEvent(
+        kind="ADDED", obj_type="NetworkPolicy", name="p1",
+        obj=ps.policies[0], span={"n1"}))  # ts=0.0
+    st = dp.realization_tracer.stats()
+    assert st["unstamped_total"] == 1 and st["pending"] == 0
+    agent.sync()
+    assert dp.realization_tracer.hist["total"].count == 0
+
+
+def test_pending_stamp_cap_truncation_metered():
+    """Satellite: stamps past the 4096 _pending_ts cap used to vanish
+    silently; now they count into realization_stamps_dropped_total and
+    the counter renders per node."""
+    from antrea_tpu.agent.controller import AgentPolicyController
+
+    dp = _dp(OracleDatapath)
+    agent = AgentPolicyController("n1", dp, None)
+    agent._pending_ts_cap = 4
+    ps, _svcs = _world()
+    for i in range(7):
+        agent.handle_event(WatchEvent(
+            kind="UPDATED", obj_type="NetworkPolicy", name="p1",
+            obj=ps.policies[0], span={"n1"}, ts=1.0 + i))
+    assert len(agent._pending_ts) == 4  # oldest kept: worst-case latency
+    assert agent.realization_stamps_dropped_total == 3
+    text = render_dissemination_metrics(agents=[agent])
+    assert ('antrea_tpu_realization_stamps_dropped_total{node="n1"} 3'
+            in text)
+
+
+def test_readded_policy_opens_new_span():
+    """A deleted-then-re-added policy restarts its spec generation at 1
+    (controller lifetime semantics), so the key (uid, 1) collides with
+    the CLOSED span of the previous lifetime.  The new realization must
+    still be traced — only true re-deliveries (controller stamp at or
+    before the close) of an already-closed realization are ignored."""
+    tr = RealizationTracer()
+
+    def realize(ts, gen_bundle):
+        tr.policy_event("p1", 1, ts=ts)
+        tr.commit_begin()
+        for s in ("compile", "canary", "swap", "settle"):
+            tr.commit_stage(s)
+        tr.commit_done(gen=gen_bundle)
+        tr.realized()
+        tr.first_hit(gen_bundle, batch_size=1)
+
+    realize(tr.now(), 1)
+    assert tr.spans_closed_total == 1
+    closed_at = tr.spans(uid="p1")[0]["closed_at"]
+    # A re-delivery of the SAME realization (stamp predates the close)
+    # stays ignored.
+    tr.policy_event("p1", 1, ts=closed_at - 1e-6)
+    assert tr.stats()["pending"] == 0
+    # The re-add: a fresh controller stamp AFTER the close opens a new
+    # span for the new lifetime, retiring the old closed entry.
+    realize(tr.now(), 2)
+    assert tr.spans_closed_total == 2
+    spans = tr.spans(uid="p1")
+    assert len(spans) == 1 and spans[0]["bundle_generation"] == 2
+
+
+def test_readded_policy_while_awaiting_first_hit():
+    """uid reuse while the OLD lifetime's span still awaits its first
+    live hit: the stale span is retired METERED (its first-hit would
+    belong to the new lifetime) and the new realization is traced."""
+    tr = RealizationTracer()
+
+    def commit(gen):
+        tr.commit_begin()
+        for s in ("compile", "canary", "swap", "settle"):
+            tr.commit_stage(s)
+        tr.commit_done(gen=gen)
+
+    t0 = tr.now()
+    tr.policy_event("p1", 1, ts=t0)
+    commit(1)
+    tr.realized()  # no live traffic yet: span awaits first hit
+    assert tr.stats()["awaiting_first_hit"] == 1
+    tr.policy_event("p1", 1, ts=t0)  # re-delivery: still just in flight
+    assert tr.stats()["pending"] == 0
+    tr.policy_event("p1", 1, ts=tr.now())  # the re-add's fresh stamp
+    st = tr.stats()
+    assert st["awaiting_first_hit"] == 0 and st["pending"] == 1
+    assert st["spans_dropped_total"] == 1
+    commit(2)
+    tr.realized()
+    tr.first_hit(2, batch_size=1)
+    spans = tr.spans(uid="p1")
+    assert len(spans) == 1 and spans[0]["state"] == "closed"
+    assert spans[0]["bundle_generation"] == 2
+
+
+def test_settle_failure_journaled_and_commit_aborted():
+    """A settle-stage persistence failure must journal like every other
+    failed commit stage (the 'deciding stage' contract) and abort the
+    tracer's open transaction so the retry's stamps bind cleanly."""
+    dp = _dp(OracleDatapath)
+    ps, svcs = _world()
+    dp.install_bundle(ps, svcs)
+
+    def boom():
+        raise IOError("disk full")
+
+    dp._persist = boom
+    ps2, _svcs = _world(gen=2)
+    with pytest.raises(IOError):
+        dp.install_bundle(ps2, svcs)
+    errs = [e for e in dp.flightrecorder_events(kind="commit")
+            if e["outcome"] == "error"]
+    assert errs and errs[-1]["stage"] == "settle"
+    assert dp.realization_tracer._open_commit is None
+
+
+def test_span_table_bounded_drop_oldest():
+    """The tracer's tables are bounded: overflow drops the OLDEST span,
+    metered — never unbounded memory, never silent."""
+    tr = RealizationTracer(span_slots=4, pending_slots=4)
+    for i in range(6):
+        tr.policy_event(f"p{i}", 1, ts=1.0)
+    st = tr.stats()
+    assert st["pending"] == 4 and st["spans_dropped_total"] == 2
+    # Close spans through a commit + first hit; the CLOSED table caps too.
+    tr.commit_begin()
+    for s in ("compile", "canary", "swap", "settle"):
+        tr.commit_stage(s)
+    tr.commit_done(gen=1)
+    tr.realized()
+    tr.first_hit(1, batch_size=1)
+    assert tr.stats()["closed"] == 4
+    for i in range(6, 9):
+        tr.policy_event(f"p{i}", 2, ts=2.0)
+    tr.commit_begin()
+    tr.commit_stage("settle")
+    tr.commit_done(gen=2)
+    tr.realized()
+    tr.first_hit(2, batch_size=1)
+    st = tr.stats()
+    assert st["closed"] == 4  # drop-oldest kept the table at its cap
+    assert st["spans_closed_total"] == 7
+
+
+# ---------------------------------------------------------------------------
+# Chaos post-mortems: the journal alone reconstructs the causal chain
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp_cls", [OracleDatapath, TpuflowDatapath])
+def test_postmortem_miscompile_rollback_chain(dp_cls):
+    """PR 4 chaos rerun: injected miscompile -> canary blocks -> rollback
+    -> degraded -> recompile passes -> recovered, and the FLIGHT RECORDER
+    ALONE carries that chain in sequence order."""
+    ps_a, _ = _world("192.0.2.0/24")
+    ps_b, _ = _world("198.51.100.0/24")
+    dp = _dp(dp_cls)
+    plan = FaultPlan()
+    dp.arm_commit_faults(plan, "n1")
+    g1 = dp.install_bundle(ps=ps_a)
+
+    plan.after("n1.canary", plan.hits("n1.canary"), "fail", times=1)
+    with pytest.raises(CanaryMismatchError):
+        dp.install_bundle(ps=ps_b)
+    assert dp.generation == g1 and dp.degraded
+    assert _fresh_parity(dp, ps_a) == 0  # LKG keeps serving correctly
+
+    # Fault exhausted: recovery recompiles and recovers.
+    dp.install_bundle(ps=ps_b)
+    assert not dp.degraded and _fresh_parity(dp, ps_b) == 0
+
+    events = dp.flightrecorder_events()
+    matched = _assert_chain(events, [
+        ("fault-injected", lambda e: e["kind"] == "fault-injected"
+         and e["site"] == "n1.canary"),
+        ("canary-mismatch", lambda e: e["kind"] == "canary-mismatch"),
+        ("commit/mismatch", lambda e: e["kind"] == "commit"
+         and e["outcome"] == "mismatch" and e["stage"] == "canary"),
+        ("rollback", lambda e: e["kind"] == "rollback"
+         and e["lkg_generation"] == g1),
+        ("degrade", lambda e: e["kind"] == "degrade"),
+        ("recompile", lambda e: e["kind"] == "commit"
+         and e["outcome"] == "ok" and e["stage"] == "settle"),
+        ("recover", lambda e: e["kind"] == "recover"),
+    ])
+    # The chain is reconstructable from the journal ALONE: every matched
+    # event is typed and ordered by the monotonic seq.
+    assert [m["seq"] for m in matched] == sorted(m["seq"] for m in matched)
+
+
+def test_postmortem_cache_corruption_chain():
+    """PR 5 chaos rerun: injected cache corruption -> audit finding ->
+    repair, journaled in order; with the divergence trip at 1, the
+    escalation ladder (degrade -> recompile -> recover) journals too."""
+    ps, svcs = _world()
+    plan = FaultPlan()
+    dp = FlakyDatapath(_dp(OracleDatapath, ps, svcs), plan, "nX")
+    # Warm one denial entry so the verdict-flip corruption has a victim.
+    den = _fresh(BLOCKED)
+    dp.step(PacketBatch.from_packets([den]), next(_NOW))
+    dp.audit_scan(now=next(_NOW))  # anchor the digests
+
+    plan.after("nX.cache", plan.hits("nX.cache"), "fail", times=1)
+    out = dp.audit_scan(now=next(_NOW))
+    assert out["repaired"] >= 1
+    assert _fresh_parity(dp, ps) == 0
+    _assert_chain(dp.flightrecorder_events(), [
+        ("fault-injected", lambda e: e["kind"] == "fault-injected"
+         and e["site"] == "nX.cache"),
+        ("audit-finding", lambda e: e["kind"] == "audit-finding"),
+        ("audit-repair", lambda e: e["kind"] == "audit-repair"),
+    ])
+
+    # Escalation variant: trip=1 degrades and the canary-gated recompile
+    # recovers — the full PR 4 ladder, reconstructed from the journal.
+    dp2 = _dp(OracleDatapath, ps, svcs, audit_divergence_trip=1)
+    plan2 = FaultPlan()
+    dp2.arm_audit_faults(plan2, "n2")
+    plan2.after("n2.audit", plan2.hits("n2.audit"), "fail", times=1)
+    out = dp2.audit_scan(now=next(_NOW))
+    assert out["recovered"] and not dp2.degraded
+    _assert_chain(dp2.flightrecorder_events(), [
+        ("fault-injected", lambda e: e["kind"] == "fault-injected"
+         and e["site"] == "n2.audit"),
+        ("audit-finding", lambda e: e["kind"] == "audit-finding"
+         and e["injected"] == 1),
+        ("degrade", lambda e: e["kind"] == "degrade"
+         and "divergence" in e["reason"]),
+        ("recompile", lambda e: e["kind"] == "commit"
+         and e["outcome"] == "ok"),
+        ("recover", lambda e: e["kind"] == "recover"),
+    ])
+
+
+def test_slowpath_events_overflow_drain_epoch():
+    """The slow-path emit sites: admission overflow, drain begin/finish,
+    epoch swap — journaled with queue state attached."""
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs, async_slowpath=True,
+             miss_queue_slots=4, drain_batch=4)
+    now = next(_NOW)
+    pkts = [_fresh(CLIENT, dst=SRV, dport=80) for _ in range(8)]
+    dp.step(PacketBatch.from_packets(pkts), now)  # 8 misses into 4 slots
+    assert dp.slowpath_stats()["overflows_total"] > 0
+    dp.drain_slowpath(next(_NOW))
+    ev = dp.flightrecorder_events()
+    _assert_chain(ev, [
+        ("queue-overflow", lambda e: e["kind"] == "queue-overflow"
+         and e["dropped"] > 0),
+        ("drain-begin", lambda e: e["kind"] == "drain-begin"
+         and e["n"] == 4),
+        ("drain-finish", lambda e: e["kind"] == "drain-finish"
+         and e["drained"] == 4),
+        ("epoch-swap", lambda e: e["kind"] == "epoch-swap"),
+    ])
+
+
+def test_maintenance_tick_and_observability_task_accounting():
+    """Ticks journal their grants/sheds; the `observability` task spends
+    the recording cost (events + stamps since its last grant) so the
+    plane's overhead is visible in the scheduler accounting, not
+    smeared."""
+    ps, svcs = _world()
+    dp = _dp(OracleDatapath, ps, svcs)
+    dp.install_bundle(ps=ps)  # journal some events -> recording cost
+    out = dp.maintenance_tick(now=next(_NOW))
+    assert out["ran"].get("observability", 0) > 0
+    st = dp.maintenance_stats()["tasks"]["observability"]
+    assert st["spent_total"] > 0
+    ticks = dp.flightrecorder_events(kind="maint-tick")
+    assert ticks and "observability" in ticks[-1]["ran"]
+    # A blocked tick journals as maint-blocked.
+    dpa = _dp(OracleDatapath, ps, svcs, async_slowpath=True,
+              miss_queue_slots=32, drain_batch=4)
+    dpa.step(PacketBatch.from_packets([_fresh(CLIENT)]), next(_NOW))
+    assert dpa._slowpath.begin_drain(next(_NOW))
+    blocked = dpa.maintenance_tick(now=next(_NOW))
+    assert blocked["blocked"] == "inflight-drain"
+    assert dpa.flightrecorder_events(kind="maint-blocked")
+    dpa._slowpath.finish_drain(next(_NOW))
+
+
+# ---------------------------------------------------------------------------
+# Hot path unharmed: HLO bit-identity with the plane enabled
+# ---------------------------------------------------------------------------
+
+
+def test_step_hlo_bit_identical_with_tracing_enabled():
+    """The whole plane is host-side: a tracing+recording twin lowers the
+    compiled step to byte-identical HLO vs a disabled twin, before AND
+    after spans close and events journal."""
+    import jax.numpy as jnp
+
+    from antrea_tpu.models import pipeline as pl
+
+    ps, svcs = _world()
+    a = _dp(TpuflowDatapath, ps, svcs)  # plane enabled (defaults)
+    b = _dp(TpuflowDatapath, ps, svcs, flightrec_slots=0,
+            realization_slots=0)
+    assert a._flightrec is not None and b._flightrec is None
+    assert a._meta_step == b._meta_step
+
+    def lower_text(dp):
+        z = np.zeros(4, np.int32)
+        return pl.pipeline_step.lower(
+            dp._state, dp._drs, dp._dsvc,
+            jnp.asarray(z), jnp.asarray(z), jnp.asarray(z),
+            jnp.asarray(z), jnp.asarray(z),
+            jnp.int32(0), jnp.int32(0), meta=dp._meta_step,
+        ).as_text()
+
+    before = lower_text(a)
+    assert before == lower_text(b)
+    # Exercise the plane: install (journal + span stamps) + live steps
+    # (the first-hit latch) + a tick (the observability task).
+    a.install_bundle(ps=ps)
+    a.step(PacketBatch.from_packets([_fresh(BLOCKED)]), next(_NOW))
+    a.maintenance_tick(now=next(_NOW))
+    assert a._flightrec.seq > 0
+    assert lower_text(a) == before
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: API routes, antctl tables, support bundle, metrics, tooling
+# ---------------------------------------------------------------------------
+
+
+def test_api_routes_antctl_metrics_bundle(capsys, tmp_path):
+    """GET /realization?uid= and GET /flightrecorder?tail=&kind= serve
+    the plane; antctl renders tables; the support bundle carries
+    flightrecorder.json + realization.json; the families render."""
+    import tarfile
+    import urllib.request
+
+    from antrea_tpu.agent.apiserver import AgentApiServer
+    from antrea_tpu.antctl import main as antctl_main
+    from antrea_tpu.observability.supportbundle import collect_bundle
+
+    span, _tr, dp = _drive_realization(OracleDatapath)
+    srv = AgentApiServer(dp, node="n1").start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            srv.address + "/realization?uid=p1").read())
+        assert body["stages"] == list(REALIZATION_STAGES)
+        assert len(body["spans"]) == 1
+        assert body["spans"][0]["total_s"] == pytest.approx(span["total_s"])
+        assert json.loads(urllib.request.urlopen(
+            srv.address + "/realization?uid=nope").read())["spans"] == []
+
+        fr = json.loads(urllib.request.urlopen(
+            srv.address + "/flightrecorder?tail=2").read())
+        assert len(fr["events"]) == 2 and fr["seq"] >= 2
+        only = json.loads(urllib.request.urlopen(
+            srv.address + "/flightrecorder?kind=realization").read())
+        assert {e["kind"] for e in only["events"]} == {"realization"}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                srv.address + "/flightrecorder?kind=bogus")
+        assert ei.value.code == 400
+
+        rc = antctl_main(["realization", "--server", srv.address,
+                          "--uid", "p1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "UID" in out and "FIRST_HIT" in out and "p1" in out
+
+        rc = antctl_main(["flightrecorder", "--server", srv.address,
+                          "--tail", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "SEQ" in out and "KIND" in out
+
+        rc = antctl_main(["flightrecorder", "--server", srv.address,
+                          "--json"])
+        assert rc == 0
+        assert "events" in json.loads(capsys.readouterr().out)
+    finally:
+        srv.close()
+
+    text = render_metrics(dp, node="n1")
+    for fam in ("antrea_tpu_policy_realization_seconds",
+                "antrea_tpu_realization_spans",
+                "antrea_tpu_realization_spans_dropped_total",
+                "antrea_tpu_flightrecorder_events_total",
+                "antrea_tpu_flightrecorder_dropped_total",
+                "antrea_tpu_flightrecorder_seq"):
+        assert fam in text, fam
+    assert 'stage="first_hit"' in text and 'kind="realization"' in text
+
+    out_tar = tmp_path / "bundle.tar.gz"
+    members = collect_bundle(dp, str(out_tar), node="n1")
+    assert {"flightrecorder.json", "realization.json"} <= set(members)
+    with tarfile.open(out_tar) as tar:
+        frj = json.load(tar.extractfile("flightrecorder.json"))
+        rzj = json.load(tar.extractfile("realization.json"))
+    assert frj["seq"] == dp.flightrecorder_stats()["seq"]
+    assert len(frj["events"]) == frj["retained"]
+    assert any(sp["uid"] == "p1" for sp in rzj["spans"])
+
+
+def test_routes_404_without_the_plane():
+    import urllib.request
+
+    from antrea_tpu.agent.apiserver import AgentApiServer
+
+    dp = _dp(OracleDatapath, flightrec_slots=0, realization_slots=0)
+    srv = AgentApiServer(dp, node="n1").start()
+    try:
+        for route in ("/realization", "/flightrecorder"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.address + route)
+            assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_fleet_realization_p99_plumbing():
+    """simulator/fleet.py carries the span plumbing: stamped events land
+    in per-agent histograms, unstamped resync replays are metered out,
+    and the fleet-wide p99 folds one bucket space."""
+    from antrea_tpu.simulator.fleet import FakeAgentFleet
+
+    store = RamStore()
+    fleet = FakeAgentFleet(store, ["n1", "n2"])
+    ps, _svcs = _world()
+    store.apply(WatchEvent(
+        kind="ADDED", obj_type="NetworkPolicy", name="p1",
+        obj=ps.policies[0], span={"n1", "n2"}))
+    assert fleet.pump() == 2
+    assert fleet.realization_hist().count == 2
+    assert fleet.realization_p99_s() > 0.0
+    # An unstamped replay (watcher overflow -> resync) meters, never
+    # observes: the p99 is honest about what it measured.
+    before = fleet.realization_hist().count
+    fleet.agents["n1"]._apply(WatchEvent(
+        kind="ADDED", obj_type="NetworkPolicy", name="p2",
+        obj=ps.policies[0], span={"n1"}))  # ts=0.0
+    assert fleet.realization_hist().count == before
+    assert fleet.realization_unstamped_total() == 1
+
+
+def test_check_events_tool_runs_clean():
+    """tools/check_events.py (satellite: schema/emit/README drift gate,
+    tier-1 via this module) passes on the tree as committed."""
+    tool = (Path(__file__).resolve().parent.parent / "tools"
+            / "check_events.py")
+    res = subprocess.run([sys.executable, str(tool)], capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "consistent" in res.stdout
+
+
+def test_event_kinds_schema_is_complete():
+    """Every kind the journal can carry is declared with an owning
+    plane; the schema is a pure literal (check_events parses it
+    dependency-free)."""
+    assert len(EVENT_KINDS) >= 18
+    for kind, desc in EVENT_KINDS.items():
+        assert kind == kind.lower() and " " not in kind
+        assert isinstance(desc, str) and desc.strip()

@@ -317,12 +317,13 @@ def test_engines_register_the_consolidated_task_set(dp_cls):
     ps, svcs = _world()
     dp = _dp(dp_cls, ps, svcs)
     assert set(dp.maintenance.task_names) == {
-        "canary", "audit-cursor", "tensor-scrub", "degraded-recompile"}
+        "canary", "audit-cursor", "tensor-scrub", "degraded-recompile",
+        "observability"}
     dpa = _dp(dp_cls, ps, svcs, async_slowpath=True, miss_queue_slots=32,
               drain_batch=16)
     assert set(dpa.maintenance.task_names) == {
         "canary", "audit-cursor", "tensor-scrub", "degraded-recompile",
-        "cache-maintain"}
+        "cache-maintain", "observability"}
     # Every name is in the parseable inventory (tools/check_maintenance).
     assert set(dpa.maintenance.task_names) | {"fqdn-ttl"} == set(MAINT_TASKS)
     out = dpa.maintenance_tick(now=next(_NOW))
