@@ -113,6 +113,10 @@ class CommitPlane:
         self.rollbacks_total = 0
         self.canary_probes_total = 0
         self.canary_mismatches_total = 0
+        # Replica-resolved mismatches (mesh engines only; see _canary):
+        # data-replica id -> mismatch records attributed to it.  Empty
+        # forever on single-chip owners.
+        self.replica_mismatches: Counter = Counter()
         self.quarantined_total = 0
         # Commit sequence: drives fresh probe src_ports (a canary round
         # must never re-probe a 5-tuple an earlier round used).
@@ -379,6 +383,7 @@ class CommitPlane:
         self.seq += 1
         forced = self._fire_canary_fault()
         mism: list[dict] = []
+        bad_probes: set[int] = set()
         pkts: list[Packet] = []
         if self.probes > 0:
             fronts = self._frontend_keys()
@@ -406,19 +411,48 @@ class CommitPlane:
             ))
             oracle = Oracle(o._ps)
             self.canary_probes_total += n_real
-            for i, p in enumerate(pkts[:n_real]):
-                want = int(oracle.classify(p).code)
-                if int(got[i]) != want:
-                    mism.append({
+            # Replica-resolved canaries (the mesh engine) return a
+            # (replicas, probes) verdict MATRIX — every data replica
+            # classified the same probe set on its own devices.  Each
+            # replica row is held to the Oracle independently: ONE
+            # replica's divergence is a full veto (the caller's rollback
+            # restores the sharded snapshot, i.e. every replica).
+            # Single-chip engines return the classic (probes,) vector.
+            replicated = got.ndim == 2
+            views = got if replicated else got[None, :]
+            wants = [int(oracle.classify(p).code) for p in pkts[:n_real]]
+            for r in range(views.shape[0]):
+                for i, want in enumerate(wants):
+                    if int(views[r, i]) == want:
+                        continue
+                    bad_probes.add(i)
+                    p = pkts[i]
+                    rec = {
                         "src": iputil.key_to_ip(p.src_ip),
                         "dst": iputil.key_to_ip(p.dst_ip),
                         "proto": p.proto, "sport": p.src_port,
                         "dport": p.dst_port,
-                        "got": int(got[i]), "want": want,
-                    })
+                        "got": int(views[r, i]), "want": want,
+                    }
+                    if replicated:
+                        rec["replica"] = r
+                    mism.append(rec)
         if forced is not None:
             mism.append({"injected": forced})
-        self.canary_mismatches_total += len(mism)
+        # The legacy counter stays PROBE-deduplicated: a D-replica mesh
+        # misclassifying one probe on every replica yields D mismatch
+        # RECORDS but one bad probe — counting records would make the
+        # same fault read D× the magnitude of a single-chip node on the
+        # fleet scrape.  Per-replica volume lives in replica_mismatches.
+        self.canary_mismatches_total += len(bad_probes) + (
+            1 if forced is not None else 0)
+        vetoed = sorted({rec["replica"] for rec in mism if "replica" in rec})
+        if vetoed:
+            for r in vetoed:
+                self.replica_mismatches[r] += sum(
+                    1 for rec in mism if rec.get("replica") == r)
+            self._emit("replica-canary-veto", replicas=vetoed,
+                       mismatches=len(mism))
         if mism:
             self._emit("canary-mismatch", probes=n_real,
                        mismatches=len(mism),
@@ -482,6 +516,10 @@ class CommitPlane:
             "rollbacks_total": int(self.rollbacks_total),
             "canary_probes_total": int(self.canary_probes_total),
             "canary_mismatches_total": int(self.canary_mismatches_total),
+            # Mesh engines only; {} forever on single-chip owners.
+            "replica_mismatches": {
+                int(r): int(n)
+                for r, n in sorted(self.replica_mismatches.items())},
             "quarantined_deltas_total": int(self.quarantined_total),
             "last_error": self.last_error,
         }

@@ -313,14 +313,20 @@ class Datapath(ABC):
         self._async = async_slowpath
         self._overlap = bool(overlap_commits)
         if async_slowpath:
-            from .slowpath import SlowPathEngine
-
-            self._slowpath = SlowPathEngine(
-                self, capacity=miss_queue_slots, admission=admission,
+            self._slowpath = self._make_slowpath(
+                capacity=miss_queue_slots, admission=admission,
                 drain_batch=drain_batch, autotune=autotune_drain,
                 autotune_bounds=autotune_bounds,
                 overlap_commits=overlap_commits,
             )
+
+    def _make_slowpath(self, **kw):
+        """Engine factory hook: the mesh datapath overrides this to build
+        its per-replica MeshSlowPath instead (parallel/meshpath.py), so
+        exactly ONE engine is ever constructed per datapath."""
+        from .slowpath import SlowPathEngine
+
+        return SlowPathEngine(self, **kw)
 
     @staticmethod
     def _queue_cols(batch: PacketBatch, flags, lens) -> dict:

@@ -173,8 +173,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         # snapshot and resume with a MONOTONIC generation; flow-cache state
         # is dropped (re-classifies, never re-verdicts differently).
         self._init_persist(persist_dir, ps, services)
-        self._state = pl.init_state(flow_slots, aff_slots,
-                                    key_words=10 if dual_stack else 4)
+        self._state = self._init_pipeline_state(flow_slots, aff_slots)
         # Per-rule packet counters (IngressMetric/EgressMetric analog),
         # keyed by stable rule id so they survive bundle renumbering.
         self._stats_in: Counter = Counter()
@@ -218,6 +217,24 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._init_maintenance(maint_budget=maint_budget,
                                maint_clock=maint_clock)
 
+    # -- placement hooks (overridden by the mesh engine, parallel/meshpath) --
+
+    def _init_pipeline_state(self, flow_slots: int, aff_slots: int):
+        """Fresh pipeline state on the engine's device layout (the mesh
+        engine returns the (D,)-leading sharded placement instead)."""
+        return pl.init_state(flow_slots, aff_slots,
+                             key_words=10 if self._dual_stack else 4)
+
+    def _place_rules(self, cps):
+        """Compile -> device rule tensors + match meta on this engine's
+        layout (mesh engine: word-axis padding + sharded placement)."""
+        return to_device(cps, delta_slots=self._delta_slots)
+
+    def _place_services(self, dsvc: pl.DeviceServiceTables):
+        """Device service-table placement hook (mesh engine: replicated
+        NamedSharding on the mesh)."""
+        return dsvc
+
     # -- Datapath ------------------------------------------------------------
 
     @property
@@ -243,9 +260,9 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         staged = list(services) if services is not None else None
         staged_dsvc = None
         if staged is not None:
-            staged_dsvc = pl.svc_to_device(compile_services(
-                staged, node_ips=self._node_ips, node_name=self._node_name
-            ))
+            staged_dsvc = self._place_services(pl.svc_to_device(
+                compile_services(staged, node_ips=self._node_ips,
+                                 node_name=self._node_name)))
         if ps is not None:
             old_in = self._cps.ingress.rule_ids
             old_out = self._cps.egress.rule_ids
@@ -293,11 +310,14 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         r_out = jnp.asarray(remap_arr(old_out, new_out))
         meta = self._state.flow.meta
         _, _, RC, _ = pl._meta_cols(self._meta.key_words - 2)
-        rp = meta[:, RC]
+        # Ellipsis indexing: the rules column is the trailing axis both on
+        # the single-chip (slots+1, 4) layout and the mesh engine's
+        # (D, slots+1, 4) sharded layout.
+        rp = meta[..., RC]
         vi = jnp.clip(rp & 0xFFFF, 0, r_in.shape[0] - 1)
         vo = jnp.clip((rp >> 16) & 0xFFFF, 0, r_out.shape[0] - 1)
         self._state = self._state._replace(flow=self._state.flow._replace(
-            meta=meta.at[:, RC].set(r_in[vi] | (r_out[vo] << 16))
+            meta=meta.at[..., RC].set(r_in[vi] | (r_out[vo] << 16))
         ))
         self._state_mutations += 1
 
@@ -478,8 +498,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
 
-        def unflip(col):
-            return (col.astype(np.int32) ^ np.int32(-(2**31))).astype(np.uint32)
+        unflip = iputil.unflip_u32_array
 
         def keys_of(wide_col):
             """(B, 4) flipped word rows -> per-lane combined keys.
@@ -566,7 +585,12 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         (pkg/agent/flowexporter/connections/conntrack_linux.go).  'Live' =
         within the idle timeout; reply-direction entries carry reply=True
         and their un-DNAT frontend in dnat_ip/dnat_port."""
-        flow = self._state.flow
+        return self._dump_flows_state(self._state, now)
+
+    def _dump_flows_state(self, state: pl.PipelineState, now: int) -> list[dict]:
+        """dump_flows over an explicit state pytree (the mesh engine calls
+        this once per data shard with the shard's local slice)."""
+        flow = state.flow
         keys = np.asarray(flow.keys)[:-1].astype(np.int64)
         meta = np.asarray(flow.meta)[:-1].astype(np.int64)
         ts = np.asarray(flow.ts)[:-1]
@@ -673,6 +697,13 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         re-classified — idempotent by the deterministic endpoint hash."""
         k = len(block["src_ip"])
         D = self._slowpath.drain_batch
+        if k > D:
+            # An explicit begin_drain(n > drain_batch) popped a wider
+            # block: pad to the next power-of-two rung so the whole
+            # block classifies (bounded compile variants) instead of
+            # overflowing the drain_batch-sized lanes and losing the
+            # already-popped rows.
+            D = 1 << (k - 1).bit_length()
 
         def pad(col, dtype=np.int32):
             out = np.zeros(D, dtype)
@@ -690,9 +721,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         # Same no-commit gating the synchronous walk applies
         # (models/forwarding.py): multicast misses classify-but-never-cache,
         # and a FIN/RST-flagged TCP miss never establishes.
-        no_commit = ((dst >> 28) == 0xE) | (
-            (proto == PROTO_TCP) & ((flags & pl._TEARDOWN_FLAGS) != 0)
-        )
+        no_commit = pl.no_commit_mask(dst, proto, flags)
         step_fn = (pl.pipeline_step_donated if self._overlap
                    else pl.pipeline_step)
         state, out = step_fn(
@@ -920,7 +949,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         service list, the compiled topology, and the delta-table host
         mirror.  Pure tensor re-uploads: no XLA recompile, no generation
         change, nothing a caller can observe but the healed bytes."""
-        drs, _match_meta = to_device(self._cps, delta_slots=self._delta_slots)
+        drs, _match_meta = self._place_rules(self._cps)
         self._drs = drs
         self._upload_delta_table()
         self._compile_services()
@@ -965,12 +994,20 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         dead rows are dead to lookups already and carry nothing to
         re-prove.  The window gather runs on device (pl.audit_gather);
         only k rows transfer to the host."""
+        N = self._meta.flow_slots
         keys_d, meta_d, ts_d = pl.audit_gather(
-            self._state, jnp.int32(cursor % self._meta.flow_slots), window=k)
+            self._state, jnp.int32(cursor % N), window=k)
+        return self._decode_audit_rows(keys_d, meta_d, ts_d, now,
+                                       lambda i: (cursor + i) % N)
+
+    def _decode_audit_rows(self, keys_d, meta_d, ts_d, now,
+                           slot_of) -> list[dict]:
+        """Gathered window tensors -> audit row dicts; `slot_of` maps a
+        window-relative index to the row's slot id (the mesh engine maps
+        to GLOBAL striped slot ids, see parallel/meshpath.py)."""
         keys = np.asarray(keys_d).astype(np.int64)
         meta = np.asarray(meta_d).astype(np.int64)
         ts = np.asarray(ts_d)
-        N = self._meta.flow_slots
         A = self._meta.key_words - 2
         DC, M1C, RC, _ZC = pl._meta_cols(A)
         kpg = keys[:, A + 1]
@@ -992,7 +1029,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
                 dst = self._wide_row_key(keys[i, 4:8])
                 dnat = self._wide_row_key(meta[i, 0:4])
             rows.append({
-                "slot": (cursor + int(i)) % N,
+                "slot": slot_of(int(i)),
                 "src": int(src),
                 "dst": int(dst),
                 "proto": pg & 0xFF,
@@ -1020,12 +1057,18 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         compiled tables — the canary's EAGER `_pipeline_trace` machinery
         (audit batch shapes vary per scan, so a jitted probe would pay an
         XLA compile per scan); state untouched."""
+        return self._audit_fresh_state(self._state, rows, now)
+
+    def _audit_fresh_state(self, state: pl.PipelineState, rows: list,
+                           now: int) -> list[dict]:
+        """_audit_fresh over an explicit state pytree (the mesh engine
+        re-proves each row against its home replica's local slice)."""
         pkts = [Packet(src_ip=r["src"], dst_ip=r["dst"], proto=r["proto"],
                        src_port=r["sport"], dst_port=r["dport"])
                 for r in rows]
         batch = PacketBatch.from_packets(pkts)
         o = pl._pipeline_trace(
-            self._state,
+            state,
             self._drs,
             self._dsvc,
             jnp.asarray(iputil.flip_u32(batch.src_ip)),
@@ -1186,8 +1229,14 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         """
         if not self._gates.enabled("Traceflow"):
             raise RuntimeError("Traceflow feature gate is disabled")
+        return self._trace_batch(self._state, batch, now)
+
+    def _trace_batch(self, state: pl.PipelineState, batch: PacketBatch,
+                     now: int) -> list[dict]:
+        """trace() over an explicit state pytree (the mesh engine traces
+        each packet against its home shard's local slice)."""
         o = pl.pipeline_trace(
-            self._state,
+            state,
             self._drs,
             self._dsvc,
             jnp.asarray(iputil.flip_u32(batch.src_ip)),
@@ -1322,7 +1371,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             services=self._services if services is None else services,
         )
         pl.check_rule_capacity(cps)
-        drs, match_meta = to_device(cps, delta_slots=self._delta_slots)
+        drs, match_meta = self._place_rules(cps)
         self._cps = cps
         self._drs = drs
         self._meta = pl.PipelineMeta(
@@ -1411,9 +1460,9 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             self._group_members[name] = c
 
     def _compile_services(self) -> None:
-        self._dsvc = pl.svc_to_device(compile_services(
+        self._dsvc = self._place_services(pl.svc_to_device(compile_services(
             self._services, node_ips=self._node_ips, node_name=self._node_name
-        ))
+        )))
 
     def _compile_topology(self) -> None:
         # Atomic swap, like rule bundles: the next step() sees either the
@@ -1489,7 +1538,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         audit plane's rule-side self-heal (which rebuilds `drs` from the
         compiled set and must re-apply the pending deltas)."""
         h = self._delta_host
-        self._drs = self._drs._replace(ip_delta=DeltaTable(
+        self._drs = self._drs._replace(ip_delta=self._place_delta(DeltaTable(
             lo_f=jnp.asarray(h["lo_f"]),
             hi_f=jnp.asarray(h["hi_f"]),
             sign=jnp.asarray(h["sign"]),
@@ -1502,7 +1551,12 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             fam=jnp.asarray(h["fam"]),
             lo6_w=jnp.asarray(h["lo6_w"]),
             hi6_w=jnp.asarray(h["hi6_w"]),
-        ))
+        )))
+
+    def _place_delta(self, dt: DeltaTable) -> DeltaTable:
+        """Delta-table placement hook (mesh engine: re-place on the mesh
+        with the word-axis specs so incremental uploads stay sharded)."""
+        return dt
 
     def _sync_ps_members(self, name: str) -> None:
         """Keep the held PolicySet's group membership in line with the

@@ -99,6 +99,19 @@ TCP_FIN = 0x01
 TCP_RST = 0x04
 _TEARDOWN_FLAGS = TCP_FIN | TCP_RST
 
+
+def no_commit_mask(dst, proto, flags, xp=np):
+    """Never-cacheable lanes of a v4 miss batch: multicast destinations
+    (conntrack bypass) and FIN/RST-flagged TCP misses (a closing segment
+    is not a new flow).  The ONE host-side commit-gating expression the
+    drain/fast-dispatch paths share — tpuflow and the mesh engine both
+    consume it; the fused device walk derives its own family-aware
+    variant in models/forwarding.py."""
+    return ((xp.asarray(dst) >> 28) == 0xE) | (
+        (xp.asarray(proto) == PROTO_TCP)
+        & ((xp.asarray(flags) & _TEARDOWN_FLAGS) != 0)
+    )
+
 # Slow-path phase bits (PipelineMeta.phases): a PROFILING surface, not a
 # correctness knob — masking a phase substitutes cheap defaults so the
 # on-device cost of each churn-loop section can be isolated by telescoped

@@ -94,6 +94,15 @@ EVENT_KINDS = {
     "fault-injected": "dissemination/faults.py — a FaultPlan rule fired "
                       "(site, fault kind, hit count): chaos cause, "
                       "journaled beside its effects",
+    "mesh-epoch-swap": "parallel/meshpath.py — the mesh datapath "
+                       "published a new flow-cache epoch: one sharded "
+                       "dispatch + one epoch counter flips every data "
+                       "replica's generation atomically (the mesh-wide "
+                       "swap)",
+    "replica-canary-veto": "datapath/commit.py — a replica-resolved "
+                           "canary found >= 1 data replica diverging "
+                           "from the scalar oracle; the single veto "
+                           "rolls back / degrades ALL replicas",
     "realization": "observability/tracing.py — a policy realization span "
                    "closed (controller commit -> first live hit)",
 }

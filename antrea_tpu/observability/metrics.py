@@ -106,6 +106,12 @@ METRICS: dict[str, str] = {
     "antrea_tpu_flightrecorder_events_total": "counter",
     "antrea_tpu_flightrecorder_dropped_total": "counter",
     "antrea_tpu_flightrecorder_seq": "gauge",
+    # multichip datapath (parallel/meshpath.py; rendered when the
+    # datapath exposes mesh_stats()) — shard-labeled families so a pod
+    # slice's per-replica health is scrapeable replica-for-replica
+    "antrea_tpu_replica_miss_queue_depth": "gauge",
+    "antrea_tpu_replica_canary_mismatches_total": "counter",
+    "antrea_tpu_replica_audit_entries_total": "counter",
 }
 
 
@@ -539,6 +545,32 @@ def render_metrics(datapath, node: str = "") -> str:
             ("antrea_tpu_flightrecorder_seq", "seq"),
         ):
             lines += [_type_line(fam), f"{fam}{_labels(node=node)} {fr[key]}"]
+    ms = getattr(datapath, "mesh_stats", None)
+    ms = ms() if ms is not None else None
+    if ms is not None:
+        # Multichip datapath (parallel/meshpath.py): shard-labeled
+        # per-replica families — queue pressure, canary outcomes and
+        # striped-audit volume, replica-for-replica.
+        lines.append(_type_line("antrea_tpu_replica_miss_queue_depth"))
+        for r, depth in enumerate(ms["replica_miss_queue_depth"]):
+            lines.append(
+                f"antrea_tpu_replica_miss_queue_depth"
+                f"{_labels(replica=r, node=node)} {depth}"
+            )
+        lines.append(
+            _type_line("antrea_tpu_replica_canary_mismatches_total"))
+        for r in range(len(ms["replica_miss_queue_depth"])):
+            lines.append(
+                f"antrea_tpu_replica_canary_mismatches_total"
+                f"{_labels(replica=r, node=node)} "
+                f"{ms['replica_canary_mismatches'].get(r, 0)}"
+            )
+        lines.append(_type_line("antrea_tpu_replica_audit_entries_total"))
+        for r, n in enumerate(ms["replica_audit_entries"]):
+            lines.append(
+                f"antrea_tpu_replica_audit_entries_total"
+                f"{_labels(replica=r, node=node)} {n}"
+            )
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

@@ -50,11 +50,13 @@ prove this, hence check_vma=False).
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -63,25 +65,92 @@ from ..compiler.services import ServiceTables
 from ..compiler.topology import ForwardingTables
 from ..models import forwarding as fw
 from ..models import pipeline as pl
+from ..ops import hashing
 from ..ops import match as m
 
 DATA, RULE = "data", "rule"
 
 
-def _shard_map(body, *, mesh, in_specs, out_specs):
-    """jax.shard_map across jax versions: the public alias (with its
-    check_vma kwarg) landed after 0.4.x; older images carry only
-    jax.experimental.shard_map (kwarg check_rep).  Outputs are replicated
-    over ``rule`` by construction, which neither checker can prove —
-    hence the disabled check on both branches (module docstring)."""
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=False)
-    from jax.experimental.shard_map import shard_map as esm
+def _probe_shard_map():
+    """Capability probe (not a version guess): pick the public
+    `jax.shard_map` when the installed jax exposes it, else the
+    experimental module, and discover the replication-check kwarg each
+    actually accepts by SIGNATURE (`check_vma` on newer public builds,
+    `check_rep` before the rename) — a jax upgrade that renames either
+    again degrades to "no check kwarg" instead of a TypeError.
 
-    return esm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               check_rep=False)
+    Why the replication check is disabled at all (the ONE place this is
+    argued): every sharded kernel here combines its per-phase first-match
+    hit tensors with `lax.pmin` over ``rule`` before anything downstream
+    consumes them, so verdicts — and every state update computed from
+    them — are bitwise identical on all rule shards BY CONSTRUCTION.
+    Neither checker can prove replication established through a collective
+    in the body, so both would reject these (correct) programs; the
+    invariant is instead enforced empirically by the parity suites
+    (tests/test_parallel.py, tests/test_mesh_datapath.py), which diff the
+    sharded outputs bit-for-bit against the single-chip kernels.
+
+    -> (implementation name, callable, check kwarg name or None).
+    """
+    sm = getattr(jax, "shard_map", None)
+    name = "jax.shard_map"
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        name = "jax.experimental.shard_map"
+    params = inspect.signature(sm).parameters
+    kw = next((k for k in ("check_vma", "check_rep") if k in params), None)
+    return name, sm, kw
+
+
+#: Which shard_map implementation the probe selected on this image —
+#: asserted by tests/test_mesh_datapath.py so a jax upgrade that moves
+#: the API surfaces loudly instead of silently falling back.
+SHARD_MAP_IMPL, _SHARD_MAP_FN, _SHARD_MAP_CHECK_KW = _probe_shard_map()
+
+
+def _shard_map(body, *, mesh, in_specs, out_specs):
+    """The one shard_map entry point (see _probe_shard_map for both the
+    capability probe and the disabled-replication-check rationale)."""
+    kwargs = {}
+    if _SHARD_MAP_CHECK_KW is not None:
+        kwargs[_SHARD_MAP_CHECK_KW] = False
+    return _SHARD_MAP_FN(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kwargs)
+
+
+# Shard-affinity hash (the multichip traffic path, datapath engine in
+# meshpath.py): a deterministic, direction-SYMMETRIC 5-tuple -> data-shard
+# map, so both conntrack legs of a connection (src/dst and ports swapped)
+# land on the shard that owns the connection's cache entries and
+# direct-mapped-cache semantics stay sound per shard.  The salt is
+# distinct from the cache-slot hash salt on purpose: shard id and slot
+# index must stay decorrelated, or shard r would only ever populate slots
+# ≡ r (mod D) and lose (D-1)/D of its private table.
+SHARD_AFFINITY_SALT = 0x6D657368  # "mesh"
+
+
+def shard_of_tuples(src_ip, dst_ip, proto, sport, dport, n_data: int):
+    """Host-side (numpy) data-shard assignment for a batch of 5-tuples.
+
+    Symmetric under direction reversal: the forward leg (c -> s) and the
+    reply leg (s -> c) hash identically, so non-DNAT connections are
+    fully shard-affine in both directions.  DNAT'd service replies
+    (endpoint -> client; the frontend address is gone from the tuple) can
+    land off-shard and re-classify — the ECMP-asymmetry analog, see the
+    README multichip failure-model row."""
+    with np.errstate(over="ignore"):
+        ea = hashing.fnv_mix(
+            [np.asarray(src_ip), np.asarray(sport)], xp=np)
+        eb = hashing.fnv_mix(
+            [np.asarray(dst_ip), np.asarray(dport)], xp=np)
+        h = hashing.fnv_mix(
+            [np.minimum(ea, eb), np.maximum(ea, eb),
+             np.asarray(proto).astype(np.uint32)
+             ^ np.uint32(SHARD_AFFINITY_SALT)],
+            xp=np,
+        )
+    return (h % np.uint32(n_data)).astype(np.int32)
 
 
 def make_mesh(n_data: int, n_rule: int, devices=None) -> Mesh:
@@ -99,13 +168,24 @@ def make_mesh(n_data: int, n_rule: int, devices=None) -> Mesh:
                 devices = cpus
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    import numpy as np
-
     arr = np.asarray(devices[:need]).reshape(n_data, n_rule)
     return Mesh(arr, (DATA, RULE))
 
 
-# PartitionSpecs for each pytree.
+# PartitionSpecs for each pytree.  EVERY field of every sharded pytree is
+# enumerated explicitly (no `len(fields)` splat): tools/check_mesh.py
+# parses these functions textually and fails the build when a NamedTuple
+# grows a field that has neither an explicit spec below nor a reasoned
+# entry in MESH_SPEC_ALLOWLIST — a new single-chip state field can no
+# longer ship replicated-by-accident.
+
+# Fields deliberately WITHOUT an explicit kwarg in the spec builders,
+# keyed "Class.field" (names collide across the tracked NamedTuples),
+# each with the reason it needs no spec.  Pure literal: tools/
+# check_mesh.py parses it with ast.literal_eval, dependency-free.  Empty
+# today — every field of every sharded pytree is enumerated.
+MESH_SPEC_ALLOWLIST: dict = {}
+
 
 def _drs_specs() -> m.DeviceRuleSet:
     def dim():
@@ -147,12 +227,48 @@ def _drs_specs() -> m.DeviceRuleSet:
 
 
 def _svc_specs() -> pl.DeviceServiceTables:
-    return pl.DeviceServiceTables(*([P()] * len(pl.DeviceServiceTables._fields)))
+    # Service tables are the small, read-mostly side: replicated whole,
+    # every field named so check_mesh.py can prove coverage.
+    return pl.DeviceServiceTables(
+        uip_f=P(),
+        ppk=P(),
+        slot_svc=P(),
+        n_ep=P(),
+        has_ep=P(),
+        aff_timeout=P(),
+        ep_base=P(),
+        ep_ip_f=P(),
+        ep_port=P(),
+        slot_snat=P(),
+        prog_svc=P(),
+        prog_dsr=P(),
+        uip6_w=P(),
+        ppk6=P(),
+        slot_svc6=P(),
+        slot_snat6=P(),
+        ep_ipw_f=P(),
+    )
 
 
 def _state_specs() -> pl.PipelineState:
-    flow = pl.FlowCache(*([P(DATA, None)] * len(pl.FlowCache._fields)))
-    aff = pl.AffinityTable(*([P(DATA, None)] * len(pl.AffinityTable._fields)))
+    # Stateful tables gain a leading (D,) axis sharded over ``data``:
+    # each data shard owns a PRIVATE (slots+1, ...) slice — its own
+    # direct-mapped flow cache and affinity table.
+    flow = pl.FlowCache(
+        keys=P(DATA, None),
+        meta=P(DATA, None),
+        ts=P(DATA, None),
+        pkts=P(DATA, None),
+        octets=P(DATA, None),
+        pkts_hi=P(DATA, None),
+        octets_hi=P(DATA, None),
+    )
+    aff = pl.AffinityTable(
+        key_client=P(DATA, None),
+        key_svc=P(DATA, None),
+        ep=P(DATA, None),
+        ts=P(DATA, None),
+    )
     return pl.PipelineState(flow=flow, aff=aff)
 
 

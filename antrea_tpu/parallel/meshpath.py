@@ -1,0 +1,904 @@
+"""MeshDatapath: the full stateful datapath promoted onto the device mesh.
+
+PR 8 left exactly one sharded component: the stateless classifier
+(parallel/mesh.py).  This module is the multichip serving engine — a
+`TpuflowDatapath` whose EVERY plane runs against the 2-D (data × rule)
+mesh, so a pod slice serves as one fleet of switches (PAPER.md L0: the
+datapath OVS implements in C, scaled the way the reference scales by
+adding nodes):
+
+  stateful fast path   conntrack/affinity tables carry the leading (D,)
+                       axis `parallel/mesh.py` always anticipated; each
+                       data shard owns a PRIVATE slots slice.  A
+                       deterministic, direction-symmetric 5-tuple hash
+                       (`mesh.shard_of_tuples`) routes every packet to
+                       its home shard on the traffic path, so a flow's
+                       entries live in exactly one shard's table and
+                       direct-mapped-cache semantics stay sound per
+                       shard.  Hash-skew overflow lanes "spill" to other
+                       shards with `no_commit` set (never caching
+                       foreign) and then take a bounded HOME-ROUTED
+                       retry dispatch (`_spill_retry`), so skew never
+                       strands an established flow on provisional
+                       verdicts.
+  sharded slow path    one bounded miss queue PER data replica
+                       (`MeshSlowPath`); a drain pops one block per
+                       replica, classifies all of them in ONE sharded
+                       dispatch (each replica's chunk in its own batch
+                       slice), and publishes via a MESH-WIDE epoch swap:
+                       a single epoch counter plus the state pytree
+                       published by the one dispatch means every replica
+                       flips generation atomically.  Re-missed flows
+                       re-enqueue idempotently (the PR 6 lost-update
+                       guard, now spanning shards: the deterministic
+                       endpoint hash makes the re-classification commit
+                       the identical entry in the identical home shard).
+  replica-gated commit the canary classifies its probe set on EVERY data
+                       replica (probes tiled over the data axis inside
+                       shard_map, so each replica's own devices walk
+                       their own table copies) and datapath/commit.py
+                       diffs each replica against the scalar Oracle —
+                       ONE replica's mismatch vetoes the bundle and the
+                       rollback restores the (D,)-sharded snapshot, i.e.
+                       ALL replicas, keeping the PR 4/5 self-healing
+                       ladder provable under sharding.
+  striped audit        the PR 5 audit cursor runs over the GLOBAL slot
+                       space D*S, striped g -> (replica g % D, local
+                       slot g // D), so every scheduler-budgeted window
+                       advances coverage on all replicas simultaneously;
+                       the tensor scrub folds the sharded tensors
+                       logically (one digest covers every shard).
+  rule-axis capacity   `_place_rules` pads + shards the incidence words
+                       over ``rule`` (ops/match.to_device word_multiple)
+                       for the whole pipeline — fast path, drains,
+                       canary and audit fresh-walks all combine hits via
+                       `lax.pmin` over the rule axis, so capacity scales
+                       past 100k rules exactly as the HBM math in
+                       parallel/mesh.py promises.
+
+Everything else — commit/audit/maintenance plane state machines, the
+membership delta bookkeeping, persistence, metrics counting — is
+INHERITED from TpuflowDatapath: the planes were built plane-owner-
+agnostic (PR 7's one-scheduler refactor was precisely for this port).
+
+Known mesh limits (documented, test-pinned):
+  * v4-only (like the async slow path); dual_stack raises ConfigError.
+  * The engine serves the policy/service pipeline; L2/L3 forwarding is
+    stateless per-packet and shards trivially over data
+    (make_sharded_pipeline_full) — it is not routed through this engine,
+    and install_topology raises.
+  * overlap_commits/autotune_drain are single-chip knobs (the mesh drain
+    is already one fused sharded dispatch per replica set).
+  * Incremental group deltas fold into a full recompile (the O(delta)
+    device patch would need per-append word-axis resharding); the delta
+    canary still gates the fold.
+  * DNAT'd service reply legs can land off-shard and re-classify — the
+    ECMP-asymmetry analog; see the README multichip failure-model row.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import ConfigError
+from ..datapath.interface import StepResult
+from ..datapath.slowpath import MissQueue, SlowPathEngine
+from ..datapath.tpuflow import TpuflowDatapath, _rid
+from ..models import pipeline as pl
+from ..ops import match as m
+from ..ops.match import to_device
+from ..packet import PacketBatch
+from ..utils import ip as iputil
+from .mesh import (
+    DATA,
+    RULE,
+    _drs_specs,
+    _pmin_rule,
+    _shard_map,
+    _state_specs,
+    _svc_specs,
+    make_mesh,
+    shard_of_tuples,
+    shard_state,
+)
+
+
+# --------------------------------------------------------------------------
+# Cached compiled kernels.  Keyed by (Mesh, PipelineMeta/StaticMeta) — both
+# hashable — so every MeshDatapath on the same mesh with the same shapes
+# shares ONE jitted program per variant (the jit-identity discipline the
+# single-chip engine gets from module-level pipeline_step): installs that
+# keep rule shapes re-use the compiled step, and the drain has one program
+# per chunk rung, never a recompile storm.  The caches are BOUNDED: rule
+# shapes change across bundle churn (each distinct meta.match retains its
+# compiled executables), so an unbounded cache would grow host+device
+# memory for the agent's whole lifetime; eviction just re-traces on the
+# next use of a long-unseen shape.
+# --------------------------------------------------------------------------
+
+@lru_cache(maxsize=32)
+def _mesh_step_fn(mesh, meta: pl.PipelineMeta):
+    """The sharded stateful step: fast path, drains and sync slow path
+    are all this one builder at different metas (phases / miss_chunk /
+    drain_reclaim), exactly like the single-chip pipeline_step."""
+    lane = P(DATA)
+
+    def body(state, drs, dsvc, src_f, dst_f, proto, sport, dport, now,
+             gen, valid, no_commit, flags, lens):
+        local = jax.tree.map(lambda x: x[0], state)
+        local, out = pl._pipeline_step(
+            local, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
+            meta=meta, hit_combine=_pmin_rule, valid=valid,
+            no_commit=no_commit, flags=flags,
+            lens=lens if meta.count_flow_stats else None,
+        )
+        # scalar per shard -> (D,) vector of per-data-shard counts
+        for k in ("n_miss", "n_evict", "n_reclaim"):
+            out[k] = out[k][None]
+        return jax.tree.map(lambda x: x[None], local), out
+
+    return jax.jit(_shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_state_specs(), _drs_specs(), _svc_specs(),
+                  lane, lane, lane, lane, lane, P(), P(),
+                  lane, lane, lane, lane),
+        out_specs=(_state_specs(), P(DATA)),
+    ))
+
+
+@lru_cache(maxsize=8)
+def _mesh_canary_fn(mesh, match_meta):
+    """Per-replica canary classify: probes tiled over the data axis, each
+    replica's devices walking their own physical table copies; verdicts
+    land (D * n,) and reshape to (D, n) for datapath/commit.py's
+    replica-resolved diff.  One XLA compile per rule-table SHAPE (probes
+    are padded to a fixed lane count by the commit plane, so repeated
+    installs of same-shaped bundles share the program)."""
+    def body(drs, src_f, dst_f, proto, dport):
+        return m.classify_batch(
+            drs, src_f, dst_f, proto, dport, meta=match_meta,
+            hit_combine=_pmin_rule,
+        )["code"]
+
+    return jax.jit(_shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_drs_specs(), P(DATA), P(DATA), P(DATA), P(DATA)),
+        out_specs=P(DATA),
+    ))
+
+
+@lru_cache(maxsize=None)
+def _vmapped_maintain(timeouts):
+    return jax.jit(jax.vmap(partial(pl._maintain_scan, timeouts=timeouts),
+                            in_axes=(0, None, None)))
+
+
+@lru_cache(maxsize=None)
+def _vmapped_revalidate():
+    return jax.jit(jax.vmap(pl._revalidate_scan, in_axes=(0, None)))
+
+
+@lru_cache(maxsize=None)
+def _vmapped_age(timeouts):
+    return jax.jit(jax.vmap(partial(pl._age_scan, timeouts=timeouts),
+                            in_axes=(0, None)))
+
+
+@lru_cache(maxsize=None)
+def _vmapped_cache_stats():
+    return jax.jit(jax.vmap(pl._cache_stats))
+
+
+def _shard_placement(shard: np.ndarray, n_data: int):
+    """Batch lanes -> mesh slots under the shard-affinity hash.
+
+    Every packet whose home shard has free capacity (B / D lanes per
+    shard) lands in its home slice; hash-skew overflow packets SPILL into
+    other shards' free slots and are flagged (the caller classifies them
+    with no_commit, so a foreign shard never caches a stray flow).
+
+    -> (perm, inv, spill): perm maps slot -> packet index, inv maps
+    packet -> slot, spill flags slots holding an off-home packet."""
+    B = shard.size
+    C = B // n_data
+    order = np.argsort(shard, kind="stable")
+    counts = np.bincount(shard, minlength=n_data)
+    bounds = np.concatenate([[0], np.cumsum(counts)])
+    perm = np.empty(B, np.int64)
+    spill = np.zeros(B, bool)
+    leftovers, free = [], []
+    for r in range(n_data):
+        seg = order[bounds[r]:bounds[r + 1]]
+        home = min(seg.size, C)
+        perm[r * C:r * C + home] = seg[:home]
+        if home < C:
+            free.append(np.arange(r * C + home, (r + 1) * C))
+        if seg.size > home:
+            leftovers.append(seg[home:])
+    if leftovers:
+        lv = np.concatenate(leftovers)
+        fs = np.concatenate(free)[:lv.size]  # conservation: |free| == |left|
+        perm[fs] = lv
+        spill[fs] = True
+    inv = np.empty(B, np.int64)
+    inv[perm] = np.arange(B)
+    return perm, inv, spill
+
+
+class _MeshQueueView:
+    """Aggregate read surface over the per-replica miss queues, so the
+    shared Datapath plumbing (dump_miss_queue, trace overlay, stats)
+    keeps its single-queue contract."""
+
+    def __init__(self, queues: list[MissQueue]):
+        self.queues = queues
+
+    @property
+    def depth(self) -> int:
+        return sum(q.depth for q in self.queues)
+
+    @property
+    def capacity(self) -> int:
+        return sum(q.capacity for q in self.queues)
+
+    @property
+    def admitted_total(self) -> int:
+        return sum(q.admitted_total for q in self.queues)
+
+    @property
+    def overflows_total(self) -> int:
+        return sum(q.overflows_total for q in self.queues)
+
+    @property
+    def drained_total(self) -> int:
+        return sum(q.drained_total for q in self.queues)
+
+    def dump(self) -> list[dict]:
+        return [row for q in self.queues for row in q.dump()]
+
+    def contains(self, *tup) -> bool:
+        return any(q.contains(*tup) for q in self.queues)
+
+
+class MeshSlowPath(SlowPathEngine):
+    """Per-replica miss queues + mesh-wide epoch swap.
+
+    One engine, D bounded queues (miss_queue_slots is PER REPLICA).  The
+    epoch plane stays a single counter: a drain classifies one popped
+    block per replica in ONE sharded dispatch and `_publish` bumps that
+    one counter — the mesh-wide swap.  Atomicity is by construction: the
+    next lookup on ANY replica consumes the state pytree that dispatch
+    published, never a mix."""
+
+    def __init__(self, owner, n_data: int, *, capacity: int,
+                 admission: str, drain_batch: int):
+        # capacity=1 seed: the base queue is immediately replaced by the
+        # per-replica set below (its buffer would be dead weight).
+        super().__init__(owner, capacity=1, admission=admission,
+                         drain_batch=drain_batch)
+        self.n_data = int(n_data)
+        self.queues = [MissQueue(capacity) for _ in range(self.n_data)]
+        self.queue = _MeshQueueView(self.queues)
+
+    # -- admission: route by home shard --------------------------------------
+
+    def admit(self, cols: dict, miss_mask, now: int, shard=None):
+        if shard is None:
+            raise ValueError(
+                "mesh admission requires the batch's shard assignment "
+                "(shard_of_tuples ids)")
+        self._seen_now = max(self._seen_now, int(now))
+        if self._published_at == 0:
+            self._published_at = int(now)
+        mask = np.asarray(miss_mask, bool)
+        admitted = dropped = 0
+        for r in range(self.n_data):
+            mr = mask & (np.asarray(shard) == r)
+            if not mr.any():
+                continue
+            a, d = self.queues[r].admit(cols, mr, self.epoch, int(now))
+            admitted += a
+            dropped += d
+            if d:
+                self._emit("queue-overflow", replica=int(r), dropped=int(d),
+                           depth=int(self.queues[r].depth), at=int(now))
+        return admitted, dropped
+
+    # -- epoch plane: the mesh-wide swap -------------------------------------
+
+    def _publish(self, now: int) -> None:
+        self.epoch += 1
+        self._published_at = int(now)
+        self._seen_now = max(self._seen_now, int(now))
+        self._emit("mesh-epoch-swap", epoch=int(self.epoch),
+                   replicas=int(self.n_data), at=int(now))
+
+    # -- drain: one block per replica, one sharded dispatch ------------------
+
+    def begin_drain(self, now: int, n: Optional[int] = None) -> bool:
+        if self._inflight is not None:
+            raise RuntimeError("a drain batch is already in flight")
+        # The popped chunk rides the in-flight record: an explicit n >
+        # drain_batch must size the drain dispatch's per-replica lane
+        # slices too, or one replica's rows would overflow into the
+        # next replica's slice (and its foreign cache).
+        chunk = int(n) if n is not None else self.drain_batch
+        blocks = [q.pop(chunk) for q in self.queues]
+        if all(b is None for b in blocks):
+            return False
+        self._inflight = (blocks, chunk, self.epoch,
+                          int(self.owner.generation))
+        self._seen_now = max(self._seen_now, int(now))
+        self._emit("drain-begin",
+                   n=sum(len(b["src_ip"]) for b in blocks if b is not None),
+                   replicas=sum(b is not None for b in blocks),
+                   epoch=int(self.epoch), gen=int(self.owner.generation))
+        return True
+
+    def finish_drain(self, now: int) -> dict:
+        if self._inflight is None:
+            raise RuntimeError("no drain batch in flight")
+        blocks, chunk, _epoch0, gen0 = self._inflight
+        self._inflight = None
+        k = sum(len(b["src_ip"]) for b in blocks if b is not None)
+        stale = int(self.owner.generation) != gen0
+        if stale:
+            self.stale_reclassified_total += k
+        self.owner._drain_classify(blocks, int(now), chunk=chunk)
+        self.drains_total += 1
+        self.drain_hist.observe(k)
+        self._emit("drain-finish", drained=k,
+                   stale_reclassified=k if stale else 0, deferred=0)
+        self._publish(now)
+        return {"drained": k, "stale_reclassified": k if stale else 0}
+
+    def stats(self) -> dict:
+        s = super().stats()
+        s["replicas"] = self.n_data
+        s["replica_depths"] = [q.depth for q in self.queues]
+        return s
+
+
+class MeshDatapath(TpuflowDatapath):
+    """TpuflowDatapath served SPMD over a (data × rule) mesh.
+
+    Same Datapath surface, same planes, same knobs — minus the
+    single-chip-only ones (module docstring).  `miss_queue_slots` is
+    per-replica; `flow_slots`/`aff_slots` are per-replica table sizes
+    (global capacity = D × slots, which is what `cache_stats`/
+    `audit_stats` report)."""
+
+    def __init__(self, ps=None, services=None, *, mesh=None, n_data: int = 2,
+                 n_rule: int = 1, devices=None, **kw):
+        if kw.get("dual_stack"):
+            raise ConfigError(
+                "the mesh datapath is v4-only (like the async slow path); "
+                "dual-stack nodes keep the single-chip engine")
+        if kw.get("overlap_commits") or kw.get("autotune_drain"):
+            raise ConfigError(
+                "overlap_commits/autotune_drain are single-chip knobs: the "
+                "mesh drain is already one fused sharded dispatch per "
+                "replica set")
+        if kw.get("topology") is not None:
+            raise ConfigError(
+                "the mesh engine serves the policy/service pipeline; "
+                "forwarding shards trivially over data "
+                "(parallel.make_sharded_pipeline_full) and stays outside "
+                "this engine")
+        self._mesh = mesh if mesh is not None else make_mesh(
+            n_data, n_rule, devices)
+        self._n_data = int(self._mesh.shape[DATA])
+        self._n_rule = int(self._mesh.shape[RULE])
+        self._replica_audit_entries = [0] * self._n_data
+        self._spill_lanes_total = 0
+        self._spill_retried_total = 0
+        super().__init__(ps, services, **kw)
+
+    # -- placement hooks (the whole tensor estate lands on the mesh) ---------
+
+    def _init_pipeline_state(self, flow_slots: int, aff_slots: int):
+        return shard_state(pl.init_state(flow_slots, aff_slots), self._mesh)
+
+    def _pin_state(self, state: pl.PipelineState) -> pl.PipelineState:
+        """Re-assert the (D,)-sharded placement after host-orchestrated
+        transforms (vmap scans, audit writebacks) — a no-op transfer when
+        the sharding already matches."""
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
+            state, _state_specs())
+
+    def _place_rules(self, cps):
+        drs, meta = to_device(cps, word_multiple=self._n_rule,
+                              delta_slots=self._delta_slots)
+        # The fused consumer must interpret iff the MESH's backend is CPU
+        # (the default platform can differ — virtual-CPU mesh on a TPU
+        # host), mirroring mesh.shard_rule_set.
+        meta = meta._replace(
+            fused_interpret=(self._mesh.devices.flat[0].platform == "cpu"))
+        drs = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
+            drs, _drs_specs())
+        return drs, meta
+
+    def _place_services(self, dsvc: pl.DeviceServiceTables):
+        repl = NamedSharding(self._mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, repl), dsvc)
+
+    def _place_delta(self, dt):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
+            dt, _drs_specs().ip_delta)
+
+    def _append_deltas(self, rows) -> None:
+        # O(delta) device patching is single-chip for now: each append
+        # would re-shard the per-slot word masks.  Folding into a fresh
+        # compile keeps the mesh path correct — and the commit plane's
+        # scoped delta canary still gates the fold on every replica.
+        del rows
+        self._compile_rules()
+
+    def _make_slowpath(self, *, capacity, admission, drain_batch,
+                       **_single_chip_knobs):
+        # autotune/overlap were rejected as ConfigError in __init__, so
+        # the ignored kwargs here are always their inert defaults.
+        return MeshSlowPath(self, self._n_data, capacity=capacity,
+                            admission=admission, drain_batch=drain_batch)
+
+    # -- unsupported single-chip surfaces ------------------------------------
+
+    def install_topology(self, topo) -> None:
+        raise NotImplementedError(
+            "the mesh engine serves the policy/service pipeline; "
+            "forwarding is stateless per-packet and shards trivially "
+            "(parallel.make_sharded_pipeline_full)")
+
+    def profile(self, batch, fresh=None, **kw) -> dict:
+        raise NotImplementedError(
+            "profile() is a single-chip surface; the multichip regime is "
+            "measured by bench.py's multichip section")
+
+    # -- the sharded step ----------------------------------------------------
+
+    def _step(self, batch: PacketBatch, now: int) -> StepResult:
+        D = self._n_data
+        B = batch.size
+        if B % D:
+            raise ValueError(
+                f"batch size {B} is not divisible by the data-axis size {D}")
+        self._v6_lanes(batch)  # v4-only guard (dual_stack is always False)
+        lens = np.maximum(batch.lens(), 0)
+        flags = np.asarray(batch.flags()).astype(np.int32)
+        shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
+                                batch.src_port, batch.dst_port, D)
+        perm, inv, spill = _shard_placement(shard, D)
+        src = batch.src_ip[perm].astype(np.uint32)
+        dst = batch.dst_ip[perm].astype(np.uint32)
+        proto = batch.proto[perm].astype(np.int32)
+        sport = batch.src_port[perm].astype(np.int32)
+        dport = batch.dst_port[perm].astype(np.int32)
+        pflags = flags[perm]
+        # Commit gating mirrors the single-chip walk (pl.no_commit_mask:
+        # multicast bypasses conntrack, FIN/RST misses never establish)
+        # PLUS the spill rule: an off-home lane classifies but never
+        # caches in a foreign shard.
+        no_commit = spill | pl.no_commit_mask(dst, proto, pflags)
+        stepf = _mesh_step_fn(self._mesh, self._meta_step)
+        state, out = stepf(
+            self._state, self._drs, self._dsvc,
+            iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
+            jnp.int32(now), jnp.int32(self._gen),
+            np.ones(B, bool), no_commit, pflags,
+            lens[perm].astype(np.int32),
+        )
+        self._state = state
+        self._state_mutations += 1
+        o = {k: np.asarray(v) for k, v in out.items()}
+        o.pop("n_miss")
+        self._evictions += int(o.pop("n_evict").sum())
+        self._reclaims += int(o.pop("n_reclaim").sum())
+        o = {k: v[inv] for k, v in o.items()}  # back to packet order
+        spilled = perm[np.nonzero(spill)[0]]  # packet indices off-home
+        if spilled.size:
+            o = self._spill_retry(batch, o, spilled, shard, flags, lens, now)
+        # Recomputed from the MERGED per-lane mask: a retried lane's miss
+        # image is its home-shard one, not the foreign always-miss.
+        n_miss = int(o["miss"].sum())
+        pending = None
+        if self._async:
+            pending = o["miss"]
+            # Route each admitted miss to its HOME replica's queue — a
+            # spilled lane's drain then classifies and commits it on the
+            # shard that owns it.
+            self._slowpath.admit(
+                self._queue_cols(batch, batch.flags(), lens),
+                pending != 0, now, shard=shard)
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+        self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
+        unflip = iputil.unflip_u32_array
+        return StepResult(
+            code=o["code"],
+            est=o["est"],
+            pending=pending,
+            reply=o["reply"],
+            reject_kind=o["reject_kind"],
+            snat=o["snat"],
+            dsr=o["dsr"],
+            svc_idx=o["svc_idx"],
+            dnat_ip=unflip(o["dnat_ip_f"]),
+            dnat_port=o["dnat_port"],
+            ingress_rule=[_rid(in_ids, i) for i in o["ingress_rule"]],
+            egress_rule=[_rid(out_ids, i) for i in o["egress_rule"]],
+            committed=o["committed"],
+            n_miss=n_miss,
+        )
+
+    def _spill_retry(self, batch: PacketBatch, o: dict, spilled: np.ndarray,
+                     shard: np.ndarray, flags: np.ndarray, lens: np.ndarray,
+                     now: int) -> dict:
+        """Second, bounded, HOME-ROUTED dispatch for hash-skew overflow.
+
+        Spilled lanes' main-dispatch image is a foreign-shard walk: they
+        can never see their home cache entry, so without this pass an
+        established flow caught in skew would serve provisional verdicts
+        forever (fatal under admission="hold": true-ALLOW traffic reads
+        as DROP).  Here each replica gets ITS OWN spilled lanes — home
+        placement by construction — padded to a power-of-two rung so the
+        compile-variant count stays O(log(B/D)).  Per-shard overflow
+        beyond one full home slice (B/D lanes; the all-flows-one-shard
+        pathology) keeps the documented provisional-spill semantics
+        rather than cascading dispatches.  Merges the retried lanes'
+        outputs into `o` (packet order) and returns it."""
+        D = self._n_data
+        C = batch.size // D
+        by_shard = [spilled[shard[spilled] == r] for r in range(D)]
+        m = max(x.size for x in by_shard)
+        rung = min(C, max(16, 1 << (m - 1).bit_length()))
+        take = [x[:rung] for x in by_shard]
+        Bm = D * rung
+        idx = np.zeros(Bm, np.int64)
+        valid = np.zeros(Bm, bool)
+        for r, x in enumerate(take):
+            idx[r * rung:r * rung + x.size] = x
+            valid[r * rung:r * rung + x.size] = True
+        src = batch.src_ip[idx].astype(np.uint32)
+        dst = batch.dst_ip[idx].astype(np.uint32)
+        proto = batch.proto[idx].astype(np.int32)
+        rflags = flags[idx]
+        no_commit = pl.no_commit_mask(dst, proto, rflags)
+        stepf = _mesh_step_fn(self._mesh, self._meta_step)
+        state, out = stepf(
+            self._state, self._drs, self._dsvc,
+            iputil.flip_u32(src), iputil.flip_u32(dst), proto,
+            batch.src_port[idx].astype(np.int32),
+            batch.dst_port[idx].astype(np.int32),
+            jnp.int32(now), jnp.int32(self._gen),
+            valid, no_commit, rflags, lens[idx].astype(np.int32),
+        )
+        self._state = state
+        self._state_mutations += 1
+        o2 = {k: np.asarray(v) for k, v in out.items()}
+        self._evictions += int(o2.pop("n_evict").sum())
+        self._reclaims += int(o2.pop("n_reclaim").sum())
+        o2.pop("n_miss")
+        sel = np.nonzero(valid)[0]
+        pkts = idx[sel]
+        for k in o:
+            o[k][pkts] = o2[k][sel]
+        self._spill_lanes_total += int(spilled.size)
+        self._spill_retried_total += int(sel.size)
+        return o
+
+    # -- sharded slow-path callbacks -----------------------------------------
+
+    def _drain_classify(self, blocks: list, now: int,
+                        chunk: Optional[int] = None):
+        """Classify one popped block PER REPLICA in a single sharded
+        drain dispatch (each replica's chunk is its slice of the batch
+        axis) and publish the new (D,)-sharded cache state — the commit
+        half of the mesh-wide epoch swap.  Padding lanes ride masked out
+        via `valid`; all lanes are home lanes (admission routed them), so
+        there is no spill term here.  `chunk` is the pop size the engine
+        pinned at begin_drain (an explicit begin_drain(n) may exceed
+        drain_batch; each replica's lane slice must be that wide)."""
+        sp = self._slowpath
+        chunk = int(chunk) if chunk is not None else sp.drain_batch
+        D = self._n_data
+        Bd = D * chunk
+        valid = np.zeros(Bd, bool)
+
+        def col(name, dtype=np.int32):
+            out = np.zeros(Bd, dtype)
+            for r, b in enumerate(blocks):
+                if b is None:
+                    continue
+                k = len(b["src_ip"])
+                out[r * chunk:r * chunk + k] = (
+                    np.asarray(b[name])[:k].astype(dtype))
+            return out
+
+        for r, b in enumerate(blocks):
+            if b is not None:
+                valid[r * chunk:r * chunk + len(b["src_ip"])] = True
+        src = col("src_ip", np.uint32)
+        dst = col("dst_ip", np.uint32)
+        proto = col("proto")
+        sport = col("src_port")
+        dport = col("dst_port")
+        flags = col("flags")
+        lens = np.maximum(col("lens"), 0)
+        no_commit = pl.no_commit_mask(dst, proto, flags)
+        drainf = _mesh_step_fn(self._mesh, self._drain_meta(chunk))
+        state, out = drainf(
+            self._state, self._drs, self._dsvc,
+            iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
+            jnp.int32(now), jnp.int32(self._gen),
+            valid, no_commit, flags, lens,
+        )
+        self._state = state
+        self._state_mutations += 1
+        o = {k: np.asarray(v) for k, v in out.items()}
+        self._evictions += int(o["n_evict"].sum())
+        self._reclaims += int(o["n_reclaim"].sum())
+        in_ids = self._cps.ingress.rule_ids
+        out_ids = self._cps.egress.rule_ids
+        sel = valid
+        self._count_metrics(
+            {k: o[k][sel] for k in ("code", "ingress_rule", "egress_rule")},
+            in_ids, out_ids, lens[sel],
+        )
+        return None  # never deferred: overlap staging is single-chip
+
+    def _epoch_maintain(self, now: int) -> tuple[int, int]:
+        st, n_aged, n_stale = _vmapped_maintain(self._meta.timeouts)(
+            self._state, jnp.int32(now), jnp.int32(self._gen))
+        self._state = self._pin_state(st)
+        self._state_mutations += 1
+        return int(np.asarray(n_aged).sum()), int(np.asarray(n_stale).sum())
+
+    def _epoch_revalidate(self) -> int:
+        st, n = _vmapped_revalidate()(self._state, jnp.int32(self._gen))
+        self._state = self._pin_state(st)
+        self._state_mutations += 1
+        return int(np.asarray(n).sum())
+
+    def _epoch_age_scan(self, now: int) -> int:
+        st, n = _vmapped_age(self._meta.timeouts)(
+            self._state, jnp.int32(now))
+        self._state = self._pin_state(st)
+        self._state_mutations += 1
+        return int(np.asarray(n).sum())
+
+    # -- commit plane hooks --------------------------------------------------
+
+    def _canary_classify(self, batch: PacketBatch, now: int) -> np.ndarray:
+        """REPLICA-RESOLVED fresh-walk verdicts: the probe set is tiled
+        over the data axis and classified inside shard_map, so each data
+        replica's own devices walk their own physical copies of the rule
+        tables -> (D, n) codes.  datapath/commit.py diffs every row
+        against the Oracle; any replica's mismatch vetoes the bundle for
+        the whole mesh (the rollback restores the sharded snapshot — all
+        replicas)."""
+        del now  # probes are stateless fresh walks
+        D = self._n_data
+        n = batch.size
+        fn = _mesh_canary_fn(self._mesh, self._meta.match)
+        got = fn(self._drs,
+                 np.tile(iputil.flip_u32(batch.src_ip), D),
+                 np.tile(iputil.flip_u32(batch.dst_ip), D),
+                 np.tile(batch.proto.astype(np.int32), D),
+                 np.tile(batch.dst_port.astype(np.int32), D))
+        return np.asarray(got).reshape(D, n)
+
+    # -- audit plane hooks (striped cursor + per-replica state) --------------
+
+    def _audit_rule_digests(self) -> dict:
+        """Checksum digests over the HOST view of each sharded tensor
+        group: the jitted XOR reduce cannot lower across device shards on
+        every backend (CPU rejects cross-shard xor reductions), so the
+        mesh scrub gathers and folds host-side.  The logical-bytes
+        contract is unchanged — state corruption on any replica's private
+        slice lands in the gathered view; per-device divergence of a
+        REPLICATED tensor is (as on single-chip) the canary's to catch,
+        which the replica-resolved canary does."""
+        leaves = jax.tree_util.tree_leaves
+        return {
+            "drs": pl.tensor_digest(np.asarray(x) for x in leaves(self._drs)),
+            "dsvc": pl.tensor_digest(
+                np.asarray(x) for x in leaves(self._dsvc)),
+            "dft": pl.tensor_digest(np.asarray(x) for x in leaves(self._dft)),
+        }
+
+    def _audit_state_digest(self) -> int:
+        return pl.tensor_digest(
+            np.asarray(x) for x in jax.tree_util.tree_leaves(self._state))
+
+    def _audit_slots(self) -> int:
+        return self._n_data * self._meta.flow_slots
+
+    def _audit_window(self, cursor: int, k: int, now: int) -> list[dict]:
+        """Striped window over the GLOBAL slot space: global slot g lives
+        at (replica g % D, local slot g // D), so one budgeted window
+        advances audit coverage on every replica simultaneously and
+        `audit_cursor_coverage_ratio` keeps its meaning fleet-wide."""
+        D, S = self._n_data, self._meta.flow_slots
+        G = D * S
+        cursor %= G
+        rows: list[dict] = []
+        for r in range(D):
+            first = cursor + ((r - cursor) % D)
+            if first >= cursor + k:
+                continue
+            count = (cursor + k - first + D - 1) // D
+            local_start = first // D
+            local = jax.tree.map(lambda x, r=r: x[r], self._state)
+            keys_d, meta_d, ts_d = pl.audit_gather(
+                local, jnp.int32(local_start % S), window=count)
+            got = self._decode_audit_rows(
+                keys_d, meta_d, ts_d, now,
+                lambda i, r=r, ls=local_start: (((ls + i) % S) * D + r))
+            self._replica_audit_entries[r] += len(got)
+            rows.extend(got)
+        rows.sort(key=lambda e: (e["slot"] - cursor) % G)
+        return rows
+
+    def _audit_fresh(self, rows: list, now: int) -> list[dict]:
+        """Fresh-walk re-proof per HOME replica: each audited row is
+        re-proved against its owning replica's local state slice (the
+        affinity view that classified it), through the shared eager
+        trace machinery."""
+        by_replica: dict[int, list[int]] = {}
+        for i, e in enumerate(rows):
+            by_replica.setdefault(e["slot"] % self._n_data, []).append(i)
+        out: list = [None] * len(rows)
+        for r, idxs in sorted(by_replica.items()):
+            local = jax.tree.map(lambda x, r=r: x[r], self._state)
+            got = self._audit_fresh_state(local, [rows[i] for i in idxs], now)
+            for i, rec in zip(idxs, got):
+                out[i] = rec
+        return out
+
+    def _audit_evict(self, slots: list) -> None:
+        D = self._n_data
+        groups: dict[int, list[int]] = {}
+        for g in slots:
+            groups.setdefault(int(g) % D, []).append(int(g) // D)
+        st = self._state
+        for r, ls in sorted(groups.items()):
+            n = max(1, len(ls))
+            padded = np.full(1 << (n - 1).bit_length(), -1, np.int32)
+            padded[:len(ls)] = np.asarray(ls, np.int32)
+            local = jax.tree.map(lambda x, r=r: x[r], st)
+            new_local, _n = pl.audit_evict(local, jnp.asarray(padded))
+            st = jax.tree.map(lambda full, nl, r=r: full.at[r].set(nl),
+                              st, new_local)
+        self._state = self._pin_state(st)
+        self._state_mutations += 1
+
+    def _audit_corrupt(self, kind: str, now: Optional[int] = None) -> str:
+        if kind == "tensor":
+            return super()._audit_corrupt(kind, now)
+        # Verdict-bit flip on ONE replica's private state slice — real
+        # replica-local corruption only the striped audit cursor can see.
+        D = self._n_data
+        flow = self._state.flow
+        keys_all = np.asarray(flow.keys)
+        _, M1C, _, _ = pl._meta_cols(self._meta.key_words - 2)
+        for r in range(D):
+            keys = keys_all[r, :-1].astype(np.int64)
+            if now is not None:
+                meta_np = np.asarray(flow.meta[r])[:-1].astype(np.int64)
+                ts_np = np.asarray(flow.ts[r])[:-1]
+                live, _egen = self._live_mask(keys, meta_np, ts_np, now)
+            else:
+                kpg = keys[:, -1]
+                gen_w = self._gen % pl.GEN_ETERNAL
+                egen = (kpg >> 9) & pl.GEN_ETERNAL
+                live = (kpg != 0) & ((egen == pl.GEN_ETERNAL)
+                                     | (egen == gen_w))
+            idx = np.nonzero(live)[0]
+            if idx.size == 0:
+                continue
+            slot = int(idx[0])
+            mta = self._state.flow.meta
+            self._state = self._state._replace(flow=self._state.flow._replace(
+                meta=mta.at[r, slot, M1C].set(mta[r, slot, M1C] ^ 1)))
+            return (f"flipped cached verdict bit of replica {r} "
+                    f"slot {slot}")
+        return super()._audit_corrupt("tensor")
+
+    def corrupt_replica(self, replica: int) -> str:
+        """Chaos helper: flip the rule-side table copies held by ONE data
+        replica's devices — real per-device divergence of a logically
+        replicated tensor (the HBM-bit-flip-on-one-chip model).  The next
+        replica-resolved canary (install gate or watchdog) diverges on
+        exactly this replica and vetoes, rolling back / degrading the
+        WHOLE mesh; recovery is the ordinary canary-gated recompile,
+        whose fresh placement rebuilds every copy from the host mirror.
+        The mutation counter is deliberately not bumped — silent
+        corruption is the thing being modeled."""
+        devs = set(self._mesh.devices[replica, :].flat)
+
+        def flip(arr):
+            bufs = []
+            for s in arr.addressable_shards:
+                buf = np.array(s.data)
+                if s.device in devs:
+                    buf = buf ^ 1
+                bufs.append(jax.device_put(buf, s.device))
+            return jax.make_array_from_single_device_arrays(
+                arr.shape, arr.sharding, bufs)
+
+        drs = self._drs
+        self._drs = drs._replace(
+            ingress=drs.ingress._replace(action=flip(drs.ingress.action)),
+            egress=drs.egress._replace(action=flip(drs.egress.action)),
+            iso_in=drs.iso_in._replace(val=flip(drs.iso_in.val)),
+            iso_out=drs.iso_out._replace(val=flip(drs.iso_out.val)),
+        )
+        return (f"flipped rule-side device copies held by data replica "
+                f"{replica}")
+
+    # -- host-side observability over the (D,) axis --------------------------
+
+    def dump_flows(self, now: int) -> list[dict]:
+        return [e for r in range(self._n_data)
+                for e in self._dump_flows_state(
+                    jax.tree.map(lambda x, r=r: x[r], self._state), now)]
+
+    def cache_stats(self) -> dict:
+        per = _vmapped_cache_stats()(self._state)
+        c = {k: int(np.asarray(v).sum()) for k, v in per.items()}
+        c["evictions"] = self._evictions
+        c["reclaims"] = self._reclaims
+        return c
+
+    def trace(self, batch: PacketBatch, now: int) -> list[dict]:
+        if not self._gates.enabled("Traceflow"):
+            raise RuntimeError("Traceflow feature gate is disabled")
+        D = self._n_data
+        shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
+                                batch.src_port, batch.dst_port, D)
+        out: list = [None] * batch.size
+        for r in range(D):
+            idx = np.nonzero(shard == r)[0]
+            if idx.size == 0:
+                continue
+            sub = PacketBatch.from_packets(
+                [batch.packet(int(i)) for i in idx])
+            local = jax.tree.map(lambda x, r=r: x[r], self._state)
+            for i, rec in zip(idx, self._trace_batch(local, sub, now)):
+                out[int(i)] = rec
+        return out
+
+    def mesh_stats(self) -> dict:
+        """Shard-labeled observability (rendered as the replica-labeled
+        metric families in observability/metrics.py): per-replica
+        miss-queue depth, replica-resolved canary mismatches, and
+        audited-entry volume under the striped cursor."""
+        cp = self._commit
+        depths = ([q.depth for q in self._slowpath.queues]
+                  if self._slowpath is not None else [0] * self._n_data)
+        return {
+            "mesh": {"data": self._n_data, "rule": self._n_rule},
+            "devices": self._n_data * self._n_rule,
+            # Hash-skew pressure: lanes placed off-home, and how many of
+            # them the bounded home-routed retry dispatch re-served
+            # (equal counters = no lane ever kept foreign semantics).
+            "spill_lanes_total": self._spill_lanes_total,
+            "spill_retried_total": self._spill_retried_total,
+            "replica_miss_queue_depth": depths,
+            "replica_canary_mismatches": {
+                int(r): int(n)
+                for r, n in (cp.replica_mismatches.items()
+                             if cp is not None else ())},
+            "replica_audit_entries": list(self._replica_audit_entries),
+        }

@@ -176,6 +176,17 @@ def unflip_u32(v) -> int:
     return (int(v) ^ 0x80000000) & 0xFFFFFFFF
 
 
+def unflip_u32_array(col):
+    """Vectorized inverse of flip_u32: a column of stored sign-flipped
+    i32 lanes back to u32 addresses — the one implementation both
+    engines' StepResult builders share (the encoding contract lives
+    here, next to flip_u32)."""
+    import numpy as np
+
+    return (np.asarray(col).astype(np.int32)
+            ^ np.int32(-(2 ** 31))).astype(np.uint32)
+
+
 def key_to_flipped_words(key: int) -> tuple[int, int, int, int]:
     """key_to_words with each word sign-flipped — the exact i32 lane values
     the device stores, for host/oracle twins that must hash or compare the

@@ -22,7 +22,31 @@ vs_baseline is value / 10e6 (the BASELINE.json north-star target:
 """
 
 import json
+import os
+import sys
 from collections import namedtuple
+
+# --force-host-devices N: provision N virtual CPU devices BEFORE jax
+# initializes — the CPU-CI escape hatch that makes the multichip regime
+# smoke-testable without a pod slice (the tier-1 suite has its own
+# 8-device conftest; this flag is for running bench.py directly).
+_FORCED_HOST_DEVICES = 0
+if "--force-host-devices" in sys.argv:
+    try:
+        _FORCED_HOST_DEVICES = int(
+            sys.argv[sys.argv.index("--force-host-devices") + 1])
+        if _FORCED_HOST_DEVICES <= 0:
+            raise ValueError
+    except (IndexError, ValueError):
+        raise SystemExit(
+            "usage: bench.py [--force-host-devices N]  "
+            "(N = positive virtual CPU device count for the multichip "
+            "smoke)")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_FORCED_HOST_DEVICES}"
+    ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +78,17 @@ BASELINE_PPS = 10e6
 # of each batch are fresh flows.
 CHURN_POOL = 1 << 22
 CHURN_DIV = 8
+# Multichip regime (round-9 tentpole, ROADMAP item 1): aggregate pps over
+# a full data-parallel mesh + a rule-sharded capacity point.  The
+# acceptance target is >150M pps aggregate on v5e-8; the capacity point
+# compiles PAST the single-chip bench scale (the word-axis sharding is
+# what buys the headroom, parallel/mesh.py HBM math).
+MC_TARGET_PPS = 150e6
+MC_CAP_RULES = 150_000
+# CPU smoke shapes (--force-host-devices / virtual-CPU platforms): prove
+# the regime end-to-end with toy worlds, emitting the same JSON keys.
+MC_RULES_SMOKE = 400
+MC_CAP_RULES_SMOKE = 1_000
 
 
 def measure_cold(drs, match_meta, src, dst, proto, dport):
@@ -417,11 +452,13 @@ def measure_sharded_cold_fused(cps, src, dst, proto, dport):
                 hit_combine=pm._pmin_rule, fused=True,
             )
 
-        sh = jax.shard_map(
+        # The version shim (capability probe) — a direct jax.shard_map
+        # call broke on images that only carry the experimental module.
+        sh = pm._shard_map(
             cls_body, mesh=mesh,
             in_specs=(pm._drs_specs(), P(pm.DATA), P(pm.DATA), P(pm.DATA),
                       P(pm.DATA)),
-            out_specs=P(pm.DATA), check_vma=False,
+            out_specs=P(pm.DATA),
         )
 
         def body(i, carry):
@@ -475,6 +512,167 @@ def measure_shard_overhead(cps, svc, src, dst, proto, sport, dport, pps):
     except Exception as e:  # report, never sink the bench
         print(f"# shard-overhead measurement failed: {e}", flush=True)
         return None, None
+
+
+def measure_multichip(cps=None, svc=None, pod_ips=None, services=None):
+    """The round-9 multichip regime (ROADMAP item 1): REAL aggregate
+    steady-state throughput of the full stateful sharded pipeline over
+    every available device (data-parallel (D, 1) mesh, per-shard private
+    flow caches), with scaling efficiency measured against a single-chip
+    reference run of the SAME regime — not the dryrun.  Plus the
+    rule-axis capacity point: cold classification of a >100k-rule set
+    sharded over a (1, D) mesh (the word-axis sharding that buys HBM
+    headroom past the single-chip ceiling).
+
+    On accelerator pods this runs the bench world (100k rules); on CPU
+    platforms (the --force-host-devices escape hatch) it swaps in toy
+    worlds so the regime is smoke-testable in CI — same JSON keys,
+    `smoke: true`.  -> the multichip JSON dict, or None (skipped/failed).
+    """
+    try:
+        return _measure_multichip(cps, svc, pod_ips, services)
+    except Exception as e:  # report, never sink the bench
+        print(f"# multichip measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_multichip(cps, svc, pod_ips, services):
+    from antrea_tpu.parallel import mesh as pm
+
+    D = jax.device_count()
+    if D < 2:
+        print(f"# multichip regime skipped: need >= 2 devices, have {D}",
+              flush=True)
+        return None
+    smoke = jax.devices()[0].platform == "cpu"
+    if smoke:
+        cluster = gen_cluster(MC_RULES_SMOKE, n_nodes=8, pods_per_node=8,
+                              seed=41)
+        cps = compile_policy_set(cluster.ps)
+        services = gen_services(16, cluster.pod_ips, seed=42)
+        svc = compile_services(services)
+        pod_ips = cluster.pod_ips
+        b_rep, slots, ks, kb, reps = 512, 1 << 12, 2, 8, 1
+        cap_rules, fused = MC_CAP_RULES_SMOKE, False
+    else:
+        b_rep, slots, ks, kb, reps = 1 << 15, 1 << 20, 4, 32, 2
+        cap_rules, fused = MC_CAP_RULES, True
+    B_total = b_rep * D
+    tr = gen_traffic(pod_ips, B_total, n_flows=max(256, B_total >> 3),
+                     seed=43, services=services, svc_fraction=0.3)
+    src = iputil.flip_u32(tr.src_ip)
+    dst = iputil.flip_u32(tr.dst_ip)
+
+    # -- data-parallel aggregate: the full stateful step over (D, 1) ------
+    mesh = pm.make_mesh(D, 1)
+    stepN, stN, (drsN, dsvcN) = pm.make_sharded_pipeline(
+        cps, svc, mesh, flow_slots=slots, miss_chunk=MISS_CHUNK)
+    for warm in (100, 101):
+        stN, _ = stepN(stN, drsN, dsvcN, src, dst, tr.proto, tr.src_port,
+                       tr.dst_port, jnp.int32(warm), jnp.int32(0))
+
+    def bodyN(i, carry):
+        acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_ = carry
+        st, o = stepN(st, drs_, dsvc_, s_, d_, p_, sp_, dp_,
+                      102 + i, jnp.int32(0))
+        acc = acc.at[:1].add(o["code"].sum(dtype=jnp.int32)
+                             + o["n_miss"].sum())
+        return (acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_)
+
+    carry = (jnp.zeros(8, jnp.int32), stN, drsN, dsvcN, src, dst, tr.proto,
+             tr.src_port, tr.dst_port)
+    sec = device_loop_time(bodyN, carry, k_small=ks, k_big=kb, repeats=reps)
+    aggregate_pps = B_total / sec
+
+    # -- single-chip reference of the SAME regime (honest efficiency) -----
+    step1, st1, (drs1, dsvc1) = pl.make_pipeline(
+        cps, svc, flow_slots=slots, miss_chunk=MISS_CHUNK)
+    s1, d1 = jnp.asarray(src[:b_rep]), jnp.asarray(dst[:b_rep])
+    p1 = jnp.asarray(tr.proto[:b_rep])
+    sp1 = jnp.asarray(tr.src_port[:b_rep])
+    dp1 = jnp.asarray(tr.dst_port[:b_rep])
+    for warm in (100, 101):
+        st1, _ = step1(st1, drs1, dsvc1, s1, d1, p1, sp1, dp1,
+                       jnp.int32(warm), jnp.int32(0))
+
+    def body1(i, carry):
+        acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_ = carry
+        st, o = pl._pipeline_step(st, drs_, dsvc_, s_, d_, p_, sp_, dp_,
+                                  102 + i, 0, meta=step1.meta)
+        acc = acc.at[:1].add(o["code"].sum(dtype=jnp.int32) + o["n_miss"])
+        return (acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_)
+
+    carry = (jnp.zeros(8, jnp.int32), st1, drs1, dsvc1, s1, d1, p1, sp1, dp1)
+    sec1 = device_loop_time(body1, carry, k_small=ks, k_big=kb, repeats=reps)
+    ref_pps = b_rep / sec1
+
+    # -- rule-axis capacity point: >100k rules sharded over (1, D) --------
+    capacity = None
+    try:
+        cl_cap = gen_cluster(cap_rules, n_nodes=32, pods_per_node=16,
+                             seed=44)
+        cps_cap = compile_policy_set(cl_cap.ps)
+        mesh_r = pm.make_mesh(1, D)
+        drs_r, meta_r = pm.shard_rule_set(cps_cap, mesh_r)
+        b_cap = 2048 if smoke else B_COLD
+        tc = gen_traffic(cl_cap.pod_ips, b_cap, n_flows=b_cap, seed=45)
+        cs, cd = iputil.flip_u32(tc.src_ip), iputil.flip_u32(tc.dst_ip)
+
+        def cls_body(drs_, s_, d_, p_, dp_):
+            return classify_batch(drs_, s_, d_, p_, dp_, meta=meta_r,
+                                  hit_combine=pm._pmin_rule, fused=fused)
+
+        from jax.sharding import PartitionSpec as P
+
+        sh = pm._shard_map(
+            cls_body, mesh=mesh_r,
+            in_specs=(pm._drs_specs(), P(pm.DATA), P(pm.DATA), P(pm.DATA),
+                      P(pm.DATA)),
+            out_specs=P(pm.DATA),
+        )
+
+        def body_cap(i, carry):
+            acc, drs_, s_, d_, p_, dp_ = carry
+            dp2 = dp_ ^ (acc[0] & 1)
+            cls = sh(drs_, s_, d_, p_, dp2)
+            acc = acc.at[:1].add(cls["code"].sum(dtype=jnp.int32))
+            return (acc, drs_, s_, d_, p_, dp_)
+
+        carry = (jnp.zeros(8, jnp.int32), drs_r, jnp.asarray(cs),
+                 jnp.asarray(cd), jnp.asarray(tc.proto),
+                 jnp.asarray(tc.dst_port))
+        sec_cap = device_loop_time(body_cap, carry, k_small=2,
+                                   k_big=8 if smoke else 64, repeats=reps)
+        capacity = {
+            "n_rules": int(cps_cap.ingress.n_rules + cps_cap.egress.n_rules),
+            "rule_shards": D,
+            "cold_classify_pps": round(b_cap / sec_cap, 1),
+            # The term the rule axis divides (parallel/mesh.py HBM math):
+            # each shard holds 1/D of the incidence words.
+            "incidence_frac_per_shard": round(1.0 / D, 4),
+        }
+    except Exception as e:
+        print(f"# rule-capacity point failed: {e}", flush=True)
+
+    return {
+        "metric": "multichip_aggregate_pps",
+        "value": round(aggregate_pps, 1),
+        "unit": "packets/s",
+        "vs_target": round(aggregate_pps / MC_TARGET_PPS, 4),
+        "extra": {
+            "devices": D,
+            "mesh": [D, 1],
+            "batch_total": B_total,
+            "batch_per_replica": b_rep,
+            "per_chip_pps": round(aggregate_pps / D, 1),
+            "singlechip_ref_pps": round(ref_pps, 1),
+            # Aggregate over D chips vs D × the single-chip SAME-regime
+            # reference: 1.0 = perfectly linear data-parallel scaling.
+            "scaling_efficiency": round(aggregate_pps / (D * ref_pps), 4),
+            "smoke": smoke,
+            "rule_capacity": capacity,
+        },
+    }
 
 
 def main():
@@ -533,9 +731,11 @@ def main():
     sh_pps, sh_overhead = measure_shard_overhead(
         cps, svc, src, dst, proto, sport, dport, pps
     )
+    multichip = measure_multichip(cps, svc, cluster.pod_ips, services)
     _print_and_gate(pps, cold_pps, sh_pps, sh_overhead, churn_pps,
                     sh_cold_pps, async_churn_pps, q_overflows,
-                    overlap_churn_pps, maint_churn_pps)
+                    overlap_churn_pps, maint_churn_pps,
+                    multichip=multichip)
 
 
 # Regression floors (round-3 verdict weak #6: a silent 10x perf regression
@@ -555,7 +755,8 @@ CHURN_FLOOR_PPS = 3.5e6
 def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     churn_pps=None, sh_cold_pps=None,
                     async_churn_pps=None, q_overflows=None,
-                    overlap_churn_pps=None, maint_churn_pps=None):
+                    overlap_churn_pps=None, maint_churn_pps=None,
+                    multichip=None):
     maint_overhead_pct = None
     if maint_churn_pps and async_churn_pps:
         maint_overhead_pct = round(
@@ -618,6 +819,11 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
             else round(sh_cold_pps, 1),
         },
     }))
+    # The multichip regime prints as its OWN json line (second), so the
+    # single-chip headline keeps its first-line position and unchanged
+    # keys for the r05 -> r06 comparison.
+    if multichip is not None:
+        print(json.dumps(multichip))
     # Explicit raises (not assert): the gate must survive python -O.
     if pps < STEADY_FLOOR_PPS:
         raise SystemExit(
