@@ -110,6 +110,23 @@ SCRUB_MANIFEST = {
     "state": "state",
 }
 
+# SUB-tensor coverage notes: leaves that ride inside a manifest group's
+# digest (tree_leaves covers every leaf) but whose failure mode deserves
+# an explicit, GATED record.  Deliberately NOT manifest rows — the
+# maintenance scheduler prices the scrub task at len(SCRUB_MANIFEST)
+# digest groups, and these are not extra folds.  Pure literal for
+# tools/check_audit_plane.py, which gates each entry against the field
+# that motivates it (a dropped field must drop its row and vice versa).
+SCRUB_SUBTENSORS = {
+    # Round-7 aggregate tables (ops/match.DimTable.agg): a corrupt
+    # aggregate bit can silently FLIP a verdict (a CLEARED bit is a
+    # false negative the pruned kernel's exactness argument forbids), so
+    # table/aggregate divergence must stay a scrub finding — it rides
+    # the `drs` digest and heals by the same host-mirror re-upload
+    # (_audit_reupload rebuilds agg via _place_rules).
+    "drs.agg": "rule",
+}
+
 # _commit_snapshot keys that are NOT device tensors, each with the reason
 # it needs no scrub.  A new snapshot key in neither table fails
 # tools/check_audit_plane.py.

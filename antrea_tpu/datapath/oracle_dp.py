@@ -101,6 +101,8 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         maint_clock=None,
         flightrec_slots: int = 1024,
         realization_slots: int = 256,
+        prune_budget: int = 0,
+        autotune_prune: bool = False,
     ):
         from ..features import DEFAULT_GATES
 
@@ -115,6 +117,24 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
             )
         audit_divergence_trip = (8 if audit_divergence_trip is None
                                  else audit_divergence_trip)
+        # Prune knobs validated like the kernel twin's (mode-for-mode
+        # construction parity for the differential harness) but otherwise
+        # inert: the scalar walk has no gather volume to prune.  The
+        # ladder snap under autotune mirrors the twin too, so both
+        # engines REPORT the same budget for the same knobs.
+        if prune_budget < 0:
+            raise ConfigError(
+                f"prune_budget must be >= 0, got {prune_budget}")
+        if autotune_prune and prune_budget <= 0:
+            raise ConfigError(
+                "autotune_prune retunes the aggregate-prune K budget, but "
+                "prune_budget=0 disables the aggregate layer — set an "
+                "initial prune_budget (e.g. 4) to autotune from")
+        if autotune_prune:
+            from ..ops.match import PruneAutotuner
+
+            prune_budget = PruneAutotuner(prune_budget).budget
+        self._prune_budget = int(prune_budget)
         self._gates = feature_gates or DEFAULT_GATES
         self._dual_stack = dual_stack
         self._node_ips = list(node_ips or [])
@@ -669,9 +689,19 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         mode="maintenance" additionally times one fused maintenance pass
         (_epoch_maintain, the cache-maintain task of the unified
         scheduler) as `maint_sweep` / `maintenance_s` — the scalar twin
-        of MAINT_PHASE_CHAIN's rider."""
-        if mode not in ("sync", "async", "overlap", "maintenance"):
+        of MAINT_PHASE_CHAIN's rider.  mode="prune" reports the
+        prune-regime names over the identical split: the scalar walk has
+        no aggregate layer (its per-packet AND is already O(matched
+        rules)), so its candidate-gather number IS its classify number —
+        the honest twin statement, kept mode-for-mode."""
+        if mode not in ("sync", "async", "overlap", "maintenance", "prune"):
             raise ValueError(f"unknown profile mode {mode!r}")
+        if mode == "prune" and self._prune_budget <= 0:
+            # Twin-parity with TpuflowDatapath.profile: both engines
+            # refuse the mode on an unpruned instance.
+            raise ValueError(
+                "profile(mode='prune') needs prune_budget > 0 "
+                "(the two-level kernel is compiled out at 0)")
         from ..models.pipeline import GEN_ETERNAL
 
         o = self._oracle
@@ -743,6 +773,12 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
                     total - t_fast - t_cls - t_maint, 0.0),
                 "maint_sweep": t_maint,
             }
+        elif mode == "prune":
+            phases = {
+                "prune_fast_path": t_fast,
+                "prune_candidate_gather": t_cls,
+                "prune_commit_residual": max(total - t_fast - t_cls, 0.0),
+            }
         else:
             phases = {
                 "fast_path": t_fast,
@@ -763,6 +799,9 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
             out["mode"] = "maintenance"
             out["maintenance_s"] = t_maint
             out["maintenance_fraction"] = t_maint / max(total, 1e-9)
+        elif mode == "prune":
+            out["mode"] = "prune"
+            out["prune_budget"] = self._prune_budget
         return out
 
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:

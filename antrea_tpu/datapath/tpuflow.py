@@ -45,8 +45,10 @@ from ..compiler import topology
 from ..compiler.topology import FWD_TUNNEL, Topology, compile_topology
 from ..models import forwarding as fwd
 from ..models import pipeline as pl
+from ..observability.flightrec import emit_into
 from ..observability.metrics import Histogram
-from ..ops.match import DeltaTable, to_device
+from ..ops.match import (PRUNE_HIST_BOUNDS, PRUNE_LADDER, DeltaTable,
+                         PruneAutotuner, to_device)
 from ..packet import Packet, PacketBatch
 from ..utils import ip as iputil
 from ..config import ConfigError
@@ -101,6 +103,8 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         maint_clock=None,
         flightrec_slots: int = 1024,
         realization_slots: int = 256,
+        prune_budget: int = 0,
+        autotune_prune: bool = False,
     ):
         from ..features import DEFAULT_GATES
 
@@ -121,6 +125,29 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             )
         audit_divergence_trip = (8 if audit_divergence_trip is None
                                  else audit_divergence_trip)
+        # Aggregated-bitmap match pruning (ops/match round 7): K = max
+        # candidate superblocks per lane/direction; 0 compiles the
+        # aggregate layer out entirely (the existing kernel, bit-for-bit).
+        # autotune_prune retunes K on PRUNE_LADDER from the measured
+        # fallback rate (one jit-cached classify variant per rung).
+        if prune_budget < 0:
+            raise ConfigError(
+                f"prune_budget must be >= 0, got {prune_budget}")
+        if autotune_prune and prune_budget <= 0:
+            raise ConfigError(
+                "autotune_prune retunes the aggregate-prune K budget, but "
+                "prune_budget=0 disables the aggregate layer — set an "
+                "initial prune_budget (e.g. 4) to autotune from")
+        self._prune_tuner = None
+        if autotune_prune:
+            self._prune_tuner = PruneAutotuner(prune_budget)
+            prune_budget = self._prune_tuner.budget  # snap to the ladder
+        self._prune_budget = int(prune_budget)
+        self._prune_skips = 0
+        self._prune_fallbacks = 0
+        self._prune_classified = 0
+        self._prune_retunes = 0
+        self._prune_hist = Histogram(bounds=PRUNE_HIST_BOUNDS)
         self._gates = feature_gates or DEFAULT_GATES
         # Per-entry traffic counters ride the FlowExporter gate: volumes
         # cost a hit-path column gather+scatter, paid only when the
@@ -228,7 +255,8 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
     def _place_rules(self, cps):
         """Compile -> device rule tensors + match meta on this engine's
         layout (mesh engine: word-axis padding + sharded placement)."""
-        return to_device(cps, delta_slots=self._delta_slots)
+        return to_device(cps, delta_slots=self._delta_slots,
+                         prune_budget=self._prune_budget)
 
     def _place_services(self, dsvc: pl.DeviceServiceTables):
         """Device service-table placement hook (mesh engine: replicated
@@ -483,6 +511,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._state_mutations += 1
         o = {k: np.asarray(v) for k, v in out.items()}
         self._evictions += int(o["n_evict"])
+        self._prune_account(o)
         pending = None
         if self._async:
             # Admit the fast step's miss lanes to the bounded queue (the
@@ -667,6 +696,64 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         c["reclaims"] = self._reclaims
         return c
 
+    # -- aggregated-bitmap prune plane (ops/match round 7) -------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        """Flight-recorder shim (the per-plane literal-kind discipline
+        tools/check_events.py greps for)."""
+        emit_into(self, kind, **fields)
+
+    def prune_stats(self) -> Optional[dict]:
+        """Prune-plane observability (None when prune_budget=0, so the
+        scrape surface only exists where the plane does): skip/fallback
+        volume, the live K rung, retunes, and the candidate-superblock
+        histogram object for the metrics renderer."""
+        if self._prune_budget <= 0:
+            return None
+        return {
+            "budget": self._prune_budget,
+            "skips_total": self._prune_skips,
+            "fallbacks_total": self._prune_fallbacks,
+            "classified_total": self._prune_classified,
+            "retunes_total": self._prune_retunes,
+            "autotune": int(self._prune_tuner is not None),
+            "hist": self._prune_hist,
+        }
+
+    def _prune_account(self, o: dict) -> None:
+        """Fold one dispatch's prune counters (pipeline output keys, which
+        exist iff prune_budget > 0; (D,)-vector shaped on the mesh) into
+        the plane's meters and feed the K autotuner one decision point."""
+        if self._prune_budget <= 0 or "n_prune_skips" not in o:
+            return
+        self._prune_skips += int(np.asarray(o["n_prune_skips"]).sum())
+        fb = int(np.asarray(o["n_prune_fb"]).sum())
+        self._prune_fallbacks += fb
+        hist = np.asarray(o["prune_cand_hist"], np.int64)
+        hist = hist.reshape(-1, len(PRUNE_HIST_BOUNDS) + 2).sum(axis=0)
+        self._prune_hist.add_counts(hist[:-1], float(hist[-1]))
+        classified = int(hist[:-1].sum())
+        self._prune_classified += classified
+        if self._prune_tuner is not None:
+            new = self._prune_tuner.observe(classified, fb)
+            if new != self._prune_budget:
+                self._retune_prune(new)
+
+    def _retune_prune(self, budget: int) -> None:
+        """Swap the prune K rung: a META-only change (the aggregate tables
+        are K-independent), so jit caches one classify/step variant per
+        ladder rung and retuning can never trigger a recompile storm.
+        Journaled as `prune-retune` — the autotune analog for this plane."""
+        old, self._prune_budget = self._prune_budget, int(budget)
+        mm = self._meta.match._replace(prune_budget=self._prune_budget)
+        self._meta = self._meta._replace(match=mm)
+        self._meta_step = self._meta_step._replace(match=mm)
+        self._prune_retunes += 1
+        self._emit("prune-retune", budget_from=int(old),
+                   budget_to=int(self._prune_budget),
+                   fallbacks_total=int(self._prune_fallbacks),
+                   classified_total=int(self._prune_classified))
+
     # -- async slow path (datapath/slowpath engine callbacks) ----------------
     # (drain_slowpath / dump_miss_queue / slowpath_stats live on the
     # Datapath base; only the classify/scan callbacks are per-engine.)
@@ -753,6 +840,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             o = {key: np.asarray(v) for key, v in out.items()}
             self._evictions += int(o["n_evict"])
             self._reclaims += int(o["n_reclaim"])
+            self._prune_account(o)
             # Each queued packet's REAL attribution counts exactly once,
             # here (its fast-step image was provisional and uncounted).
             sel = valid
@@ -866,6 +954,14 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._dsvc = snap["dsvc"]
         self._meta = snap["meta"]
         self._meta_step = snap["meta_step"]
+        # A prune retune between snapshot and restore must not leave the
+        # K bookkeeping diverged from the restored metas — and the
+        # autotuner must be RE-SEEDED at the restored rung, or its stale
+        # index would silently retune back to the pre-rollback rung on
+        # the next dispatch with no fresh fallback-rate evidence.
+        self._prune_budget = snap["meta"].match.prune_budget
+        if self._prune_tuner is not None:
+            self._prune_tuner = PruneAutotuner(self._prune_budget)
         self._state = snap["state"]
         self._has_named_ports = snap["has_named_ports"]
         self._n_deltas = snap["n_deltas"]
@@ -1207,6 +1303,21 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             # riding every step; `maintenance_s` is the plane's own
             # attributed cost.
             return prof.profile_churn_maintenance(
+                self._meta, self._state, self._drs, self._dsvc, hot, pool,
+                n_new=n_new, now0=now, gen=self._gen,
+                k_small=k_small, k_big=k_big, repeats=repeats,
+            )
+        if mode == "prune":
+            # Two-level prune attribution (PRUNE_PHASE_CHAIN): the async
+            # drain cadence with the classify entry split into
+            # summary-gather (PH_CLS_SUM) vs candidate-gather (PH_CLS) —
+            # requires a pruned instance, there is nothing to attribute
+            # otherwise.
+            if self._prune_budget <= 0:
+                raise ValueError(
+                    "profile(mode='prune') needs prune_budget > 0 "
+                    "(the two-level kernel is compiled out at 0)")
+            return prof.profile_churn_prune(
                 self._meta, self._state, self._drs, self._dsvc, hot, pool,
                 n_new=n_new, now0=now, gen=self._gen,
                 k_small=k_small, k_big=k_big, repeats=repeats,

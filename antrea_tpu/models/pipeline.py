@@ -63,7 +63,8 @@ from ..apis.controlplane import PROTO_TCP
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT, CompiledPolicySet
 from ..compiler.services import ServiceTables
 from ..ops import hashing
-from ..ops.match import DeviceRuleSet, StaticMeta, classify_batch, to_device, to_host
+from ..ops.match import (PRUNE_HIST_BOUNDS, DeviceRuleSet, StaticMeta,
+                         classify_batch, to_device, to_host)
 
 # Python ints, never eager jnp scalars: see the BIG comment in ops/match.py.
 MISS = -1
@@ -121,6 +122,13 @@ def no_commit_mask(dst, proto, flags, xp=np):
 #              round loop, output scatters (the lax.cond body itself)
 #   PH_LB      ServiceLB frontend lookup + affinity + endpoint choice
 #   PH_CLS     the conjunctive-match classifier on the post-DNAT tuple
+#   PH_CLS_SUM the classifier's AGGREGATE phase alone (round-7 two-level
+#              pruning, ops/match summary_only): summary gathers + AND +
+#              short-circuit defaults, no candidate gather and no
+#              fallback.  Only meaningful under PH_CLS's absence and a
+#              prune_budget > 0 meta (a no-op bit otherwise) — the
+#              profiler entry that splits summary-gather from
+#              candidate-gather cost.
 #   PH_COMMIT  flow-cache insert prep + both-direction scatters + learn
 #   PH_EVICT   eviction accounting (requires PH_COMMIT: it audits the
 #              insert targets)
@@ -129,7 +137,23 @@ PH_LB = 2
 PH_CLS = 4
 PH_COMMIT = 8
 PH_EVICT = 16
-PH_ALL = PH_SLOW | PH_LB | PH_CLS | PH_COMMIT | PH_EVICT
+PH_CLS_SUM = 32
+PH_ALL = PH_SLOW | PH_LB | PH_CLS | PH_COMMIT | PH_EVICT | PH_CLS_SUM
+
+
+def _prune_bucket_counts(cand: jax.Array, mask: jax.Array) -> jax.Array:
+    """Per-lane candidate-superblock counts -> per-bucket counts PLUS a
+    trailing value-sum element: (len(PRUNE_HIST_BOUNDS)+2,) i32.  Bucket
+    indexing replicates observability.metrics.Histogram.observe's
+    bisect_left over the SAME bounds (ops/match.PRUNE_HIST_BOUNDS), so
+    the device counts merge into the host histogram loss-free
+    (Histogram.add_counts)."""
+    bounds = jnp.asarray(PRUNE_HIST_BOUNDS, jnp.int32)
+    idx = (cand[:, None] > bounds[None, :]).sum(axis=1)  # == bisect_left
+    mi = mask.astype(jnp.int32)
+    counts = jnp.zeros(len(PRUNE_HIST_BOUNDS) + 1, jnp.int32).at[idx].add(mi)
+    vsum = (cand * mi).sum(dtype=jnp.int32)
+    return jnp.concatenate([counts, vsum[None]])
 
 
 def reject_kind_of(code, proto, xp=jnp):
@@ -489,6 +513,7 @@ def make_pipeline(
     fused: bool = False,
     dual_stack: bool = False,
     count_flow_stats: bool = False,
+    prune_budget: int = 0,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -505,10 +530,10 @@ def make_pipeline(
     """
     check_rule_capacity(cps)
     if host:
-        drs, match_meta = to_host(cps)
+        drs, match_meta = to_host(cps, prune_budget=prune_budget)
         dsvc = svc_to_host(svc)
     else:
-        drs, match_meta = to_device(cps)
+        drs, match_meta = to_device(cps, prune_budget=prune_budget)
         dsvc = svc_to_device(svc)
     meta = PipelineMeta(
         match=match_meta,
@@ -1005,13 +1030,24 @@ def _pipeline_step(
     else:
         out_dnat_w = None
 
+    # Round-7 prune observability (python-static: zero ops, zero extra
+    # outputs when the budget is 0 — the HLO-identity contract).
+    prune_on = meta.match.prune_budget > 0
+    n_extra = (1 if A == 8 else 0) + (3 if prune_on else 0)
+
     # ---- slow path: ServiceLB + classify + commit, misses only -------------
     def slow(args):
         flow, aff, outs = args
         (out_code, out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
          out_rule_out, out_committed, out_snat, out_dsr, n_evict0,
          n_reclaim0) = outs[:11]
-        out_dnat_w = outs[11] if A == 8 else None
+        pos = 11
+        out_dnat_w = None
+        if A == 8:
+            out_dnat_w = outs[pos]
+            pos += 1
+        if prune_on:
+            pr_sk0, pr_fb0, pr_hist0 = outs[pos:pos + 3]
         # Batch semantics: affinity LOOKUPS see start-of-batch state even
         # across slow-path rounds; learns land in the carried table.
         aff_snap = aff
@@ -1021,7 +1057,13 @@ def _pipeline_step(
             (r, n_evict, n_reclaim, flow, aff, out_code, out_svc,
              out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
              out_committed, out_snat, out_dsr) = carry[:14]
-            out_dnat_w = carry[14] if A == 8 else None
+            pos = 14
+            out_dnat_w = None
+            if A == 8:
+                out_dnat_w = carry[pos]
+                pos += 1
+            if prune_on:
+                pr_sk, pr_fb, pr_hist = carry[pos:pos + 3]
             idx = jax.lax.dynamic_slice(
                 jnp.concatenate([midx, jnp.full((M,), B, jnp.int32)]),
                 (r * M,),
@@ -1071,6 +1113,7 @@ def _pipeline_step(
                     "ep": jnp.zeros((M,), jnp.int32),
                 }
 
+            cls = None
             if meta.phases & PH_CLS:
                 # Lanes classify on their POST-DNAT tuple (EndpointDNAT
                 # before the policy tables, ref pipeline.go table order);
@@ -1087,6 +1130,20 @@ def _pipeline_step(
                     v6=None if wide_m is None else (saddr_m, dnat_w, is6_m),
                     svc_ref=_svc_ref_of(svc_idx, dsvc),
                 )
+            elif prune_on and (meta.phases & PH_CLS_SUM):
+                # Summary-only classify (round-7 profiling surface): the
+                # aggregate gathers + AND + short-circuit defaults, no
+                # candidate gather, no fallback — PRUNE_PHASE_CHAIN's
+                # summary-gather vs candidate-gather split.
+                cls = classify_batch(
+                    drs, s_f, dnat_ip, p_m, dnat_port,
+                    meta=meta.match, hit_combine=hit_combine,
+                    fused=meta.fused,
+                    v6=None if wide_m is None else (saddr_m, dnat_w, is6_m),
+                    svc_ref=_svc_ref_of(svc_idx, dsvc),
+                    summary_only=True,
+                )
+            if cls is not None:
                 code = jnp.where(
                     no_ep, ACT_REJECT, cls["code"]).astype(jnp.int32)
                 # SvcReject happens in EndpointDNAT, BEFORE the policy
@@ -1100,6 +1157,15 @@ def _pipeline_step(
                 code = jnp.where(no_ep, ACT_REJECT, ACT_ALLOW).astype(jnp.int32)
                 rule_in = jnp.full((M,), MISS, jnp.int32)
                 rule_out = jnp.full((M,), MISS, jnp.int32)
+            if prune_on and cls is not None:
+                # Prune observability (valid lanes only — padding lanes
+                # classify garbage tuples and must not meter).
+                pr_sk = pr_sk + (cls["prune_skip"] & valid).sum(
+                    dtype=jnp.int32)
+                pr_fb = pr_fb + (cls["prune_fb"] & valid).sum(
+                    dtype=jnp.int32)
+                pr_hist = pr_hist + _prune_bucket_counts(
+                    cls["prune_cand"], valid)
 
             # no_commit lanes (multicast dst — the reference's multicast
             # pipeline bypasses conntrack entirely, pkg/agent/openflow/
@@ -1316,7 +1382,8 @@ def _pipeline_step(
             return (r + 1, n_evict, n_reclaim, flow, aff, out_code, out_svc,
                     out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
                     out_committed, out_snat, out_dsr) + (
-                    (out_dnat_w,) if A == 8 else ())
+                    (out_dnat_w,) if A == 8 else ()) + (
+                    (pr_sk, pr_fb, pr_hist) if prune_on else ())
 
         def round_cond(carry):
             r = carry[0]
@@ -1325,15 +1392,16 @@ def _pipeline_step(
         carry = (jnp.int32(0), n_evict0, n_reclaim0, flow, aff, out_code,
                  out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
                  out_rule_out, out_committed, out_snat, out_dsr) + (
-                 (out_dnat_w,) if A == 8 else ())
+                 (out_dnat_w,) if A == 8 else ()) + (
+                 (pr_sk0, pr_fb0, pr_hist0) if prune_on else ())
         carry = jax.lax.while_loop(round_cond, round_body, carry)
         (_, n_evict, n_reclaim, flow, aff, out_code, out_svc, out_dnat_ip,
          out_dnat_port, out_rule_in, out_rule_out, out_committed,
          out_snat, out_dsr) = carry[:14]
         return flow, aff, (out_code, out_svc, out_dnat_ip, out_dnat_port,
                            out_rule_in, out_rule_out, out_committed,
-                           out_snat, out_dsr, n_evict, n_reclaim) + (
-                           (carry[14],) if A == 8 else ())
+                           out_snat, out_dsr, n_evict, n_reclaim) + tuple(
+                           carry[14:14 + n_extra])
 
     def noop(args):
         return args
@@ -1342,7 +1410,10 @@ def _pipeline_step(
                              out_rule_in, out_rule_out, out_committed,
                              out_snat, out_dsr, jnp.int32(0),
                              jnp.int32(0)) + (
-                             (out_dnat_w,) if A == 8 else ()))
+                             (out_dnat_w,) if A == 8 else ()) + ((
+                             jnp.int32(0), jnp.int32(0),
+                             jnp.zeros(len(PRUNE_HIST_BOUNDS) + 2,
+                                       jnp.int32)) if prune_on else ()))
     if meta.phases & PH_SLOW:
         flow, aff, outs = jax.lax.cond(n_miss > 0, slow, noop, slow_init)
     else:
@@ -1394,6 +1465,17 @@ def _pipeline_step(
         # overlapped drain's fused maintenance); always 0 otherwise.
         "n_reclaim": n_reclaim,
     }
+    if prune_on:
+        pos = 11 + (1 if A == 8 else 0)
+        # Round-7 prune observability, aggregated over the slow-path
+        # rounds (valid lanes only): aggregate-AND-zero short circuits,
+        # full-width fallback redispatches, and the candidate-superblock
+        # bucket counts + value sum (_prune_bucket_counts layout).  Keys
+        # exist iff prune_budget > 0, so the unpruned step's output
+        # pytree — and its compiled HLO — is unchanged.
+        out["n_prune_skips"] = outs[pos]
+        out["n_prune_fb"] = outs[pos + 1]
+        out["prune_cand_hist"] = outs[pos + 2]
     if A == 8:
         # Wide (4-word) DNAT resolution — the full-address view v6
         # consumers (forwarding, StepResult) read; v4 lanes' word 3 equals

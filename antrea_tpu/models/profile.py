@@ -36,8 +36,13 @@ PHASE_CHAIN: tuple[tuple[str, int], ...] = (
     ("fast_path", 0),
     ("miss_detect", pl.PH_SLOW),
     ("service_lb", pl.PH_SLOW | pl.PH_LB),
-    ("classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
-    ("cache_commit", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+    # PH_CLS_SUM: the classifier's aggregate (summary) phase alone — a
+    # ~zero-cost entry unless the meta carries a prune budget (round 7),
+    # where it splits summary-gather cost from candidate-gather cost.
+    ("classify_summary", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM),
+    ("classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS),
+    ("cache_commit",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS | pl.PH_COMMIT),
     ("eviction_scan", pl.PH_ALL),
 )
 
@@ -52,9 +57,10 @@ ASYNC_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
     ("async_fast_path", 0),
     ("drain_miss_detect", pl.PH_SLOW),
     ("drain_service_lb", pl.PH_SLOW | pl.PH_LB),
-    ("drain_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
+    ("drain_classify_summary", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM),
+    ("drain_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS),
     ("drain_cache_commit",
-     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS | pl.PH_COMMIT),
     ("drain_eviction_scan", pl.PH_ALL),
 )
 
@@ -74,9 +80,11 @@ OVERLAP_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
     ("overlap_fast_path", 0),
     ("overlap_miss_detect", pl.PH_SLOW),
     ("overlap_service_lb", pl.PH_SLOW | pl.PH_LB),
-    ("overlap_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
+    ("overlap_classify_summary", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM),
+    ("overlap_classify",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS),
     ("overlap_cache_commit",
-     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS | pl.PH_COMMIT),
     ("overlap_evict_age", pl.PH_ALL),
 )
 
@@ -95,10 +103,33 @@ MAINT_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
     ("maint_fast_path", 0),
     ("maint_miss_detect", pl.PH_SLOW),
     ("maint_service_lb", pl.PH_SLOW | pl.PH_LB),
-    ("maint_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS),
+    ("maint_classify_summary", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM),
+    ("maint_classify", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS),
     ("maint_cache_commit",
-     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS | pl.PH_COMMIT),
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS | pl.PH_COMMIT),
     ("maint_sweep", pl.PH_ALL),
+)
+
+
+# Prune-regime chain (round 7, ROADMAP item 2's kernel half): the async
+# drain cadence over a prune_budget > 0 meta, with the classify entry
+# SPLIT at the two-level kernel's seam — `prune_summary_gather` adds
+# PH_CLS_SUM (aggregate rows gathered + ANDed, short-circuit defaults,
+# no candidate work) and `prune_candidate_gather` adds PH_CLS on top
+# (the K-superblock candidate gather, the first-match scan, and the
+# pow2-rung fallback redispatches).  Telescoping their difference IS the
+# candidate-path cost the aggregate layer was built to bound; the ±15%
+# gate (bench_profile.py --mode prune) cross-checks the attribution.
+PRUNE_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
+    ("prune_fast_path", 0),
+    ("prune_miss_detect", pl.PH_SLOW),
+    ("prune_service_lb", pl.PH_SLOW | pl.PH_LB),
+    ("prune_summary_gather", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM),
+    ("prune_candidate_gather",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS),
+    ("prune_cache_commit",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS | pl.PH_COMMIT),
+    ("prune_evict", pl.PH_ALL),
 )
 
 
@@ -566,3 +597,40 @@ def profile_churn_maintenance(
         "pps": B / total,
         "phase_fractions": {k: v / total for k, v in phases.items()},
     }
+
+
+def profile_churn_prune(
+    meta: pl.PipelineMeta,
+    state: pl.PipelineState,
+    drs,
+    dsvc,
+    hot: tuple,
+    pool: tuple,
+    *,
+    n_new: Optional[int] = None,
+    now0: int = 1000,
+    gen: int = 0,
+    k_small: int = 2,
+    k_big: int = 8,
+    repeats: int = 2,
+    chain: tuple = PRUNE_PHASE_CHAIN,
+) -> dict:
+    """Per-phase breakdown of the PRUNED churn regime (round 7): the
+    async drain cadence (profile_churn_async's exact body) over a
+    prune_budget > 0 meta, attributed on PRUNE_PHASE_CHAIN so the
+    classify cost splits at the two-level kernel's seam —
+    `prune_summary_gather` (aggregate rows + AND + short-circuit) vs
+    `prune_candidate_gather` (K-superblock gather + first-match scan +
+    fallback redispatches).  Same telescoped-sum honesty property; the
+    ±15% gate applies via bench_profile.py --mode prune."""
+    if meta.match.prune_budget <= 0:
+        raise ValueError(
+            "profile_churn_prune needs a prune_budget > 0 meta (the "
+            "two-level kernel is compiled out at 0)")
+    out = profile_churn_async(
+        meta, state, drs, dsvc, hot, pool, n_new=n_new, now0=now0, gen=gen,
+        k_small=k_small, k_big=k_big, repeats=repeats, chain=chain,
+    )
+    out["mode"] = "prune"
+    out["prune_budget"] = meta.match.prune_budget
+    return out

@@ -105,6 +105,9 @@ EVENT_KINDS = {
                            "rolls back / degrades ALL replicas",
     "realization": "observability/tracing.py — a policy realization span "
                    "closed (controller commit -> first live hit)",
+    "prune-retune": "datapath/tpuflow.py — the match-prune K-budget "
+                    "hysteresis controller moved one PRUNE_LADDER rung "
+                    "(fed by the measured fallback rate)",
 }
 
 

@@ -112,6 +112,13 @@ METRICS: dict[str, str] = {
     "antrea_tpu_replica_miss_queue_depth": "gauge",
     "antrea_tpu_replica_canary_mismatches_total": "counter",
     "antrea_tpu_replica_audit_entries_total": "counter",
+    # aggregated-bitmap match pruning (ops/match round 7; rendered when
+    # the datapath exposes prune_stats())
+    "antrea_tpu_match_prune_skips_total": "counter",
+    "antrea_tpu_match_prune_fallbacks_total": "counter",
+    "antrea_tpu_match_prune_candidate_superblocks": "histogram",
+    "antrea_tpu_match_prune_budget": "gauge",
+    "antrea_tpu_match_prune_retunes_total": "counter",
 }
 
 
@@ -170,6 +177,23 @@ class Histogram:
         self._counts[bisect.bisect_left(self.bounds, v)] += 1
         self.sum += v
         self.count += 1
+
+    def add_counts(self, counts, value_sum: float = 0.0) -> None:
+        """Merge DEVICE-side per-bucket counts (one int per bucket incl.
+        +Inf, indexed exactly like observe's bisect_left — see
+        models/pipeline._prune_bucket_counts) plus the observations'
+        value sum.  Lets a jitted kernel bucket thousands of lanes on
+        device and transfer one small vector instead of per-lane
+        values."""
+        counts = [int(c) for c in counts]
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"expected {len(self._counts)} bucket counts, "
+                f"got {len(counts)}")
+        for i, c in enumerate(counts):
+            self._counts[i] += c
+        self.count += sum(counts)
+        self.sum += float(value_sum)
 
     def merge(self, other: "Histogram") -> "Histogram":
         """Fold another histogram's observations into this one (fleet
@@ -545,6 +569,25 @@ def render_metrics(datapath, node: str = "") -> str:
             ("antrea_tpu_flightrecorder_seq", "seq"),
         ):
             lines += [_type_line(fam), f"{fam}{_labels(node=node)} {fr[key]}"]
+    pr = getattr(datapath, "prune_stats", None)
+    pr = pr() if pr is not None else None
+    if pr is not None:
+        # Aggregated-bitmap match pruning (ops/match round 7): aggregate
+        # short circuits, full-width fallback redispatches, the current
+        # K rung, retune volume, and the candidate-superblock spread.
+        for fam, key in (
+            ("antrea_tpu_match_prune_skips_total", "skips_total"),
+            ("antrea_tpu_match_prune_fallbacks_total", "fallbacks_total"),
+            ("antrea_tpu_match_prune_budget", "budget"),
+            ("antrea_tpu_match_prune_retunes_total", "retunes_total"),
+        ):
+            lines += [_type_line(fam), f"{fam}{_labels(node=node)} {pr[key]}"]
+        ph = pr.get("hist")
+        if ph is not None and ph.count:
+            lines.extend(_render_histograms(
+                [("antrea_tpu_match_prune_candidate_superblocks",
+                  {"node": node}, ph)]
+            ))
     ms = getattr(datapath, "mesh_stats", None)
     ms = ms() if ms is not None else None
     if ms is not None:

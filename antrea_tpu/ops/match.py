@@ -38,7 +38,7 @@ jit-compatible; batch size is the only trace-time variable.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +63,37 @@ BIG = 1 << 30
 
 _ALL1 = 0xFFFFFFFF
 
+# Aggregated-bitmap pruning (round 7; ABV-style two-level incidence).
+# One aggregate BIT summarizes one incidence WORD (32 rules); one
+# aggregate WORD therefore summarizes a 32-word SUPERBLOCK (1024 rules),
+# which is the granularity the candidate gather fetches at.  Aggregate
+# bits are conservative: never a false negative (a zero aggregate AND
+# proves no-match), possibly a false positive (the candidate gather then
+# finds an all-zero AND and the lane takes the default verdict).
+AGG_BLOCK = 32
+
+# The K-budget autotuner's closed rung ladder (one jit-cached classify
+# variant per rung, like the drain CHUNK_LADDER) and its hysteresis.
+PRUNE_LADDER = (1, 2, 4, 8, 16)
+PRUNE_STICKY = 2
+# Fallback-rate pressure band: above the high-water mark the budget
+# presses UP (too many full-width redispatches), below the low-water
+# mark it presses DOWN (budget head-room wasted on candidate volume).
+PRUNE_FB_HIGH = 0.05
+PRUNE_FB_LOW = 0.005
+
+# Candidate-superblock histogram bucket bounds (per-lane max over the
+# two directions) — shared by the device-side bucket counts
+# (models/pipeline._prune_bucket_counts) and the host Histogram they
+# merge into, so the exposition buckets can never drift.
+PRUNE_HIST_BOUNDS = (0, 1, 2, 4, 8, 16, 32)
+
+# Smallest in-kernel fallback rung (pow2 ladder, x4 steps up to the
+# batch size): unresolved lanes are compacted and redispatched at full
+# incidence width inside ONE lax.switch branch — the in-jit analog of
+# the PR 9 _spill_retry pow2-rung host dispatch.
+_FB_MIN = 64
+
 
 class DimTable(NamedTuple):
     """One match dimension: interval bounds + rule-incidence rows.
@@ -80,6 +111,14 @@ class DimTable(NamedTuple):
     # ascending lexicographically.  Empty (0, 4) for the svc dimension.
     bounds6: jax.Array
     inc: jax.Array  # (NB4+1+NB6+1, W) u32 — rule bitmap per interval
+    # Aggregate level (round 7, built only under prune_budget > 0 so the
+    # unpruned pytree — and every jit signature over it — is unchanged):
+    # (rows, W/AGG_BLOCK) u32, bit j of word s set iff inc word
+    # s*AGG_BLOCK+j is nonzero (build_agg is the ONE builder, shared with
+    # the consistency property tests).  W is padded to an AGG_BLOCK
+    # multiple whenever agg is built, so superblocks never straddle the
+    # row end (or a rule-axis shard boundary — see _width).
+    agg: Optional[jax.Array] = None
 
 
 class DeviceDirection(NamedTuple):
@@ -171,6 +210,13 @@ class StaticMeta(NamedTuple):
     # SECOND key derived from the lane's ServiceLB resolution.  Static so
     # svcref-free rule sets compile the extra gather out entirely.
     svcref: bool = False
+    # Two-level aggregate pruning (round 7): K = max candidate
+    # superblocks gathered per lane and direction; 0 compiles the whole
+    # aggregate layer out (the tables are then not even built — agg is
+    # None and the classify HLO is bit-identical to the pre-aggregate
+    # kernel).  Runtime-retunable on PRUNE_LADDER by swapping the meta
+    # (one jit-cached variant per rung; the tables are K-independent).
+    prune_budget: int = 0
 
 
 def empty_delta(slots: int, w_in: int, w_out: int, xp=jnp) -> DeltaTable:
@@ -211,6 +257,24 @@ def _inc_mask(rule_idx: np.ndarray, w: int) -> np.ndarray:
     inc = np.zeros(w, dtype=np.uint32)
     np.bitwise_or.at(inc, rule_idx >> 5, (1 << (rule_idx & 31)).astype(np.uint32))
     return inc
+
+
+def build_agg(inc: np.ndarray) -> np.ndarray:
+    """(rows, W) u32 incidence -> (rows, ceil(W/AGG_BLOCK)) u32 aggregate:
+    bit j of aggregate word s == (inc word s*AGG_BLOCK+j) != 0.  The ONE
+    aggregate builder — to_host, the delta kernel's on-the-fly mask
+    aggregation (_agg_mask) and the consistency property tests all follow
+    this definition, so table/aggregate divergence is a scrub finding,
+    never a construction ambiguity."""
+    inc = np.asarray(inc)
+    rows, w = inc.shape
+    s = -(-w // AGG_BLOCK)
+    pad = s * AGG_BLOCK - w
+    if pad:
+        inc = np.pad(inc, ((0, 0), (0, pad)))
+    nz = (inc.reshape(rows, s, AGG_BLOCK) != 0).astype(np.uint32)
+    return (nz << np.arange(AGG_BLOCK, dtype=np.uint32)[None, None, :]).sum(
+        axis=2, dtype=np.uint32)  # disjoint bits: sum == OR
 
 
 _V6_OFF = iputil.V6_OFF
@@ -281,7 +345,8 @@ def _paint(b4: list, b6: list, lo: int, hi: int, write) -> None:
         write(off + a, off + b)
 
 
-def _dim_table_host(gids: np.ndarray, groups: list, w: int, ip_dim: bool) -> DimTable:
+def _dim_table_host(gids: np.ndarray, groups: list, w: int, ip_dim: bool,
+                    agg: bool = False) -> DimTable:
     """Build one dimension's (bounds, bounds6, incidence) triple.
 
     Only the groups this dimension actually uses contribute boundary points,
@@ -318,7 +383,8 @@ def _dim_table_host(gids: np.ndarray, groups: list, w: int, ip_dim: bool) -> Dim
     else:
         bounds = np.array(b4, dtype=np.int64).astype(np.int32)
         bounds6 = np.zeros((0, 4), dtype=np.int32)
-    return DimTable(bounds=bounds, bounds6=bounds6, inc=inc)
+    return DimTable(bounds=bounds, bounds6=bounds6, inc=inc,
+                    agg=build_agg(inc) if agg else None)
 
 
 def _iso_host(gid: int, groups: list) -> IsoTable:
@@ -339,7 +405,7 @@ def _iso_host(gid: int, groups: list) -> IsoTable:
 
 
 def _direction_host(
-    dt: DirectionTensors, cps: CompiledPolicySet, w: int
+    dt: DirectionTensors, cps: CompiledPolicySet, w: int, agg: bool = False
 ) -> DeviceDirection:
     action = np.full(w * 32, ACT_DROP, dtype=np.int32)
     action[: dt.n_rules] = dt.action
@@ -347,16 +413,26 @@ def _direction_host(
     if dt.l7 is not None:
         l7[: dt.n_rules] = dt.l7
     return DeviceDirection(
-        at=_dim_table_host(dt.at_gid, cps.ip_groups, w, ip_dim=True),
-        peer=_dim_table_host(dt.peer_gid, cps.ip_groups, w, ip_dim=True),
-        svc=_dim_table_host(dt.svc_gid, cps.svc_groups, w, ip_dim=False),
+        at=_dim_table_host(dt.at_gid, cps.ip_groups, w, ip_dim=True, agg=agg),
+        peer=_dim_table_host(dt.peer_gid, cps.ip_groups, w, ip_dim=True,
+                             agg=agg),
+        svc=_dim_table_host(dt.svc_gid, cps.svc_groups, w, ip_dim=False,
+                            agg=agg),
         action=action,
         l7=l7,
         word_idx=np.arange(w, dtype=np.int32),
     )
 
 
-def _width(n_rules: int, word_multiple: int) -> int:
+def _width(n_rules: int, word_multiple: int, agg: bool = False) -> int:
+    # Dual-level alignment under pruning: W must divide by word_multiple
+    # (the rule-axis shard count) AND each shard's W/word_multiple slice
+    # must itself be an AGG_BLOCK multiple, so aggregate words never
+    # straddle a shard boundary and the agg axis shards evenly — hence
+    # word_multiple * AGG_BLOCK, not lcm (lcm alone leaves per-SHARD
+    # widths misaligned whenever gcd(word_multiple, 32) > 1).
+    if agg:
+        word_multiple *= AGG_BLOCK
     w = max(1, -(-n_rules // 32))
     return -(-w // word_multiple) * word_multiple
 
@@ -365,6 +441,7 @@ def to_host(
     cps: CompiledPolicySet,
     word_multiple: int = 1,
     delta_slots: int = 0,
+    prune_budget: int = 0,
 ) -> tuple[DeviceRuleSet, StaticMeta]:
     """Numpy-resident variant of to_device: the same pytree, zero device
     placement (jit accepts numpy leaves and places them itself — used by the
@@ -375,12 +452,16 @@ def to_host(
     the incidence word axis divides evenly across a rule-parallel mesh
     axis).  delta_slots reserves capacity for incremental membership deltas
     (see DeltaTable); 0 compiles the delta machinery out entirely.
+    prune_budget > 0 builds the aggregate tables (DimTable.agg) and enables
+    the two-level pruned classify at K = prune_budget candidate superblocks
+    per lane and direction; 0 builds the exact pre-aggregate pytree.
     """
-    w_in = _width(cps.ingress.n_rules, word_multiple)
-    w_out = _width(cps.egress.n_rules, word_multiple)
+    agg = prune_budget > 0
+    w_in = _width(cps.ingress.n_rules, word_multiple, agg=agg)
+    w_out = _width(cps.egress.n_rules, word_multiple, agg=agg)
     drs = DeviceRuleSet(
-        ingress=_direction_host(cps.ingress, cps, w_in),
-        egress=_direction_host(cps.egress, cps, w_out),
+        ingress=_direction_host(cps.ingress, cps, w_in, agg=agg),
+        egress=_direction_host(cps.egress, cps, w_out, agg=agg),
         iso_in=_iso_host(cps.iso_in_gid, cps.ip_groups),
         iso_out=_iso_host(cps.iso_out_gid, cps.ip_groups),
         ip_delta=empty_delta(max(delta_slots, 1), w_in, w_out, xp=np),
@@ -392,6 +473,7 @@ def to_host(
         w_out=w_out,
         delta_slots=delta_slots,
         svcref=cps.has_svcref,
+        prune_budget=prune_budget,
     )
     return drs, meta
 
@@ -400,8 +482,9 @@ def to_device(
     cps: CompiledPolicySet,
     word_multiple: int = 1,
     delta_slots: int = 0,
+    prune_budget: int = 0,
 ) -> tuple[DeviceRuleSet, StaticMeta]:
-    host, meta = to_host(cps, word_multiple, delta_slots)
+    host, meta = to_host(cps, word_multiple, delta_slots, prune_budget)
     return jax.tree_util.tree_map(jnp.asarray, host), meta
 
 
@@ -466,6 +549,169 @@ def _patch_iso(bit: jax.Array, ip_f: jax.Array, dt: DeltaTable, which: int,
         return bit
 
     return jax.lax.fori_loop(0, dt.n, body, bit)
+
+
+def _agg_mask(mask_w: jax.Array) -> jax.Array:
+    """(W,) u32 delta rule mask -> (W/AGG_BLOCK,) u32 aggregate mask, the
+    device-side twin of build_agg over one row (delta-slot aggregate
+    patching needs no new DeltaTable fields — the aggregate of a slot's
+    pre-resolved mask is derived in-kernel from the mask itself, so the
+    two can never drift)."""
+    s = mask_w.shape[0] // AGG_BLOCK
+    nz = (mask_w.reshape(s, AGG_BLOCK) != 0).astype(jnp.uint32)
+    j = jnp.arange(AGG_BLOCK, dtype=jnp.uint32)[None, :]
+    return (nz << j).sum(axis=1, dtype=jnp.uint32)  # disjoint bits: sum==OR
+
+
+def _patch_agg(rows: jax.Array, ip_f: jax.Array, dt: DeltaTable, masks,
+               wide=None) -> jax.Array:
+    """Delta-slot aggregate patching of gathered aggregate rows (B, S):
+    SET slots OR their aggregate mask in (a new member may light words the
+    compiled table left dark — skipping this would be a false NEGATIVE);
+    CLEAR slots leave the aggregate alone (a stale set bit is a legal
+    false positive — the candidate gather fetches the full words, applies
+    the full-width clear, and finds no match)."""
+
+    def body(i, rows):
+        m = _delta_lane_match(ip_f, dt, i, wide) & (dt.sign[i] > 0)
+        am = _agg_mask(masks[i])[None, :]
+        return jnp.where(m[:, None], rows | am, rows)
+
+    return jax.lax.fori_loop(0, dt.n, body, rows)
+
+
+def _patch_cand(cw: jax.Array, widx: jax.Array, ip_f: jax.Array,
+                dt: DeltaTable, masks, wide=None) -> jax.Array:
+    """_patch_rows over CANDIDATE-shaped rows (B, K, AGG_BLOCK): each
+    slot's (W,) mask is gathered at the lanes' candidate word indices
+    `widx` so set AND clear apply at full precision on exactly the words
+    the pruned path fetched."""
+
+    def body(i, cw):
+        m = _delta_lane_match(ip_f, dt, i, wide)
+        mw = masks[i][widx]  # (B, K, AGG_BLOCK) gather from (W,)
+        s = dt.sign[i]
+        cw = jnp.where((m & (s > 0))[:, None, None], cw | mw, cw)
+        cw = jnp.where((m & (s < 0))[:, None, None], cw & ~mw, cw)
+        return cw
+
+    return jax.lax.fori_loop(0, dt.n, body, cw)
+
+
+def _phase_first_from_base(mu: jax.Array, base: jax.Array, phases):
+    """Per-phase first-set-bit over words with PER-ELEMENT global rule
+    bases: mu (..., n) u32 match words, base (..., n) i32 = global word
+    index * 32.  The _phase_hits/_phase_scan_tile_dyn mask discipline
+    applied element-wise — shared by the pruned candidate scan (XLA and
+    pallas consumer alike) so the three first-match paths cannot drift.
+    -> 3 x (...,) i32 global rule indices (BIG = no match)."""
+
+    def first_bounded(lo_rule, hi_rule):
+        k_lo = jnp.clip(lo_rule - base, 0, 32)
+        k_hi = jnp.clip(hi_rule - base, 0, 32)
+        mask_lo = jnp.where(
+            k_lo <= 0,
+            jnp.uint32(_ALL1),
+            ~((jnp.uint32(1) << jnp.minimum(k_lo, 31).astype(jnp.uint32))
+              - jnp.uint32(1)),
+        )
+        mask_lo = jnp.where(k_lo >= 32, jnp.uint32(0), mask_lo)
+        mask_hi = jnp.where(
+            k_hi >= 32,
+            jnp.uint32(_ALL1),
+            (jnp.uint32(1) << jnp.clip(k_hi, 0, 31).astype(jnp.uint32))
+            - jnp.uint32(1),
+        )
+        mw = mu & mask_lo & mask_hi
+        lsb = mw & (jnp.uint32(0) - mw)
+        tz = jax.lax.population_count(lsb - jnp.uint32(1))
+        v = jnp.where(mw == jnp.uint32(0), BIG, base + tz.astype(jnp.int32))
+        return jnp.min(v, axis=-1)
+
+    n0, nk, _nb = phases
+    return (
+        first_bounded(0, n0),
+        first_bounded(n0, n0 + nk),
+        first_bounded(n0 + nk, 1 << 30),
+    )
+
+
+class PruneAutotuner:
+    """Bounded hysteresis controller for the prune K budget (the
+    DrainAutotuner pattern, fed by the measured fallback rate instead of
+    queue depth).  Pure decision logic: observe(classified, fallbacks)
+    -> the budget for subsequent classifies.  One rung per move, only
+    after `sticky` consecutive same-direction pressure signals; empty
+    windows hold."""
+
+    def __init__(self, initial: int, sticky: int = PRUNE_STICKY,
+                 fb_high: float = PRUNE_FB_HIGH, fb_low: float = PRUNE_FB_LOW):
+        self.rungs = list(PRUNE_LADDER)
+        self.idx = min(
+            range(len(self.rungs)),
+            key=lambda i: (abs(self.rungs[i] - int(initial)), self.rungs[i]),
+        )
+        self.sticky = int(sticky)
+        self.fb_high = float(fb_high)
+        self.fb_low = float(fb_low)
+        self._streak = 0
+        self.decisions_up = 0
+        self.decisions_down = 0
+
+    @property
+    def budget(self) -> int:
+        return self.rungs[self.idx]
+
+    def observe(self, classified: int, fallbacks: int) -> int:
+        if classified <= 0:
+            return self.budget  # empty window: no signal, streak kept
+        rate = fallbacks / classified
+        if rate > self.fb_high:
+            signal = 1
+        elif rate < self.fb_low:
+            signal = -1
+        else:
+            signal = 0
+        if signal == 0 or (self._streak and (signal > 0) != (self._streak > 0)):
+            self._streak = signal
+            return self.budget
+        self._streak += signal
+        if self._streak >= self.sticky and self.idx < len(self.rungs) - 1:
+            self.idx += 1
+            self.decisions_up += 1
+            self._streak = 0
+        elif self._streak <= -self.sticky and self.idx > 0:
+            self.idx -= 1
+            self.decisions_down += 1
+            self._streak = 0
+        return self.budget
+
+
+def _dim_index(tab, x: jax.Array, x6w, is6) -> jax.Array:
+    """Interval row index for one dimension: searchsorted in the v4
+    sub-space, or (for v6 lanes) the lexicographic v6 sub-space offset by
+    the v4 rows (DimTable dual-stack layout).  x6w=None = no v6 lanes for
+    this probe (pure-v4 batch, or the family-blind svc key space).  The
+    ONE derivation — shared by the full-width and pruned classify paths
+    so the v6 index math cannot drift between them."""
+    i4 = _searchsorted_right(tab.bounds, x)
+    if x6w is None:
+        return i4
+    i6 = tab.bounds.shape[0] + 1 + _searchsorted6(tab.bounds6, x6w)
+    return jnp.where(is6 != 0, i6, i4)
+
+
+def _svcref_key(svc_key: jax.Array, svc_ref) -> jax.Array:
+    """The toServices SECOND probe key (compiler SVCREF_BASE contract):
+    the lane's ServiceLB-resolved service index mapped into the reference
+    sub-space, SVCREF_NONE for non-service lanes.  The ONE derivation —
+    shared by the full-width and pruned classify paths so the probe-key
+    contract cannot drift between them."""
+    from ..compiler.compile import SVCREF_BASE, SVCREF_NONE
+
+    if svc_ref is None:
+        return jnp.full_like(svc_key, SVCREF_NONE)
+    return jnp.where(svc_ref >= 0, SVCREF_BASE + svc_ref, SVCREF_NONE)
 
 
 def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, int]):
@@ -636,6 +882,39 @@ def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, i
 #       PROFILE bench_profile.py --mode overlap (the ±15% gate
 #       cross-checks the attribution); this container is CPU-only, so
 #       the r06 record is the bench's to write, not this note's.
+#
+# Round-7: aggregated-bitmap pruning (ROADMAP item 2's kernel half; the
+# two-level classify shipped below as _classify_pruned).  Why this is NOT
+# the round-3 negative result re-tried: round 3 summarized at 32-WORD
+# block granularity (one bit per 1024 rules), where per-dim summary
+# density was 0.90/0.94/1.00 and the AND left 86% of blocks candidates.
+# The round-7 aggregate is one bit per WORD (32 rules) — 32x finer — and
+# the 32-word superblock is the candidate unit only for the SECOND
+# gather's shape (contiguous 128B block rows, the fast TPU gather
+# pattern), not for the pruning decision: a superblock is live iff its
+# aggregate WORD is nonzero, i.e. iff at least one of its 32
+# word-granular AND bits survives.  The ABV lesson (aggregated bit
+# vectors over sparse rule bitmaps) is that the 3-way AND at word
+# granularity is what is sparse, and that is knowable from ~W/32 words
+# per dimension instead of W.  Volume math at the bench world (W=3136
+# agg-padded, S=98): phase 1 gathers 6 x 98 u32 = ~2.4KB/packet (vs
+# ~75KB full-width, XLA's gather write-back doubling both); phase 2 at
+# K=4 adds 6 x 128 words = ~3KB for lanes the aggregate AND leaves live
+# — ~12x less candidate-path row volume, moving the ~7.4M pps hard
+# gather bound (round 4) past the 10M target, while the
+# aggregate-AND-zero short circuit drops the adversarial/all-miss
+# regime to phase-1 volume alone.  Exactness is structural, not
+# statistical: aggregate bits admit false positives (the candidate
+# gather then finds an all-zero AND -> default verdict) but never false
+# negatives, and lanes whose candidate count exceeds K redispatch at
+# full width in a pow2-rung lax.switch (the PR 9 _spill_retry shape,
+# in-jit), metered as match_prune_fallbacks_total and fed to the
+# K-budget autotuner (PruneAutotuner, the PR 6 DrainAutotuner pattern).
+# Decomposition + fallback-rate-vs-K + match-density sweeps:
+# bench_cold_study.py case 6; per-phase attribution: PRUNE_PHASE_CHAIN
+# (prune_summary_gather vs prune_candidate_gather) under the ±15% gate.
+# This container is CPU-only — the on-chip r07 cold/churn numbers, and
+# the honest fallback rate beside them, are the driver's to write.
 
 
 def _resolve(action: jax.Array, hits, pod_iso: jax.Array):
@@ -737,6 +1016,7 @@ def classify_batch(
     fused: bool = False,
     v6=None,
     svc_ref=None,
+    summary_only: bool = False,
 ):
     """-> dict with final/egress/ingress codes and deciding rule indices.
 
@@ -765,28 +1045,33 @@ def classify_batch(
     fused cold-path win.  Delta patching composes (it runs on the
     gathered rows before the consumer).  Off-TPU the kernel runs in
     interpret mode (slow; parity tests only).
+
+    meta.prune_budget > 0 routes through the two-level aggregated-bitmap
+    path (_classify_pruned, round 7); summary_only is its profiling
+    sub-mode (aggregate phase only, live lanes take defaults — the
+    PH_CLS_SUM surface, never a production verdict path) and is ignored
+    when pruning is off.
     """
+    if meta.prune_budget > 0 and drs.ingress.at.agg is not None:
+        return _classify_pruned(
+            drs, src_ip_f, dst_ip_f, proto, dst_port, meta=meta,
+            hit_combine=hit_combine, fused=fused, v6=v6, svc_ref=svc_ref,
+            summary_only=summary_only,
+        )
     ing, eg = drs.ingress, drs.egress
     svc_key = (proto << 16) | dst_port
     if v6 is not None:
         src6w, dst6w, is6 = v6
-
-    def dim_idx(tab, x, x6w):
-        i4 = _searchsorted_right(tab.bounds, x)
-        if v6 is None:
-            return i4
-        i6 = tab.bounds.shape[0] + 1 + _searchsorted6(tab.bounds6, x6w)
-        return jnp.where(is6 != 0, i6, i4)
+    else:
+        is6 = None
 
     def dim_row(tab: DimTable, x: jax.Array, x6w=None) -> jax.Array:
-        if x6w is None:
-            # svc dimension: the (proto<<16|port) key space is shared by
-            # both families — no v6 sub-space.
-            return tab.inc[_searchsorted_right(tab.bounds, x)]
-        return tab.inc[dim_idx(tab, x, x6w)]
+        # x6w is None for the svc dimension (the (proto<<16|port) key
+        # space is shared by both families — no v6 sub-space).
+        return tab.inc[_dim_index(tab, x, x6w, is6)]
 
     def iso_bit(tab: IsoTable, x: jax.Array, x6w=None) -> jax.Array:
-        return tab.val[dim_idx(tab, x, x6w)]
+        return tab.val[_dim_index(tab, x, x6w, is6)]
 
     # Ingress: pod = dst, peer = src.  Egress: pod = src, peer = dst.
     s6 = src6w if v6 is not None else None
@@ -804,15 +1089,7 @@ def classify_batch(
         # port ranges live below SVCREF_BASE and reference ranges at
         # SVCREF_BASE + idx, so each rule can match via exactly one of
         # the two probes (compiler/compile.py SVCREF_BASE contract).
-        from ..compiler.compile import SVCREF_BASE, SVCREF_NONE
-
-        if svc_ref is None:
-            ref_key = jnp.full_like(svc_key, SVCREF_NONE)
-        else:
-            ref_key = jnp.where(
-                svc_ref >= 0, SVCREF_BASE + svc_ref, SVCREF_NONE
-            )
-        out_svc = out_svc | dim_row(eg.svc, ref_key)
+        out_svc = out_svc | dim_row(eg.svc, _svcref_key(svc_key, svc_ref))
     iso_in = iso_bit(drs.iso_in, dst_ip_f, d6)
     iso_out = iso_bit(drs.iso_out, src_ip_f, s6)
 
@@ -1046,6 +1323,334 @@ def _fused_hits(rows_in, rows_out, meta: StaticMeta, w0_in=None, w0_out=None):
     return (hits[:, 0], hits[:, 1], hits[:, 2]), (hits[:, 3], hits[:, 4], hits[:, 5])
 
 
+# ---------------------------------------------------------------------------
+# Two-level aggregated-bitmap pruning (round 7; see the study notes above).
+# Phase 1 gathers only the aggregate rows (~W/32 words per dimension), ANDs
+# them per direction, and proves most lanes no-match outright; phase 2
+# gathers the K lowest candidate superblocks (K x AGG_BLOCK words) and
+# finishes the first-match scan on them; lanes with more than K candidate
+# superblocks redispatch at full width inside a pow2-rung lax.switch (the
+# in-jit analog of the PR 9 _spill_retry shape) so verdicts are always
+# exact — the aggregate layer can cost a fallback, never flip a verdict.
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=32)
+def _pruned_consumer_call(b, kw_in, kw_out, in_phases, out_phases, interpret):
+    """Pallas consumer for the pruned candidate matrices: per direction,
+    3 x (B, K*AGG_BLOCK) candidate words + 1 x (B, K*AGG_BLOCK) i32
+    per-element rule-base matrix (global word index * 32 — the base folds
+    in the rule-shard word offset, so one compiled kernel serves every
+    shard and emits GLOBAL rule indices for the pmin seam)."""
+    from jax.experimental import pallas as pl
+
+    tb = _FUSE_TB
+
+    def kernel(ia, ip_, is_, bi, oa, op_, os_, bo, o_ref):
+        i0, ik, ib = _phase_first_from_base(
+            ia[:] & ip_[:] & is_[:], bi[:], in_phases)
+        o0, ok_, ob = _phase_first_from_base(
+            oa[:] & op_[:] & os_[:], bo[:], out_phases)
+        o_ref[:] = jnp.stack(
+            [i0, ik, ib, o0, ok_, ob,
+             jnp.zeros_like(i0), jnp.zeros_like(i0)], axis=1,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 8), jnp.int32),
+        grid=(b // tb,),
+        in_specs=[pl.BlockSpec((tb, w), lambda i: (i, 0))
+                  for w in (kw_in, kw_in, kw_in, kw_in,
+                            kw_out, kw_out, kw_out, kw_out)],
+        out_specs=pl.BlockSpec((tb, 8), lambda i: (i, 0)),
+        interpret=interpret,
+    )
+
+
+def _classify_pruned(
+    drs: DeviceRuleSet,
+    src_ip_f: jax.Array,
+    dst_ip_f: jax.Array,
+    proto: jax.Array,
+    dst_port: jax.Array,
+    *,
+    meta: StaticMeta,
+    hit_combine=None,
+    fused: bool = False,
+    v6=None,
+    svc_ref=None,
+    summary_only: bool = False,
+):
+    """Two-level pruned classify (classify_batch's round-7 fast path).
+
+    Exactness: an aggregate bit is set iff its incidence word is nonzero
+    (build_agg), so a zero aggregate AND proves a zero full AND (no false
+    negatives) and candidates are a superset of match words.  Candidate
+    superblocks are scanned LOWEST-FIRST (first-match priority == lowest
+    set bit), so any phase hit found within the K lowest candidates is
+    the true first match; only a phase that found NOTHING on a lane with
+    more than K candidates is unproven — those lanes redispatch at full
+    width.  Delta slots patch the aggregate rows conservatively (SET ORs
+    the slot's aggregate mask; CLEAR leaves false-positive bits for the
+    candidate gather's full-width clear to resolve).
+
+    Returns the classify_batch dict plus per-lane prune observability
+    (REPLICATED over the rule axis under hit_combine — skip combines as
+    AND, fb as OR, cand as the per-shard MAX, all through the same
+    min-combine the hits use):
+      prune_skip (B,) bool — both directions proved no-match by the
+                             aggregate AND alone (the short-circuit lanes)
+      prune_fb   (B,) bool — lane took the full-width fallback redispatch
+                             (on ANY rule shard)
+      prune_cand (B,) i32  — candidate superblocks, max over directions
+                             and rule shards (what the per-shard K budget
+                             must cover)
+
+    summary_only (the PH_CLS_SUM profiling surface): stop after phase 1 —
+    every live lane takes the default-verdict image, nothing falls back.
+    """
+    ing, eg = drs.ingress, drs.egress
+    B = src_ip_f.shape[0]
+    K = meta.prune_budget
+    svc_key = (proto << 16) | dst_port
+    if v6 is not None:
+        src6w, dst6w, is6 = v6
+    else:
+        src6w = dst6w = is6 = None
+
+    def dim_idx(tab, x, x6w):
+        return _dim_index(tab, x, x6w, is6)
+
+    iv_in_at = dim_idx(ing.at, dst_ip_f, dst6w)
+    iv_in_peer = dim_idx(ing.peer, src_ip_f, src6w)
+    iv_in_svc = dim_idx(ing.svc, svc_key, None)
+    iv_out_at = dim_idx(eg.at, src_ip_f, src6w)
+    iv_out_peer = dim_idx(eg.peer, dst_ip_f, dst6w)
+    iv_out_svc = dim_idx(eg.svc, svc_key, None)
+    iv_ref = None
+    if meta.svcref:
+        iv_ref = dim_idx(eg.svc, _svcref_key(svc_key, svc_ref), None)
+
+    iso_in = drs.iso_in.val[dim_idx(drs.iso_in, dst_ip_f, dst6w)]
+    iso_out = drs.iso_out.val[dim_idx(drs.iso_out, src_ip_f, src6w)]
+
+    d = drs.ip_delta if meta.delta_slots > 0 else None
+    wide_d = None if v6 is None else (dst6w, is6)
+    wide_s = None if v6 is None else (src6w, is6)
+    if d is not None:
+        iso_in = _patch_iso(iso_in, dst_ip_f, d, 0, wide_d)
+        iso_out = _patch_iso(iso_out, src_ip_f, d, 1, wide_s)
+
+    # Per-direction dimension wiring: (tables, interval rows, probe ip
+    # column + wide words per ip dim, delta masks, phases).  Ingress: pod
+    # = dst probes appliedTo, peer = src; egress mirrored.
+    dir_in = dict(
+        dd=ing, iv_at=iv_in_at, iv_peer=iv_in_peer, iv_svc=iv_in_svc,
+        iv_ref=None, ip_at=dst_ip_f, ip_peer=src_ip_f, w_at=wide_d,
+        w_peer=wide_s, m_at=None if d is None else d.at_in,
+        m_peer=None if d is None else d.peer_in, phases=meta.in_phases,
+    )
+    dir_out = dict(
+        dd=eg, iv_at=iv_out_at, iv_peer=iv_out_peer, iv_svc=iv_out_svc,
+        iv_ref=iv_ref, ip_at=src_ip_f, ip_peer=dst_ip_f, w_at=wide_s,
+        w_peer=wide_d, m_at=None if d is None else d.at_out,
+        m_peer=None if d is None else d.peer_out, phases=meta.out_phases,
+    )
+
+    def agg_and(dc):
+        a = dc["dd"].at.agg[dc["iv_at"]]
+        p = dc["dd"].peer.agg[dc["iv_peer"]]
+        s = dc["dd"].svc.agg[dc["iv_svc"]]
+        if dc["iv_ref"] is not None:
+            s = s | dc["dd"].svc.agg[dc["iv_ref"]]
+        if d is not None:
+            a = _patch_agg(a, dc["ip_at"], d, dc["m_at"], dc["w_at"])
+            p = _patch_agg(p, dc["ip_peer"], d, dc["m_peer"], dc["w_peer"])
+        g = a & p & s
+        return g, (g != jnp.uint32(0)).sum(axis=1, dtype=jnp.int32)
+
+    g_in, nc_in = agg_and(dir_in)
+    g_out, nc_out = agg_and(dir_out)
+    BIGS = jnp.full((B,), BIG, jnp.int32)
+    no_fb = jnp.zeros((B,), bool)
+
+    def cand_mats(dc, g):
+        """Phase-2 candidate gather for one direction -> ((ca, cp, cs,
+        base) flattened to (B, Ke*AGG_BLOCK), Ke); the caller derives the
+        fallback mask from nc vs Ke."""
+        dd = dc["dd"]
+        S = dd.at.agg.shape[1]
+        Ke = min(K, S)
+        w = dd.at.inc.shape[1]  # == S * AGG_BLOCK (agg-padded width)
+        score = jnp.where(
+            g != jnp.uint32(0),
+            jax.lax.broadcasted_iota(jnp.int32, (B, S), 1),
+            S,
+        )
+        neg, _idx = jax.lax.top_k(-score, Ke)
+        cand = -neg  # (B, Ke) ascending superblock ids, S = fill
+        valid = cand < S
+        candc = jnp.minimum(cand, S - 1)
+
+        def cwords(tab, iv_):
+            inc2 = tab.inc.reshape(-1, AGG_BLOCK)
+            return inc2[iv_[:, None] * S + candc]  # (B, Ke, 32) block rows
+
+        ca = cwords(dd.at, dc["iv_at"])
+        cp = cwords(dd.peer, dc["iv_peer"])
+        cs = cwords(dd.svc, dc["iv_svc"])
+        if dc["iv_ref"] is not None:
+            cs = cs | cwords(dd.svc, dc["iv_ref"])
+        if d is not None:
+            widx = jnp.minimum(
+                candc[:, :, None] * AGG_BLOCK
+                + jnp.arange(AGG_BLOCK, dtype=jnp.int32)[None, None, :],
+                w - 1,
+            )
+            ca = _patch_cand(ca, widx, dc["ip_at"], d, dc["m_at"],
+                             dc["w_at"])
+            cp = _patch_cand(cp, widx, dc["ip_peer"], d, dc["m_peer"],
+                             dc["w_peer"])
+        # Fill candidates must contribute nothing: zero ONE dim (the AND
+        # kills the rest); done after delta patching on purpose.
+        ca = jnp.where(valid[:, :, None], ca, jnp.uint32(0))
+        j = jnp.arange(AGG_BLOCK, dtype=jnp.int32)[None, None, :]
+        base = (dd.word_idx[0] + candc[:, :, None] * AGG_BLOCK + j) * 32
+        flat = lambda x: x.reshape(B, Ke * AGG_BLOCK)  # noqa: E731
+        return (flat(ca), flat(cp), flat(cs), flat(base)), Ke
+
+    def full_dir_hits(dc, safe):
+        """Full-width fallback walk of the compacted lanes `safe`."""
+        dd = dc["dd"]
+        ra = dd.at.inc[dc["iv_at"][safe]]
+        rp = dd.peer.inc[dc["iv_peer"][safe]]
+        rs = dd.svc.inc[dc["iv_svc"][safe]]
+        if dc["iv_ref"] is not None:
+            rs = rs | dd.svc.inc[dc["iv_ref"][safe]]
+        if d is not None:
+            def sub(wd):
+                return None if wd is None else (wd[0][safe], wd[1][safe])
+
+            ra = _patch_rows(ra, dc["ip_at"][safe], d, dc["m_at"],
+                             sub(dc["w_at"]))
+            rp = _patch_rows(rp, dc["ip_peer"][safe], d, dc["m_peer"],
+                             sub(dc["w_peer"]))
+        return _phase_hits(ra & rp & rs, dd.word_idx, dc["phases"])
+
+    if summary_only:
+        in_hits = (BIGS, BIGS, BIGS)
+        out_hits = (BIGS, BIGS, BIGS)
+        fb = no_fb
+    else:
+        def phase2(_):
+            mats_in, ke_in = cand_mats(dir_in, g_in)
+            mats_out, ke_out = cand_mats(dir_out, g_out)
+            if fused:
+                if meta.fused_interpret is not None:
+                    interpret = meta.fused_interpret
+                else:
+                    interpret = jax.devices()[0].platform == "cpu"
+                pad = (-B) % _FUSE_TB
+                if pad:
+                    mats_in = tuple(jnp.pad(x, ((0, pad), (0, 0)))
+                                    for x in mats_in)
+                    mats_out = tuple(jnp.pad(x, ((0, pad), (0, 0)))
+                                     for x in mats_out)
+                call = _pruned_consumer_call(
+                    B + pad, ke_in * AGG_BLOCK, ke_out * AGG_BLOCK,
+                    meta.in_phases, meta.out_phases, interpret,
+                )
+                hits = call(*mats_in, *mats_out)[:B]
+                hits6 = tuple(hits[:, i] for i in range(6))
+            else:
+                ia, ipr, isv, bi = mats_in
+                oa, opr, osv, bo = mats_out
+                hits6 = (_phase_first_from_base(ia & ipr & isv, bi,
+                                                meta.in_phases)
+                         + _phase_first_from_base(oa & opr & osv, bo,
+                                                  meta.out_phases))
+            fb = (nc_in > ke_in) | (nc_out > ke_out)
+            fb_idx = jnp.nonzero(fb, size=B, fill_value=B)[0].astype(
+                jnp.int32)
+            n_fb = fb.sum(dtype=jnp.int32)
+            rungs = []
+            r = _FB_MIN
+            while r < B:
+                rungs.append(r)
+                r *= 4
+            rungs = sorted(set(min(r, B) for r in rungs + [B]))
+
+            def apply_rung(r):
+                def go(h6):
+                    idx = fb_idx[:r]
+                    safe = jnp.minimum(idx, B - 1)
+                    ih = full_dir_hits(dir_in, safe)
+                    oh = full_dir_hits(dir_out, safe)
+                    tgt = jnp.where(idx < B, idx, B)  # B drops (OOB)
+                    return tuple(
+                        cur.at[tgt].set(new, mode="drop")
+                        for cur, new in zip(h6, ih + oh)
+                    )
+
+                return go
+
+            branches = [lambda h6: h6] + [apply_rung(r) for r in rungs]
+            sel = jnp.where(
+                n_fb == 0,
+                0,
+                1 + sum(((n_fb > r).astype(jnp.int32) for r in rungs[:-1]),
+                        start=jnp.int32(0)),
+            )
+            hits6 = jax.lax.switch(sel, branches, hits6)
+            return hits6 + (fb,)
+
+        def all_dead(_):
+            # Aggregate-AND-zero short circuit for the whole batch (the
+            # adversarial / default-deny cold shape): no candidate
+            # gather, no fallback — straight to the default verdicts.
+            return (BIGS,) * 6 + (no_fb,)
+
+        res = jax.lax.cond(
+            ((nc_in > 0) | (nc_out > 0)).any(), phase2, all_dead, None
+        )
+        in_hits, out_hits, fb = res[0:3], res[3:6], res[6]
+
+    skip = ((nc_in == 0) & (nc_out == 0)).astype(jnp.int32)
+    cand = jnp.maximum(nc_in, nc_out)
+    fbi = fb.astype(jnp.int32)
+    if hit_combine is not None:
+        in_hits = tuple(hit_combine(h) for h in in_hits)
+        out_hits = tuple(hit_combine(h) for h in out_hits)
+        # The prune observables are SHARD-LOCAL under rule sharding
+        # (each shard prunes its own aggregate slice); emitting them raw
+        # would violate the replicated-output contract every other
+        # output keeps via the pmin (mesh._probe_shard_map).  Combine
+        # them through the SAME min-combine: skip is an AND (min of
+        # 0/1 — no shard had a candidate), fallback an OR (1 - min of
+        # the complement — ANY shard redispatched), and cand the MAX
+        # per-shard count (min of the negation) — the quantity the
+        # per-shard K budget must actually cover, which is what the
+        # autotuner and the histogram exist to answer.
+        skip = hit_combine(skip)
+        fbi = 1 - hit_combine(1 - fbi)
+        cand = -hit_combine(-cand)
+
+    in_code, in_rule = _resolve(ing.action, in_hits, iso_in)
+    out_code, out_rule = _resolve(eg.action, out_hits, iso_out)
+    final = jnp.where(out_code != ACT_ALLOW, out_code, in_code)
+    return {
+        "code": final,
+        "egress_code": out_code,
+        "egress_rule": out_rule,
+        "ingress_code": in_code,
+        "ingress_rule": in_rule,
+        "prune_skip": skip > 0,
+        "prune_fb": fbi > 0,
+        "prune_cand": cand,
+    }
+
+
 def flip_ips(a: np.ndarray) -> np.ndarray:
     """Host helper: u32 IP array -> sign-flipped i32 (kernel input layout)."""
     return iputil.flip_u32(a)
@@ -1054,7 +1659,8 @@ def flip_ips(a: np.ndarray) -> np.ndarray:
 # meta is static (plain ints/tuples, hashable); drs is a traced pytree arg so
 # the big incidence tensors stay runtime inputs instead of baked-in constants.
 _classify_jit = jax.jit(
-    classify_batch, static_argnames=("meta", "hit_combine", "fused")
+    classify_batch,
+    static_argnames=("meta", "hit_combine", "fused", "summary_only"),
 )
 
 

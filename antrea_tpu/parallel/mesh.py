@@ -187,11 +187,19 @@ def make_mesh(n_data: int, n_rule: int, devices=None) -> Mesh:
 MESH_SPEC_ALLOWLIST: dict = {}
 
 
-def _drs_specs() -> m.DeviceRuleSet:
+def _drs_specs(agg: bool = False) -> m.DeviceRuleSet:
     def dim():
         # Interval bounds (v4 + v6 lexicographic) replicated, incidence
         # words sharded — bounds are the small side in both families.
-        return m.DimTable(bounds=P(), bounds6=P(), inc=P(None, RULE))
+        # The aggregate level (round-7 pruning) shards on ITS word axis
+        # exactly like the incidence it summarizes: to_device pads W to a
+        # word_multiple*AGG_BLOCK multiple under pruning (dual-level
+        # alignment, ops/match._width), so each rule shard's agg slice
+        # covers precisely its own inc words and no aggregate word
+        # straddles a shard boundary.  agg=False worlds carry agg=None
+        # (an EMPTY pytree node), matching the unpruned table pytree.
+        return m.DimTable(bounds=P(), bounds6=P(), inc=P(None, RULE),
+                          agg=P(None, RULE) if agg else None)
 
     dd = m.DeviceDirection(
         at=dim(),
@@ -272,16 +280,18 @@ def _state_specs() -> pl.PipelineState:
     return pl.PipelineState(flow=flow, aff=aff)
 
 
-def shard_rule_set(cps: CompiledPolicySet, mesh: Mesh):
+def shard_rule_set(cps: CompiledPolicySet, mesh: Mesh,
+                   prune_budget: int = 0):
     """Compile + place rule tensors on the mesh -> (drs, StaticMeta)."""
     n_rule = mesh.shape[RULE]
-    drs, meta = m.to_device(cps, word_multiple=n_rule)
+    drs, meta = m.to_device(cps, word_multiple=n_rule,
+                            prune_budget=prune_budget)
     # The fused consumer must interpret iff the MESH's backend is CPU —
     # the default platform can differ (virtual-CPU dryrun on a TPU host).
     meta = meta._replace(
         fused_interpret=(mesh.devices.flat[0].platform == "cpu")
     )
-    specs = _drs_specs()
+    specs = _drs_specs(agg=prune_budget > 0)
     drs = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), drs, specs
     )
@@ -303,13 +313,18 @@ def _pmin_rule(h: jax.Array) -> jax.Array:
     return lax.pmin(h, RULE)
 
 
-def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh):
+def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh,
+                            prune_budget: int = 0):
     """Stateless sharded classification: -> (fn(src_f, dst_f, proto, dport), drs).
 
     fn is jitted over the mesh; inputs are (B,) arrays with B divisible by the
-    data axis size; outputs land sharded over ``data``.
+    data axis size; outputs land sharded over ``data``.  prune_budget > 0
+    builds + shards the aggregate tables and runs the two-level pruned
+    walk per shard (candidates and fallback stay shard-local; the pmin
+    combine is unchanged).
     """
-    drs, meta = shard_rule_set(cps, mesh)
+    drs, meta = shard_rule_set(cps, mesh, prune_budget=prune_budget)
+    dspec = _drs_specs(agg=prune_budget > 0)
 
     def body(drs, src_f, dst_f, proto, dport):
         return m.classify_batch(
@@ -319,7 +334,7 @@ def make_sharded_classifier(cps: CompiledPolicySet, mesh: Mesh):
     shmapped = _shard_map(
         body,
         mesh=mesh,
-        in_specs=(_drs_specs(), P(DATA), P(DATA), P(DATA), P(DATA)),
+        in_specs=(dspec, P(DATA), P(DATA), P(DATA), P(DATA)),
         out_specs=P(DATA),
     )
     jitted = jax.jit(shmapped)
@@ -339,12 +354,14 @@ def _fwd_specs() -> fw.DeviceForwardingTables:
 
 
 def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
-                        ct_timeout_s, miss_chunk, fused=False):
+                        ct_timeout_s, miss_chunk, fused=False,
+                        prune_budget=0):
     """Shared builder behind make_sharded_pipeline[_full] — one place for
     the capacity check, placement, meta/state construction and shard_map
     scaffolding so the two public variants can never drift."""
     pl.check_rule_capacity(cps)
-    drs, match_meta = shard_rule_set(cps, mesh)
+    drs, match_meta = shard_rule_set(cps, mesh, prune_budget=prune_budget)
+    dspec = _drs_specs(agg=prune_budget > 0)
     repl = NamedSharding(mesh, P())
     dsvc = jax.tree.map(
         lambda x: jax.device_put(x, repl), pl.svc_to_device(svc)
@@ -367,10 +384,13 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
     state = shard_state(pl.init_state(flow_slots, aff_slots), mesh)
 
     def finish(local, out):
-        # scalar per shard -> (D,) vector of per-data-shard counts
-        out["n_miss"] = out["n_miss"][None]
-        out["n_evict"] = out["n_evict"][None]
-        out["n_reclaim"] = out["n_reclaim"][None]
+        # scalar per shard -> (D,) vector of per-data-shard counts (the
+        # prune keys exist iff prune_budget > 0; the hist vector gains
+        # the same leading axis and is summed host-side)
+        for k in ("n_miss", "n_evict", "n_reclaim", "n_prune_skips",
+                  "n_prune_fb", "prune_cand_hist"):
+            if k in out:
+                out[k] = out[k][None]
         return jax.tree.map(lambda x: x[None], local), out
 
     if ft is None:
@@ -385,7 +405,7 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
             return finish(local, out)
 
         in_specs = (
-            _state_specs(), _drs_specs(), _svc_specs(),
+            _state_specs(), dspec, _svc_specs(),
             P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(), P(),
         )
     else:
@@ -400,7 +420,7 @@ def _build_sharded_step(cps, svc, mesh, ft, flow_slots, aff_slots,
             return finish(local, out)
 
         in_specs = (
-            _state_specs(), _drs_specs(), _svc_specs(), _fwd_specs(),
+            _state_specs(), dspec, _svc_specs(), _fwd_specs(),
             P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA), P(DATA),
             P(DATA), P(), P(),
         )
@@ -424,6 +444,7 @@ def make_sharded_pipeline(
     ct_timeout_s: int = 3600,
     miss_chunk: int = 4096,
     fused: bool = False,
+    prune_budget: int = 0,
 ):
     """Full stateful datapath step, SPMD over (data, rule).
 
@@ -435,7 +456,7 @@ def make_sharded_pipeline(
     """
     step, state, drs, dsvc, _dft = _build_sharded_step(
         cps, svc, mesh, None, flow_slots, aff_slots, ct_timeout_s,
-        miss_chunk, fused=fused,
+        miss_chunk, fused=fused, prune_budget=prune_budget,
     )
     return step, state, (drs, dsvc)
 
@@ -451,6 +472,7 @@ def make_sharded_pipeline_full(
     ct_timeout_s: int = 3600,
     miss_chunk: int = 4096,
     fused: bool = False,
+    prune_budget: int = 0,
 ):
     """The FULL per-packet walk (SpoofGuard -> policy/service pipeline ->
     L2/L3 forward -> Output, models/forwarding._pipeline_step_full), SPMD
@@ -466,6 +488,6 @@ def make_sharded_pipeline_full(
     """
     step, state, drs, dsvc, dft = _build_sharded_step(
         cps, svc, mesh, ft, flow_slots, aff_slots, ct_timeout_s,
-        miss_chunk, fused=fused,
+        miss_chunk, fused=fused, prune_budget=prune_budget,
     )
     return step, state, (drs, dsvc, dft)

@@ -138,15 +138,20 @@ def _mesh_step_fn(mesh, meta: pl.PipelineMeta):
             no_commit=no_commit, flags=flags,
             lens=lens if meta.count_flow_stats else None,
         )
-        # scalar per shard -> (D,) vector of per-data-shard counts
-        for k in ("n_miss", "n_evict", "n_reclaim"):
-            out[k] = out[k][None]
+        # scalar per shard -> (D,) vector of per-data-shard counts (the
+        # prune keys exist iff the meta carries a prune budget)
+        for k in ("n_miss", "n_evict", "n_reclaim", "n_prune_skips",
+                  "n_prune_fb", "prune_cand_hist"):
+            if k in out:
+                out[k] = out[k][None]
         return jax.tree.map(lambda x: x[None], local), out
 
     return jax.jit(_shard_map(
         body,
         mesh=mesh,
-        in_specs=(_state_specs(), _drs_specs(), _svc_specs(),
+        in_specs=(_state_specs(),
+                  _drs_specs(agg=meta.match.prune_budget > 0),
+                  _svc_specs(),
                   lane, lane, lane, lane, lane, P(), P(),
                   lane, lane, lane, lane),
         out_specs=(_state_specs(), P(DATA)),
@@ -170,7 +175,8 @@ def _mesh_canary_fn(mesh, match_meta):
     return jax.jit(_shard_map(
         body,
         mesh=mesh,
-        in_specs=(_drs_specs(), P(DATA), P(DATA), P(DATA), P(DATA)),
+        in_specs=(_drs_specs(agg=match_meta.prune_budget > 0),
+                  P(DATA), P(DATA), P(DATA), P(DATA)),
         out_specs=P(DATA),
     ))
 
@@ -417,7 +423,8 @@ class MeshDatapath(TpuflowDatapath):
 
     def _place_rules(self, cps):
         drs, meta = to_device(cps, word_multiple=self._n_rule,
-                              delta_slots=self._delta_slots)
+                              delta_slots=self._delta_slots,
+                              prune_budget=self._prune_budget)
         # The fused consumer must interpret iff the MESH's backend is CPU
         # (the default platform can differ — virtual-CPU mesh on a TPU
         # host), mirroring mesh.shard_rule_set.
@@ -425,7 +432,7 @@ class MeshDatapath(TpuflowDatapath):
             fused_interpret=(self._mesh.devices.flat[0].platform == "cpu"))
         drs = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
-            drs, _drs_specs())
+            drs, _drs_specs(agg=self._prune_budget > 0))
         return drs, meta
 
     def _place_services(self, dsvc: pl.DeviceServiceTables):
@@ -504,6 +511,9 @@ class MeshDatapath(TpuflowDatapath):
         o.pop("n_miss")
         self._evictions += int(o.pop("n_evict").sum())
         self._reclaims += int(o.pop("n_reclaim").sum())
+        self._prune_account(o)
+        for k in ("n_prune_skips", "n_prune_fb", "prune_cand_hist"):
+            o.pop(k, None)
         o = {k: v[inv] for k, v in o.items()}  # back to packet order
         spilled = perm[np.nonzero(spill)[0]]  # packet indices off-home
         if spilled.size:
@@ -589,6 +599,12 @@ class MeshDatapath(TpuflowDatapath):
         self._evictions += int(o2.pop("n_evict").sum())
         self._reclaims += int(o2.pop("n_reclaim").sum())
         o2.pop("n_miss")
+        # NOT _prune_account'ed: every spilled lane was already metered by
+        # the main dispatch (counts-exactly-once, like _count_metrics —
+        # the retry is a re-dispatch of the same packets, and feeding the
+        # K autotuner the same lanes twice would double their evidence).
+        for k in ("n_prune_skips", "n_prune_fb", "prune_cand_hist"):
+            o2.pop(k, None)
         sel = np.nonzero(valid)[0]
         pkts = idx[sel]
         for k in o:
@@ -648,6 +664,7 @@ class MeshDatapath(TpuflowDatapath):
         o = {k: np.asarray(v) for k, v in out.items()}
         self._evictions += int(o["n_evict"].sum())
         self._reclaims += int(o["n_reclaim"].sum())
+        self._prune_account(o)
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         sel = valid

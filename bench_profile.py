@@ -35,9 +35,10 @@ from antrea_tpu.compiler.services import compile_services
 from antrea_tpu.models import pipeline as pl
 from antrea_tpu.models.profile import (MAINT_PHASE_CHAIN,
                                        OVERLAP_PHASE_CHAIN, PHASE_CHAIN,
-                                       profile_churn,
+                                       PRUNE_PHASE_CHAIN, profile_churn,
                                        profile_churn_maintenance,
-                                       profile_churn_overlap)
+                                       profile_churn_overlap,
+                                       profile_churn_prune)
 from antrea_tpu.simulator.genpolicy import gen_cluster
 from antrea_tpu.simulator.genservice import gen_services
 from antrea_tpu.simulator.traffic import gen_traffic
@@ -79,7 +80,8 @@ def main() -> int:
     ap.add_argument("--k-big", type=int, default=16)
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument(
-        "--mode", choices=("sync", "overlap", "maintenance"), default="sync",
+        "--mode", choices=("sync", "overlap", "maintenance", "prune"),
+        default="sync",
         help="sync = the inline slow-path chain (PHASE_CHAIN); overlap = "
              "the round-6 double-buffered regime (OVERLAP_PHASE_CHAIN: "
              "drain of window i-1 overlapping fast step i) — diff the "
@@ -87,8 +89,13 @@ def main() -> int:
              "maintenance = the unified background plane's cadence "
              "(MAINT_PHASE_CHAIN: the scheduler's fused maintenance pass "
              "riding every step) — maintenance_s is the plane's own "
-             "attributed cost",
+             "attributed cost; prune = the round-7 two-level kernel's "
+             "regime (PRUNE_PHASE_CHAIN: the async cadence over a "
+             "prune_budget>0 meta, classify split into summary-gather vs "
+             "candidate-gather)",
     )
+    ap.add_argument("--prune-budget", type=int, default=4,
+                    help="K budget for --mode prune (PRUNE_LADDER rung)")
     args = ap.parse_args()
     out_path = args.out or _next_out(os.path.dirname(os.path.abspath(__file__)))
 
@@ -106,7 +113,8 @@ def main() -> int:
                        seed=32, services=services, svc_fraction=0.3,
                        one_per_flow=True)
     step, state, (drs, dsvc) = pl.make_pipeline(
-        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=4096, fused=True
+        cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=4096, fused=True,
+        prune_budget=args.prune_budget if args.mode == "prune" else 0,
     )
     hot_c, pool_c = _cols(hot), _cols(pool)
     n_new = B // CHURN_DIV
@@ -135,6 +143,20 @@ def main() -> int:
         # Independent full-step measurement of the SAME maintenance
         # cadence (rider included): fresh dispatches, different K values.
         indep = profile_churn_maintenance(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
+            repeats=args.repeats,
+            chain=(("base", 0), ("full", pl.PH_ALL)),
+        )
+    elif args.mode == "prune":
+        chain = PRUNE_PHASE_CHAIN
+        prof = profile_churn_prune(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=args.k_small, k_big=args.k_big, repeats=args.repeats,
+        )
+        # Independent full-step measurement of the SAME pruned cadence:
+        # fresh dispatches, different K values.
+        indep = profile_churn_prune(
             step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
             k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
             repeats=args.repeats,
@@ -176,6 +198,8 @@ def main() -> int:
         # per-step cost (maint_fast_path minus a rider-free fast step).
         "maintenance_s": prof.get("maintenance_s"),
         "maintenance_fraction": prof.get("maintenance_fraction"),
+        # Prune mode only: the K budget the chain was attributed at.
+        "prune_budget": prof.get("prune_budget"),
         "check": {
             "sum_phases_s": sum_phases,
             "independent_step_s": indep["total_s"],

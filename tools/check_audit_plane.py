@@ -40,6 +40,7 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "antrea_tpu"
 AUDIT = PKG / "datapath" / "audit.py"
+MATCH = PKG / "ops" / "match.py"
 ENGINES = (
     PKG / "datapath" / "tpuflow.py",
     PKG / "datapath" / "oracle_dp.py",
@@ -101,6 +102,38 @@ def check() -> list[str]:
         problems.append(
             f"{key!r} is both scrubbed (SCRUB_MANIFEST) and waived "
             f"(SCRUB_ALLOWLIST) — pick one"
+        )
+
+    # Round-7 aggregate tables: while DimTable carries an `agg` field the
+    # SUB-tensor table must carry its "drs.agg" row (a corrupt aggregate
+    # bit can flip a verdict — see the SCRUB_SUBTENSORS comment; it rides
+    # the `drs` digest, so it must NOT be a manifest row, which would
+    # inflate the maintenance scheduler's scrub cost) and vice versa (a
+    # stale row must not outlive the field).
+    try:
+        subtensors = load_table(audit_text, "SCRUB_SUBTENSORS")
+    except ValueError as e:
+        return problems + [str(e)]
+    for key in set(subtensors) & set(manifest):
+        problems.append(
+            f"{key!r} is in both SCRUB_MANIFEST and SCRUB_SUBTENSORS — "
+            f"sub-tensors ride a group digest, they are not extra folds"
+        )
+    match_text = MATCH.read_text() if MATCH.exists() else ""
+    dim_cls = re.search(r"^class DimTable\(.*?(?=^class |^def )",
+                        match_text, re.M | re.S)
+    has_agg_field = bool(dim_cls) and bool(
+        re.search(r"^    agg\s*:", dim_cls.group(0), re.M))
+    if has_agg_field and "drs.agg" not in subtensors:
+        problems.append(
+            "ops/match.DimTable declares `agg` but SCRUB_SUBTENSORS has "
+            "no 'drs.agg' row — aggregate/table divergence would go "
+            "undocumented/ungated"
+        )
+    if not has_agg_field and "drs.agg" in subtensors:
+        problems.append(
+            "SCRUB_SUBTENSORS carries 'drs.agg' but ops/match.DimTable "
+            "declares no `agg` field — stale row"
         )
 
     for path in ENGINES:

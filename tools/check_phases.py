@@ -37,7 +37,7 @@ BENCH = REPO / "bench_profile.py"
 _PH_DEF = re.compile(r"^(PH_[A-Z0-9_]+)\s*=\s*(.+?)\s*(?:#.*)?$", re.M)
 _CHAIN = re.compile(
     r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN|OVERLAP_PHASE_CHAIN"
-    r"|MAINT_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
+    r"|MAINT_PHASE_CHAIN|PRUNE_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
     re.M | re.S,
 )
 _ENTRY = re.compile(r'\(\s*"([a-z0-9_]+)"\s*,\s*([^)]*?)\s*\)', re.S)
@@ -99,7 +99,8 @@ def check() -> list[str]:
 
     chains = parse_chains()
     for required in ("PHASE_CHAIN", "ASYNC_PHASE_CHAIN",
-                     "OVERLAP_PHASE_CHAIN", "MAINT_PHASE_CHAIN"):
+                     "OVERLAP_PHASE_CHAIN", "MAINT_PHASE_CHAIN",
+                     "PRUNE_PHASE_CHAIN"):
         if required not in chains:
             problems.append(f"profile.py defines no {required}")
     seen_names: set[str] = set()
