@@ -70,6 +70,10 @@ MAINT_TASKS = {
     "fqdn-ttl": "agent/fqdn.py (DNS-learned membership TTL GC)",
     "observability": "observability/flightrec.py + tracing.py (journal/"
                      "span bookkeeping, cost-accounted not smeared)",
+    "reshard-migrate": "parallel/reshard.py (budgeted drain-and-migrate of "
+                       "flow-cache rows to their target-topology home "
+                       "shards; registered by the mesh engine only while "
+                       "a live data-axis resize is in flight)",
 }
 
 # A starved task's deficit keeps accumulating so it can eventually afford

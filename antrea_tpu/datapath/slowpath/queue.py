@@ -45,6 +45,24 @@ class MissQueue:
     def _slots(self, start: int, n: int) -> np.ndarray:
         return (start + np.arange(n)) % self.capacity
 
+    def _append(self, cols: dict, idx: np.ndarray, names) -> tuple:
+        """The ONE bounded-ring append: write `idx`-selected rows of the
+        `names` columns, tail-dropping past capacity (keep arrival order,
+        drop newest; drops metered in overflows_total).  -> (written
+        positions or None, selected indices, dropped count)."""
+        room = self.capacity - self._size
+        take = min(int(idx.size), room)
+        dropped = int(idx.size) - take
+        pos = None
+        if take:
+            sel = idx[:take]
+            pos = self._slots(self._head + self._size, take)
+            for c in names:
+                self._buf[c][pos] = np.asarray(cols[c]).astype(np.int64)[sel]
+            self._size += take
+        self.overflows_total += dropped
+        return pos, take, dropped
+
     def admit(self, cols: dict, mask: np.ndarray, epoch: int, now: int
               ) -> tuple[int, int]:
         """Append the masked lanes -> (admitted, dropped).  cols maps the
@@ -53,20 +71,23 @@ class MissQueue:
         idx = np.nonzero(np.asarray(mask, bool))[0]
         if idx.size == 0:
             return 0, 0
-        room = self.capacity - self._size
-        take = min(int(idx.size), room)
-        dropped = int(idx.size) - take
+        pos, take, dropped = self._append(
+            cols, idx, ("src_ip", "dst_ip", "proto", "src_port", "dst_port",
+                        "flags", "lens"))
         if take:
-            sel = idx[:take]  # tail-drop: keep arrival order, drop newest
-            pos = self._slots(self._head + self._size, take)
-            for c in ("src_ip", "dst_ip", "proto", "src_port", "dst_port",
-                      "flags", "lens"):
-                self._buf[c][pos] = np.asarray(cols[c]).astype(np.int64)[sel]
             self._buf["epoch"][pos] = epoch
             self._buf["enq_ts"][pos] = now
-            self._size += take
             self.admitted_total += take
-        self.overflows_total += dropped
+        return take, dropped
+
+    def requeue(self, block: dict, idx) -> tuple[int, int]:
+        """Append selected rows of a popped block VERBATIM (epoch/enq_ts
+        preserved) -> (requeued, dropped).  The reshard re-route path
+        (parallel/meshpath.MeshSlowPath.resize): these are not
+        admissions, so `admitted_total` is untouched; rows that do not
+        fit tail-drop into `overflows_total` — the ordinary bounded-queue
+        contract, the flow re-admits on its next miss."""
+        _pos, take, dropped = self._append(block, np.asarray(idx), COLUMNS)
         return take, dropped
 
     def pop(self, n: int) -> dict | None:

@@ -263,6 +263,12 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         NamedSharding on the mesh)."""
         return dsvc
 
+    def _place_forwarding(self, dft: fwd.DeviceForwardingTables):
+        """Forwarding-table placement hook (mesh engine: replicated on
+        the mesh, like the service tables — forwarding is the small,
+        read-mostly side and shards trivially over data)."""
+        return dft
+
     # -- Datapath ------------------------------------------------------------
 
     @property
@@ -439,7 +445,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._topo = topo
         self._ft = ft
         self._rt = topology.resolve_topology(topo)
-        self._dft = fwd.fwd_to_device(ft)
+        self._dft = self._place_forwarding(fwd.fwd_to_device(ft))
         self._persist_topology()
         # The forwarding tensors changed legitimately: re-anchor the
         # checksum scrub's golden digests (datapath/audit.py).
@@ -1049,7 +1055,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._drs = drs
         self._upload_delta_table()
         self._compile_services()
-        self._dft = fwd.fwd_to_device(self._ft)
+        self._dft = self._place_forwarding(fwd.fwd_to_device(self._ft))
 
     def _live_mask(self, keys, meta, ts, now):
         """The ONE liveness predicate over decoded (int64) entry rows,
@@ -1581,7 +1587,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         # backs trace() (slow-path observability, scalar spec functions).
         self._ft = compile_topology(self._topo)
         self._rt = topology.resolve_topology(self._topo)
-        self._dft = fwd.fwd_to_device(self._ft)
+        self._dft = self._place_forwarding(fwd.fwd_to_device(self._ft))
 
     def _ranges_of(self, name: str) -> list[tuple[int, int]]:
         """Current merged ranges of a named group (members + static blocks)."""
@@ -1648,8 +1654,16 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         device DeltaTable — shared by the incremental append path and the
         audit plane's rule-side self-heal (which rebuilds `drs` from the
         compiled set and must re-apply the pending deltas)."""
+        self._drs = self._drs._replace(
+            ip_delta=self._place_delta(self._build_delta_table()))
+
+    def _build_delta_table(self) -> DeltaTable:
+        """The host delta mirror as an (unplaced) device DeltaTable — the
+        one construction shared by _upload_delta_table and the reshard
+        plane's target-topology placement (parallel/reshard.py, which
+        must carry the pending deltas onto the target mesh)."""
         h = self._delta_host
-        self._drs = self._drs._replace(ip_delta=self._place_delta(DeltaTable(
+        return DeltaTable(
             lo_f=jnp.asarray(h["lo_f"]),
             hi_f=jnp.asarray(h["hi_f"]),
             sign=jnp.asarray(h["sign"]),
@@ -1662,7 +1676,7 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             fam=jnp.asarray(h["fam"]),
             lo6_w=jnp.asarray(h["lo6_w"]),
             hi6_w=jnp.asarray(h["hi6_w"]),
-        )))
+        )
 
     def _place_delta(self, dt: DeltaTable) -> DeltaTable:
         """Delta-table placement hook (mesh engine: re-place on the mesh

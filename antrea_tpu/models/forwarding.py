@@ -306,9 +306,18 @@ def _pipeline_step_full(
     meta: pl.PipelineMeta,
     hit_combine=None,
     v6=None,
+    valid=None,
+    no_commit=None,
 ):
     """Full per-packet walk: SpoofGuard/ARP -> (IGMP punt) -> policy/
     service pipeline -> forwarding -> Output; one jit, one dispatch.
+
+    `valid`/`no_commit` are OPTIONAL external lane masks ANDed/ORed into
+    the internally derived ones (spoof/ARP/IGMP exclusion, multicast +
+    FIN/RST commit gating): the mesh engine threads its padding mask and
+    the spill never-cache-foreign rule through them
+    (parallel/meshpath.py).  None — every single-chip call site — traces
+    the identical program as before they existed.
 
     arp_op lanes (ref pipeline.go ARPSpoofGuard/ARPResponder, :114-195):
     ARP is handled BEFORE the IP pipeline — sender-IP spoof gating via the
@@ -344,21 +353,25 @@ def _pipeline_step_full(
     is_mc = (dst_f >= MCAST_LO_F) & (dst_f <= MCAST_HI_F)
     if is6 is not None:
         is_mc = is_mc & ~m6
-    no_commit = is_mc
+    no_commit_l = is_mc
     if flags is not None:
         # A FIN/RST-flagged TCP miss classifies but never ESTABLISHES a
         # connection (a closing segment is not a new flow); established
         # hits tear down inside the pipeline (pl._TEARDOWN_FLAGS path).
-        no_commit = no_commit | (
+        no_commit_l = no_commit_l | (
             (proto == pl.PROTO_TCP) & ((flags & pl._TEARDOWN_FLAGS) != 0)
         )
-    valid = ~spoof & ~igmp
+    if no_commit is not None:
+        no_commit_l = no_commit_l | no_commit
+    valid_l = ~spoof & ~igmp
     if is_arp is not None:
-        valid = valid & ~is_arp
+        valid_l = valid_l & ~is_arp
+    if valid is not None:
+        valid_l = valid_l & valid
     state, out = pl._pipeline_step(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
-        meta=meta, hit_combine=hit_combine, valid=valid,
-        no_commit=no_commit, flags=flags, v6=v6, lens=lens,
+        meta=meta, hit_combine=hit_combine, valid=valid_l,
+        no_commit=no_commit_l, flags=flags, v6=v6, lens=lens,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
     # Forward toward the packet's effective destination: the DNAT-resolved

@@ -108,6 +108,21 @@ EVENT_KINDS = {
     "prune-retune": "datapath/tpuflow.py — the match-prune K-budget "
                     "hysteresis controller moved one PRUNE_LADDER rung "
                     "(fed by the measured fallback rate)",
+    "reshard-begin": "parallel/reshard.py — a live data-axis resize "
+                     "started: target mesh constructed, dual-topology "
+                     "serving begins (the old affinity ring keeps "
+                     "serving while migration runs)",
+    "reshard-migrated": "parallel/reshard.py — the budgeted migration "
+                        "cursor covered the whole source slot space; "
+                        "the plane is ready to certify and cut over",
+    "reshard-cutover": "parallel/reshard.py — the target topology passed "
+                       "its replica-resolved canary + migrated-row audit "
+                       "and the affinity hash flipped generation in one "
+                       "mesh-wide epoch swap",
+    "reshard-abort": "parallel/reshard.py — the resize aborted "
+                     "(target-topology canary veto, audit divergence, "
+                     "flip failure, or operator abort): the old mesh "
+                     "keeps serving, generation unchanged",
 }
 
 

@@ -112,6 +112,16 @@ METRICS: dict[str, str] = {
     "antrea_tpu_replica_miss_queue_depth": "gauge",
     "antrea_tpu_replica_canary_mismatches_total": "counter",
     "antrea_tpu_replica_audit_entries_total": "counter",
+    # elastic mesh resharding (parallel/reshard.py; rendered when the
+    # datapath exposes reshard_stats()) — migration progress, resident
+    # target rows, and the cutover/abort history of the resize plane
+    "antrea_tpu_reshard_topology_generation": "gauge",
+    "antrea_tpu_reshard_active": "gauge",
+    "antrea_tpu_reshard_progress_ratio": "gauge",
+    "antrea_tpu_reshard_migrated_rows_total": "counter",
+    "antrea_tpu_reshard_resident_rows": "gauge",
+    "antrea_tpu_reshard_cutovers_total": "counter",
+    "antrea_tpu_reshard_aborts_total": "counter",
     # aggregated-bitmap match pruning (ops/match round 7; rendered when
     # the datapath exposes prune_stats())
     "antrea_tpu_match_prune_skips_total": "counter",
@@ -614,6 +624,26 @@ def render_metrics(datapath, node: str = "") -> str:
                 f"antrea_tpu_replica_audit_entries_total"
                 f"{_labels(replica=r, node=node)} {n}"
             )
+    rs = getattr(datapath, "reshard_stats", None)
+    rs = rs() if rs is not None else None
+    if rs is not None:
+        # Elastic mesh resharding (parallel/reshard.py): the live
+        # affinity-topology generation, migration progress/volume, and
+        # the plane's cutover/abort history — schema-stable whether or
+        # not a resize is in flight.
+        for fam, key in (
+            ("antrea_tpu_reshard_topology_generation",
+             "topology_generation"),
+            ("antrea_tpu_reshard_active", "active"),
+            ("antrea_tpu_reshard_progress_ratio", "progress_ratio"),
+            ("antrea_tpu_reshard_migrated_rows_total",
+             "migrated_rows_total"),
+            ("antrea_tpu_reshard_resident_rows", "resident_rows"),
+            ("antrea_tpu_reshard_cutovers_total", "cutovers_total"),
+            ("antrea_tpu_reshard_aborts_total", "aborts_total"),
+        ):
+            lines += [_type_line(fam),
+                      f"{fam}{_labels(node=node)} {_num(rs[key])}"]
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

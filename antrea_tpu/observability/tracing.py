@@ -108,6 +108,11 @@ class RealizationTracer:
         self._stamps_total = 0
         self._stamps_taken = 0
         self.hist = {s: Histogram() for s in _HIST_STAGES}
+        # The most recent elastic-mesh resize span (parallel/reshard.py):
+        # migrate/certify/cutover stage durations telescoping to total,
+        # the realization-span shape.  One slot — resizes are rare
+        # operator/autoscaler events, not a table workload.
+        self.last_resize = None
 
     def now(self) -> float:
         return float(self._clock())
@@ -286,6 +291,16 @@ class RealizationTracer:
                 bundle_gen=sp["bundle_generation"],
                 total_s=round(sp["total_s"], 6))
 
+    # -- elastic-mesh resize spans (parallel/reshard.py) ---------------------
+
+    def note_resize_span(self, span: dict) -> None:
+        """Record a completed data-axis resize span so resize latency is
+        measurable beside policy-realization latency (served in stats()
+        as `last_resize`; the flight recorder's reshard-cutover event
+        carries the same total on the journal clock)."""
+        self._stamps_total += 1
+        self.last_resize = dict(span)
+
     # -- maintenance accounting ----------------------------------------------
 
     def take_cost(self) -> int:
@@ -340,4 +355,5 @@ class RealizationTracer:
             "first_hit_generation": int(self._hit_gen),
             "p99_s": (self.hist["total"].quantile(0.99)
                       if self.hist["total"].count else None),
+            "last_resize": self.last_resize,
         }

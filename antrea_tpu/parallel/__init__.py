@@ -9,3 +9,4 @@ from .mesh import (  # noqa: F401
     shard_state,
 )
 from .meshpath import MeshDatapath, MeshSlowPath  # noqa: F401
+from .reshard import RESHARD_MANIFEST, ReshardPlane  # noqa: F401

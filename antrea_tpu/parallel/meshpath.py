@@ -61,17 +61,29 @@ membership delta bookkeeping, persistence, metrics counting — is
 INHERITED from TpuflowDatapath: the planes were built plane-owner-
 agnostic (PR 7's one-scheduler refactor was precisely for this port).
 
+Round-8 additions (the PR 9 follow-ups + the elastic plane):
+  * the engine now serves the FULL per-packet walk — SpoofGuard -> policy/
+    service pipeline -> L2/L3 forward -> Output — through one sharded
+    dispatch (`_mesh_step_full_fn`): forwarding is stateless per-packet
+    and shards trivially over data with replicated topology tables, so
+    `install_topology` works exactly like single-chip;
+  * incremental group deltas take the O(delta) slot path on the mesh:
+    the per-slot rule masks upload sharded on the same word axis as the
+    incidence they patch (`_place_delta`), so pod churn never forces a
+    recompile here either — overflow and named-port folds still recompile
+    (canary-gated), as on single-chip;
+  * the data axis RESIZES under live traffic (parallel/reshard.py):
+    `reshard_begin(D')` builds the target mesh and serves dual-topology
+    (in-flight batches resolve against the old affinity generation while
+    a budgeted maintenance task migrates flow-cache rows to their target
+    ring homes); a replica-resolved canary + a migrated-row audit certify
+    the target before `shard_of_tuples` flips generation in one
+    mesh-wide epoch swap, and a veto aborts back to the old mesh.
+
 Known mesh limits (documented, test-pinned):
   * v4-only (like the async slow path); dual_stack raises ConfigError.
-  * The engine serves the policy/service pipeline; L2/L3 forwarding is
-    stateless per-packet and shards trivially over data
-    (make_sharded_pipeline_full) — it is not routed through this engine,
-    and install_topology raises.
   * overlap_commits/autotune_drain are single-chip knobs (the mesh drain
     is already one fused sharded dispatch per replica set).
-  * Incremental group deltas fold into a full recompile (the O(delta)
-    device patch would need per-append word-axis resharding); the delta
-    canary still gates the fold.
   * DNAT'd service reply legs can land off-shard and re-classify — the
     ECMP-asymmetry analog; see the README multichip failure-model row.
 """
@@ -86,10 +98,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..compiler.topology import FWD_TUNNEL
 from ..config import ConfigError
 from ..datapath.interface import StepResult
+from ..datapath.maintenance import MaintenanceTask
 from ..datapath.slowpath import MissQueue, SlowPathEngine
 from ..datapath.tpuflow import TpuflowDatapath, _rid
+from ..models import forwarding as fw
 from ..models import pipeline as pl
 from ..ops import match as m
 from ..ops.match import to_device
@@ -99,6 +114,7 @@ from .mesh import (
     DATA,
     RULE,
     _drs_specs,
+    _fwd_specs,
     _pmin_rule,
     _shard_map,
     _state_specs,
@@ -107,6 +123,7 @@ from .mesh import (
     shard_of_tuples,
     shard_state,
 )
+from .reshard import ReshardPlane
 
 
 # --------------------------------------------------------------------------
@@ -154,6 +171,49 @@ def _mesh_step_fn(mesh, meta: pl.PipelineMeta):
                   _svc_specs(),
                   lane, lane, lane, lane, lane, P(), P(),
                   lane, lane, lane, lane),
+        out_specs=(_state_specs(), P(DATA)),
+    ))
+
+
+@lru_cache(maxsize=32)
+def _mesh_step_full_fn(mesh, meta: pl.PipelineMeta, has_arp: bool):
+    """The sharded FULL per-packet walk (SpoofGuard/ARP -> policy/service
+    pipeline -> L2/L3 forward -> Output, models/forwarding
+    ._pipeline_step_full) — the mesh twin of the single-chip step().
+    Forwarding is stateless per-packet, so it shards trivially over the
+    data axis with replicated topology tables; the rule axis participates
+    only in the classification pmin, exactly as in the policy-only step.
+    `has_arp` keys the variant the way the single-chip step's conditional
+    ARP lane does — pure-IP batches keep the no-ARP program."""
+    lane = P(DATA)
+
+    def body(state, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
+             in_port, now, gen, flags, arp_op, valid, no_commit, lens):
+        local = jax.tree.map(lambda x: x[0], state)
+        local, out = fw._pipeline_step_full(
+            local, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
+            in_port, now, gen, flags,
+            arp_op if has_arp else None,
+            lens if meta.count_flow_stats else None,
+            meta=meta, hit_combine=_pmin_rule, valid=valid,
+            no_commit=no_commit,
+        )
+        # scalar per shard -> (D,) vector of per-data-shard counts (the
+        # prune keys exist iff the meta carries a prune budget)
+        for k in ("n_miss", "n_evict", "n_reclaim", "n_prune_skips",
+                  "n_prune_fb", "prune_cand_hist"):
+            if k in out:
+                out[k] = out[k][None]
+        return jax.tree.map(lambda x: x[None], local), out
+
+    return jax.jit(_shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_state_specs(),
+                  _drs_specs(agg=meta.match.prune_budget > 0),
+                  _svc_specs(), _fwd_specs(),
+                  lane, lane, lane, lane, lane, lane, P(), P(),
+                  lane, lane, lane, lane, lane),
         out_specs=(_state_specs(), P(DATA)),
     ))
 
@@ -242,10 +302,15 @@ def _shard_placement(shard: np.ndarray, n_data: int):
 class _MeshQueueView:
     """Aggregate read surface over the per-replica miss queues, so the
     shared Datapath plumbing (dump_miss_queue, trace overlay, stats)
-    keeps its single-queue contract."""
+    keeps its single-queue contract.  `base` carries the cumulative
+    counters of a PREVIOUS queue generation across a reshard cutover
+    (the queue set is rebuilt at the new replica width; the meters must
+    not reset or double-count the re-route pops)."""
 
-    def __init__(self, queues: list[MissQueue]):
+    def __init__(self, queues: list[MissQueue], base: Optional[dict] = None):
         self.queues = queues
+        self._base = base or {"admitted_total": 0, "overflows_total": 0,
+                              "drained_total": 0}
 
     @property
     def depth(self) -> int:
@@ -257,15 +322,18 @@ class _MeshQueueView:
 
     @property
     def admitted_total(self) -> int:
-        return sum(q.admitted_total for q in self.queues)
+        return self._base["admitted_total"] + sum(
+            q.admitted_total for q in self.queues)
 
     @property
     def overflows_total(self) -> int:
-        return sum(q.overflows_total for q in self.queues)
+        return self._base["overflows_total"] + sum(
+            q.overflows_total for q in self.queues)
 
     @property
     def drained_total(self) -> int:
-        return sum(q.drained_total for q in self.queues)
+        return self._base["drained_total"] + sum(
+            q.drained_total for q in self.queues)
 
     def dump(self) -> list[dict]:
         return [row for q in self.queues for row in q.dump()]
@@ -291,6 +359,7 @@ class MeshSlowPath(SlowPathEngine):
         super().__init__(owner, capacity=1, admission=admission,
                          drain_batch=drain_batch)
         self.n_data = int(n_data)
+        self._q_capacity = int(capacity)  # per-replica; resize() reuses it
         self.queues = [MissQueue(capacity) for _ in range(self.n_data)]
         self.queue = _MeshQueueView(self.queues)
 
@@ -366,6 +435,43 @@ class MeshSlowPath(SlowPathEngine):
         self._publish(now)
         return {"drained": k, "stale_reclassified": k if stale else 0}
 
+    # -- elastic resharding: re-home the queue set ---------------------------
+
+    def resize(self, n_data: int, home_fn, now: int) -> tuple[int, int]:
+        """Rebuild the per-replica queue set at a new data-axis width and
+        re-home every queued miss under the new topology map (the flip
+        half of the reshard cutover, parallel/reshard.py) -> (requeued,
+        dropped).  Rows move VERBATIM (epoch/enq_ts preserved — these are
+        re-routes, not re-admissions, so admitted_total is untouched);
+        the previous generation's cumulative meters carry over through
+        the view's base.  A shrink can overflow the smaller aggregate
+        capacity: overflow rows tail-drop with accounting, the ordinary
+        bounded-queue contract (the flow re-admits on its next miss)."""
+        base = {"admitted_total": self.queue.admitted_total,
+                "overflows_total": self.queue.overflows_total,
+                "drained_total": self.queue.drained_total}
+        blocks = [q.pop(q.depth) for q in self.queues]
+        self.n_data = int(n_data)
+        self.queues = [MissQueue(self._q_capacity)
+                       for _ in range(self.n_data)]
+        self.queue = _MeshQueueView(self.queues, base)
+        requeued = dropped = 0
+        for b in blocks:
+            if b is None:
+                continue
+            home = np.asarray(home_fn(b))
+            for r in range(self.n_data):
+                idx = np.nonzero(home == r)[0]
+                if idx.size == 0:
+                    continue
+                t, d = self.queues[r].requeue(b, idx)
+                requeued += t
+                dropped += d
+        if dropped:
+            self._emit("queue-overflow", dropped=int(dropped),
+                       depth=int(self.queue.depth), at=int(now))
+        return requeued, dropped
+
     def stats(self) -> dict:
         s = super().stats()
         s["replicas"] = self.n_data
@@ -383,7 +489,8 @@ class MeshDatapath(TpuflowDatapath):
     `audit_stats` report)."""
 
     def __init__(self, ps=None, services=None, *, mesh=None, n_data: int = 2,
-                 n_rule: int = 1, devices=None, **kw):
+                 n_rule: int = 1, devices=None, reshard_budget: int = 256,
+                 **kw):
         if kw.get("dual_stack"):
             raise ConfigError(
                 "the mesh datapath is v4-only (like the async slow path); "
@@ -393,12 +500,10 @@ class MeshDatapath(TpuflowDatapath):
                 "overlap_commits/autotune_drain are single-chip knobs: the "
                 "mesh drain is already one fused sharded dispatch per "
                 "replica set")
-        if kw.get("topology") is not None:
+        if int(reshard_budget) <= 0:
             raise ConfigError(
-                "the mesh engine serves the policy/service pipeline; "
-                "forwarding shards trivially over data "
-                "(parallel.make_sharded_pipeline_full) and stays outside "
-                "this engine")
+                f"reshard_budget must be positive (rows per maintenance "
+                f"tick), got {reshard_budget}")
         self._mesh = mesh if mesh is not None else make_mesh(
             n_data, n_rule, devices)
         self._n_data = int(self._mesh.shape[DATA])
@@ -406,6 +511,20 @@ class MeshDatapath(TpuflowDatapath):
         self._replica_audit_entries = [0] * self._n_data
         self._spill_lanes_total = 0
         self._spill_retried_total = 0
+        # Elastic resharding plane (parallel/reshard.py): the affinity
+        # topology generation (0 = the boot dense map; every resized
+        # topology elects on the consistent ring), the in-flight plane,
+        # and the cumulative meters that outlive individual planes.
+        self._reshard_budget = int(reshard_budget)
+        self._topo_gen = 0
+        self._reshard = None
+        self._reshard_canary = None  # (mesh, drs, match_meta, D) redirect
+        self._reshard_cutovers = 0
+        self._reshard_aborts = 0
+        self._reshard_migrated_total = 0
+        self._reshard_requeued_total = 0
+        self._reshard_resident_rows = 0
+        self._last_reshard_span = None
         super().__init__(ps, services, **kw)
 
     # -- placement hooks (the whole tensor estate lands on the mesh) ---------
@@ -439,18 +558,22 @@ class MeshDatapath(TpuflowDatapath):
         repl = NamedSharding(self._mesh, P())
         return jax.tree.map(lambda x: jax.device_put(x, repl), dsvc)
 
+    def _place_forwarding(self, dft):
+        # Forwarding tables are the small, read-mostly side (one node's
+        # pods + routes): replicated whole, like the service tables.
+        repl = NamedSharding(self._mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, repl), dft)
+
     def _place_delta(self, dt):
+        # The O(delta) slot path works unchanged on the mesh: the host
+        # mirror's per-slot rule masks are built at the PADDED word width
+        # (the match meta's w_in/w_out reflect to_device's word_multiple
+        # padding), so each append re-places the whole small table with
+        # the word axis sharded exactly like the incidence it patches —
+        # pod churn never forces a recompile here either.
         return jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
             dt, _drs_specs().ip_delta)
-
-    def _append_deltas(self, rows) -> None:
-        # O(delta) device patching is single-chip for now: each append
-        # would re-shard the per-slot word masks.  Folding into a fresh
-        # compile keeps the mesh path correct — and the commit plane's
-        # scoped delta canary still gates the fold on every replica.
-        del rows
-        self._compile_rules()
 
     def _make_slowpath(self, *, capacity, admission, drain_batch,
                        **_single_chip_knobs):
@@ -460,12 +583,6 @@ class MeshDatapath(TpuflowDatapath):
                             admission=admission, drain_batch=drain_batch)
 
     # -- unsupported single-chip surfaces ------------------------------------
-
-    def install_topology(self, topo) -> None:
-        raise NotImplementedError(
-            "the mesh engine serves the policy/service pipeline; "
-            "forwarding is stateless per-packet and shards trivially "
-            "(parallel.make_sharded_pipeline_full)")
 
     def profile(self, batch, fresh=None, **kw) -> dict:
         raise NotImplementedError(
@@ -483,8 +600,13 @@ class MeshDatapath(TpuflowDatapath):
         self._v6_lanes(batch)  # v4-only guard (dual_stack is always False)
         lens = np.maximum(batch.lens(), 0)
         flags = np.asarray(batch.flags()).astype(np.int32)
+        in_ports = np.asarray(batch.in_ports()).astype(np.int32)
+        has_arp = batch.arp_op is not None
+        arp = (np.asarray(batch.arp_ops()).astype(np.int32) if has_arp
+               else np.zeros(B, np.int32))
         shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
-                                batch.src_port, batch.dst_port, D)
+                                batch.src_port, batch.dst_port, D,
+                                self._topo_gen)
         perm, inv, spill = _shard_placement(shard, D)
         src = batch.src_ip[perm].astype(np.uint32)
         dst = batch.dst_ip[perm].astype(np.uint32)
@@ -492,17 +614,16 @@ class MeshDatapath(TpuflowDatapath):
         sport = batch.src_port[perm].astype(np.int32)
         dport = batch.dst_port[perm].astype(np.int32)
         pflags = flags[perm]
-        # Commit gating mirrors the single-chip walk (pl.no_commit_mask:
-        # multicast bypasses conntrack, FIN/RST misses never establish)
-        # PLUS the spill rule: an off-home lane classifies but never
-        # caches in a foreign shard.
-        no_commit = spill | pl.no_commit_mask(dst, proto, pflags)
-        stepf = _mesh_step_fn(self._mesh, self._meta_step)
+        # The fused walk derives the mcast/teardown commit gating and the
+        # SpoofGuard/ARP/IGMP validity masks itself (models/forwarding);
+        # the engine contributes only the spill rule — an off-home lane
+        # classifies but never caches in a foreign shard.
+        stepf = _mesh_step_full_fn(self._mesh, self._meta_step, has_arp)
         state, out = stepf(
-            self._state, self._drs, self._dsvc,
+            self._state, self._drs, self._dsvc, self._dft,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
-            jnp.int32(now), jnp.int32(self._gen),
-            np.ones(B, bool), no_commit, pflags,
+            in_ports[perm], jnp.int32(now), jnp.int32(self._gen),
+            pflags, arp[perm], np.ones(B, bool), spill,
             lens[perm].astype(np.int32),
         )
         self._state = state
@@ -517,7 +638,8 @@ class MeshDatapath(TpuflowDatapath):
         o = {k: v[inv] for k, v in o.items()}  # back to packet order
         spilled = perm[np.nonzero(spill)[0]]  # packet indices off-home
         if spilled.size:
-            o = self._spill_retry(batch, o, spilled, shard, flags, lens, now)
+            o = self._spill_retry(batch, o, spilled, shard, flags, in_ports,
+                                  arp, has_arp, lens, now)
         # Recomputed from the MERGED per-lane mask: a retried lane's miss
         # image is its home-shard one, not the foreign always-miss.
         n_miss = int(o["miss"].sum())
@@ -549,11 +671,27 @@ class MeshDatapath(TpuflowDatapath):
             egress_rule=[_rid(out_ids, i) for i in o["egress_rule"]],
             committed=o["committed"],
             n_miss=n_miss,
+            spoofed=o["spoofed"],
+            punt=o["punt"],
+            mcast_idx=o["mcast_idx"],
+            l7_redirect=o["l7_redirect"],
+            fwd_kind=o["fwd_kind"],
+            out_port=o["out_port"],
+            # peer_f is zeroed for non-deliverable lanes in the kernel; the
+            # (kind==TUNNEL & deliverable) gate avoids un-flipping that 0.
+            peer_ip=np.where(
+                (o["fwd_kind"] == FWD_TUNNEL) & (o["out_port"] != -1),
+                unflip(o["peer_f"]), 0,
+            ).astype(np.uint32),
+            dec_ttl=o["dec_ttl"],
+            tc_act=o["tc_act"],
+            tc_port=o["tc_port"],
         )
 
     def _spill_retry(self, batch: PacketBatch, o: dict, spilled: np.ndarray,
-                     shard: np.ndarray, flags: np.ndarray, lens: np.ndarray,
-                     now: int) -> dict:
+                     shard: np.ndarray, flags: np.ndarray,
+                     in_ports: np.ndarray, arp: np.ndarray, has_arp: bool,
+                     lens: np.ndarray, now: int) -> dict:
         """Second, bounded, HOME-ROUTED dispatch for hash-skew overflow.
 
         Spilled lanes' main-dispatch image is a foreign-shard walk: they
@@ -583,15 +721,15 @@ class MeshDatapath(TpuflowDatapath):
         dst = batch.dst_ip[idx].astype(np.uint32)
         proto = batch.proto[idx].astype(np.int32)
         rflags = flags[idx]
-        no_commit = pl.no_commit_mask(dst, proto, rflags)
-        stepf = _mesh_step_fn(self._mesh, self._meta_step)
+        stepf = _mesh_step_full_fn(self._mesh, self._meta_step, has_arp)
         state, out = stepf(
-            self._state, self._drs, self._dsvc,
+            self._state, self._drs, self._dsvc, self._dft,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto,
             batch.src_port[idx].astype(np.int32),
             batch.dst_port[idx].astype(np.int32),
-            jnp.int32(now), jnp.int32(self._gen),
-            valid, no_commit, rflags, lens[idx].astype(np.int32),
+            in_ports[idx], jnp.int32(now), jnp.int32(self._gen),
+            rflags, arp[idx], valid, np.zeros(idx.size, bool),
+            lens[idx].astype(np.int32),
         )
         self._state = state
         self._state_mutations += 1
@@ -705,10 +843,20 @@ class MeshDatapath(TpuflowDatapath):
         the whole mesh (the rollback restores the sharded snapshot — all
         replicas)."""
         del now  # probes are stateless fresh walks
-        D = self._n_data
+        # A reshard plane certifying its TARGET topology redirects the
+        # probe walk onto the target placement (parallel/reshard.py sets
+        # _reshard_canary around the commit plane's _canary call): the
+        # same replica-resolved diff and veto machinery then gates the
+        # cutover the way it gates every bundle.
+        tgt = self._reshard_canary
+        if tgt is None:
+            mesh, drs, mm, D = (self._mesh, self._drs, self._meta.match,
+                                self._n_data)
+        else:
+            mesh, drs, mm, D = tgt
         n = batch.size
-        fn = _mesh_canary_fn(self._mesh, self._meta.match)
-        got = fn(self._drs,
+        fn = _mesh_canary_fn(mesh, mm)
+        got = fn(drs,
                  np.tile(iputil.flip_u32(batch.src_ip), D),
                  np.tile(iputil.flip_u32(batch.dst_ip), D),
                  np.tile(batch.proto.astype(np.int32), D),
@@ -883,7 +1031,8 @@ class MeshDatapath(TpuflowDatapath):
             raise RuntimeError("Traceflow feature gate is disabled")
         D = self._n_data
         shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
-                                batch.src_port, batch.dst_port, D)
+                                batch.src_port, batch.dst_port, D,
+                                self._topo_gen)
         out: list = [None] * batch.size
         for r in range(D):
             idx = np.nonzero(shard == r)[0]
@@ -895,6 +1044,89 @@ class MeshDatapath(TpuflowDatapath):
             for i, rec in zip(idx, self._trace_batch(local, sub, now)):
                 out[int(i)] = rec
         return out
+
+    # -- elastic resharding plane (parallel/reshard.py) ----------------------
+
+    def reshard_begin(self, n_data: int, devices=None) -> dict:
+        """Begin a LIVE resize of the data axis to `n_data` replicas.
+
+        Constructs the target mesh and the next affinity-hash generation
+        (dual-topology serving: in-flight batches keep resolving against
+        the old topology), and registers the budgeted `reshard-migrate`
+        maintenance task that walks the flow-cache/conntrack tables and
+        re-commits rows to their target ring homes.  The cutover flips
+        only after the target passes its replica-resolved canary and a
+        migrated-row audit sweep; a veto aborts back to the old mesh
+        with the generation unchanged.  -> the plane's status dict."""
+        if self._reshard is not None:
+            raise RuntimeError(
+                "a reshard is already in flight; wait for its cutover or "
+                "abort it first (reshard_abort)")
+        if self.degraded:
+            raise RuntimeError(
+                "datapath is degraded (serving last-known-good): the "
+                "cutover gate could never certify a target topology — "
+                "recover before resizing")
+        plane = ReshardPlane(self, int(n_data), devices=devices)
+        self._reshard = plane
+        self._maintenance.register(MaintenanceTask(
+            "reshard-migrate", self._maint_reshard,
+            budget=self._reshard_budget, priority=4,
+            shed_when_degraded=True))
+        return plane.status()
+
+    def _maint_reshard(self, now: int, budget: int) -> int:
+        """The reshard plane's maintenance-task runner: budgeted
+        migration windows while migrating, then the certified cutover
+        (true cost reported unclamped — the scheduler's overrun path
+        meters it, the canary/scrub discipline)."""
+        plane = self._reshard
+        if plane is None:
+            return 0
+        return plane.advance(now, budget)
+
+    def reshard_status(self):
+        """The in-flight resize's progress (None when no resize is in
+        flight) — see ReshardPlane.status."""
+        return None if self._reshard is None else self._reshard.status()
+
+    def reshard_abort(self, reason: str = "operator abort") -> None:
+        """Abandon the in-flight resize: the old mesh keeps serving, the
+        affinity generation never flips, target structures are dropped."""
+        if self._reshard is None:
+            raise RuntimeError("no reshard in flight")
+        self._reshard.abort(reason)
+
+    def _finish_reshard(self, plane) -> None:
+        """Plane lifecycle callback (cutover or abort): unregister the
+        migration task and fold the plane's meters into the engine's."""
+        if self._reshard is plane:
+            self._reshard = None
+            self._maintenance.unregister("reshard-migrate")
+
+    def reshard_stats(self) -> dict:
+        """Elastic-mesh observability (schema-stable whether or not a
+        resize is in flight): the live affinity-topology generation,
+        migration progress/volume, resident target rows, and cutover/
+        abort counters — rendered as the reshard metric families."""
+        plane = self._reshard
+        st = plane.status() if plane is not None else None
+        migrated = self._reshard_migrated_total + (
+            plane.migrated_rows if plane is not None else 0)
+        return {
+            "topology_generation": self._topo_gen,
+            "active": int(plane is not None),
+            "phase": None if st is None else st["phase"],
+            "target_n_data": None if st is None else st["n_data_to"],
+            "progress_ratio": 0.0 if st is None else st["progress_ratio"],
+            "migrated_rows_total": migrated,
+            "resident_rows": (plane.resident_rows if plane is not None
+                              else self._reshard_resident_rows),
+            "requeued_total": self._reshard_requeued_total,
+            "cutovers_total": self._reshard_cutovers,
+            "aborts_total": self._reshard_aborts,
+            "last_span": self._last_reshard_span,
+        }
 
     def mesh_stats(self) -> dict:
         """Shard-labeled observability (rendered as the replica-labeled

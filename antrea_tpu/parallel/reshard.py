@@ -1,0 +1,627 @@
+"""Elastic mesh resharding: resize the data axis under live traffic.
+
+The reference survives topology change by design — memberlist gossip
+plus consistent-hash ownership re-elects owners on every node join/leave
+without restarting the datapath (SURVEY §2.6,
+pkg/agent/memberlist/cluster.go:89; mirrored host-side in
+agent/memberlist.py + agent/gossip.py).  `MeshDatapath` had no analog on
+the device mesh: the data axis D was fixed at construction, so a
+preempted or resized TPU slice could only restart cold and drop every
+established flow.  This plane is the missing subsystem — a live resize
+(grow 2→4, shrink 4→2) with zero established-flow loss:
+
+  dual-topology serving   `ReshardPlane` builds the TARGET mesh and the
+                          next affinity-hash generation
+                          (mesh.shard_of_tuples gained `topo_gen`; the
+                          consistent ring of agent/memberlist ported to
+                          the device-side shard election).  In-flight
+                          batches keep resolving against the OLD
+                          topology for the whole resize — the old ring
+                          serves, the new ring only routes migration.
+  drain-and-migrate       a budgeted maintenance task (`reshard-migrate`
+                          in MAINT_TASKS, rows/tick like the audit
+                          cursor) walks the per-replica flow-cache
+                          tables striped over the global slot space,
+                          decodes live rows, and re-commits each to its
+                          target-ring home — SAME local slot (the cache
+                          slot hash is D-independent by the PR 9 salt
+                          decorrelation), so committed/reply/attribution
+                          state carries bitwise and established flows
+                          never flap.  Direct-mapped collisions on a
+                          shrink keep the newest row; the loser simply
+                          re-misses and re-classifies to the identical
+                          verdict (the PR 6 lost-update guard extended
+                          across topologies).  A final catch-up sweep
+                          runs at cutover, serialized with the flip, so
+                          rows touched after their migration window
+                          (fresh commits, attribution remaps from
+                          mid-resize bundles) re-sync before serving.
+  certified cutover       before the flip, the PR 4 canary runs
+                          replica-resolved ON THE TARGET placement (one
+                          replica's veto aborts, `replica-canary-veto`)
+                          and a striped audit sweep re-proves the
+                          migrated rows against fresh walks (committed
+                          rows held to the PR 5 structural invariant).
+                          Only then does the affinity hash flip
+                          generation — state, rules, services and
+                          forwarding re-place in one atomic host-side
+                          swap published as one mesh-wide epoch swap.
+                          Abort (veto, audit divergence, flip exception)
+                          restores the old mesh from the pre-flip
+                          snapshot: generation unchanged, old ring keeps
+                          serving, nothing dropped.
+  observability           reshard-begin/-migrated/-cutover/-abort
+                          flight-recorder kinds on the scheduler clock,
+                          the reshard metric families
+                          (progress, migrated/resident rows, cutovers,
+                          aborts), and a resize span (migrate/certify/
+                          cutover stages telescoping to total) recorded
+                          on the realization tracer.
+
+Migration-rule manifest: every `(D,)`-sharded field of the state pytrees
+must name its migration rule below — tools/check_reshard.py (tier-1 via
+tests/test_reshard.py) parses `mesh._state_specs` and fails the build
+when a new stateful field ships without one (a field nobody taught the
+migrator is a silent flow-loss bug).  The migrator itself copies rows
+field-generically from `FlowCache._fields`/`AffinityTable._fields`, so
+the manifest and the copy loop cannot drift apart.
+
+Documented residue (the README failure-model row): a row evicted or
+idle-expired in the OLD topology between its migration window and the
+cutover catch-up can survive in the target table.  This is verdict-safe
+by construction — liveness (idle timeout) and generation validity are
+re-checked at every lookup, so expired/stale-gen copies are dead on
+arrival, and a resurrected committed row serves exactly what it served
+before its capacity eviction — and the continuous revalidator re-proves
+the migrated table like any other cache.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ..compiler.compile import ACT_ALLOW
+from ..models import pipeline as pl
+from ..observability.flightrec import emit_into
+from ..ops.match import to_device
+from ..utils import ip as iputil
+from .mesh import _drs_specs, _state_specs, make_mesh, shard_of_tuples
+
+# Migration rule per (D,)-sharded state field, keyed "Class.field".  Pure
+# literal: tools/check_reshard.py parses it with ast.literal_eval,
+# dependency-free, and diffs it against the P(DATA, ...) fields of
+# mesh._state_specs — every sharded field must carry a rule, every rule
+# must name a sharded field.
+RESHARD_MANIFEST = {
+    "FlowCache.keys": "row-migrate to (target-ring home, SAME local slot "
+                      "— the cache slot hash is D-independent); the key "
+                      "row carries the occupancy/validity bit",
+    "FlowCache.meta": "row-migrate with its key row (verdict, DNAT "
+                      "resolution, rule attribution, SNAT/DSR/CONF marks)",
+    "FlowCache.ts": "row-migrate; newest-ts wins direct-mapped collisions "
+                    "(shrink merges two source replicas into one slot)",
+    "FlowCache.pkts": "row-migrate (low limb of the 64-bit per-direction "
+                      "packet counter)",
+    "FlowCache.pkts_hi": "row-migrate (carry limb; rides its low limb)",
+    "FlowCache.octets": "row-migrate (low limb of the byte counter)",
+    "FlowCache.octets_hi": "row-migrate (carry limb; rides its low limb)",
+    "AffinityTable.key_client": "broadcast to EVERY target replica at the "
+                                "same slot: affinity rows self-identify "
+                                "by client key and the flow's home shard "
+                                "is not derivable from the row (ports "
+                                "are not stored), so stickiness is "
+                                "preserved wherever the client lands; "
+                                "newest-ts wins collisions",
+    "AffinityTable.key_svc": "broadcast with its client key",
+    "AffinityTable.ep": "broadcast (the sticky endpoint choice; "
+                        "occupancy = ep > 0)",
+    "AffinityTable.ts": "broadcast; newest-ts wins collisions",
+}
+
+
+class ReshardPlane:
+    """One live data-axis resize of a `MeshDatapath` (the owner).
+
+    Single-threaded like every plane it composes with: migration windows
+    and the cutover run inside the maintenance scheduler's tick (ONE
+    serialization point — never concurrent with an in-flight drain), and
+    the old topology serves every packet until the certified flip.
+    """
+
+    def __init__(self, owner, n_data: int, devices=None):
+        if n_data <= 0:
+            raise ValueError(f"target data-axis size must be positive, "
+                             f"got {n_data}")
+        if int(n_data) == owner._n_data:
+            raise ValueError(
+                f"target data-axis size {n_data} equals the current one — "
+                f"nothing to reshard")
+        self.owner = owner
+        self.src_n = int(owner._n_data)
+        self.dst_n = int(n_data)
+        # The next affinity-hash generation: generation 0 is the boot
+        # dense map; every resized topology elects on the consistent
+        # ring (mesh.shard_of_tuples), so consecutive resizes move only
+        # the ring-minimal key fraction.
+        self.gen = int(owner._topo_gen) + 1
+        # make_mesh raises when the device pool cannot host D' x R.
+        self.t_mesh = make_mesh(self.dst_n, owner._n_rule, devices)
+        # Target rule placement is built lazily at certification time
+        # (gen-checked), so bundles landing mid-migration are absorbed.
+        self.t_drs = None
+        self.t_match_meta = None
+        self._t_rules_gen = -1
+        # HOST mirrors of the target state tables: migration scatters
+        # land here (row-at-a-time host writes, no device round trips);
+        # the flip places them sharded in one device_put per leaf.
+        flow = owner._state.flow
+        self.flow_host = {
+            name: np.zeros((self.dst_n,) + tuple(
+                getattr(flow, name).shape[1:]), np.int32)
+            for name in pl.FlowCache._fields
+        }
+        aff = owner._state.aff
+        self.aff_host = {
+            name: np.zeros((self.dst_n,) + tuple(
+                getattr(aff, name).shape[1:]), np.int32)
+            for name in pl.AffinityTable._fields
+        }
+        # Striped migration cursor over the GLOBAL source slot space
+        # (g -> replica g % D, local slot g // D — the audit striping),
+        # so every budgeted window advances all source replicas.
+        self.G = self.src_n * int(owner._meta.flow_slots)
+        self.covered = 0
+        self.phase = "migrate"  # -> "ready" -> done/aborted
+        self.done = False
+        self.aborted = False
+        self.migrated_rows = 0
+        self.resident_rows = 0
+        self.catchup_rows = 0
+        self.aff_rows = 0
+        self.certify_divergences = 0
+        # Resize span stamps (the realization-span shape: stages clamp
+        # monotonic and telescope to total) on the commit plane's clock.
+        self._clock = getattr(owner._commit, "_clock", None) or time.monotonic
+        self._stamps = {"begin": float(self._clock())}
+        self._emit("reshard-begin", topo_gen_target=self.gen,
+                   n_data_from=self.src_n, n_data_to=self.dst_n,
+                   slots=self.G)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        emit_into(self.owner, kind, **fields)
+
+    def _stamp(self, name: str) -> None:
+        prev = max(self._stamps.values())
+        self._stamps[name] = max(float(self._clock()), prev)
+
+    def status(self) -> dict:
+        return {
+            "phase": "aborted" if self.aborted else (
+                "done" if self.done else self.phase),
+            "topo_gen_target": self.gen,
+            "n_data_from": self.src_n,
+            "n_data_to": self.dst_n,
+            "progress_ratio": round(self.covered / max(self.G, 1), 4),
+            "migrated_rows": int(self.migrated_rows),
+            "resident_rows": int(self.resident_rows),
+            "catchup_rows": int(self.catchup_rows),
+            "affinity_rows": int(self.aff_rows),
+        }
+
+    # -- the maintenance-task entry point ------------------------------------
+
+    def advance(self, now: int, budget: int) -> int:
+        """One budgeted round -> units spent (slots scanned + probes).
+        Migration windows honor `budget`; the cutover round reports its
+        TRUE cost unclamped — the scheduler's overrun path clamps the
+        accounting and meters it, the canary/scrub discipline."""
+        if self.done or self.aborted:
+            return 0
+        if self.phase == "migrate":
+            spent = self._migrate_window(now, budget)
+            if self.covered >= self.G:
+                self.phase = "ready"
+                self._stamp("migrated")
+                self._emit("reshard-migrated", rows=int(self.migrated_rows),
+                           resident=int(self.resident_rows),
+                           slots=int(self.G), at=int(now))
+            return spent
+        # phase == "ready": certified cutover.  Degradation pauses the
+        # flip (shed_when_degraded on the task is the first gate; this is
+        # the belt for a degrade landing between shed check and run) —
+        # the cutover gate could never certify against a degraded plane.
+        if self.owner.degraded:
+            return 0
+        return self._cutover(now)
+
+    # -- drain-and-migrate ---------------------------------------------------
+
+    def _migrate_window(self, now: int, budget: int) -> int:
+        """Walk `budget` global slots from the striped cursor, migrating
+        every live row to its target-ring home -> slots scanned."""
+        D = self.src_n
+        cursor = self.covered
+        k = min(max(int(budget), 0), self.G - cursor)
+        if k <= 0:
+            return 0
+        for r in range(D):
+            first = cursor + ((r - cursor) % D)
+            if first >= cursor + k:
+                continue
+            count = (cursor + k - first + D - 1) // D
+            self._copy_rows(r, first // D, count, now)
+        self.covered += k
+        return k
+
+    def _copy_rows(self, r: int, ls: int, count: int, now: int,
+                   catchup: bool = False) -> int:
+        """Decode `count` consecutive local slots of source replica `r`
+        and re-commit the live rows into the target host mirror.
+
+        Host-loop implementation (one transfer per column per window,
+        per-row collision resolution): simple and provably bitwise, and
+        the budget meter prices it honestly.  The production fast path —
+        one fused window transfer + a vectorized (home, slot, ts)-sorted
+        scatter — is an optimization residue noted in ROADMAP item 3
+        beside the dirty-row catch-up tracking."""
+        o = self.owner
+        flow = o._state.flow
+        cols = {name: np.asarray(getattr(flow, name)[r, ls:ls + count])
+                for name in pl.FlowCache._fields}
+        keys = cols["keys"].astype(np.int64)
+        meta = cols["meta"].astype(np.int64)
+        live, _egen = o._live_mask(keys, meta, cols["ts"], now)
+        idx = np.nonzero(live)[0]
+        if idx.size == 0:
+            return 0
+        A = o._meta.key_words - 2
+        kpg = keys[:, A + 1]
+        src_u = iputil.unflip_u32_array(cols["keys"][:, 0])
+        dst_u = iputil.unflip_u32_array(cols["keys"][:, 1])
+        pp = keys[:, A]
+        sport = ((pp >> 16) & 0xFFFF).astype(np.int32)
+        dport = (pp & 0xFFFF).astype(np.int32)
+        proto = (kpg & 0xFF).astype(np.int32)
+        # The stored key IS the direction the packets arrive with (reply
+        # rows are keyed on the reply tuple), and the affinity hash is
+        # direction-symmetric — so hashing the stored tuple homes every
+        # row exactly where its own lookups will land.
+        home = shard_of_tuples(src_u, dst_u, proto, sport, dport,
+                               self.dst_n, self.gen)
+        moved = 0
+        t = self.flow_host
+        for i in idx:
+            i = int(i)
+            r2, slot = int(home[i]), ls + i
+            ts_new = int(cols["ts"][i])
+            # Newest-ts wins direct-mapped collisions; TIES overwrite, so
+            # the cutover catch-up re-syncs rows whose content changed
+            # without a ts refresh (e.g. a mid-resize bundle's
+            # attribution remap).
+            if int(t["keys"][r2, slot, -1]) != 0:
+                if int(t["ts"][r2, slot]) > ts_new:
+                    continue
+            else:
+                self.resident_rows += 1
+            for name in pl.FlowCache._fields:
+                t[name][r2, slot] = cols[name][i]
+            moved += 1
+        self.migrated_rows += moved
+        if catchup:
+            self.catchup_rows += moved
+        return moved
+
+    def _migrate_affinity(self) -> int:
+        """Broadcast every occupied affinity row to all target replicas
+        at the same slot (see the manifest rationale) -> rows copied."""
+        o = self.owner
+        aff = o._state.aff
+        t = self.aff_host
+        moved = 0
+        for r in range(self.src_n):
+            cols = {name: np.asarray(getattr(aff, name)[r])
+                    for name in pl.AffinityTable._fields}
+            for i in np.nonzero(cols["ep"][:-1] > 0)[0]:
+                i = int(i)
+                ts_new = int(cols["ts"][i])
+                for r2 in range(self.dst_n):
+                    if t["ep"][r2, i] > 0 and int(t["ts"][r2, i]) > ts_new:
+                        continue
+                    for name in pl.AffinityTable._fields:
+                        t[name][r2, i] = cols[name][i]
+                moved += 1
+        self.aff_rows = moved
+        return moved
+
+    def _catchup(self, now: int) -> int:
+        """The final delta sweep, serialized with the flip (the
+        scheduler's tick already excludes in-flight drains, and no
+        traffic steps between this sweep and the generation flip in the
+        single-threaded engine): re-walk every source slot so rows
+        committed, refreshed or remapped AFTER their migration window
+        land in the target before it serves.  Idempotent by the
+        newest-ts/tie-overwrite rule.  Affinity broadcasts here too —
+        one pass at the freshest view."""
+        S = self.G // self.src_n
+        for r in range(self.src_n):
+            self._copy_rows(r, 0, S, now, catchup=True)
+        return self.G + self._migrate_affinity()
+
+    # -- certification -------------------------------------------------------
+
+    def _ensure_target_rules(self) -> None:
+        """(Re)place the rule tensors on the target mesh — lazily and
+        generation-checked, so bundles/deltas landing mid-migration are
+        absorbed into what the canary actually certifies (and what the
+        flip actually serves: certify-what-you-serve)."""
+        o = self.owner
+        if self.t_drs is not None and self._t_rules_gen == o._gen:
+            return
+        drs, _meta = to_device(o._cps, word_multiple=o._n_rule,
+                               delta_slots=o._delta_slots,
+                               prune_budget=o._prune_budget)
+        specs = _drs_specs(agg=o._prune_budget > 0)
+        drs = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.t_mesh, s)),
+            drs, specs)
+        if o._n_deltas:
+            # Pending O(delta) slot rows ride onto the target placement
+            # from the host mirror — the fold the audit self-heal uses.
+            drs = drs._replace(ip_delta=jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.t_mesh, s)),
+                o._build_delta_table(), specs.ip_delta))
+        self.t_drs = drs
+        # The LIVE match meta (it carries the current prune K rung; the
+        # tables are K-independent, so placement and meta stay coherent
+        # across retunes).
+        self.t_match_meta = o._meta.match
+        self._t_rules_gen = int(o._gen)
+
+    def corrupt_target(self, replica: int) -> str:
+        """Chaos helper (the corrupt_replica twin for the TARGET
+        placement): flip the rule-side copies held by one target data
+        replica's devices, so the cutover canary's row for exactly that
+        replica diverges and vetoes the flip."""
+        self._ensure_target_rules()
+        devs = set(self.t_mesh.devices[replica, :].flat)
+
+        def flip(arr):
+            bufs = []
+            for s in arr.addressable_shards:
+                buf = np.array(s.data)
+                if s.device in devs:
+                    buf = buf ^ 1
+                bufs.append(jax.device_put(buf, s.device))
+            return jax.make_array_from_single_device_arrays(
+                arr.shape, arr.sharding, bufs)
+
+        drs = self.t_drs
+        self.t_drs = drs._replace(
+            ingress=drs.ingress._replace(action=flip(drs.ingress.action)),
+            egress=drs.egress._replace(action=flip(drs.egress.action)),
+            iso_in=drs.iso_in._replace(val=flip(drs.iso_in.val)),
+            iso_out=drs.iso_out._replace(val=flip(drs.iso_out.val)),
+        )
+        return (f"flipped target rule-side device copies held by data "
+                f"replica {replica}")
+
+    def _certify(self, now: int) -> tuple[bool, int]:
+        """The cutover gate -> (certified, units spent).  (1) the PR 4
+        canary, replica-resolved on the TARGET placement — one replica's
+        veto aborts; (2) a striped audit sweep re-proving the migrated
+        rows (committed rows held to the PR 5 structural invariant,
+        affinity-bearing rows outside the veto, the audit discipline)."""
+        o = self.owner
+        self._ensure_target_rules()
+        cost = 0
+        cp = o._commit
+        if cp is not None and cp.probes > 0:
+            o._reshard_canary = (self.t_mesh, self.t_drs,
+                                 self.t_match_meta, self.dst_n)
+            try:
+                mism = cp._canary()
+            finally:
+                o._reshard_canary = None
+            cost += cp.probes
+            if mism:
+                self.abort(
+                    f"target-topology canary veto: {mism[0]}"[:200])
+                return False, cost
+        div, rows = self._audit_target(now)
+        cost += rows
+        if div:
+            self.certify_divergences = div
+            self.abort(f"target-topology audit found {div} divergent "
+                       f"migrated row(s)")
+            return False, cost
+        return True, cost
+
+    def _audit_target(self, now: int) -> tuple[int, int]:
+        """Re-prove every migrated row against a fresh walk through the
+        current tables -> (divergences, rows audited)."""
+        o = self.owner
+        div = rows_total = 0
+        for r2 in range(self.dst_n):
+            rows = o._decode_audit_rows(
+                self.flow_host["keys"][r2, :-1],
+                self.flow_host["meta"][r2, :-1],
+                self.flow_host["ts"][r2, :-1],
+                now,
+                lambda i, r2=r2: i * self.dst_n + r2,
+            )
+            if not rows:
+                continue
+            local = pl.PipelineState(
+                flow=pl.FlowCache(**{
+                    n: jnp.asarray(self.flow_host[n][r2])
+                    for n in pl.FlowCache._fields}),
+                aff=pl.AffinityTable(**{
+                    n: jnp.asarray(self.aff_host[n][r2])
+                    for n in pl.AffinityTable._fields}),
+            )
+            fresh = o._audit_fresh_state(local, rows, now)
+            rows_total += len(rows)
+            for row, f in zip(rows, fresh):
+                if row["committed"] or row["reply"]:
+                    # PR 5 structural invariant: a conntrack-committed or
+                    # reply entry MUST cache ALLOW — never diffed against
+                    # a fresh walk (it legitimately outlives policy).
+                    if row["code"] != ACT_ALLOW:
+                        div += 1
+                elif row["aff"]:
+                    continue  # session-affinity drift, outside the veto
+                elif row["code"] != f["code"]:
+                    div += 1
+        return div, rows_total
+
+    # -- cutover / abort -----------------------------------------------------
+
+    def _cutover(self, now: int) -> int:
+        spent = self._catchup(now)
+        ok, cost = self._certify(now)
+        spent += cost
+        if not ok:
+            return spent  # _certify aborted; old mesh keeps serving
+        self._stamp("certified")
+        self._flip(now)
+        return spent
+
+    def _flip(self, now: int) -> None:
+        """The atomic swap: state/rules/services/forwarding re-place on
+        the target mesh and the affinity hash flips generation, published
+        as ONE mesh-wide epoch swap.  Any exception restores the old mesh
+        from the pre-flip snapshot (abort; generation unchanged)."""
+        o = self.owner
+        sp = o._slowpath
+        snap = {
+            "mesh": o._mesh, "n_data": o._n_data, "topo_gen": o._topo_gen,
+            "state": o._state, "drs": o._drs, "dsvc": o._dsvc,
+            "dft": o._dft, "replica_audit": o._replica_audit_entries,
+            "queues": (None if sp is None
+                       else (sp.n_data, sp.queues, sp.queue)),
+        }
+        try:
+            o._mesh = self.t_mesh
+            o._n_data = self.dst_n
+            o._topo_gen = self.gen
+            o._drs = self.t_drs  # the placement the canary CERTIFIED
+            # Through the owner's OWN placement hooks (o._mesh already
+            # points at the target), so the flip can never drift from
+            # whatever layout the hooks define.
+            o._dsvc = o._place_services(o._dsvc)
+            o._dft = o._place_forwarding(o._dft)
+            o._state = jax.tree.map(
+                lambda h, s: jax.device_put(
+                    jnp.asarray(h), NamedSharding(self.t_mesh, s)),
+                pl.PipelineState(
+                    flow=pl.FlowCache(**self.flow_host),
+                    aff=pl.AffinityTable(**self.aff_host)),
+                _state_specs())
+            o._state_mutations += 1
+            o._replica_audit_entries = [0] * self.dst_n
+            if o._audit is not None:
+                o._audit.cursor = 0  # the striping changed; restart
+            o._audit_refresh_golden()
+            # Queue re-home LAST: every raise-capable step is behind us,
+            # so a restored snapshot can never strand a resized queue set
+            # against an unflipped data axis.
+            requeued = dropped = 0
+            if sp is not None:
+                requeued, dropped = sp.resize(
+                    self.dst_n, self._home_of_block, now)
+        except Exception as e:  # noqa: BLE001 — the flip must never
+            # strand the engine between topologies: restore and abort.
+            o._mesh = snap["mesh"]
+            o._n_data = snap["n_data"]
+            o._topo_gen = snap["topo_gen"]
+            o._state = snap["state"]
+            o._drs = snap["drs"]
+            o._dsvc = snap["dsvc"]
+            o._dft = snap["dft"]
+            o._replica_audit_entries = snap["replica_audit"]
+            if sp is not None:
+                # Belt for a raise INSIDE resize(): the queue set must
+                # match the restored data axis.  Rows already popped for
+                # re-homing may drop here — the ordinary bounded-queue
+                # contract (the flow re-admits on its next miss), never
+                # a verdict loss.
+                sp.n_data, sp.queues, sp.queue = snap["queues"]
+            self.abort(f"cutover flip failed ({type(e).__name__}: {e}); "
+                       f"old mesh restored from the pre-flip snapshot")
+            return
+        o._reshard_requeued_total += requeued
+        if o._slowpath is not None:
+            # THE mesh-wide swap: one epoch bump — the next lookup on any
+            # replica consumes the re-placed state, never a mix.
+            o._slowpath._publish(now)
+        self._stamp("cutover")
+        span = self._span()
+        o._last_reshard_span = span
+        if o._realization is not None:
+            o._realization.note_resize_span(span)
+        self._emit("reshard-cutover", topo_gen=self.gen,
+                   n_data_from=self.src_n, n_data_to=self.dst_n,
+                   migrated_rows=int(self.migrated_rows),
+                   resident_rows=int(self.resident_rows),
+                   requeued=int(requeued), dropped=int(dropped),
+                   at=int(now))
+        self.done = True
+        o._reshard_cutovers += 1
+        o._reshard_migrated_total += self.migrated_rows
+        o._reshard_resident_rows = self.resident_rows
+        o._finish_reshard(self)
+
+    def abort(self, reason: str) -> None:
+        """Abandon the resize: the old mesh keeps serving (it never
+        stopped), the affinity generation never flips, and every target
+        structure is dropped.  Idempotent."""
+        if self.done or self.aborted:
+            return
+        self.aborted = True
+        o = self.owner
+        o._reshard_aborts += 1
+        o._reshard_migrated_total += self.migrated_rows
+        self._emit("reshard-abort", reason=str(reason)[:200],
+                   topo_gen_target=self.gen, n_data_to=self.dst_n,
+                   progress=round(self.covered / max(self.G, 1), 4))
+        o._finish_reshard(self)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _home_of_block(self, block: dict) -> np.ndarray:
+        """Target-ring homes for a popped miss-queue block (the queue
+        re-route at flip time)."""
+        return shard_of_tuples(
+            np.asarray(block["src_ip"]).astype(np.uint32),
+            np.asarray(block["dst_ip"]).astype(np.uint32),
+            np.asarray(block["proto"]).astype(np.int32),
+            np.asarray(block["src_port"]).astype(np.int32),
+            np.asarray(block["dst_port"]).astype(np.int32),
+            self.dst_n, self.gen)
+
+    def _span(self) -> dict:
+        """The resize span: stage durations clamped monotonic,
+        telescoping exactly to total (the realization-span shape)."""
+        s = self._stamps
+        t0 = s["begin"]
+        prev = t0
+        out = {}
+        for name, key in (("migrated", "migrate_s"),
+                          ("certified", "certify_s"),
+                          ("cutover", "cutover_s")):
+            t = max(s.get(name, prev), prev)
+            out[key] = t - prev
+            prev = t
+        out["total_s"] = prev - t0
+        out["n_data_from"] = self.src_n
+        out["n_data_to"] = self.dst_n
+        out["rows_migrated"] = int(self.migrated_rows)
+        return out

@@ -325,7 +325,10 @@ def test_engines_register_the_consolidated_task_set(dp_cls):
         "canary", "audit-cursor", "tensor-scrub", "degraded-recompile",
         "cache-maintain", "observability"}
     # Every name is in the parseable inventory (tools/check_maintenance).
-    assert set(dpa.maintenance.task_names) | {"fqdn-ttl"} == set(MAINT_TASKS)
+    # fqdn-ttl is the agent-side registration; reshard-migrate is the
+    # mesh engine's, registered only while a resize is in flight.
+    assert (set(dpa.maintenance.task_names)
+            | {"fqdn-ttl", "reshard-migrate"} == set(MAINT_TASKS))
     out = dpa.maintenance_tick(now=next(_NOW))
     assert set(out["ran"]) >= {"canary", "audit-cursor", "tensor-scrub",
                                "cache-maintain"}

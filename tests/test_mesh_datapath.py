@@ -426,30 +426,131 @@ def test_mesh_config_rejections(world, mesh):
         _mesh_dp(world, mesh, async_slowpath=True, overlap_commits=True)
     with pytest.raises(ConfigError, match="single-chip knobs"):
         _mesh_dp(world, mesh, async_slowpath=True, autotune_drain=True)
+    with pytest.raises(ConfigError, match="reshard_budget"):
+        _mesh_dp(world, mesh, reshard_budget=0)
     mdp = _mesh_dp(world, mesh)
     with pytest.raises(ValueError, match="not divisible"):
         mdp.step(gen_traffic(cluster.pod_ips, 7, n_flows=7, seed=2), 100)
     with pytest.raises(NotImplementedError):
-        mdp.install_topology(None)
-    with pytest.raises(NotImplementedError):
         mdp.profile(None)
 
 
-def test_mesh_group_delta_folds_to_recompile_with_parity(world, mesh):
-    """Incremental deltas on the mesh fold into a full recompile (the
-    documented capacity/complexity tradeoff) — still canary-gated, still
-    generation-bumping, and verdict parity with the single-chip delta
-    path holds after the fold."""
+def _fwd_topo(n_pods=3):
+    from antrea_tpu.compiler.topology import NodeRoute, Topology
+
+    return Topology(
+        node_name="node-a",
+        gateway_ip="10.10.0.1",
+        pod_cidr="10.10.0.0/24",
+        local_pods=[(f"10.10.0.{5 + i}", 3 + i) for i in range(n_pods)],
+        remote_nodes=[NodeRoute(name="node-b", node_ip="192.168.1.2",
+                                pod_cidr="10.10.1.0/24")],
+    )
+
+
+def test_mesh_forwarding_full_walk_parity(world, mesh):
+    """PR 9 follow-up (satellite): the mesh engine serves the FULL
+    per-packet walk — SpoofGuard -> policy/service -> L2/L3 forward ->
+    Output — through one sharded dispatch, bitwise-identical to the
+    single-chip engine on every forwarding observable, and
+    install_topology swaps atomically like single-chip."""
+    from antrea_tpu.compiler.topology import OFPORT_TUNNEL
+    from antrea_tpu.packet import PacketBatch
+    from antrea_tpu.utils import ip as iputil
+
+    cluster, services = world
+    topo = _fwd_topo(3)
+    mdp = _mesh_dp(world, mesh, topology=topo)
+    sdp = TpuflowDatapath(cluster.ps, services, **KW, topology=topo)
+    rows = [
+        ("10.10.0.5", "10.10.0.6", 3),   # pod->pod local
+        ("10.10.0.5", "10.10.1.9", 3),   # pod->remote (tunnel)
+        ("10.10.0.6", "8.8.8.8", 4),     # pod->external via gateway
+        ("10.10.0.5", "10.10.0.99", 3),  # local CIDR, no such pod
+        ("10.10.1.9", "10.10.0.5", OFPORT_TUNNEL),  # tunnel ingress
+        ("10.10.0.9", "10.10.0.6", 3),   # SPOOF: src not bound to port 3
+        ("10.10.0.7", "10.10.0.5", 5),
+        ("10.10.0.6", "10.10.0.7", 4),
+    ]
+    b = PacketBatch(
+        src_ip=np.array([iputil.ip_to_u32(s) for s, _, _ in rows],
+                        np.uint32),
+        dst_ip=np.array([iputil.ip_to_u32(d) for _, d, _ in rows],
+                        np.uint32),
+        proto=np.full(len(rows), 6, np.int32),
+        src_port=np.full(len(rows), 40000, np.int32),
+        dst_port=np.full(len(rows), 80, np.int32),
+        in_port=np.array([p for _, _, p in rows], np.int32),
+    )
+    for t in (100, 101):  # step 2: cached-entry path through the walk
+        rm, rs = mdp.step(b, t), sdp.step(b, t)
+        for f in ("code", "spoofed", "fwd_kind", "out_port", "peer_ip",
+                  "dec_ttl", "tc_act", "tc_port", "punt", "mcast_idx",
+                  "l7_redirect", "dnat_ip", "dnat_port"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(rm, f)), np.asarray(getattr(rs, f)),
+                err_msg=f"step{t}:{f}")
+    assert int(np.asarray(rm.spoofed).sum()) == 1  # the guard engaged
+    # Topology swap: both engines recompute identically (replicated
+    # placement re-lands on the mesh through _place_forwarding).
+    topo2 = _fwd_topo(2)
+    mdp.install_topology(topo2)
+    sdp.install_topology(topo2)
+    rm, rs = mdp.step(b, 102), sdp.step(b, 102)
+    for f in ("code", "spoofed", "fwd_kind", "out_port", "dec_ttl"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rm, f)), np.asarray(getattr(rs, f)),
+            err_msg=f)
+
+
+def test_mesh_group_delta_o1_slot_path_with_parity(world, mesh):
+    """PR 9 follow-up (satellite): incremental deltas take the O(delta)
+    device slot path ON THE MESH — the per-slot rule masks upload sharded
+    on the word axis (no recompile fold) — still canary-gated, still
+    generation-bumping, with verdict AND attribution parity on the
+    delta-affected tuples."""
+    from antrea_tpu.packet import PacketBatch
+    from antrea_tpu.utils import ip as iputil
+
     cluster, services = world
     mdp = _mesh_dp(world, mesh)
     sdp = TpuflowDatapath(cluster.ps, services, **KW)
     group = sorted(cluster.ps.address_groups)[0]
     fresh_ip = "172.31.9.9"
+    cps0 = mdp._cps
     g1 = mdp.apply_group_delta(group, [fresh_ip], [])
     g2 = sdp.apply_group_delta(group, [fresh_ip], [])
     assert g1 == g2 == 1
+    # The slot path, not a fold: the compiled set is untouched and one
+    # delta slot is occupied — same bookkeeping as the single-chip twin.
+    assert mdp._cps is cps0
+    assert mdp._n_deltas == sdp._n_deltas >= 1
     tr = gen_traffic(cluster.pod_ips, 128, n_flows=64, seed=23)
-    rm = mdp.step(tr, 100)
-    rs = sdp.step(tr, 100)
+    rm, rs = mdp.step(tr, 100), sdp.step(tr, 100)
     np.testing.assert_array_equal(np.asarray(rm.code), np.asarray(rs.code))
     assert rm.ingress_rule == rs.ingress_rule
+    # The delta-affected tuples themselves (fresh member as src and dst).
+    pods = sorted(cluster.pod_ips)[:2]
+    key = iputil.ip_to_u32(fresh_ip)
+    pod_u = [p if not isinstance(p, str) else iputil.ip_to_u32(p)
+             for p in pods]
+    db = PacketBatch(
+        src_ip=np.array([key, pod_u[0]], np.uint32),
+        dst_ip=np.array([pod_u[1], key], np.uint32),
+        proto=np.full(2, 6, np.int32),
+        src_port=np.full(2, 40000, np.int32),
+        dst_port=np.full(2, 80, np.int32),
+    )
+    rm, rs = mdp.step(db, 101), sdp.step(db, 101)
+    np.testing.assert_array_equal(np.asarray(rm.code), np.asarray(rs.code))
+    assert rm.ingress_rule == rs.ingress_rule
+    assert rm.egress_rule == rs.egress_rule
+    # Removal leg clears through the slot path too, and the journal
+    # carries the canary-gated delta commits (flightrec assertion).
+    mdp.apply_group_delta(group, [], [fresh_ip])
+    sdp.apply_group_delta(group, [], [fresh_ip])
+    rm, rs = mdp.step(db, 102), sdp.step(db, 102)
+    np.testing.assert_array_equal(np.asarray(rm.code), np.asarray(rs.code))
+    ev = [e for e in mdp.flightrecorder_events(kind="commit")
+          if e.get("delta")]
+    assert ev and ev[-1]["outcome"] == "ok" and ev[-1]["stage"] == "settle"
