@@ -312,6 +312,95 @@ class CompiledPolicySet:
 _flip = iputil.flip_u32
 
 
+# ---------------------------------------------------------------------------
+# Phase-capacity padding (the multi-tenant packing layer, round 9)
+# ---------------------------------------------------------------------------
+
+# Smallest non-empty phase capacity: rule counts below this share one
+# rung, so small tenants collapse onto one compiled program.
+PHASE_RUNG_FLOOR = 8
+
+
+def phase_cap(n: int, floor: int = PHASE_RUNG_FLOOR) -> int:
+    """Natural phase rule count -> its pow2 capacity rung (0 stays 0)."""
+    if n <= 0:
+        return 0
+    return max(floor, 1 << (n - 1).bit_length())
+
+
+def _pad_direction_phases(dt: DirectionTensors, caps: tuple[int, int, int],
+                          pad_ip_gid: int, pad_svc_gid: int
+                          ) -> DirectionTensors:
+    n0, nk, nb = dt.n_phase0, dt.n_k8s, dt.n_baseline
+    segs = [(0, n0, caps[0] - n0), (n0, n0 + nk, caps[1] - nk),
+            (n0 + nk, n0 + nk + nb, caps[2] - nb)]
+
+    def stitch(arr: np.ndarray, pad_val) -> np.ndarray:
+        pieces = []
+        for a, b, pad in segs:
+            pieces.append(arr[a:b])
+            if pad:
+                pieces.append(np.full(pad, pad_val, arr.dtype))
+        return np.concatenate(pieces) if pieces else arr
+
+    ids: list[str] = []
+    for a, b, pad in segs:
+        ids.extend(dt.rule_ids[a:b])
+        ids.extend("" for _ in range(pad))
+    return DirectionTensors(
+        at_gid=stitch(dt.at_gid, pad_ip_gid),
+        peer_gid=stitch(dt.peer_gid, pad_ip_gid),
+        svc_gid=stitch(dt.svc_gid, pad_svc_gid),
+        action=stitch(dt.action, ACT_DROP),
+        n_phase0=caps[0],
+        n_k8s=caps[1],
+        n_baseline=caps[2],
+        rule_ids=ids,
+        l7=None if dt.l7 is None else stitch(dt.l7, 0),
+    )
+
+
+def pad_compiled_phases(cps: CompiledPolicySet) -> CompiledPolicySet:
+    """Pad each direction's phase segments to pow2 capacity rungs.
+
+    The pipeline's static jit signature carries the per-phase rule
+    counts (ops/match.StaticMeta.in_phases/out_phases): without
+    quantization every tenant's rule world would compile its own XLA
+    program.  Padding inserts inert rules AT THE END of each phase —
+    bound to a fresh EMPTY address/service group, so they paint no
+    interval, set no incidence bit and can never decide a verdict — and
+    order within a phase is preserved, so first-match semantics (and the
+    decided rule's stable id) are bit-identical to the unpadded compile
+    (the tenancy parity suite pins this).  Pad positions carry the empty
+    rule id "" (resolved to None by attribution, like a vanished rule).
+
+    Returns a new CompiledPolicySet whose phase counts are the rung
+    capacities; composes with entry-axis padding
+    (ops/match.pad_ruleset_entries) to make the whole compiled shape a
+    function of the rung alone."""
+    in_caps = (phase_cap(cps.ingress.n_phase0), phase_cap(cps.ingress.n_k8s),
+               phase_cap(cps.ingress.n_baseline))
+    out_caps = (phase_cap(cps.egress.n_phase0), phase_cap(cps.egress.n_k8s),
+                phase_cap(cps.egress.n_baseline))
+    ip_groups = list(cps.ip_groups) + [[]]  # the empty pad group
+    svc_groups = list(cps.svc_groups) + [[]]
+    pad_ip = len(ip_groups) - 1
+    pad_svc = len(svc_groups) - 1
+    return CompiledPolicySet(
+        ingress=_pad_direction_phases(cps.ingress, in_caps, pad_ip, pad_svc),
+        egress=_pad_direction_phases(cps.egress, out_caps, pad_ip, pad_svc),
+        iso_in_gid=cps.iso_in_gid,
+        iso_out_gid=cps.iso_out_gid,
+        n_ip_groups=len(ip_groups),
+        n_svc_groups=len(svc_groups),
+        ip_groups=ip_groups,
+        svc_groups=svc_groups,
+        ag_gids=dict(cps.ag_gids),
+        gid_ident=dict(cps.gid_ident),
+        has_svcref=cps.has_svcref,
+    )
+
+
 def compile_policy_set(ps: PolicySet, services=None) -> CompiledPolicySet:
     """services (list[ServiceEntry], optional): the datapath's Service view,
     consumed ONLY by toServices peer lowering (svcref_ranges) — policies

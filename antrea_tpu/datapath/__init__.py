@@ -4,6 +4,7 @@ from .audit import AuditPlane
 from .commit import BundleQuarantinedError, CanaryMismatchError, CommitPlane
 from .interface import Datapath, DatapathType, StepResult
 from .oracle_dp import OracleDatapath
+from .tenancy import TenantedDatapath, TenantRegistry, TenantSpec
 from .tpuflow import TpuflowDatapath
 
 
@@ -26,6 +27,9 @@ __all__ = [
     "Datapath",
     "DatapathType",
     "StepResult",
+    "TenantedDatapath",
+    "TenantRegistry",
+    "TenantSpec",
     "TpuflowDatapath",
     "OracleDatapath",
     "make_datapath",

@@ -329,9 +329,14 @@ class Datapath(ABC):
         return SlowPathEngine(self, **kw)
 
     @staticmethod
-    def _queue_cols(batch: PacketBatch, flags, lens) -> dict:
+    def _queue_cols(batch: PacketBatch, flags, lens, tenant: int = 0) -> dict:
         """The miss queue's admission columns from a stepped batch (one
-        schema for both engines — MissQueue.COLUMNS sans epoch/enq_ts)."""
+        schema for both engines — MissQueue.COLUMNS sans epoch/enq_ts).
+        `tenant` rides every row (0 = the default world) so drains
+        classify each queued miss in its owner's policy world — the
+        tenant id joins the queue exactly as it joins the slot/affinity/
+        shard hashes (datapath/tenancy.py; tools/check_tenant.py fails
+        the build if an admit path drops it)."""
         return {
             "src_ip": batch.src_ip.astype(np.int64),
             "dst_ip": batch.dst_ip.astype(np.int64),
@@ -340,6 +345,7 @@ class Datapath(ABC):
             "dst_port": batch.dst_port.astype(np.int64),
             "flags": np.asarray(flags).astype(np.int64),
             "lens": np.asarray(lens).astype(np.int64),
+            "tenant": np.full(batch.size, int(tenant), np.int64),
         }
 
     def drain_slowpath(self, now: int, max_batches: Optional[int] = None) -> dict:

@@ -74,6 +74,10 @@ MAINT_TASKS = {
                        "flow-cache rows to their target-topology home "
                        "shards; registered by the mesh engine only while "
                        "a live data-axis resize is in flight)",
+    "tenant-maintain": "datapath/tenancy.py (fused age+revalidate of one "
+                       "tenant world per granted unit, rotating over "
+                       "worlds; registered on first tenant_create only — "
+                       "untenanted engines keep the original task set)",
 }
 
 # A starved task's deficit keeps accumulating so it can eventually afford

@@ -54,6 +54,7 @@ from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 from .maintenance import MaintainableDatapath
 from .slowpath import ADMIT_HOLD
+from .tenancy import TenantedDatapath, TenantSpec
 
 
 def _group_ranges(g) -> set:
@@ -67,9 +68,20 @@ def _group_ranges(g) -> set:
     return set(iputil.merge_ranges(rs))
 
 
-class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
-                     AuditableDatapath, persist.PersistableDatapath,
-                     Datapath):
+class OracleDatapath(TenantedDatapath, MaintainableDatapath,
+                     TransactionalDatapath, AuditableDatapath,
+                     persist.PersistableDatapath, Datapath):
+    # Per-world swap set of the scalar twin (datapath/tenancy; the
+    # tpuflow list's scalar counterpart — tools/check_tenant.py pins the
+    # required members).  The PipelineOracle object IS the world's
+    # rule + state estate here.
+    _TENANT_WORLD_FIELDS = (
+        "_ps", "_oracle", "_gen", "_has_named_ports", "_l7_ids",
+        "_exemplars", "_stats_in", "_stats_out", "_bytes_in", "_bytes_out",
+        "_default_allow", "_default_deny", "_state_mutations",
+        "_persist_dirty",
+    )
+
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -157,14 +169,19 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
             self._topo = Topology()
         self._ft = compile_topology(self._topo)
         self._rt = resolve_topology(self._topo)
-        self._oracle = PipelineOracle(
-            self._ps, self._services,
-            flow_slots=flow_slots, aff_slots=aff_slots, ct_timeout_s=ct_timeout_s,
+        # Stashed for tenant world builds (datapath/tenancy): a tenant's
+        # PipelineOracle shares every knob but the quota-rung slot counts.
+        self._oracle_kw = dict(
+            ct_timeout_s=ct_timeout_s,
             ct_syn_timeout_s=ct_syn_timeout_s,
             ct_other_new_s=ct_other_new_s, ct_other_est_s=ct_other_est_s,
             node_ips=list(node_ips or []), node_name=node_name,
             dual_stack=dual_stack,
             count_flow_stats=self._gates.enabled("FlowExporter"),
+        )
+        self._oracle = PipelineOracle(
+            self._ps, self._services,
+            flow_slots=flow_slots, aff_slots=aff_slots, **self._oracle_kw,
         )
         self._stats_in: Counter = Counter()
         self._stats_out: Counter = Counter()
@@ -191,6 +208,8 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         # differential harness diffs the background plane tick-for-tick.
         self._init_maintenance(maint_budget=maint_budget,
                                maint_clock=maint_clock)
+        # Tenancy plane — same contract as the kernel twin.
+        self._init_tenancy()
 
     def _rebuild_l7_ids(self) -> None:
         """Stable ids of rules carrying L7 protocols in the CURRENT policy
@@ -221,6 +240,41 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
                 ex = self._exemplars.setdefault(name, {})
                 for m in g.members:
                     ex.setdefault(m.ip, m)
+
+    # -- tenancy hooks (datapath/tenancy.TenantedDatapath) -------------------
+
+    def _tenant_init_world(self, spec: TenantSpec, ps) -> None:
+        """Scalar twin of TpuflowDatapath._tenant_init_world: a fresh
+        PipelineOracle at the tenant's quota rungs, zeroed counters,
+        generation 0 (no compiles — the interpreter is shape-free, so
+        the rung machinery is inert here by construction)."""
+        self._ps = ps
+        self._gen = 0
+        self._oracle = PipelineOracle(
+            ps, self._services,
+            flow_slots=spec.quota, aff_slots=spec.aff_quota,
+            **self._oracle_kw,
+        )
+        self._stats_in = Counter()
+        self._stats_out = Counter()
+        self._bytes_in = Counter()
+        self._bytes_out = Counter()
+        self._default_allow = 0
+        self._default_deny = 0
+        self._state_mutations = 0
+        self._persist_dirty = False
+        self._rebuild_l7_ids()
+
+    def _tenant_rung_sig(self) -> tuple:
+        # The interpreter has no compiled shapes; the "rung" is the
+        # quota pair alone (reported for symmetry with the kernel twin).
+        return ("oracle", self._oracle.flow_slots, self._oracle.aff_slots)
+
+    def _tenant_occupied(self, fields: dict) -> int:
+        return len(fields["_oracle"].flow)
+
+    def _tenant_words(self) -> int:
+        return 0  # no device rule-word axis on the scalar engine
 
     @property
     def datapath_type(self) -> DatapathType:
@@ -364,7 +418,13 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         (state mutated now, observation counted at retire time) so the
         engine's staging depth, deferred counters and metric timing stay
         behaviorally identical to the tpuflow twin — the differential
-        harness diffs the overlap semantics themselves."""
+        harness diffs the overlap semantics themselves.
+
+        Tenant rows partition per tenant and classify inside their
+        owner's world (datapath/tenancy), like the kernel twin."""
+        split = self._tenant_drain_split(block)
+        if split is not None:
+            return self._tenant_drain_dispatch(split, now)
         from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
 
         batch = PacketBatch(
@@ -939,9 +999,15 @@ class OracleDatapath(MaintainableDatapath, TransactionalDatapath,
         if self._async:
             pend = np.array([o.pending for o in outs], bool)
             if pend.any():
-                self._slowpath.admit(
-                    self._queue_cols(batch, flags, lens), pend, now,
+                # Tenant worlds: quota-clamped admission + the tenant id
+                # column, same contract as the kernel twin's admit path
+                # (both are no-ops on the default world).
+                admitted, _dropped = self._slowpath.admit(
+                    self._queue_cols(batch, flags, lens,
+                                     tenant=self._tenant_id()),
+                    self._tenant_admit_mask(pend), now,
                 )
+                self._tenant_note_admitted(admitted, _dropped)
         fwd = self._forward_fields(batch, outs, in_ports, lane_modes,
                                    arp_ops)
         self._count_outcomes(outs, lens)

@@ -19,10 +19,13 @@ import numpy as np
 # One row per admitted packet.  flags/lens ride along so the drain step
 # can reconstruct the no-commit gating (multicast / FIN-RST misses) and
 # the per-flow volume contribution exactly as the synchronous slow path
-# would have seen them; epoch/enq_ts are observability (dump + epoch-age).
+# would have seen them; `tenant` is the owning policy world (0 = the
+# default world — datapath/tenancy.py partitions drains by it, and
+# tools/check_tenant.py fails the build if the schema drops it);
+# epoch/enq_ts are observability (dump + epoch-age).
 COLUMNS = (
     "src_ip", "dst_ip", "proto", "src_port", "dst_port",
-    "flags", "lens", "epoch", "enq_ts",
+    "flags", "lens", "tenant", "epoch", "enq_ts",
 )
 
 class MissQueue:
@@ -71,9 +74,15 @@ class MissQueue:
         idx = np.nonzero(np.asarray(mask, bool))[0]
         if idx.size == 0:
             return 0, 0
+        if "tenant" not in cols:
+            # Hand-built admission columns (tests, tools) predate the
+            # tenant column: default-world rows.
+            cols = dict(cols)
+            cols["tenant"] = np.zeros(
+                np.asarray(cols["src_ip"]).shape[0], np.int64)
         pos, take, dropped = self._append(
             cols, idx, ("src_ip", "dst_ip", "proto", "src_port", "dst_port",
-                        "flags", "lens"))
+                        "flags", "lens", "tenant"))
         if take:
             self._buf["epoch"][pos] = epoch
             self._buf["enq_ts"][pos] = now

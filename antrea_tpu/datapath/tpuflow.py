@@ -48,7 +48,7 @@ from ..models import pipeline as pl
 from ..observability.flightrec import emit_into
 from ..observability.metrics import Histogram
 from ..ops.match import (PRUNE_HIST_BOUNDS, PRUNE_LADDER, DeltaTable,
-                         PruneAutotuner, to_device)
+                         PruneAutotuner, to_host)
 from ..packet import Packet, PacketBatch
 from ..utils import ip as iputil
 from ..config import ConfigError
@@ -58,6 +58,7 @@ from .commit import TransactionalDatapath
 from .interface import Datapath, DatapathStats, DatapathType, StepResult
 from .maintenance import MaintainableDatapath
 from .slowpath import ADMIT_HOLD
+from .tenancy import TenantedDatapath, TenantSpec
 
 
 def _rid(ids: list, idx: int):
@@ -66,9 +67,24 @@ def _rid(ids: list, idx: int):
     return ids[idx] if 0 <= idx < len(ids) and ids[idx] else None
 
 
-class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
-                      AuditableDatapath, persist.PersistableDatapath,
-                      Datapath):
+class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
+                      TransactionalDatapath, AuditableDatapath,
+                      persist.PersistableDatapath, Datapath):
+    # The complete per-world swap set of this engine (datapath/tenancy:
+    # everything a tenant's own spec/tensors/commit bookkeeping touches;
+    # tools/check_tenant.py pins the required members).  Deliberately
+    # absent = shared across worlds: _services/_dsvc (the platform
+    # service view), _topo/_ft/_rt/_dft (forwarding), the prune plane,
+    # the slow-path queue and every scheduler/observability object.
+    _TENANT_WORLD_FIELDS = (
+        "_ps", "_cps", "_drs", "_meta", "_meta_step", "_state", "_gen",
+        "_has_named_ports", "_n_deltas", "_delta_host", "_name_gids",
+        "_gid_ident", "_group_members", "_static_blocks", "_member_meta",
+        "_stats_in", "_stats_out", "_bytes_in", "_bytes_out",
+        "_default_allow", "_default_deny", "_evictions", "_reclaims",
+        "_state_mutations", "_pipe_kw", "_persist_dirty",
+    )
+
     def __init__(
         self,
         ps: Optional[PolicySet] = None,
@@ -243,6 +259,9 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         # (datapath/maintenance.py — the ONE background plane).
         self._init_maintenance(maint_budget=maint_budget,
                                maint_clock=maint_clock)
+        # Tenancy plane (datapath/tenancy.py): pure host-side registry —
+        # an engine without tenant worlds serves bit-identically.
+        self._init_tenancy()
 
     # -- placement hooks (overridden by the mesh engine, parallel/meshpath) --
 
@@ -254,9 +273,15 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
 
     def _place_rules(self, cps):
         """Compile -> device rule tensors + match meta on this engine's
-        layout (mesh engine: word-axis padding + sharded placement)."""
-        return to_device(cps, delta_slots=self._delta_slots,
-                         prune_budget=self._prune_budget)
+        layout (mesh engine: word-axis padding + sharded placement).
+        Tenant worlds interpose entry-axis rung padding between the host
+        build and device placement (datapath/tenancy._pad_tables — a
+        no-op on the default world, preserving the untenanted pytree
+        bit-for-bit)."""
+        host, match_meta = to_host(cps, delta_slots=self._delta_slots,
+                                   prune_budget=self._prune_budget)
+        host = self._pad_tables(host)
+        return jax.tree_util.tree_map(jnp.asarray, host), match_meta
 
     def _place_services(self, dsvc: pl.DeviceServiceTables):
         """Device service-table placement hook (mesh engine: replicated
@@ -524,11 +549,17 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             # upcall handoff); their outputs carry the provisional
             # admission verdict (miss_code) until a drain classifies the
             # flow.  Overflowed admissions are counted, never blocked on.
+            # Tenant worlds: the admission mask is clamped to the
+            # tenant's in-queue quota and the queued rows carry the
+            # tenant id, so drains classify them in their owner's world
+            # (datapath/tenancy — both are no-ops on the default world).
             pending = o["miss"]
-            self._slowpath.admit(
-                self._queue_cols(batch, batch.flags(), lens),
-                pending != 0, now,
+            admitted, _dropped = self._slowpath.admit(
+                self._queue_cols(batch, batch.flags(), lens,
+                                 tenant=self._tenant_id()),
+                self._tenant_admit_mask(pending != 0), now,
             )
+            self._tenant_note_admitted(admitted, _dropped)
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
@@ -740,7 +771,11 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         self._prune_hist.add_counts(hist[:-1], float(hist[-1]))
         classified = int(hist[:-1].sum())
         self._prune_classified += classified
-        if self._prune_tuner is not None:
+        # The K autotuner observes DEFAULT-world evidence only: a retune
+        # is a meta swap, and a tenant world's swapped-in meta must not
+        # diverge the engine-wide K bookkeeping (tenant worlds inherit
+        # the engine's budget at their next compile).
+        if self._prune_tuner is not None and self._active_tenant is None:
             new = self._prune_tuner.observe(classified, fb)
             if new != self._prune_budget:
                 self._retune_prune(new)
@@ -787,7 +822,14 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         (metrics, eviction accounting) is returned as a deferred
         finalizer for the engine's two-slot staging; a flow whose packets
         re-missed before this commit landed is simply re-enqueued and
-        re-classified — idempotent by the deterministic endpoint hash."""
+        re-classified — idempotent by the deterministic endpoint hash.
+
+        Tenant rows (datapath/tenancy): a popped block carrying tenant
+        ids partitions per tenant and each sub-block classifies inside
+        its owner's world — zero cost without tenant worlds."""
+        split = self._tenant_drain_split(block)
+        if split is not None:
+            return self._tenant_drain_dispatch(split, now)
         k = len(block["src_ip"])
         D = self._slowpath.drain_batch
         if k > D:
@@ -1487,6 +1529,10 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
             self._ps,
             services=self._services if services is None else services,
         )
+        # Tenant worlds: pad phase capacities onto pow2 rungs BEFORE the
+        # capacity check and placement (datapath/tenancy — no-op on the
+        # default world).
+        cps = self._pad_cps(cps)
         pl.check_rule_capacity(cps)
         drs, match_meta = self._place_rules(cps)
         self._cps = cps
@@ -1682,6 +1728,37 @@ class TpuflowDatapath(MaintainableDatapath, TransactionalDatapath,
         """Delta-table placement hook (mesh engine: re-place on the mesh
         with the word-axis specs so incremental uploads stay sharded)."""
         return dt
+
+    # -- tenancy hook (datapath/tenancy.TenantedDatapath) --------------------
+
+    def _tenant_init_world(self, spec: TenantSpec, ps) -> None:
+        """Re-initialize the swapped-out engine fields as a fresh rule
+        world for `spec`: its own compiled (rung-padded) tensors, its
+        own quota-rung state tables, zeroed counters, generation 0.  The
+        caller (tenant_create) holds the saved world and restores it in
+        its finally; placement goes through the engine hooks, so the
+        mesh engine builds sharded worlds with no code of its own."""
+        self._ps = ps
+        self._gen = 0
+        self._pipe_kw = dict(self._pipe_kw, flow_slots=spec.quota,
+                             aff_slots=spec.aff_quota)
+        self._stats_in = Counter()
+        self._stats_out = Counter()
+        self._bytes_in = Counter()
+        self._bytes_out = Counter()
+        self._default_allow = 0
+        self._default_deny = 0
+        self._evictions = 0
+        self._reclaims = 0
+        self._state_mutations = 0
+        self._persist_dirty = False
+        self._compile_rules()
+        self._state = self._init_pipeline_state(spec.quota, spec.aff_quota)
+
+    def _tenant_occupied(self, fields: dict) -> int:
+        """Occupancy of a SNAPSHOTTED world state (datapath/tenancy
+        tenant_stats — the scrape path must never swap worlds)."""
+        return int(pl.cache_stats(fields["_state"])["occupied"])
 
     def _sync_ps_members(self, name: str) -> None:
         """Keep the held PolicySet's group membership in line with the

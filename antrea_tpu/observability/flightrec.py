@@ -123,6 +123,18 @@ EVENT_KINDS = {
                      "(target-topology canary veto, audit divergence, "
                      "flip failure, or operator abort): the old mesh "
                      "keeps serving, generation unchanged",
+    "tenant-create": "datapath/tenancy.py — an isolated tenant policy "
+                     "world was created (rung-padded rule window, "
+                     "quota-rung state tables, generation 0)",
+    "tenant-quota-clamp": "datapath/tenancy.py — a tenant's miss-queue "
+                          "admissions were clamped to its in-queue "
+                          "quota (noisy-neighbor containment; the "
+                          "clamped flows re-admit once its backlog "
+                          "drains)",
+    "tenant-rollback": "datapath/tenancy.py — a tenant's install failed "
+                       "its transaction (canary veto / compile fault) "
+                       "and rolled back ONLY that tenant's world; every "
+                       "other tenant's generation is untouched",
 }
 
 

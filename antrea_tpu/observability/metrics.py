@@ -122,6 +122,7 @@ METRICS: dict[str, str] = {
     "antrea_tpu_reshard_resident_rows": "gauge",
     "antrea_tpu_reshard_cutovers_total": "counter",
     "antrea_tpu_reshard_aborts_total": "counter",
+    "antrea_tpu_reshard_catchup_rows_total": "counter",
     # aggregated-bitmap match pruning (ops/match round 7; rendered when
     # the datapath exposes prune_stats())
     "antrea_tpu_match_prune_skips_total": "counter",
@@ -129,6 +130,19 @@ METRICS: dict[str, str] = {
     "antrea_tpu_match_prune_candidate_superblocks": "histogram",
     "antrea_tpu_match_prune_budget": "gauge",
     "antrea_tpu_match_prune_retunes_total": "counter",
+    # multi-tenant serving plane (datapath/tenancy.py; rendered when the
+    # datapath exposes tenant_stats()) — tenant-labeled families so each
+    # policy world's generation, quota pressure and isolation meters are
+    # scrapeable tenant-for-tenant
+    "antrea_tpu_tenant_worlds": "gauge",
+    "antrea_tpu_tenant_generation": "gauge",
+    "antrea_tpu_tenant_degraded": "gauge",
+    "antrea_tpu_tenant_flow_quota_slots": "gauge",
+    "antrea_tpu_tenant_flow_occupied": "gauge",
+    "antrea_tpu_tenant_rule_words": "gauge",
+    "antrea_tpu_tenant_evictions_total": "counter",
+    "antrea_tpu_tenant_quota_clamps_total": "counter",
+    "antrea_tpu_tenant_rollbacks_total": "counter",
 }
 
 
@@ -641,9 +655,33 @@ def render_metrics(datapath, node: str = "") -> str:
             ("antrea_tpu_reshard_resident_rows", "resident_rows"),
             ("antrea_tpu_reshard_cutovers_total", "cutovers_total"),
             ("antrea_tpu_reshard_aborts_total", "aborts_total"),
+            ("antrea_tpu_reshard_catchup_rows_total", "catchup_rows_total"),
         ):
             lines += [_type_line(fam),
                       f"{fam}{_labels(node=node)} {_num(rs[key])}"]
+    ts = getattr(datapath, "tenant_stats", None)
+    ts = ts() if ts is not None else None
+    if ts:
+        # Multi-tenant serving plane (datapath/tenancy.py): per-world
+        # generation/degrade state, quota pressure and the isolation
+        # meters, labeled {tenant} so fleet scrapes aggregate per world.
+        lines += [_type_line("antrea_tpu_tenant_worlds"),
+                  f"antrea_tpu_tenant_worlds{_labels(node=node)} {len(ts)}"]
+        per = (
+            ("antrea_tpu_tenant_generation", "generation"),
+            ("antrea_tpu_tenant_degraded", "degraded"),
+            ("antrea_tpu_tenant_flow_quota_slots", "quota_slots"),
+            ("antrea_tpu_tenant_flow_occupied", "occupied"),
+            ("antrea_tpu_tenant_rule_words", "rule_words"),
+            ("antrea_tpu_tenant_evictions_total", "evictions_total"),
+            ("antrea_tpu_tenant_quota_clamps_total", "quota_clamps_total"),
+            ("antrea_tpu_tenant_rollbacks_total", "rollbacks_total"),
+        )
+        for fam, key in per:
+            lines.append(_type_line(fam))
+            for tid, row in ts.items():
+                lines.append(
+                    f"{fam}{_labels(tenant=tid, node=node)} {_num(row[key])}")
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

@@ -489,6 +489,130 @@ def to_device(
 
 
 # ---------------------------------------------------------------------------
+# Entry-axis rung padding (round 9, the multi-tenant packing layer)
+# ---------------------------------------------------------------------------
+
+# Smallest entry-axis rung a padded table lands on: interval-boundary
+# counts below this all share one shape, so small tenants collapse onto
+# one compiled program instead of one per distinct group structure.
+ENTRY_RUNG_FLOOR = 16
+
+# Padding boundary values: the MAXIMUM of each key space.  searchsorted
+# side='right' counts bounds <= x, so every x below the maximum resolves
+# to its original interval row unchanged; x == maximum lands past the
+# pad block, which is why the padder REPLICATES the top row's incidence
+# across the whole pad region (any bisect variant then reads the same
+# row content).  For IP dims the flipped-space max is the flip of
+# 255.255.255.255 == int32 max; svc keys live below 2^24, so int32 max
+# is unreachable there outright.
+_PAD_BOUND = 2**31 - 1
+
+
+def _entry_cap(n: int, floor: int = ENTRY_RUNG_FLOOR) -> int:
+    """Natural entry count -> its pow2 rung (0 stays 0: an empty v6
+    sub-table is a SHAPE, shared by every all-v4 world on the rung)."""
+    if n <= 0:
+        return 0
+    return max(floor, 1 << (n - 1).bit_length())
+
+
+def _pad_rows(rows: np.ndarray, at: int, count: int) -> np.ndarray:
+    """Insert `count` replicas of row `at` directly after it."""
+    if count <= 0:
+        return rows
+    return np.concatenate(
+        [rows[: at + 1], np.repeat(rows[at : at + 1], count, axis=0),
+         rows[at + 1 :]], axis=0)
+
+
+def _pad_dim_table(tab: DimTable, cap4: int, cap6: int) -> DimTable:
+    bounds = np.asarray(tab.bounds)
+    bounds6 = np.asarray(tab.bounds6)
+    inc = np.asarray(tab.inc)
+    nb4, nb6 = bounds.shape[0], bounds6.shape[0]
+    ip_dim = inc.shape[0] == nb4 + 1 + nb6 + 1  # svc dims have no v6 rows
+    p4 = max(0, cap4 - nb4)
+    p6 = max(0, cap6 - nb6) if ip_dim else 0
+    if p4 == 0 and p6 == 0:
+        return tab
+    if p4:
+        bounds = np.concatenate(
+            [bounds, np.full(p4, _PAD_BOUND, bounds.dtype)])
+        inc = _pad_rows(inc, nb4, p4)  # replicate the v4 top row
+    if p6:
+        bounds6 = np.concatenate(
+            [bounds6, np.full((p6, 4), _PAD_BOUND, bounds6.dtype)], axis=0)
+        inc = _pad_rows(inc, inc.shape[0] - 1, p6)  # replicate the v6 top row
+    return DimTable(
+        bounds=bounds, bounds6=bounds6, inc=inc,
+        agg=build_agg(inc) if tab.agg is not None else None)
+
+
+def _pad_iso_table(tab: IsoTable, cap4: int, cap6: int) -> IsoTable:
+    bounds = np.asarray(tab.bounds)
+    bounds6 = np.asarray(tab.bounds6)
+    val = np.asarray(tab.val)
+    nb4, nb6 = bounds.shape[0], bounds6.shape[0]
+    p4 = max(0, cap4 - nb4)
+    p6 = max(0, cap6 - nb6)
+    if p4 == 0 and p6 == 0:
+        return tab
+    if p4:
+        bounds = np.concatenate(
+            [bounds, np.full(p4, _PAD_BOUND, bounds.dtype)])
+        val = _pad_rows(val, nb4, p4)
+    if p6:
+        bounds6 = np.concatenate(
+            [bounds6, np.full((p6, 4), _PAD_BOUND, bounds6.dtype)], axis=0)
+        val = _pad_rows(val, val.shape[0] - 1, p6)
+    return IsoTable(bounds=bounds, bounds6=bounds6, val=val)
+
+
+def pad_ruleset_entries(
+    drs: DeviceRuleSet, cap4: Optional[int] = None,
+    cap6: Optional[int] = None,
+) -> tuple[DeviceRuleSet, tuple[int, int]]:
+    """Pad every dimension's ENTRY axes (interval boundaries + incidence
+    rows) of a HOST ruleset to pow2 rungs -> (padded drs, (cap4, cap6)).
+
+    The word axis is already rung-shaped by the caller (padded rule
+    counts through `_width`); this pads the other jit-signature axes —
+    per-dim boundary counts, which otherwise vary with each tenant's
+    group structure — so two rule worlds on the same rung produce
+    IDENTICAL tensor shapes and share one compiled program (the
+    multi-tenant shared-compile contract, datapath/tenancy.py).  Padding
+    is semantically invisible: pad boundaries sit at the key-space
+    maximum and every pad row replicates its neighbor's incidence, so no
+    probe can resolve to different rule bits (regression-pinned by the
+    tenancy parity suite).  Aggregate tables are rebuilt from the padded
+    incidence (build_agg is the one builder, so the scrub/property tests
+    keep their consistency contract)."""
+    dims = [drs.ingress.at, drs.ingress.peer, drs.ingress.svc,
+            drs.egress.at, drs.egress.peer, drs.egress.svc]
+    isos = [drs.iso_in, drs.iso_out]
+    if cap4 is None:
+        cap4 = _entry_cap(max(
+            [np.asarray(t.bounds).shape[0] for t in dims + isos]))
+    if cap6 is None:
+        cap6 = _entry_cap(max(
+            [np.asarray(t.bounds6).shape[0] for t in dims + isos]))
+
+    def pad_dir(d: DeviceDirection) -> DeviceDirection:
+        return d._replace(
+            at=_pad_dim_table(d.at, cap4, cap6),
+            peer=_pad_dim_table(d.peer, cap4, cap6),
+            svc=_pad_dim_table(d.svc, cap4, cap6),
+        )
+
+    return drs._replace(
+        ingress=pad_dir(drs.ingress),
+        egress=pad_dir(drs.egress),
+        iso_in=_pad_iso_table(drs.iso_in, cap4, cap6),
+        iso_out=_pad_iso_table(drs.iso_out, cap4, cap6),
+    ), (int(cap4), int(cap6))
+
+
+# ---------------------------------------------------------------------------
 # Kernel
 # ---------------------------------------------------------------------------
 

@@ -183,7 +183,7 @@ def _tuple_hash(src_ip, dst_ip, proto, sport, dport):
 
 
 def shard_of_tuples(src_ip, dst_ip, proto, sport, dport, n_data: int,
-                    topo_gen: int = 0):
+                    topo_gen: int = 0, tenant: int = 0):
     """Host-side (numpy) data-shard assignment for a batch of 5-tuples.
 
     Symmetric under direction reversal: the forward leg (c -> s) and the
@@ -200,8 +200,22 @@ def shard_of_tuples(src_ip, dst_ip, proto, sport, dport, n_data: int,
     shape, so consecutive resizes move only the ring-minimal key
     fraction.  During a live reshard the old and new maps resolve side
     by side — in-flight batches against (D_old, g), migration routing
-    against (D_new, g+1)."""
+    against (D_new, g+1).
+
+    `tenant` folds the owning policy world's id into the key hash
+    (datapath/tenancy.py): two tenants presenting the same 5-tuple are
+    DIFFERENT connections and must decorrelate across shards like any
+    other key material.  Batch-constant, so direction symmetry is
+    preserved; 0 (the default world) leaves the hash bit-identical to
+    the untenanted map.  The golden-ratio pre-scramble spreads the small
+    sequential ids across the word (the `_ring` lesson — raw small ints
+    cluster in u32 order)."""
     h = _tuple_hash(src_ip, dst_ip, proto, sport, dport)
+    if tenant:
+        with np.errstate(over="ignore"):
+            h = hashing.fnv_mix(
+                [h, np.full(h.shape, np.uint32(int(tenant))
+                            * np.uint32(0x9E3779B9), np.uint32)], xp=np)
     if topo_gen == 0:
         return (h % np.uint32(n_data)).astype(np.int32)
     pts, owners = _ring(int(n_data))

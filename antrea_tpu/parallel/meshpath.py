@@ -106,8 +106,9 @@ from ..datapath.slowpath import MissQueue, SlowPathEngine
 from ..datapath.tpuflow import TpuflowDatapath, _rid
 from ..models import forwarding as fw
 from ..models import pipeline as pl
+from ..ops import hashing
 from ..ops import match as m
-from ..ops.match import to_device
+from ..ops.match import to_host
 from ..packet import PacketBatch
 from ..utils import ip as iputil
 from .mesh import (
@@ -522,6 +523,7 @@ class MeshDatapath(TpuflowDatapath):
         self._reshard_cutovers = 0
         self._reshard_aborts = 0
         self._reshard_migrated_total = 0
+        self._reshard_catchup_total = 0
         self._reshard_requeued_total = 0
         self._reshard_resident_rows = 0
         self._last_reshard_span = None
@@ -541,9 +543,14 @@ class MeshDatapath(TpuflowDatapath):
             state, _state_specs())
 
     def _place_rules(self, cps):
-        drs, meta = to_device(cps, word_multiple=self._n_rule,
-                              delta_slots=self._delta_slots,
-                              prune_budget=self._prune_budget)
+        host, meta = to_host(cps, word_multiple=self._n_rule,
+                             delta_slots=self._delta_slots,
+                             prune_budget=self._prune_budget)
+        # Tenant worlds: entry-axis rung padding between host build and
+        # sharded placement (datapath/tenancy._pad_tables — no-op on the
+        # default world), composing with the word_multiple padding above
+        # so tenant shapes stay rung-determined ON the mesh too.
+        drs = self._pad_tables(host)
         # The fused consumer must interpret iff the MESH's backend is CPU
         # (the default platform can differ — virtual-CPU mesh on a TPU
         # host), mirroring mesh.shard_rule_set.
@@ -606,7 +613,7 @@ class MeshDatapath(TpuflowDatapath):
                else np.zeros(B, np.int32))
         shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
                                 batch.src_port, batch.dst_port, D,
-                                self._topo_gen)
+                                self._topo_gen, tenant=self._tenant_id())
         perm, inv, spill = _shard_placement(shard, D)
         src = batch.src_ip[perm].astype(np.uint32)
         dst = batch.dst_ip[perm].astype(np.uint32)
@@ -643,15 +650,29 @@ class MeshDatapath(TpuflowDatapath):
         # Recomputed from the MERGED per-lane mask: a retried lane's miss
         # image is its home-shard one, not the foreign always-miss.
         n_miss = int(o["miss"].sum())
+        # Dirty-row tracking for an in-flight resize (parallel/reshard):
+        # every lane's home (replica, slot) may be refreshed/committed/
+        # torn down by this step after its migration window — record it
+        # so the cutover catch-up sweeps the touched set, not O(slots).
+        if self._reshard is not None:
+            self._note_reshard_touched(
+                shard, batch.src_ip, batch.dst_ip, batch.proto,
+                batch.src_port, batch.dst_port,
+                committed=o.get("committed"), dnat_f=o.get("dnat_ip_f"),
+                dnat_port=o.get("dnat_port"))
         pending = None
         if self._async:
             pending = o["miss"]
             # Route each admitted miss to its HOME replica's queue — a
             # spilled lane's drain then classifies and commits it on the
-            # shard that owns it.
-            self._slowpath.admit(
-                self._queue_cols(batch, batch.flags(), lens),
-                pending != 0, now, shard=shard)
+            # shard that owns it.  Tenant worlds: quota-clamped admission
+            # + the tenant id column (datapath/tenancy — no-ops on the
+            # default world).
+            admitted, _dropped = self._slowpath.admit(
+                self._queue_cols(batch, batch.flags(), lens,
+                                 tenant=self._tenant_id()),
+                self._tenant_admit_mask(pending != 0), now, shard=shard)
+            self._tenant_note_admitted(admitted, _dropped)
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
@@ -762,7 +783,14 @@ class MeshDatapath(TpuflowDatapath):
         via `valid`; all lanes are home lanes (admission routed them), so
         there is no spill term here.  `chunk` is the pop size the engine
         pinned at begin_drain (an explicit begin_drain(n) may exceed
-        drain_batch; each replica's lane slice must be that wide)."""
+        drain_batch; each replica's lane slice must be that wide).
+
+        Tenant rows (datapath/tenancy): blocks carrying tenant ids
+        partition per tenant and each tenant's per-replica sub-blocks
+        classify inside its world — zero cost without tenant worlds."""
+        split = self._tenant_drain_split_blocks(blocks)
+        if split is not None:
+            return self._tenant_drain_dispatch_blocks(split, now, chunk)
         sp = self._slowpath
         chunk = int(chunk) if chunk is not None else sp.drain_batch
         D = self._n_data
@@ -810,6 +838,16 @@ class MeshDatapath(TpuflowDatapath):
             {k: o[k][sel] for k in ("code", "ingress_rule", "egress_rule")},
             in_ids, out_ids, lens[sel],
         )
+        # Dirty-row tracking for an in-flight resize: a drain COMMITS
+        # rows (both conntrack directions) after their migration window.
+        if self._reshard is not None:
+            replica = (np.arange(Bd) // chunk).astype(np.int32)
+            self._note_reshard_touched(
+                replica[valid], src[valid], dst[valid], proto[valid],
+                sport[valid], dport[valid],
+                committed=o["committed"][valid],
+                dnat_f=o["dnat_ip_f"][valid],
+                dnat_port=o["dnat_port"][valid])
         return None  # never deferred: overlap staging is single-chip
 
     def _epoch_maintain(self, now: int) -> tuple[int, int]:
@@ -1026,13 +1064,19 @@ class MeshDatapath(TpuflowDatapath):
         c["reclaims"] = self._reclaims
         return c
 
+    def _tenant_occupied(self, fields: dict) -> int:
+        """Snapshot-state occupancy, (D,)-summed (tenancy tenant_stats —
+        the scrape path must never swap worlds)."""
+        per = _vmapped_cache_stats()(fields["_state"])
+        return int(np.asarray(per["occupied"]).sum())
+
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         if not self._gates.enabled("Traceflow"):
             raise RuntimeError("Traceflow feature gate is disabled")
         D = self._n_data
         shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
                                 batch.src_port, batch.dst_port, D,
-                                self._topo_gen)
+                                self._topo_gen, tenant=self._tenant_id())
         out: list = [None] * batch.size
         for r in range(D):
             idx = np.nonzero(shard == r)[0]
@@ -1046,6 +1090,55 @@ class MeshDatapath(TpuflowDatapath):
         return out
 
     # -- elastic resharding plane (parallel/reshard.py) ----------------------
+
+    def _note_reshard_touched(self, replica, src, dst, proto, sport, dport,
+                              committed=None, dnat_f=None,
+                              dnat_port=None) -> None:
+        """Record the home (replica, local slot) of every lane a live
+        dispatch may have refreshed/committed/torn down, plus — for
+        conntrack-committed lanes — the REPLY-direction entry's slot
+        (keyed on the post-DNAT swapped tuple, written in the same
+        replica's slice).  Conservative: marking an untouched slot just
+        re-sweeps one row at catch-up; the one write class NOT derivable
+        host-side is the deferred partner-refresh ts stamp (its slot
+        comes from cached meta) — a missed ts refresh is the documented
+        verdict-safe staleness class, re-proved by the revalidator."""
+        plane = self._reshard
+        if plane is None or plane.dirty_all:
+            return
+        N = self._meta.flow_slots
+        src = np.asarray(src).astype(np.uint32)
+        dst = np.asarray(dst).astype(np.uint32)
+        proto = np.asarray(proto).astype(np.int32)
+        sport = np.asarray(sport).astype(np.int32)
+        dport = np.asarray(dport).astype(np.int32)
+        h = hashing.flow_hash(src, dst, proto, sport, dport, xp=np)
+        plane.note_touched(np.asarray(replica),
+                           (h & np.uint32(N - 1)).astype(np.int64))
+        if committed is None or dnat_f is None:
+            return
+        com = np.asarray(committed) != 0
+        if not com.any():
+            return
+        dnat = iputil.unflip_u32_array(np.asarray(dnat_f)[com])
+        dp = np.asarray(dnat_port)[com].astype(np.int32)
+        rh = hashing.flow_hash(dnat.astype(np.uint32), src[com], proto[com],
+                               dp, sport[com], xp=np)
+        plane.note_touched(np.asarray(replica)[com],
+                           (rh & np.uint32(N - 1)).astype(np.int64))
+
+    def _remap_cached_attribution(self, old_in: list, old_out: list) -> None:
+        # Same-ids-in-same-order is the base method's no-op fast path
+        # (services-only bundles, degraded-recovery recompiles): zero
+        # cache rows rewritten, so the bounded dirty set must survive.
+        changed = (list(old_in) != list(self._cps.ingress.rule_ids)
+                   or list(old_out) != list(self._cps.egress.rule_ids))
+        super()._remap_cached_attribution(old_in, old_out)
+        # A mid-resize bundle that REALLY remapped attribution touched
+        # the WHOLE cache: no bounded dirty set covers that — fall back
+        # to the full catch-up sweep (metered; the pre-tracking shape).
+        if changed and self._reshard is not None:
+            self._reshard.note_all_dirty()
 
     def reshard_begin(self, n_data: int, devices=None) -> dict:
         """Begin a LIVE resize of the data axis to `n_data` replicas.
@@ -1067,6 +1160,15 @@ class MeshDatapath(TpuflowDatapath):
                 "datapath is degraded (serving last-known-good): the "
                 "cutover gate could never certify a target topology — "
                 "recover before resizing")
+        if self.tenant_count:
+            # Tenant worlds hold their own (D,)-sharded state the
+            # migrator does not walk; re-homing them under a resize is
+            # an open item (datapath/tenancy.py residue) — refuse
+            # loudly rather than silently strand tenant rows.
+            raise RuntimeError(
+                f"{self.tenant_count} tenant world(s) exist; the elastic "
+                f"resharding plane migrates the default world only — "
+                f"drain tenants before resizing")
         plane = ReshardPlane(self, int(n_data), devices=devices)
         self._reshard = plane
         self._maintenance.register(MaintenanceTask(
@@ -1120,6 +1222,11 @@ class MeshDatapath(TpuflowDatapath):
             "target_n_data": None if st is None else st["n_data_to"],
             "progress_ratio": 0.0 if st is None else st["progress_ratio"],
             "migrated_rows_total": migrated,
+            # Cutover catch-up volume: slots the dirty-row sweep walked
+            # (the full O(slots) fallback only after a whole-cache
+            # write — the production-boundedness meter of ROADMAP 3).
+            "catchup_rows_total": self._reshard_catchup_total + (
+                plane.catchup_scanned if plane is not None else 0),
             "resident_rows": (plane.resident_rows if plane is not None
                               else self._reshard_resident_rows),
             "requeued_total": self._reshard_requeued_total,

@@ -176,6 +176,22 @@ class ReshardPlane:
         # so every budgeted window advances all source replicas.
         self.G = self.src_n * int(owner._meta.flow_slots)
         self.covered = 0
+        # Dirty-row tracking (ROADMAP item 3's production residue): the
+        # engine records every (replica, local slot) a live dispatch may
+        # have committed/refreshed/torn down while this resize is in
+        # flight (MeshDatapath._note_reshard_touched), and the cutover
+        # catch-up sweeps ONLY that set instead of re-walking all G
+        # slots.  A boolean BITMAP, not a set: note_touched sits on the
+        # live dispatch path, so marking must be one vectorized
+        # fancy-index write (memory bounded at 1 bit/slot).  `dirty_all`
+        # is the escape hatch: a mid-resize attribution remap touches
+        # the whole cache, so the sweep falls back to the full walk
+        # (metered either way via catchup_scanned ->
+        # reshard_catchup_rows_total).
+        self.dirty = np.zeros(
+            (self.src_n, int(owner._meta.flow_slots)), bool)
+        self.dirty_all = False
+        self.catchup_scanned = 0
         self.phase = "migrate"  # -> "ready" -> done/aborted
         self.done = False
         self.aborted = False
@@ -197,6 +213,25 @@ class ReshardPlane:
     def _emit(self, kind: str, **fields) -> None:
         emit_into(self.owner, kind, **fields)
 
+    def note_touched(self, replica, slots) -> None:
+        """Record source-(replica, local slot) pairs a live dispatch may
+        have written (conservative over-marking is harmless: the
+        catch-up re-sweeps one already-synced row).  One masked
+        fancy-index write — this runs on the traffic path."""
+        if self.dirty_all:
+            return
+        rep = np.asarray(replica).ravel()
+        sl = np.asarray(slots).ravel()
+        ok = ((rep >= 0) & (rep < self.src_n)
+              & (sl >= 0) & (sl < self.dirty.shape[1]))
+        self.dirty[rep[ok], sl[ok]] = True
+
+    def note_all_dirty(self) -> None:
+        """Whole-cache write (attribution remap): bounded tracking can't
+        cover it — the catch-up falls back to the full sweep."""
+        self.dirty_all = True
+        self.dirty[:] = False
+
     def _stamp(self, name: str) -> None:
         prev = max(self._stamps.values())
         self._stamps[name] = max(float(self._clock()), prev)
@@ -212,6 +247,9 @@ class ReshardPlane:
             "migrated_rows": int(self.migrated_rows),
             "resident_rows": int(self.resident_rows),
             "catchup_rows": int(self.catchup_rows),
+            "catchup_scanned": int(self.catchup_scanned),
+            "dirty_rows": int(self.dirty.sum()),
+            "dirty_all": bool(self.dirty_all),
             "affinity_rows": int(self.aff_rows),
         }
 
@@ -344,15 +382,38 @@ class ReshardPlane:
         """The final delta sweep, serialized with the flip (the
         scheduler's tick already excludes in-flight drains, and no
         traffic steps between this sweep and the generation flip in the
-        single-threaded engine): re-walk every source slot so rows
-        committed, refreshed or remapped AFTER their migration window
-        land in the target before it serves.  Idempotent by the
-        newest-ts/tie-overwrite rule.  Affinity broadcasts here too —
-        one pass at the freshest view."""
+        single-threaded engine): re-sync rows committed, refreshed or
+        torn down AFTER their migration window so they land in the
+        target before it serves.  Idempotent by the newest-ts/
+        tie-overwrite rule.  Affinity broadcasts here too — one pass at
+        the freshest view.
+
+        Sweeps ONLY the engine-recorded dirty set (note_touched) —
+        consecutive dirty slots coalesce into one decode window — and
+        falls back to the full O(slots) walk only after a whole-cache
+        write (dirty_all: the mid-resize attribution remap).  Swept
+        volume is metered (catchup_scanned ->
+        antrea_tpu_reshard_catchup_rows_total)."""
         S = self.G // self.src_n
+        if self.dirty_all:
+            for r in range(self.src_n):
+                self._copy_rows(r, 0, S, now, catchup=True)
+            self.catchup_scanned += self.G
+            return self.G + self._migrate_affinity()
+        scanned = 0
         for r in range(self.src_n):
-            self._copy_rows(r, 0, S, now, catchup=True)
-        return self.G + self._migrate_affinity()
+            slots = np.flatnonzero(self.dirty[r, :S])
+            # Consecutive dirty slots coalesce into one decode window.
+            for run in np.split(slots,
+                                np.flatnonzero(np.diff(slots) > 1) + 1):
+                if run.size == 0:
+                    continue
+                self._copy_rows(r, int(run[0]), int(run.size), now,
+                                catchup=True)
+                scanned += int(run.size)
+            self.dirty[r] = False
+        self.catchup_scanned += scanned
+        return scanned + self._migrate_affinity()
 
     # -- certification -------------------------------------------------------
 
@@ -576,6 +637,7 @@ class ReshardPlane:
         self.done = True
         o._reshard_cutovers += 1
         o._reshard_migrated_total += self.migrated_rows
+        o._reshard_catchup_total += self.catchup_scanned
         o._reshard_resident_rows = self.resident_rows
         o._finish_reshard(self)
 
@@ -589,6 +651,7 @@ class ReshardPlane:
         o = self.owner
         o._reshard_aborts += 1
         o._reshard_migrated_total += self.migrated_rows
+        o._reshard_catchup_total += self.catchup_scanned
         self._emit("reshard-abort", reason=str(reason)[:200],
                    topo_gen_target=self.gen, n_data_to=self.dst_n,
                    progress=round(self.covered / max(self.G, 1), 4))

@@ -78,3 +78,68 @@ def gen_traffic(
         src_port=f_sport[idx],
         dst_port=f_dport[idx],
     )
+
+
+# ---------------------------------------------------------------------------
+# Attack-shaped generators (ROADMAP item 4's adversarial tier; also the
+# quota-isolation stressors of the multi-tenant tests, datapath/tenancy).
+# ---------------------------------------------------------------------------
+
+def gen_syn_flood(
+    dst_ips: list[int],
+    batch: int,
+    *,
+    start_seq: int = 0,
+    seed: int = 0,
+) -> PacketBatch:
+    """SYN-flood batch: NEVER-repeating 5-tuples — every lane is a fresh
+    TCP SYN whose (src, sport) pair is unique across the whole sequence
+    of calls (thread `start_seq` forward by `batch` per call), so no
+    packet can ever hit the flow cache and every one is a miss-queue
+    admission.  The cache-kill shape: zero locality by construction (the
+    megaflow-cache attack OVS's bounded upcall sockets exist for)."""
+    rng = np.random.default_rng(seed)
+    seq = start_seq + np.arange(batch, dtype=np.int64)
+    # 16k ephemeral ports x 2^18 source-address block: unique pairs for
+    # 2^32 packets before wrap, way past any test/bench horizon.
+    sport = (1024 + (seq % 16384)).astype(np.int32)
+    src = (np.uint32(0xC6000000) + (seq // 16384).astype(np.uint32))
+    dst = np.asarray(dst_ips, np.uint32)[
+        rng.integers(0, len(dst_ips), batch)]
+    return PacketBatch(
+        src_ip=src.astype(np.uint32),
+        dst_ip=dst,
+        proto=np.full(batch, PROTO_TCP, np.int32),
+        src_port=sport,
+        dst_port=np.full(batch, 80, np.int32),
+        tcp_flags=np.full(batch, 0x02, np.int32),  # SYN
+    )
+
+
+def gen_cache_thrash(
+    pod_ips: list[int],
+    batch: int,
+    *,
+    n_flows: int,
+    seed: int = 0,
+) -> PacketBatch:
+    """Cache-thrash batch: a UNIFORM draw over a flow universe sized far
+    past the flow-cache slot count (callers pass n_flows >> slots), so
+    every slot sees continuous eviction pressure and the hit rate pins
+    to ~slots/n_flows.  Unlike gen_syn_flood the flows DO repeat — this
+    is the thrash shape (replacement-policy stress), not the
+    never-repeat shape (admission stress)."""
+    rng = np.random.default_rng(seed)
+    pods = np.asarray(pod_ips, dtype=np.uint32)
+    f_src = pods[rng.integers(0, len(pods), n_flows)]
+    f_dst = pods[rng.integers(0, len(pods), n_flows)]
+    f_sport = rng.integers(1024, 65536, n_flows).astype(np.int32)
+    f_dport = rng.integers(1, 65536, n_flows).astype(np.int32)
+    idx = rng.integers(0, n_flows, batch)
+    return PacketBatch(
+        src_ip=f_src[idx],
+        dst_ip=f_dst[idx],
+        proto=np.full(batch, PROTO_UDP, np.int32),
+        src_port=f_sport[idx],
+        dst_port=f_dport[idx],
+    )

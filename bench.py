@@ -715,6 +715,103 @@ def _measure_multichip(cps, svc, pod_ips, services):
     }
 
 
+# Multi-tenant regime (round-9 tentpole, ROADMAP item 5): aggregate pps
+# across MT_TENANTS uneven tenant worlds packed into ONE engine on pow2
+# rule-window rungs (datapath/tenancy.py).  The compile-sharing proof
+# rides the extras: step executables grow with occupied rungs, never
+# with tenant count.
+MT_TENANTS = 64
+
+
+def measure_multitenant():
+    """The round-9 multi-tenant regime: MT_TENANTS isolated policy
+    worlds — UNEVEN rule counts drawn over a few pow2 rungs — served
+    round-robin by one TpuflowDatapath, measuring aggregate pps plus the
+    per-tenant quota/eviction meters and the shared-compile evidence
+    (XLA step executables vs occupied rungs).
+
+    On CPU platforms the worlds are toy-sized so the regime is
+    smoke-testable in CI — same JSON keys, `smoke: true`; the on-chip
+    numbers are the driver's to write.  -> the JSON dict, or None."""
+    try:
+        return _measure_multitenant()
+    except Exception as e:  # report, never sink the bench
+        print(f"# multitenant measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_multitenant():
+    import time
+
+    from antrea_tpu.datapath.tpuflow import TpuflowDatapath
+    from antrea_tpu.models import forwarding as fwd_model
+
+    smoke = jax.devices()[0].platform == "cpu"
+    rng = np.random.default_rng(71)
+    # Uneven tenant sizes over a handful of rungs (zipf-ish: many small
+    # worlds, a few heavy ones) — the SaaS shape the plane exists for.
+    sizes = ((4, 7, 14, 28, 60) if smoke else (40, 90, 200, 450, 1000))
+    weights = (0.35, 0.30, 0.18, 0.12, 0.05)
+    rule_counts = rng.choice(sizes, size=MT_TENANTS, p=weights)
+    quota = 1 << (8 if smoke else 12)
+    dp = TpuflowDatapath(flow_slots=1 << 12, aff_slots=1 << 8,
+                         canary_probes=8, flightrec_slots=256,
+                         realization_slots=0)
+    exec0 = fwd_model.pipeline_step_full._cache_size()
+    t_build0 = time.perf_counter()
+    tids = []
+    for i, n in enumerate(rule_counts):
+        cl = gen_cluster(int(n), n_nodes=2, pods_per_node=8, seed=300 + i)
+        tids.append((dp.tenant_create(f"t{i}", cl.ps, quota=quota),
+                     cl.pod_ips))
+    build_s = time.perf_counter() - t_build0
+    Bt = 256 if smoke else 4096
+    batches = {
+        tid: gen_traffic(pod_ips, Bt, n_flows=max(Bt // 2, 16),
+                         seed=500 + tid)
+        for tid, pod_ips in tids
+    }
+    t = 100
+    for tid, _ in tids:  # warm round: each rung compiles once
+        dp.tenant_step(tid, batches[tid], t)
+    rounds = 2 if smoke else 8
+    pkts = 0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        t += 1
+        for tid, _ in tids:
+            dp.tenant_step(tid, batches[tid], t)
+            pkts += Bt
+    dt = time.perf_counter() - t0
+    execs = fwd_model.pipeline_step_full._cache_size() - exec0
+    ts = dp.tenant_stats()
+    return {
+        "metric": "multitenant_aggregate_pps",
+        "value": round(pkts / max(dt, 1e-9), 1),
+        "unit": "packets/s",
+        "extra": {
+            "n_tenants": MT_TENANTS,
+            "rule_count_min": int(min(rule_counts)),
+            "rule_count_max": int(max(rule_counts)),
+            # The shared-compile proof: occupied rung signatures vs XLA
+            # step executables — both must sit far under tenant count
+            # (tier-1 asserts equality; here they are the honest record).
+            "rule_rungs_occupied": len(dp.tenant_rungs()),
+            "step_executables": int(execs),
+            "world_build_s": round(build_s, 3),
+            "per_tenant_batch": Bt,
+            "rounds": rounds,
+            "quota_slots": quota,
+            "evictions_total": sum(r["evictions_total"]
+                                   for r in ts.values()),
+            "quota_clamps_total": sum(r["quota_clamps_total"]
+                                      for r in ts.values()),
+            "occupied_rows_total": sum(r["occupied"] for r in ts.values()),
+            "smoke": smoke,
+        },
+    }
+
+
 def measure_reshard():
     """The round-8 elastic-mesh regime (ROADMAP item 3): a LIVE resize of
     the data axis — grow 2→4 then shrink 4→2 — executed on a serving
@@ -878,6 +975,7 @@ def main():
     )
     multichip = measure_multichip(cps, svc, cluster.pod_ips, services)
     reshard = measure_reshard()
+    multitenant = measure_multitenant()
     _print_and_gate(pps, cold_pps, sh_pps, sh_overhead, churn_pps,
                     sh_cold_pps, async_churn_pps, q_overflows,
                     overlap_churn_pps, maint_churn_pps,
@@ -885,7 +983,7 @@ def main():
                     cold_pruned_pps=cold_pruned_pps,
                     prune_fb_rate=prune_fb_rate,
                     prune_skip_rate=prune_skip_rate,
-                    reshard=reshard)
+                    reshard=reshard, multitenant=multitenant)
 
 
 # Regression floors (round-3 verdict weak #6: a silent 10x perf regression
@@ -908,7 +1006,7 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     overlap_churn_pps=None, maint_churn_pps=None,
                     multichip=None, cold_pruned_pps=None,
                     prune_fb_rate=None, prune_skip_rate=None,
-                    reshard=None):
+                    reshard=None, multitenant=None):
     maint_overhead_pct = None
     if maint_churn_pps and async_churn_pps:
         maint_overhead_pct = round(
@@ -995,6 +1093,11 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
     # stay untouched for the r07 -> r08 comparison.
     if reshard is not None:
         print(json.dumps(reshard))
+    # The multi-tenant regime prints fourth (round 9): aggregate pps
+    # over 64 uneven tenant worlds + the shared-compile evidence —
+    # single-chip keys stay untouched for the r08 -> r09 comparison.
+    if multitenant is not None:
+        print(json.dumps(multitenant))
     # Explicit raises (not assert): the gate must survive python -O.
     if pps < STEADY_FLOOR_PPS:
         raise SystemExit(

@@ -116,19 +116,26 @@ def fleet_realization(n_agents: int, churn: int = 64) -> dict:
         fleet.pump()
     wall = time.perf_counter() - t0
     hist = fleet.realization_hist()
-    p99 = hist.quantile(0.99)
+    # Empty-histogram guard (churn 0, or every delivered event
+    # unstamped): there is no p99 to report — rounding/ratio math on a
+    # vacuous quantile would either crash or fabricate a perfect-zero
+    # latency.  Emit a null metric with the unstamped count so the soak
+    # harness sees "no signal", never "0 s p99".
+    empty = hist.count == 0
+    p99 = None if empty else hist.quantile(0.99)
     return {
         "metric": "realization_p99_s",
-        "value": round(p99, 6),
+        "value": None if empty else round(p99, 6),
         "unit": "s",
-        "vs_baseline": round(REALIZATION_TARGET_S / p99, 4) if p99 else None,
+        "vs_baseline": (round(REALIZATION_TARGET_S / p99, 4)
+                        if p99 else None),
         "extra": {
             "n_agents": n_agents,
             "churn_events": churn,
             "events_delivered": fleet.total_events(),
             "events_measured": hist.count,
             "unstamped_excluded": fleet.realization_unstamped_total(),
-            "p50_s": round(hist.quantile(0.5), 6),
+            "p50_s": None if empty else round(hist.quantile(0.5), 6),
             "storm_wall_s": round(wall, 3),
             "target_s": REALIZATION_TARGET_S,
         },

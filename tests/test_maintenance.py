@@ -326,9 +326,12 @@ def test_engines_register_the_consolidated_task_set(dp_cls):
         "cache-maintain", "observability"}
     # Every name is in the parseable inventory (tools/check_maintenance).
     # fqdn-ttl is the agent-side registration; reshard-migrate is the
-    # mesh engine's, registered only while a resize is in flight.
+    # mesh engine's, registered only while a resize is in flight;
+    # tenant-maintain joins on the first tenant_create only
+    # (datapath/tenancy — untenanted engines keep this base set).
     assert (set(dpa.maintenance.task_names)
-            | {"fqdn-ttl", "reshard-migrate"} == set(MAINT_TASKS))
+            | {"fqdn-ttl", "reshard-migrate", "tenant-maintain"}
+            == set(MAINT_TASKS))
     out = dpa.maintenance_tick(now=next(_NOW))
     assert set(out["ran"]) >= {"canary", "audit-cursor", "tensor-scrub",
                                "cache-maintain"}
