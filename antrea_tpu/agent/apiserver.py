@@ -67,6 +67,48 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+# The handler-thread contract: every datapath attribute a request
+# handler may touch, either directly in the routes below or through the
+# /metrics renderers (observability/metrics.py functions taking the
+# datapath as a parameter — they run on the handler thread too).  The
+# ThreadingHTTPServer gives each request its OWN thread, racing the
+# engine thread's steps/drains/world swaps, so everything named here
+# must serve from snapshots (the `tenant_stats`/`spans()` discipline PR
+# 12/PR 8 review had to enforce by hand).  The analysis `thread-safety`
+# pass fails the build when a handler touches an undeclared attribute,
+# when an entry goes stale, or when a declared method's body enters
+# `_world_ctx` / mutates engine state (see antrea_tpu/analysis/
+# threads.py for the reasoned waivers).
+HANDLER_SAFE = (
+    "stats",
+    "dump_flows",
+    "cache_stats",
+    "commit_stats",
+    "audit_stats",
+    "maintenance_stats",
+    "maintenance_tick",
+    "maintenance_force_audit",
+    "realization_stats",
+    "realization_tracer",
+    "realization_tracer.spans",
+    "flightrecorder_stats",
+    "flightrecorder_events",
+    "trace",
+    # /agentinfo collector (observability/agentinfo.collect_agent_info
+    # receives the live object; generation/datapath_type are single
+    # atomic attribute reads).
+    "generation",
+    "datapath_type",
+    # /metrics renderers (render_metrics reads these off the live
+    # object; each returns plain host dicts/snapshots).
+    "slowpath_stats",
+    "prune_stats",
+    "mesh_stats",
+    "reshard_stats",
+    "tenant_stats",
+    "step_hist",
+)
+
 
 class AgentApiServer:
     def __init__(

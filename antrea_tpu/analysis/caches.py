@@ -1,0 +1,117 @@
+"""Pass `bounded-cache` — executable-retaining caches must declare a
+bound.
+
+The bug class (caught by hand in PR 9 review): a module-level
+`@lru_cache` whose entries hold JITTED CALLABLES retains one XLA
+executable (host + device memory) per distinct key for the agent's
+whole lifetime — rule shapes churn across bundle installs, so an
+unbounded cache is a slow leak that no test sees and no metric names.
+The PR 9 fix bounded the mesh step/canary caches; this pass makes the
+discipline structural:
+
+  * every `functools.lru_cache` / `functools.cache` decorated function
+    whose body references the jit machinery (`jax.jit`, `shard_map`,
+    `vmap`, `pmap` — i.e. it builds or returns compiled callables) must
+    declare an explicit integer `maxsize` — `maxsize=None` and the
+    unbounded bare forms are findings;
+  * `functools.cache` (which HAS no bound) on such a function is always
+    a finding.
+
+Functions that cache plain host data (numpy tables, parsed literals)
+are out of scope — eviction of a compiled executable merely re-traces,
+so a bound is always safe to add where this pass asks for one."""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceCache, analysis_pass, apply_allowlist
+
+# Names whose presence in a decorated function's body marks it as
+# building/returning compiled callables.
+JIT_MARKERS = {"jit", "vmap", "pmap", "shard_map", "_shard_map", "xla_call"}
+
+#: obj key ("relpath:function") -> reason.
+CACHE_ALLOWLIST: dict[str, str] = {}
+
+
+def _decorator_cache_call(dec: ast.AST):
+    """-> ("lru_cache"|"cache", Call node or None) when `dec` is a
+    functools cache decorator (bare or called), else None."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = (target.attr if isinstance(target, ast.Attribute)
+            else target.id if isinstance(target, ast.Name) else None)
+    if name in ("lru_cache", "cache"):
+        return name, dec if isinstance(dec, ast.Call) else None
+    return None
+
+
+def _jit_marked(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name in JIT_MARKERS:
+            return True
+    return False
+
+
+def _explicit_maxsize(call: ast.Call | None) -> bool:
+    """True when the decorator call declares maxsize=<int literal> (or a
+    positional first arg that is an int literal)."""
+    if call is None:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            return (isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, int))
+    if call.args:
+        first = call.args[0]
+        return (isinstance(first, ast.Constant)
+                and isinstance(first.value, int))
+    return False
+
+
+@analysis_pass("bounded-cache", "caches retaining jitted executables "
+                                "declare an explicit maxsize")
+def check(src: SourceCache) -> list[Finding]:
+    problems: list[Finding] = []
+    for p in src.pkg_files():
+        tree = src.tree(p)
+        if tree is None:
+            continue
+        rel = src.rel(p)
+        pkg_rel = str(p.relative_to(src.pkg)).replace("\\", "/")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for dec in node.decorator_list:
+                hit = _decorator_cache_call(dec)
+                if hit is None:
+                    continue
+                kind, call = hit
+                if not _jit_marked(node):
+                    continue  # host-data cache: out of scope
+                if kind == "cache":
+                    problems.append(Finding(
+                        "bounded-cache", rel, node.lineno,
+                        f"{node.name}() builds/returns jitted callables "
+                        f"under @functools.cache, which has no bound — "
+                        f"one XLA executable is retained per key forever; "
+                        f"use @lru_cache(maxsize=N)",
+                        obj=f"{pkg_rel}:{node.name}"))
+                elif not _explicit_maxsize(call):
+                    problems.append(Finding(
+                        "bounded-cache", rel, node.lineno,
+                        f"{node.name}() builds/returns jitted callables "
+                        f"but its lru_cache declares no integer maxsize "
+                        f"(bare/None = unbounded) — rule-shape churn "
+                        f"retains one XLA executable per key for the "
+                        f"agent's lifetime (the PR 9 leak class); "
+                        f"eviction only re-traces, so bound it",
+                        obj=f"{pkg_rel}:{node.name}"))
+    return apply_allowlist("bounded-cache",
+                           "antrea_tpu/analysis/caches.py",
+                           problems, CACHE_ALLOWLIST)

@@ -1,0 +1,196 @@
+"""Pass `donation-safety` — donated buffers are never read after the
+dispatch that consumed them.
+
+`jax.jit(..., donate_argnums=(i,))` lets XLA alias argument i's buffers
+in place (the overlapped drain's whole point: ~150MB of cache columns
+scatter in place instead of copying, models/pipeline.py
+`pipeline_step_donated`).  The contract is one-sided: after the
+dispatch, the PASSED arrays are deleted — a host read of the same
+reference returns garbage or raises, and nothing in the type system
+says so.  The comment block over `pipeline_step_donated` states the
+caller discipline ("callers MUST drop every reference to the passed
+state"); this pass enforces it:
+
+  * collect every callable built with a `donate_argnums=` literal
+    anywhere under antrea_tpu/ (by its bound name), plus per-function
+    local aliases whose right-hand side references one (the
+    `step_fn = pl.pipeline_step_donated if overlap else ...` pattern);
+  * at every call site of such a callable, each argument at a donated
+    position that is a plain name or `self.<attr>` must not be LOADED
+    again in the enclosing function after the dispatch — in EXECUTION
+    order: (line, col) positions, and a dispatch inside a loop wraps
+    around to the body's earlier lines (they run again next iteration)
+    — until it is re-BOUND (the `self._state = state` publish kills the
+    taint).
+
+Reads hidden behind further calls are out of scope (the donated
+arguments in this repo are the engines' single-owner `self._state`
+columns, whose only readers are the methods this pass walks)."""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceCache, analysis_pass, apply_allowlist
+
+#: obj key ("relpath:function:arg") -> reason.
+DONATION_ALLOWLIST: dict[str, str] = {}
+
+
+def _last_component(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                return None
+            return (v,) if isinstance(v, int) else tuple(v)
+    return None
+
+
+def collect_donated_names(src: SourceCache) -> dict[str, tuple[int, ...]]:
+    """Bound name -> donated positions, for every
+    `NAME = ...jit(..., donate_argnums=...)` under the package."""
+    out: dict[str, tuple[int, ...]] = {}
+    for p in src.pkg_files():
+        tree = src.tree(p)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            for call in ast.walk(node.value):
+                if isinstance(call, ast.Call):
+                    pos = _donated_positions(call)
+                    if pos:
+                        out[node.targets[0].id] = pos
+    return out
+
+
+def _arg_key(node: ast.AST) -> str | None:
+    """Trackable donated-argument shapes: a bare name, or self.<attr>."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return None
+
+
+def _matches(node: ast.AST, key: str) -> bool:
+    if "." in key:
+        _self, attr = key.split(".", 1)
+        return (isinstance(node, ast.Attribute) and node.attr == attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+    return isinstance(node, ast.Name) and node.id == key
+
+
+def _check_function(fn: ast.FunctionDef, donated: dict[str, tuple[int, ...]],
+                    rel: str, pkg_rel: str) -> list[Finding]:
+    # Per-function aliases: `x = <expr referencing a donated name>`.
+    local = dict(donated)
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            for ref in ast.walk(node.value):
+                name = _last_component(ref)
+                if name in donated and not isinstance(ref, ast.Call):
+                    local[node.targets[0].id] = donated[name]
+
+    nested: set[int] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.FunctionDef) and sub is not fn:
+            nested.update(id(n) for n in ast.walk(sub))
+    # Enclosing loops, innermost last: a dispatch INSIDE a loop is
+    # followed (in execution order) by the loop body's earlier lines on
+    # the next iteration, so the event order wraps around.
+    loops = [(n, {id(d) for d in ast.walk(n)})
+             for n in ast.walk(fn)
+             if isinstance(n, (ast.For, ast.AsyncFor, ast.While))]
+
+    problems: list[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) or id(node) in nested:
+            continue  # nested defs own their call sites
+        callee = _last_component(node.func)
+        if callee not in local:
+            continue
+        enclosing = [ids for _loop, ids in loops if id(node) in ids]
+        loop_ids = min(enclosing, key=len) if enclosing else None
+        for pos in local[callee]:
+            if pos >= len(node.args):
+                continue
+            key = _arg_key(node.args[pos])
+            if key is None:
+                continue
+            # Events on the donated reference, in EXECUTION order after
+            # the dispatch: (lineno, col) position tuples (so a read
+            # later on the dispatch's own line counts), wrapping around
+            # the enclosing loop body (a read at an earlier line runs
+            # again on the next iteration, AFTER this dispatch).  The
+            # first re-binding store kills the taint; loads before it
+            # read deleted buffers.  The call's own argument nodes are
+            # the dispatch itself — excluded by identity.
+            own = {id(n) for n in ast.walk(node)}
+            call_pos = (node.lineno, node.col_offset)
+            events = []  # (phase, position, is_store, lineno)
+            for ev in ast.walk(fn):
+                ln = getattr(ev, "lineno", None)
+                if ln is None or id(ev) in own or not _matches(ev, key):
+                    continue
+                ev_pos = (ln, ev.col_offset)
+                if loop_ids is not None and id(ev) in loop_ids:
+                    # same iteration (0) or next iteration's prefix (1)
+                    phase = 0 if ev_pos > call_pos else 1
+                elif ev_pos > call_pos:
+                    phase = 2  # after the loop / straight-line tail
+                else:
+                    continue  # strictly before any dispatch
+                events.append((phase, ev_pos,
+                               isinstance(ev.ctx, ast.Store), ln))
+            for _phase, _pos, is_store, ln in sorted(events):
+                if is_store:
+                    break  # rebound: the taint dies here
+                problems.append(Finding(
+                    "donation-safety", rel, ln,
+                    f"{fn.name}() reads {key} at line {ln} after passing "
+                    f"it to {callee}() (donated position {pos}, line "
+                    f"{node.lineno}) — XLA aliased those buffers in "
+                    f"place; rebind before reading or drop the read",
+                    obj=f"{pkg_rel}:{fn.name}:{key}"))
+    return problems
+
+
+@analysis_pass("donation-safety", "donated arguments are never read after "
+                                  "their dispatch site")
+def check(src: SourceCache) -> list[Finding]:
+    donated = collect_donated_names(src)
+    if not donated:
+        return []
+    problems: list[Finding] = []
+    for p in src.pkg_files():
+        tree = src.tree(p)
+        if tree is None:
+            continue
+        rel = src.rel(p)
+        pkg_rel = str(p.relative_to(src.pkg)).replace("\\", "/")
+        # Innermost-ownership walk: check each FunctionDef, skipping
+        # call sites that belong to a nested def (the nested def is
+        # checked in its own right).
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            problems.extend(_check_function(node, donated, rel, pkg_rel))
+    return apply_allowlist("donation-safety",
+                           "antrea_tpu/analysis/donation.py",
+                           problems, DONATION_ALLOWLIST)

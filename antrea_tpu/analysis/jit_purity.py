@@ -1,0 +1,193 @@
+"""Pass `jit-purity` — no host coercion of tracers, no Python-side
+state mutation, inside jit-compiled bodies.
+
+The bug class: a `.item()` / `int(...)` / `float(...)` / `bool(...)`
+on a traced value inside a function handed to `jax.jit` / `shard_map`
+forces a device->host sync per call (the boxed-int-on-the-traffic-path
+class PR 12 review caught in the reshard dirty tracking: a set-based
+host structure boxed ints on every dispatch), and a `self.<attr>`
+assignment inside a traced body runs ONCE at trace time, then silently
+never again — both are invisible to every parity test because the
+verdicts stay right; only the latency (or the stale attribute) is
+wrong.
+
+Detection (one module at a time, the granularity the repo's jit usage
+actually has):
+
+  * a function is JITTED when it is decorated with `jax.jit` (bare or
+    via functools.partial) or its name is passed to a `jit` /
+    `shard_map` / `_shard_map` / `vmap` / `pmap` call in the module
+    (`pipeline_step = jax.jit(_pipeline_step, ...)`), including the
+    local `body` functions handed to `_shard_map(...)` inside cached
+    builders;
+  * `static_argnames=` / `static_argnums=` literals at the jit site
+    exclude those parameters from the tracer set (coercing a STATIC
+    argument is host-side and legal — `int(meta.miss_chunk)` stays
+    fine);
+  * findings inside a jitted body: `.item()` anywhere; `int()` /
+    `float()` / `bool()` whose argument expression mentions a tracer
+    parameter; `self.<attr>` assignment; `global` / `nonlocal`
+    declarations."""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceCache, analysis_pass, apply_allowlist
+
+JIT_CALLEES = {"jit", "shard_map", "_shard_map", "vmap", "pmap"}
+COERCIONS = ("int", "float", "bool")
+
+#: obj key ("relpath:function:detail") -> reason.
+PURITY_ALLOWLIST: dict[str, str] = {}
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _static_names(call: ast.Call, fn: ast.FunctionDef) -> set[str]:
+    """Parameter names the jit site marks static (by name or position)."""
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            try:
+                names = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            out |= set((names,) if isinstance(names, str) else names)
+        elif kw.arg == "static_argnums":
+            try:
+                nums = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            for i in ((nums,) if isinstance(nums, int) else nums):
+                if 0 <= i < len(params):
+                    out.add(params[i])
+    return out
+
+
+def _jitted_functions(tree: ast.AST):
+    """-> [(FunctionDef, static param names, how)] for every function
+    the module jits: decorated, or referenced by name at a jit/shard_map
+    call site anywhere in the module (matched per enclosing scope would
+    be stricter; per module matches how the repo names things — the
+    `_pipeline_step` / `body` pattern)."""
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, []).append(node)
+
+    out = []
+    seen: set[int] = set()
+
+    def add(fn: ast.FunctionDef, statics: set[str], how: str):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append((fn, statics, how))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                target = dec
+                statics: set[str] = set()
+                if isinstance(dec, ast.Call):
+                    # @partial(jax.jit, static_argnames=...) or @jax.jit(...)
+                    inner = [a for a in dec.args
+                             if _callee_name_node(a) in JIT_CALLEES]
+                    if _callee_name(dec) == "partial" and inner:
+                        add(node, _static_names(dec, node), "decorator")
+                        continue
+                    target = dec.func
+                    statics = _static_names(dec, node)
+                if _callee_name_node(target) in JIT_CALLEES:
+                    add(node, statics, "decorator")
+        if isinstance(node, ast.Call) and _callee_name(node) in JIT_CALLEES:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    for fn in by_name.get(arg.id, ()):
+                        add(fn, _static_names(node, fn), "call")
+    return out
+
+
+def _callee_name_node(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _mentions(expr: ast.AST, names: set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(expr))
+
+
+@analysis_pass("jit-purity", "jitted bodies never coerce tracers to host "
+                             "scalars or mutate Python state")
+def check(src: SourceCache) -> list[Finding]:
+    problems: list[Finding] = []
+    for p in src.pkg_files():
+        tree = src.tree(p)
+        if tree is None:
+            continue
+        rel = src.rel(p)
+        pkg_rel = str(p.relative_to(src.pkg)).replace("\\", "/")
+        for fn, statics, _how in _jitted_functions(tree):
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)} - statics
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"):
+                    problems.append(Finding(
+                        "jit-purity", rel, node.lineno,
+                        f"{fn.name}() is jitted but calls .item() — a "
+                        f"device->host sync inside the traced body (the "
+                        f"boxed-scalar-on-the-traffic-path class)",
+                        obj=f"{pkg_rel}:{fn.name}:item"))
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in COERCIONS
+                        and node.args
+                        and _mentions(node.args[0], params)):
+                    problems.append(Finding(
+                        "jit-purity", rel, node.lineno,
+                        f"{fn.name}() is jitted but coerces a traced "
+                        f"parameter with {node.func.id}() — host boxing "
+                        f"inside the traced body; keep it a jnp array "
+                        f"(static arguments are exempt via "
+                        f"static_argnames/static_argnums)",
+                        obj=f"{pkg_rel}:{fn.name}:{node.func.id}"))
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        problems.append(Finding(
+                            "jit-purity", rel, node.lineno,
+                            f"{fn.name}() is jitted but assigns "
+                            f"self.{t.attr} — the write runs once at "
+                            f"trace time and never again",
+                            obj=f"{pkg_rel}:{fn.name}:self.{t.attr}"))
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    problems.append(Finding(
+                        "jit-purity", rel, node.lineno,
+                        f"{fn.name}() is jitted but declares "
+                        f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                        f"{', '.join(node.names)} — Python-side mutation "
+                        f"inside a traced body runs once at trace time",
+                        obj=f"{pkg_rel}:{fn.name}:mutation"))
+    return apply_allowlist("jit-purity",
+                           "antrea_tpu/analysis/jit_purity.py",
+                           problems, PURITY_ALLOWLIST)

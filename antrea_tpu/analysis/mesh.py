@@ -1,0 +1,160 @@
+"""Pass `mesh` — partition-spec drift: every sharded pytree field is
+specced (migrated from tools/check_mesh.py, which remains as a shim).
+
+The multichip datapath (parallel/mesh.py + parallel/meshpath.py) places
+three pytrees on the (data × rule) mesh under the PartitionSpecs built
+by `_state_specs` / `_drs_specs` / `_svc_specs`.  Those builders
+enumerate every field BY NAME on purpose: a field that is merely
+splatted would let a new single-chip state column ship
+replicated-by-accident (or sharded on the wrong axis) the first time
+someone grows a NamedTuple.  Fails when any field of the tracked
+NamedTuples is neither named as a keyword in a spec builder nor waived
+in `mesh.MESH_SPEC_ALLOWLIST` with a reason — and when the allowlist
+itself goes stale."""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceCache, analysis_pass
+
+# NamedTuples whose fields must be specced, per defining module (package
+# relative).  The nested leaf types are tracked alongside their
+# containers so a field added anywhere in the tree is caught.
+TRACKED = {
+    "models/pipeline.py": (
+        "PipelineState", "FlowCache", "AffinityTable", "DeviceServiceTables",
+    ),
+    "ops/match.py": (
+        "DeviceRuleSet", "DeviceDirection", "DimTable", "IsoTable",
+        "DeltaTable",
+    ),
+}
+
+SPEC_BUILDERS = ("_state_specs", "_drs_specs", "_svc_specs")
+
+
+def namedtuple_fields(src: SourceCache, relpath: str, classes) -> dict:
+    """class name -> ordered field names (AnnAssign rows of NamedTuple
+    class bodies)."""
+    tree = src.tree(src.pkg / relpath)
+    out: dict[str, list[str]] = {}
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or node.name not in classes:
+            continue
+        out[node.name] = [
+            stmt.target.id
+            for stmt in node.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+        ]
+    return out
+
+
+def specced_kwargs(src: SourceCache) -> dict:
+    """Constructor-class name -> keyword-argument names used at its call
+    sites inside the spec builder functions of parallel/mesh.py.  Keyed
+    PER CLASS (the callee's name), not pooled: field names legitimately
+    collide across the tracked NamedTuples, and a pooled set would let a
+    new field ride a same-named field of a DIFFERENT class through the
+    gate unspecced."""
+    tree = src.tree(src.pkg / "parallel" / "mesh.py")
+    by_class: dict[str, set] = {}
+    if tree is None:
+        return by_class
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name not in SPEC_BUILDERS:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name is None:
+                continue
+            by_class.setdefault(name, set()).update(
+                kw.arg for kw in call.keywords if kw.arg)
+    return by_class
+
+
+def allowlist(src: SourceCache) -> dict:
+    tree = src.tree(src.pkg / "parallel" / "mesh.py")
+    if tree is None:
+        raise ValueError("antrea_tpu/parallel/mesh.py is missing/unparseable")
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        if "MESH_SPEC_ALLOWLIST" in targets and node.value is not None:
+            return ast.literal_eval(node.value)
+    raise ValueError("parallel/mesh.py defines no MESH_SPEC_ALLOWLIST literal")
+
+
+@analysis_pass("mesh", "every sharded pytree field carries an explicit "
+                       "PartitionSpec or a reasoned waiver")
+def check(src: SourceCache) -> list[Finding]:
+    mesh_rel = "antrea_tpu/parallel/mesh.py"
+
+    def f(reason, obj="", path=mesh_rel, line=0):
+        return Finding("mesh", path, line, reason, obj=obj)
+
+    try:
+        waived = allowlist(src)
+    except (OSError, ValueError) as e:
+        return [f(str(e), obj="no-allowlist")]
+    specced = specced_kwargs(src)
+    if not specced:
+        return [f(f"parallel/mesh.py spec builders {SPEC_BUILDERS} name no "
+                  f"fields at all", obj="no-spec-builders")]
+
+    problems: list[Finding] = []
+    qualified: set[str] = set()  # "Class.field" of every tracked field
+    for relpath, classes in TRACKED.items():
+        fields_by_class = namedtuple_fields(src, relpath, classes)
+        for cls in classes:
+            if cls not in fields_by_class:
+                problems.append(f(
+                    f"antrea_tpu/{relpath} no longer defines {cls} — update "
+                    f"the analysis mesh pass's TRACKED table",
+                    obj=f"missing-class:{cls}",
+                    path=f"antrea_tpu/{relpath}"))
+                continue
+            for field in fields_by_class[cls]:
+                qualified.add(f"{cls}.{field}")
+                if (field in specced.get(cls, ())
+                        or f"{cls}.{field}" in waived):
+                    continue
+                problems.append(f(
+                    f"{cls}.{field} (antrea_tpu/{relpath}) has no explicit "
+                    f"PartitionSpec at a {cls}(...) call in parallel/mesh.py "
+                    f"{SPEC_BUILDERS} and no MESH_SPEC_ALLOWLIST waiver — it "
+                    f"would ship on the mesh with an accidental layout",
+                    obj=f"{cls}.{field}"))
+
+    for key, reason in waived.items():
+        cls, _, field = key.partition(".")
+        if key not in qualified:
+            problems.append(f(
+                f"MESH_SPEC_ALLOWLIST waives {key!r} (expected 'Class.field' "
+                f"of a tracked NamedTuple) — stale waiver",
+                obj=f"stale-waiver:{key}"))
+        elif field in specced.get(cls, ()):
+            problems.append(f(
+                f"MESH_SPEC_ALLOWLIST waives {key!r}, but it IS specced in "
+                f"the builders — drop the stale waiver",
+                obj=f"specced-waiver:{key}"))
+        if not (isinstance(reason, str) and reason.strip()):
+            problems.append(f(
+                f"MESH_SPEC_ALLOWLIST waiver {key!r} carries no reason",
+                obj=f"reasonless-waiver:{key}"))
+    return problems

@@ -1,0 +1,108 @@
+"""Pass `reshard` — every (D,)-sharded state field migrates (migrated
+from tools/check_reshard.py, which remains as a shim).
+
+The elastic resharding plane (parallel/reshard.py) moves the stateful
+tables — the pytree fields `parallel/mesh._state_specs` shards with a
+leading ``data`` axis — to their new home shards when the data axis
+resizes.  A NEW stateful field that nobody taught the migrator is a
+silent flow-loss bug.  Fails when any field specced `P(DATA, ...)` in
+`_state_specs` has no migration rule in `reshard.RESHARD_MANIFEST` —
+and when the manifest itself goes stale."""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceCache, analysis_pass
+
+STATE_BUILDER = "_state_specs"
+
+
+def data_sharded_fields(src: SourceCache) -> set:
+    """'Class.field' for every kwarg of a constructor call inside
+    _state_specs whose value is a P(DATA, ...) spec — the fields that
+    carry a leading data axis and therefore must migrate on resize."""
+    tree = src.tree(src.pkg / "parallel" / "mesh.py")
+    out: set[str] = set()
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == STATE_BUILDER):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            cls = (fn.attr if isinstance(fn, ast.Attribute)
+                   else fn.id if isinstance(fn, ast.Name) else None)
+            if cls is None:
+                continue
+            for kw in call.keywords:
+                v = kw.value
+                if (isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Name)
+                        and v.func.id == "P"
+                        and v.args
+                        and isinstance(v.args[0], ast.Name)
+                        and v.args[0].id == "DATA"):
+                    out.add(f"{cls}.{kw.arg}")
+    return out
+
+
+def manifest(src: SourceCache) -> dict:
+    tree = src.tree(src.pkg / "parallel" / "reshard.py")
+    if tree is None:
+        raise ValueError("antrea_tpu/parallel/reshard.py is missing")
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        if "RESHARD_MANIFEST" in targets and node.value is not None:
+            return ast.literal_eval(node.value)
+    raise ValueError(
+        "parallel/reshard.py defines no RESHARD_MANIFEST literal")
+
+
+@analysis_pass("reshard", "every (D,)-sharded state field has a reshard "
+                          "migration rule")
+def check(src: SourceCache) -> list[Finding]:
+    reshard_rel = "antrea_tpu/parallel/reshard.py"
+    mesh_rel = "antrea_tpu/parallel/mesh.py"
+
+    def f(reason, obj, path=reshard_rel):
+        return Finding("reshard", path, 0, reason, obj=obj)
+
+    try:
+        rules = manifest(src)
+    except (OSError, ValueError) as e:
+        return [f(str(e), "no-manifest")]
+    sharded = data_sharded_fields(src)
+    if not sharded:
+        return [f(f"parallel/mesh.py {STATE_BUILDER} names no P(DATA, ...) "
+                  f"fields at all — the parse is broken or the specs moved",
+                  "no-sharded-fields", mesh_rel)]
+
+    problems: list[Finding] = []
+    for key in sorted(sharded - set(rules)):
+        problems.append(f(
+            f"{key} is (D,)-sharded in parallel/mesh.py {STATE_BUILDER} "
+            f"but has NO migration rule in reshard.RESHARD_MANIFEST — a "
+            f"live resize would silently zero it (flow loss); teach the "
+            f"migrator and document the rule", f"unmigrated:{key}"))
+    for key in sorted(set(rules) - sharded):
+        problems.append(f(
+            f"RESHARD_MANIFEST names {key!r}, which is not a (D,)-sharded "
+            f"field of {STATE_BUILDER} — stale manifest row",
+            f"stale:{key}"))
+    for key, rule in rules.items():
+        if not (isinstance(rule, str) and rule.strip()):
+            problems.append(f(
+                f"RESHARD_MANIFEST[{key!r}] carries no rule text",
+                f"no-rule:{key}"))
+    return problems

@@ -25,9 +25,10 @@ flushing — established flows survive policy churn, per conntrack
 semantics.
 """
 
-from .engine import (ADMIT_FORWARD, ADMIT_HOLD, CHUNK_LADDER, DrainAutotuner,
+from .engine import (ADMIT_DROP, ADMIT_FORWARD, ADMIT_HOLD, CHUNK_LADDER,
+                     DrainAutotuner,
                      SlowPathEngine)
 from .queue import MissQueue
 
-__all__ = ["ADMIT_FORWARD", "ADMIT_HOLD", "CHUNK_LADDER", "DrainAutotuner",
-           "MissQueue", "SlowPathEngine"]
+__all__ = ["ADMIT_DROP", "ADMIT_FORWARD", "ADMIT_HOLD", "CHUNK_LADDER",
+           "DrainAutotuner", "MissQueue", "SlowPathEngine"]

@@ -24,6 +24,23 @@ the engine classifies its flow):
   ADMIT_HOLD     drop until classified (ACT_DROP): strict admission for
                  deny-by-default postures; the flow passes only after a
                  drain has committed its verdict.
+  ADMIT_DROP     forward-with-early-drop (round 10, ROADMAP item 4's
+                 admission half): packets keep ADMIT_FORWARD's
+                 provisional ACT_ALLOW, but once a queue is past
+                 EARLY_DROP_FLOOR of its capacity, miss ADMISSIONS are
+                 probabilistically shed — depth-proportional, ramping
+                 to 1.0 at a full ring — so an attack load (the
+                 gen_syn_flood shape: never-repeating tuples, 100%
+                 admissions) degrades smoothly BEFORE the tail-drop
+                 cliff instead of saturating the drain pipeline.  The
+                 shed decision is a DETERMINISTIC per-flow 5-tuple hash
+                 coin (salted per process — see _EARLY_DROP_SALT — so
+                 the shed set is not attacker-predictable), not an RNG,
+                 so the oracle twin sheds identical lanes and verdict
+                 parity stays provable under attack;
+                 a shed flow simply re-tries admission on its next
+                 miss.  Metered as `early_drops_total`
+                 (antrea_tpu_miss_queue_early_drops_total).
 
 Epoch discipline: every published slow-plane mutation (drain commit,
 revalidation, aging scan) bumps `epoch`; `install_bundle` marks the
@@ -68,14 +85,37 @@ Round-6 additions (the overlapped churn datapath, ROADMAP item 2):
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from typing import Callable, Optional
+
+import numpy as np
 
 from ...observability.metrics import Histogram
 from .queue import MissQueue
 
 ADMIT_FORWARD = "forward"
 ADMIT_HOLD = "hold"
+ADMIT_DROP = "drop"
+
+# admission="drop": the queue-depth fraction where probabilistic
+# early-drop engages.  Below it every miss admits; above it the drop
+# probability ramps linearly, reaching 1.0 at a full ring — RED for the
+# upcall queue, tuned to spend the ring's top half absorbing bursts.
+EARLY_DROP_FLOOR = 0.5
+
+# Per-PROCESS random salt folded into the early-drop coin.  An unsalted
+# 5-tuple hash would be computable offline: an attacker sustaining
+# pressure could craft flows whose coin always falls below the shed
+# threshold — deterministically shed at every retry, never classified,
+# forwarding forever on the provisional ALLOW (a chooseable policy
+# bypass).  The salt keeps the coin deterministic WITHIN a process
+# (retries stay consistent; the tpuflow and oracle twins share the
+# module, so differential parity holds) while making the shed set
+# unpredictable across deployments — the same reasoning as the mesh's
+# decorrelated shard/slot salts (parallel/mesh.py).
+_EARLY_DROP_SALT = np.uint32(
+    int.from_bytes(os.urandom(4), "little"))
 
 # Drain-batch sizes are packet counts, not seconds: dedicated bounds.
 _DRAIN_BOUNDS = (16, 64, 256, 1024, 4096, 16384, 65536)
@@ -162,10 +202,11 @@ class SlowPathEngine:
         autotune_bounds: Optional[tuple[int, int]] = None,
         overlap_commits: bool = False,
     ):
-        if admission not in (ADMIT_FORWARD, ADMIT_HOLD):
+        if admission not in (ADMIT_FORWARD, ADMIT_HOLD, ADMIT_DROP):
             raise ValueError(
                 f"unknown admission policy {admission!r} "
-                f"(expected {ADMIT_FORWARD!r} or {ADMIT_HOLD!r})"
+                f"(expected {ADMIT_FORWARD!r}, {ADMIT_HOLD!r} or "
+                f"{ADMIT_DROP!r})"
             )
         if drain_batch <= 0:
             raise ValueError(f"drain_batch must be positive, got {drain_batch}")
@@ -180,6 +221,7 @@ class SlowPathEngine:
         else:
             self.drain_batch = int(drain_batch)
         self._overflows_seen = 0  # autotune: overflow delta baseline
+        self.early_drops_total = 0  # admission="drop": shed admissions
         self.overlap = bool(overlap_commits)
         # Two-slot pending-commit ring: (finalize, staged packet-clock).
         self._staged: deque[tuple[Callable[[], None], int]] = deque()
@@ -208,6 +250,45 @@ class SlowPathEngine:
 
     # -- admission (fast-step side) ------------------------------------------
 
+    @staticmethod
+    def _drop_coin(cols: dict, n: int) -> np.ndarray:
+        """The per-flow early-drop coin in [0, 1<<16): a golden-ratio
+        hash of the 5-tuple seeded with the per-process salt (see
+        _EARLY_DROP_SALT — an unsalted coin would let an attacker craft
+        flows that always shed).  Replica/depth-independent, so mesh
+        callers compute it ONCE per batch and threshold per queue."""
+        with np.errstate(over="ignore"):
+            h = np.full(n, _EARLY_DROP_SALT, np.uint32)
+            for c in ("src_ip", "dst_ip", "proto", "src_port", "dst_port"):
+                h = (h ^ np.asarray(cols[c]).astype(np.uint32)) \
+                    * np.uint32(0x9E3779B1)
+        return (h >> np.uint32(16)) & np.uint32(0xFFFF)
+
+    def _early_drop(self, cols: dict, mask: np.ndarray, queue: MissQueue,
+                    coin: Optional[np.ndarray] = None
+                    ) -> tuple[np.ndarray, int]:
+        """admission="drop": shed miss admissions while `queue` is under
+        pressure -> (kept mask, shed count).  Depth-proportional (linear
+        from EARLY_DROP_FLOOR to a full ring) and DETERMINISTIC per flow
+        — the 5-tuple hash coin, so the oracle twin sheds the identical
+        lanes (parity provable under attack traffic) and a given flow's
+        retries stay consistent at a given pressure level.  No-op for
+        the other admission policies."""
+        mask = np.asarray(mask, bool)
+        if self.admission != ADMIT_DROP or not mask.any():
+            return mask, 0
+        lo = int(queue.capacity * EARLY_DROP_FLOOR)
+        depth = queue.depth
+        if depth <= lo:
+            return mask, 0
+        p = min(1.0, (depth - lo) / max(1, queue.capacity - lo))
+        if coin is None:
+            coin = self._drop_coin(cols, mask.shape[0])
+        shed = mask & (coin < int(p * 65536))
+        n = int(shed.sum())
+        self.early_drops_total += n
+        return mask & ~shed, n
+
     def admit(self, cols: dict, miss_mask, now: int) -> tuple[int, int]:
         """Admit the fast step's miss lanes -> (admitted, dropped)."""
         self._seen_now = max(self._seen_now, int(now))
@@ -216,7 +297,8 @@ class SlowPathEngine:
             # first one, anchor to the first traffic the engine sees so
             # the gauge reports time-since-birth, not the raw clock.
             self._published_at = int(now)
-        admitted, dropped = self.queue.admit(cols, miss_mask, self.epoch,
+        kept, _shed = self._early_drop(cols, miss_mask, self.queue)
+        admitted, dropped = self.queue.admit(cols, kept, self.epoch,
                                              int(now))
         if dropped:
             self._emit("queue-overflow", dropped=int(dropped),
@@ -402,6 +484,7 @@ class SlowPathEngine:
             "depth": q.depth,
             "capacity": q.capacity,
             "admitted_total": q.admitted_total,
+            "early_drops_total": self.early_drops_total,
             "overflows_total": q.overflows_total,
             "drained_total": q.drained_total,
             "drains_total": self.drains_total,

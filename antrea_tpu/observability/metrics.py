@@ -56,6 +56,7 @@ METRICS: dict[str, str] = {
     "antrea_tpu_miss_queue_capacity": "gauge",
     "antrea_tpu_miss_queue_admitted_total": "counter",
     "antrea_tpu_miss_queue_overflows_total": "counter",
+    "antrea_tpu_miss_queue_early_drops_total": "counter",
     "antrea_tpu_slowpath_drained_total": "counter",
     "antrea_tpu_slowpath_stale_reclassified_total": "counter",
     "antrea_tpu_slowpath_drain_batch_size": "histogram",
@@ -445,6 +446,9 @@ def render_metrics(datapath, node: str = "") -> str:
             ("antrea_tpu_miss_queue_capacity", "capacity"),
             ("antrea_tpu_miss_queue_admitted_total", "admitted_total"),
             ("antrea_tpu_miss_queue_overflows_total", "overflows_total"),
+            # admission="drop": depth-proportional early-shed admissions
+            # (0 under the other policies — mode-stable scrape surface).
+            ("antrea_tpu_miss_queue_early_drops_total", "early_drops_total"),
             ("antrea_tpu_slowpath_drained_total", "drained_total"),
             ("antrea_tpu_slowpath_stale_reclassified_total",
              "stale_reclassified_total"),

@@ -141,8 +141,8 @@ SHARD_RING_SALT = 0x72696E67  # "ring"
 RING_VNODES = 128
 
 
-@lru_cache(maxsize=None)
-def _ring(n_data: int):
+@lru_cache(maxsize=32)  # host arrays keyed by axis width: pure function
+def _ring(n_data: int):  # of n_data, so eviction just recomputes — bounded
     """The consistent-hash ring for a data-axis size: (points, owners),
     points sorted ascending.  The device-side port of the reference's
     memberlist election (agent/memberlist.ConsistentHash; ref

@@ -102,7 +102,7 @@ from ..compiler.topology import FWD_TUNNEL
 from ..config import ConfigError
 from ..datapath.interface import StepResult
 from ..datapath.maintenance import MaintenanceTask
-from ..datapath.slowpath import MissQueue, SlowPathEngine
+from ..datapath.slowpath import ADMIT_DROP, MissQueue, SlowPathEngine
 from ..datapath.tpuflow import TpuflowDatapath, _rid
 from ..models import forwarding as fw
 from ..models import pipeline as pl
@@ -242,24 +242,30 @@ def _mesh_canary_fn(mesh, match_meta):
     ))
 
 
-@lru_cache(maxsize=None)
+# The vmapped maintenance/census helpers are keyed by at most the
+# timeout tuple (reconfigured rarely, but each distinct value retains a
+# compiled executable) — bounded like the step/canary caches above so a
+# timeout-churning control plane can never grow device memory without
+# limit (the analysis `bounded-cache` pass gates this).
+
+@lru_cache(maxsize=8)
 def _vmapped_maintain(timeouts):
     return jax.jit(jax.vmap(partial(pl._maintain_scan, timeouts=timeouts),
                             in_axes=(0, None, None)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=1)
 def _vmapped_revalidate():
     return jax.jit(jax.vmap(pl._revalidate_scan, in_axes=(0, None)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=8)
 def _vmapped_age(timeouts):
     return jax.jit(jax.vmap(partial(pl._age_scan, timeouts=timeouts),
                             in_axes=(0, None)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=1)
 def _vmapped_cache_stats():
     return jax.jit(jax.vmap(pl._cache_stats))
 
@@ -375,9 +381,18 @@ class MeshSlowPath(SlowPathEngine):
         if self._published_at == 0:
             self._published_at = int(now)
         mask = np.asarray(miss_mask, bool)
+        # admission="drop": the hash coin is replica-independent — one
+        # batch-wide compute, thresholded per replica below (each
+        # replica's OWN queue depth drives its early-drop ramp; capacity
+        # is per-replica, so is the floor).
+        coin = (self._drop_coin(cols, mask.shape[0])
+                if self.admission == ADMIT_DROP and mask.any() else None)
         admitted = dropped = 0
         for r in range(self.n_data):
             mr = mask & (np.asarray(shard) == r)
+            if not mr.any():
+                continue
+            mr, _shed = self._early_drop(cols, mr, self.queues[r], coin=coin)
             if not mr.any():
                 continue
             a, d = self.queues[r].admit(cols, mr, self.epoch, int(now))

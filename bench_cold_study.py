@@ -1,5 +1,7 @@
-"""Cold-path phase-breakdown study (rounds 5-7; see the study notes in
-antrea_tpu/ops/match.py).
+"""Cold-path phase-breakdown study (rounds 4-7; see the study notes in
+antrea_tpu/ops/match.py — cases 2-4 re-measure the ROUND-4 gather-bound
+decomposition that set the ~7.4M pps ceiling, case 1 is the round-5
+fused baseline, cases 5-6 the round-6/7 overlap and pruning studies).
 
 Measures, at the bench's 100k-rule world and B=32k on the real chip:
   1. fused end-to-end cold classification (the shipped path);

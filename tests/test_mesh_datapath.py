@@ -8,13 +8,12 @@ tests/test_parallel.py (raw kernel parity, slow tier), these cases drive
 the full ENGINE: commit plane, per-replica slow path, striped audit and
 the maintenance scheduler on the mesh.
 
-Also hosts the tools/check_mesh.py drift gate (every sharded pytree
+The partition-spec drift gate (analysis pass `mesh`: every sharded pytree
 field has an explicit PartitionSpec or a reasoned waiver) and the
 _shard_map capability-probe assertion.
 """
 
 import pathlib
-import subprocess
 import sys
 
 import jax
@@ -65,15 +64,9 @@ def _mesh_dp(world, mesh, **extra):
 # Satellites: the drift gate + the shard_map capability probe
 # --------------------------------------------------------------------------
 
-def test_check_mesh_tool_runs_clean():
-    """tools/check_mesh.py (satellite: partition-spec coverage gate)
-    exits 0 on the committed tree."""
-    tool = (pathlib.Path(__file__).resolve().parent.parent / "tools"
-            / "check_mesh.py")
-    proc = subprocess.run([sys.executable, str(tool)],
-                         capture_output=True, text=True)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "covered" in proc.stdout
+# The partition-spec coverage gate (tools/check_mesh.py -> analysis pass
+# `mesh`) runs once for the whole tier-1 suite in
+# tests/test_static_analysis.py.
 
 
 def test_shard_map_capability_probe():

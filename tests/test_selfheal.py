@@ -400,16 +400,6 @@ def test_flaky_wrapper_arms_commit_sites():
     assert not dp.degraded
 
 
-def test_check_commit_plane_tool_runs_clean():
-    """tools/check_commit_plane.py (satellite: CI routing check) exits 0 —
-    both engines route all installs through the shared commit plane."""
-    import subprocess
-    import sys
-    from pathlib import Path
-
-    tool = (Path(__file__).resolve().parent.parent / "tools"
-            / "check_commit_plane.py")
-    res = subprocess.run([sys.executable, str(tool)], capture_output=True,
-                         text=True)
-    assert res.returncode == 0, res.stdout + res.stderr
-    assert "commit plane consistent" in res.stdout
+# The install-routing gate (tools/check_commit_plane.py -> analysis pass
+# `commit-plane`) runs once for the whole tier-1 suite in
+# tests/test_static_analysis.py.

@@ -169,6 +169,49 @@ def test_hold_admission_drops_until_classified():
     assert list(rt2.code) == [0] and list(rt2.pending) == [0]
 
 
+def test_early_drop_admission_parity_under_syn_flood():
+    """admission="drop" (ROADMAP item 4's admission half, round 10):
+    under gen_syn_flood pressure — never-repeating tuples, 100%
+    admissions — the depth-proportional early-drop sheds admissions
+    BEFORE the tail-drop cliff, deterministically (a 5-tuple hash coin),
+    so both engines shed the identical lanes and every step keeps full
+    oracle parity; the shed volume is metered on both identically."""
+    from antrea_tpu.simulator.traffic import gen_syn_flood
+
+    ps, svcs = _world()
+    t, o = _pair(ps, svcs, queue=64, admission="drop", drain_batch=8)
+    dst = [iputil.ip_to_u32(SRV)]
+    seq = 0
+    for rnd in range(6):
+        flood = gen_syn_flood(dst, 128, start_seq=seq)
+        seq += 128
+        now = next(_NOW)
+        rt, ro = t.step(flood, now=now), o.step(flood, now=now)
+        _assert_parity(rt, ro, f"flood round {rnd}")
+        if rnd % 2 == 1:
+            _drain_both(t, o, next(_NOW))  # asserts drained parity
+    te, oe = t._slowpath.early_drops_total, o._slowpath.early_drops_total
+    assert te == oe > 0, (te, oe)  # shed, and shed identically
+    for dp in (t, o):
+        assert dp.slowpath_stats()["early_drops_total"] == te
+        # The meter renders as its registered family.
+        from antrea_tpu.observability.metrics import render_metrics
+
+        assert (f'antrea_tpu_miss_queue_early_drops_total{{node="n1"}} {te}'
+                in render_metrics(dp, node="n1"))
+    # Below the floor nothing sheds: a fresh pair's first flood batch
+    # admits in full (floor = capacity/2 = 32 > one 24-lane batch).
+    t2, o2 = _pair(ps, svcs, queue=64, admission="drop", drain_batch=8)
+    small = gen_syn_flood(dst, 24, start_seq=10_000)
+    now = next(_NOW)
+    _assert_parity(t2.step(small, now=now), o2.step(small, now=now), "calm")
+    assert t2._slowpath.early_drops_total == 0
+    assert o2._slowpath.early_drops_total == 0
+    # And the policy set rejects typos with the full inventory.
+    with pytest.raises(ValueError, match="drop"):
+        _pair(ps, svcs, admission="shed")
+
+
 def test_churn_established_survives_fresh_reclassifies():
     """Bundle swap: the established flow keeps flowing (conntrack
     semantics) while a FRESH tuple of the same pair classifies under the

@@ -1,184 +1,26 @@
 #!/usr/bin/env python
 """Audit-plane coverage check: every mutable tensor is scrubbed or waived.
 
-The checksum scrub (datapath/audit.py mechanism 2) only protects what it
-digests.  The authoritative inventory of everything a commit can touch is
-`_commit_snapshot` on the two engines — so this tool fails the build when
-a snapshot key is covered by NEITHER:
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/audit_plane.py as pass `audit-plane` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-  1. SCRUB_MANIFEST  (datapath/audit.py): snapshot key -> "rule" | "state"
-     — the tensor classes the scrub digests ("rule": golden at settle,
-     heal by host-mirror re-upload; "state": digest pinned to the
-     accounted-mutation counter, heal by forced full revalidation);
-  2. SCRUB_ALLOWLIST (datapath/audit.py): snapshot key -> reason string
-     explaining why it needs no scrub (host-side bookkeeping, static
-     metas, re-upload SOURCES).
-
-State added by a future PR therefore fails here until it is scrubbed or
-explicitly waived with a reason.  Additional consistency:
-
-  * manifest values must be "rule" or "state";
-  * allowlist reasons must be non-empty strings;
-  * no key may appear in both tables;
-  * each engine must implement the scrub hooks
-    (_audit_rule_digests / _audit_state_digest / _audit_reupload) and
-    inherit AuditableDatapath.
-
-Dependency-free on purpose (no jax, no package import): the files are
-parsed textually and the manifest/allowlist literals evaluated with
-ast.literal_eval, so this runs in any CI step and from the tier-1 suite
-(tests/test_cache_audit.py).  Exit 0 = covered; 1 = drift (diff printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
-AUDIT = PKG / "datapath" / "audit.py"
-MATCH = PKG / "ops" / "match.py"
-ENGINES = (
-    PKG / "datapath" / "tpuflow.py",
-    PKG / "datapath" / "oracle_dp.py",
-)
-ENGINE_CLASSES = {
-    "tpuflow.py": "TpuflowDatapath",
-    "oracle_dp.py": "OracleDatapath",
-}
-HOOKS = ("_audit_rule_digests", "_audit_state_digest", "_audit_reupload",
-         "_audit_window", "_audit_fresh", "_audit_evict")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-_DICT_LITERAL = r"^{name}\s*(?::[^=]+)?=\s*(\{{.*?^\}})"
-
-
-def load_table(text: str, name: str) -> dict:
-    """Extract + literal-eval a module-level dict assignment from audit.py
-    (pure literals by contract — the docstring on the tables says so)."""
-    m = re.search(_DICT_LITERAL.format(name=name), text, re.M | re.S)
-    if m is None:
-        raise ValueError(f"datapath/audit.py defines no {name} literal")
-    return ast.literal_eval(m.group(1))
-
-
-def snapshot_keys(path: pathlib.Path) -> list[str]:
-    """String keys of the dict `_commit_snapshot` returns."""
-    text = path.read_text()
-    m = re.search(r"def _commit_snapshot\(.*?(?=\n    def )", text, re.S)
-    if m is None:
-        raise ValueError(f"{path.name}: no _commit_snapshot found")
-    body = m.group(0)
-    ret = body[body.index("return {"):]
-    return re.findall(r'^\s*"(\w+)":', ret, re.M)
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-    audit_text = AUDIT.read_text() if AUDIT.exists() else ""
-    if not audit_text:
-        return [f"{AUDIT.relative_to(REPO)} is missing"]
-    try:
-        manifest = load_table(audit_text, "SCRUB_MANIFEST")
-        allowlist = load_table(audit_text, "SCRUB_ALLOWLIST")
-    except ValueError as e:
-        return [str(e)]
-
-    for key, klass in manifest.items():
-        if klass not in ("rule", "state"):
-            problems.append(
-                f"SCRUB_MANIFEST[{key!r}] = {klass!r} — must be 'rule' or "
-                f"'state'"
-            )
-    for key, reason in allowlist.items():
-        if not (isinstance(reason, str) and reason.strip()):
-            problems.append(
-                f"SCRUB_ALLOWLIST[{key!r}] has no reason — every waived "
-                f"snapshot key must say WHY it needs no scrub"
-            )
-    for key in set(manifest) & set(allowlist):
-        problems.append(
-            f"{key!r} is both scrubbed (SCRUB_MANIFEST) and waived "
-            f"(SCRUB_ALLOWLIST) — pick one"
-        )
-
-    # Round-7 aggregate tables: while DimTable carries an `agg` field the
-    # SUB-tensor table must carry its "drs.agg" row (a corrupt aggregate
-    # bit can flip a verdict — see the SCRUB_SUBTENSORS comment; it rides
-    # the `drs` digest, so it must NOT be a manifest row, which would
-    # inflate the maintenance scheduler's scrub cost) and vice versa (a
-    # stale row must not outlive the field).
-    try:
-        subtensors = load_table(audit_text, "SCRUB_SUBTENSORS")
-    except ValueError as e:
-        return problems + [str(e)]
-    for key in set(subtensors) & set(manifest):
-        problems.append(
-            f"{key!r} is in both SCRUB_MANIFEST and SCRUB_SUBTENSORS — "
-            f"sub-tensors ride a group digest, they are not extra folds"
-        )
-    match_text = MATCH.read_text() if MATCH.exists() else ""
-    dim_cls = re.search(r"^class DimTable\(.*?(?=^class |^def )",
-                        match_text, re.M | re.S)
-    has_agg_field = bool(dim_cls) and bool(
-        re.search(r"^    agg\s*:", dim_cls.group(0), re.M))
-    if has_agg_field and "drs.agg" not in subtensors:
-        problems.append(
-            "ops/match.DimTable declares `agg` but SCRUB_SUBTENSORS has "
-            "no 'drs.agg' row — aggregate/table divergence would go "
-            "undocumented/ungated"
-        )
-    if not has_agg_field and "drs.agg" in subtensors:
-        problems.append(
-            "SCRUB_SUBTENSORS carries 'drs.agg' but ops/match.DimTable "
-            "declares no `agg` field — stale row"
-        )
-
-    for path in ENGINES:
-        rel = path.relative_to(REPO)
-        try:
-            keys = snapshot_keys(path)
-        except ValueError as e:
-            problems.append(str(e))
-            continue
-        if not keys:
-            problems.append(f"{rel}: _commit_snapshot returns no keys?")
-        for key in keys:
-            if key not in manifest and key not in allowlist:
-                problems.append(
-                    f"{rel}: _commit_snapshot key {key!r} is neither in "
-                    f"SCRUB_MANIFEST nor SCRUB_ALLOWLIST — new state must "
-                    f"be checksum-scrubbed or explicitly waived with a "
-                    f"reason (datapath/audit.py)"
-                )
-        text = path.read_text()
-        cls = ENGINE_CLASSES[path.name]
-        m = re.search(rf"^class {cls}\(([^)]*)\)", text, re.M | re.S)
-        if m is None or "AuditableDatapath" not in m.group(1):
-            problems.append(f"{rel}: {cls} does not inherit AuditableDatapath")
-        for hook in HOOKS:
-            if not re.search(rf"^\s*def {hook}\(", text, re.M):
-                problems.append(f"{rel} does not implement {hook}()")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    audit_text = AUDIT.read_text()
-    manifest = load_table(audit_text, "SCRUB_MANIFEST")
-    allowlist = load_table(audit_text, "SCRUB_ALLOWLIST")
-    print(
-        f"audit plane covered: {len(manifest)} scrubbed tensor groups, "
-        f"{len(allowlist)} waived host keys, {len(ENGINES)} engines"
-    )
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("audit-plane", sys.argv[1:]))

@@ -1,118 +1,26 @@
 #!/usr/bin/env python
 """Commit-plane routing check: every install goes through datapath/commit.py.
 
-The self-healing guarantees of the transactional commit plane (compile ->
-canary -> atomic swap -> settle, rollback to last-known-good, degraded
-mode) hold only if NO datapath exposes a tensor-swap entry point that
-bypasses the plane.  This tool fails the build when:
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/commit_plane.py as pass `commit-plane` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-  1. an engine (tpuflow.py / oracle_dp.py) defines the PUBLIC
-     `install_bundle` or `apply_group_delta` itself — those names must
-     live only on the TransactionalDatapath mixin in commit.py, with the
-     engines implementing `_install_bundle_impl` / `_apply_group_delta_impl`;
-  2. anything under antrea_tpu/ CALLS an `_impl` hook outside commit.py
-     (a caller reaching past the canary gate);
-  3. an engine class does not inherit TransactionalDatapath;
-  4. an engine impl performs its own settle (`self._persist()` /
-     `self._record_round()`) — durability must wait for the canary, or a
-     crash could reboot into a never-certified bundle.
-
-Dependency-free on purpose (no jax, no package import): purely textual,
-runnable in any CI step and invoked from the tier-1 suite
-(tests/test_selfheal.py).  Exit 0 = consistent; 1 = drift (diff printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
-COMMIT = PKG / "datapath" / "commit.py"
-ENGINES = (
-    PKG / "datapath" / "tpuflow.py",
-    PKG / "datapath" / "oracle_dp.py",
-)
-ENGINE_CLASSES = {
-    "tpuflow.py": "TpuflowDatapath",
-    "oracle_dp.py": "OracleDatapath",
-}
-PUBLIC = ("install_bundle", "apply_group_delta")
-IMPLS = ("_install_bundle_impl", "_apply_group_delta_impl")
-SETTLE = (r"self\._persist\(\)", r"self\._record_round\(\)")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def check() -> list[str]:
-    problems: list[str] = []
-    commit_text = COMMIT.read_text() if COMMIT.exists() else ""
-    if not commit_text:
-        return [f"{COMMIT.relative_to(REPO)} is missing"]
-
-    # 1 + 3 + 4: per-engine rules.
-    for path in ENGINES:
-        text = path.read_text()
-        rel = path.relative_to(REPO)
-        for name in PUBLIC:
-            if re.search(rf"^\s*def {name}\(", text, re.M):
-                problems.append(
-                    f"{rel} defines public {name}() — installs must route "
-                    f"through the commit plane (datapath/commit.py)"
-                )
-        for name in IMPLS:
-            if not re.search(rf"^\s*def {name}\(", text, re.M):
-                problems.append(f"{rel} does not implement {name}()")
-        cls = ENGINE_CLASSES[path.name]
-        m = re.search(rf"^class {cls}\(([^)]*)\)", text, re.M | re.S)
-        if m is None or "TransactionalDatapath" not in m.group(1):
-            problems.append(f"{rel}: {cls} does not inherit TransactionalDatapath")
-        for pat in SETTLE:
-            for ln, line in enumerate(text.splitlines(), 1):
-                if re.search(pat, line) and not line.lstrip().startswith("#"):
-                    problems.append(
-                        f"{rel}:{ln} settles its own persistence "
-                        f"({pat.replace(chr(92), '')}) — settle belongs to "
-                        f"the commit plane, after the canary"
-                    )
-
-    # 2: _impl call sites only inside commit.py.
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(REPO)
-        text = path.read_text()
-        for name in IMPLS:
-            for ln, line in enumerate(text.splitlines(), 1):
-                if f"{name}(" not in line:
-                    continue
-                stripped = line.lstrip()
-                if stripped.startswith(("def ", "#")):
-                    continue  # the definition / commentary, not a call
-                if path == COMMIT:
-                    continue
-                problems.append(
-                    f"{rel}:{ln} calls {name}() outside datapath/commit.py "
-                    f"— a tensor swap bypassing the canary gate"
-                )
-
-    # The mixin really carries the public surface.
-    for name in PUBLIC:
-        if not re.search(rf"^\s*def {name}\(", commit_text, re.M):
-            problems.append(f"datapath/commit.py defines no {name}()")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    print(
-        f"commit plane consistent: {len(ENGINES)} engines route "
-        f"{'/'.join(PUBLIC)} through datapath/commit.py"
-    )
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("commit-plane", sys.argv[1:]))

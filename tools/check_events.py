@@ -1,126 +1,26 @@
 #!/usr/bin/env python
 """Flight-recorder / realization-tracing drift check.
 
-The post-mortem journal is only trustworthy if its schema, its emit
-sites and its operator documentation agree.  Checked:
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/events.py as pass `events` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-  1. every `FlightRecorder.emit(kind="...")` / plane `_emit("...")` call
-     site under antrea_tpu/ uses a kind declared in
-     observability/flightrec.EVENT_KINDS (variable-kind forwarding shims
-     are validated at runtime by FlightRecorder.emit itself, which
-     raises on an undeclared kind);
-  2. every declared kind has >= 1 emit site — a kind nobody emits is a
-     dead schema row that would silently document nothing;
-  3. every declared kind has a README row (the event-kind table in the
-     "Observability" section is the operator contract);
-  4. the realization stage labels (observability/tracing.py
-     REALIZATION_STAGES) each have a README row, and the
-     antrea_tpu_policy_realization_seconds family is registered in the
-     metrics registry (observability/metrics.py METRICS) — the stage
-     label set and the histogram family must not drift apart.
-
-Dependency-free on purpose (no jax, no package import — the literals are
-parsed textually with ast.literal_eval): runnable standalone in any CI
-step and invoked from the tier-1 suite (tests/test_flightrec.py).
-
-Exit 0 = consistent; 1 = drift (diff printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
-FLIGHTREC = PKG / "observability" / "flightrec.py"
-TRACING = PKG / "observability" / "tracing.py"
-METRICS = PKG / "observability" / "metrics.py"
-README = REPO / "README.md"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# Emit call sites carrying a LITERAL kind: the recorder's own keyword
-# form and the planes' positional `_emit("kind", ...)` helpers.
-EMIT_RES = (
-    re.compile(r"\.emit\(\s*kind=\"([a-z0-9-]+)\""),
-    re.compile(r"\._emit\(\s*\"([a-z0-9-]+)\""),
-)
-
-
-def _literal(path: pathlib.Path, name: str):
-    """Evaluate a module-level literal assignment without importing."""
-    text = path.read_text()
-    m = re.search(rf"^{name}\s*(?::[^=]+)?=\s*(\{{.*?^\}}|\(.*?^\))", text,
-                  re.M | re.S)
-    if m is None:
-        raise ValueError(f"{path.relative_to(REPO)} defines no {name} literal")
-    return ast.literal_eval(m.group(1))
-
-
-def emit_sites() -> dict:
-    """kind -> [package-relative paths with a literal emit of it]."""
-    out: dict[str, list[str]] = {}
-    for p in sorted(PKG.rglob("*.py")):
-        text = p.read_text()
-        for rx in EMIT_RES:
-            for kind in rx.findall(text):
-                out.setdefault(kind, []).append(
-                    str(p.relative_to(REPO)))
-    return out
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-    try:
-        kinds = _literal(FLIGHTREC, "EVENT_KINDS")
-        stages = _literal(TRACING, "REALIZATION_STAGES")
-        registry = _literal(METRICS, "METRICS")
-    except (OSError, ValueError) as e:
-        return [str(e)]
-    readme = README.read_text()
-
-    sites = emit_sites()
-    for kind in sorted(set(sites) - set(kinds)):
-        problems.append(
-            f"emit site uses undeclared kind {kind!r} "
-            f"({', '.join(sites[kind])}) — declare it in EVENT_KINDS")
-    for kind in sorted(set(kinds) - set(sites)):
-        problems.append(
-            f"declared kind {kind!r} has no emit site under antrea_tpu/ "
-            f"— dead schema row")
-    for kind in sorted(kinds):
-        if f"`{kind}`" not in readme:
-            problems.append(
-                f"declared kind {kind!r} has no README row (event-kind "
-                f"table in the Observability section)")
-
-    fam = "antrea_tpu_policy_realization_seconds"
-    if fam not in registry:
-        problems.append(
-            f"{fam} is not registered in observability/metrics.METRICS")
-    if fam not in readme:
-        problems.append(f"{fam} has no README row")
-    for stage in stages:
-        if f"`{stage}`" not in readme:
-            problems.append(
-                f"realization stage {stage!r} has no README row "
-                f"(span-stage table in the Observability section)")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    kinds = _literal(FLIGHTREC, "EVENT_KINDS")
-    stages = _literal(TRACING, "REALIZATION_STAGES")
-    print(f"events consistent: {len(kinds)} kinds, "
-          f"{len(stages)} realization stages")
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("events", sys.argv[1:]))

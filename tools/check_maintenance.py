@@ -1,166 +1,26 @@
 #!/usr/bin/env python
-"""Maintenance-plane discipline check: every background loop runs ONLY
-via the unified scheduler.
+"""Maintenance-plane discipline check: every background loop runs only via the unified scheduler.
 
-PR 7's consolidation guarantee (datapath/maintenance.py) only holds if
-no plane grows a private cadence again: a direct call site of the
-off-hot-step loop entry points — `canary_scan(...)`, `audit_scan(...)`,
-the slow-path engine's `maintain(...)`, the FQDN controller's
-`tick(...)` — anywhere under antrea_tpu/ outside the scheduler module
-re-introduces exactly the plane-vs-plane interleaving races the
-scheduler's single serialization point retired.  Tests drive the entry
-points directly on purpose (they exercise the planes in isolation) and
-are exempt.
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/maintenance.py as pass `maintenance` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-Checked:
-
-  1. the MAINT_TASKS inventory (datapath/maintenance.py, a pure literal)
-     names every consolidated loop — canary, audit-cursor, tensor-scrub,
-     cache-maintain, fqdn-ttl, degraded-recompile;
-  2. every inventoried task is actually constructed somewhere
-     (`MaintenanceTask("<name>", ...)`) under antrea_tpu/;
-  3. both engines inherit MaintainableDatapath and call
-     `_init_maintenance` (the scheduler exists on every instance);
-  4. no forbidden call site outside datapath/maintenance.py:
-       .canary_scan(   allowed only in datapath/commit.py (the mixin's
-                       own delegation to its plane)
-       .audit_scan(    allowed only in datapath/interface.py (the base
-                       default of maintenance_force_audit for datapaths
-                       without a scheduler; the mixin delegates via
-                       _audit.scan)
-       .maintain(      allowed only in datapath/slowpath/engine.py
-                       (drain()'s lazy stale-epoch heal is on-demand
-                       work on the drain path, not a background loop)
-       .tick(          allowed only in agent/fqdn.py (the fqdn-ttl task
-                       registration wires self.tick as its runner;
-                       MaintenanceScheduler.tick is reached via the
-                       maintenance_tick wrapper)
-
-Dependency-free on purpose (no jax, no package import): files are parsed
-textually and the task table literal evaluated with ast.literal_eval, so
-this runs in any CI step and from the tier-1 suite
-(tests/test_maintenance.py).  Exit 0 = disciplined; 1 = drift (printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
-MAINT = PKG / "datapath" / "maintenance.py"
-ENGINES = {
-    PKG / "datapath" / "tpuflow.py": "TpuflowDatapath",
-    PKG / "datapath" / "oracle_dp.py": "OracleDatapath",
-}
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-REQUIRED_TASKS = {
-    "canary", "audit-cursor", "tensor-scrub", "cache-maintain",
-    "fqdn-ttl", "degraded-recompile",
-}
-
-# pattern -> set of package-relative paths allowed to carry it (the
-# scheduler module itself is always exempt).
-FORBIDDEN = {
-    r"\.canary_scan\(": {"datapath/commit.py"},
-    # interface.py: the Datapath base default for maintenance_force_audit
-    # — the fallback for audit-capable datapaths WITHOUT a scheduler
-    # (nothing to serialize against); both engines override through the
-    # mixin, which routes via MaintenanceScheduler.force.
-    r"\.audit_scan\(": {"datapath/interface.py"},
-    r"\.maintain\(": {"datapath/slowpath/engine.py"},
-    r"\.tick\(": {"agent/fqdn.py"},
-}
-
-
-def load_tasks(text: str) -> dict:
-    m = re.search(r"^MAINT_TASKS\s*(?::[^=]+)?=\s*(\{.*?^\})", text,
-                  re.M | re.S)
-    if m is None:
-        raise ValueError(
-            "datapath/maintenance.py defines no MAINT_TASKS literal")
-    return ast.literal_eval(m.group(1))
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-    maint_text = MAINT.read_text() if MAINT.exists() else ""
-    if not maint_text:
-        return [f"{MAINT.relative_to(REPO)} is missing"]
-    try:
-        tasks = load_tasks(maint_text)
-    except ValueError as e:
-        return [str(e)]
-
-    missing = REQUIRED_TASKS - set(tasks)
-    for name in sorted(missing):
-        problems.append(
-            f"MAINT_TASKS is missing the consolidated loop {name!r}")
-    for name, plane in tasks.items():
-        if not (isinstance(plane, str) and plane.strip()):
-            problems.append(
-                f"MAINT_TASKS[{name!r}] names no owning plane")
-
-    # Every inventoried task must be constructed somewhere in the package.
-    ctor = re.compile(r"MaintenanceTask\(\s*\n?\s*[\"']([a-z-]+)[\"']")
-    constructed: set[str] = set()
-    pkg_files = sorted(PKG.rglob("*.py"))
-    for p in pkg_files:
-        constructed |= set(ctor.findall(p.read_text()))
-    for name in sorted(set(tasks) - constructed):
-        problems.append(
-            f"MAINT_TASKS names {name!r} but no MaintenanceTask("
-            f"\"{name}\", ...) is registered anywhere under antrea_tpu/"
-        )
-
-    for path, cls in ENGINES.items():
-        rel = path.relative_to(REPO)
-        text = path.read_text()
-        m = re.search(rf"^class {cls}\(([^)]*)\)", text, re.M | re.S)
-        if m is None or "MaintainableDatapath" not in m.group(1):
-            problems.append(
-                f"{rel}: {cls} does not inherit MaintainableDatapath")
-        if "_init_maintenance(" not in text:
-            problems.append(f"{rel}: {cls} never calls _init_maintenance")
-
-    for p in pkg_files:
-        rel = str(p.relative_to(PKG)).replace("\\", "/")
-        if rel == "datapath/maintenance.py":
-            continue
-        text = p.read_text()
-        for pat, allowed in FORBIDDEN.items():
-            if rel in allowed:
-                continue
-            for ln, line in enumerate(text.splitlines(), 1):
-                stripped = line.strip()
-                if stripped.startswith("#"):
-                    continue
-                if re.search(pat, line):
-                    problems.append(
-                        f"antrea_tpu/{rel}:{ln}: direct background-loop "
-                        f"call site ({pat}) outside the maintenance "
-                        f"scheduler — register a MaintenanceTask and run "
-                        f"it via MaintenanceScheduler.tick() instead"
-                    )
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    tasks = load_tasks(MAINT.read_text())
-    print(
-        f"maintenance plane disciplined: {len(tasks)} consolidated loops, "
-        f"{len(ENGINES)} engines, 0 rogue call sites"
-    )
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("maintenance", sys.argv[1:]))

@@ -1,177 +1,26 @@
 #!/usr/bin/env python
 """Mesh partition-spec drift check: every sharded pytree field is specced.
 
-The multichip datapath (parallel/mesh.py + parallel/meshpath.py) places
-three pytrees on the (data × rule) mesh — `PipelineState` (with its
-`FlowCache`/`AffinityTable` leaves), `DeviceRuleSet` (with its
-`DimTable`/`DeviceDirection`/`IsoTable`/`DeltaTable` leaves) and
-`DeviceServiceTables` — under the PartitionSpecs built by `_state_specs`
-/ `_drs_specs` / `_svc_specs`.  Those builders enumerate every field BY
-NAME on purpose: a field that is merely splatted would let a new
-single-chip state column ship replicated-by-accident (or worse, sharded
-on the wrong axis) the first time someone grows a NamedTuple.
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/mesh.py as pass `mesh` (one shared AST engine,
+typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-This tool fails the build when any field of the tracked NamedTuples is
-neither named as a keyword in one of the spec builders nor waived in
-`mesh.MESH_SPEC_ALLOWLIST` with a reason — and when the allowlist itself
-goes stale (waives a field that no longer exists, or one that IS
-specced, or carries no reason).
-
-Dependency-free on purpose (stdlib ast only, no jax, no package import):
-runnable standalone in any CI step and invoked from the tier-1 suite
-(tests/test_mesh_datapath.py).  Exit 0 = covered; 1 = drift (printed).
-"""
+Exit 0 = covered; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
-MESH = PKG / "parallel" / "mesh.py"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# NamedTuples whose fields must be specced, per defining module.  The
-# nested leaf types are tracked alongside their containers so a field
-# added anywhere in the tree is caught.
-TRACKED = {
-    PKG / "models" / "pipeline.py": (
-        "PipelineState", "FlowCache", "AffinityTable", "DeviceServiceTables",
-    ),
-    PKG / "ops" / "match.py": (
-        "DeviceRuleSet", "DeviceDirection", "DimTable", "IsoTable",
-        "DeltaTable",
-    ),
-}
-
-SPEC_BUILDERS = ("_state_specs", "_drs_specs", "_svc_specs")
-
-
-def namedtuple_fields(path: pathlib.Path, classes) -> dict:
-    """class name -> ordered field names, parsed via ast (AnnAssign rows
-    of NamedTuple class bodies)."""
-    tree = ast.parse(path.read_text())
-    out: dict[str, list[str]] = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ClassDef) or node.name not in classes:
-            continue
-        fields = [
-            stmt.target.id
-            for stmt in node.body
-            if isinstance(stmt, ast.AnnAssign)
-            and isinstance(stmt.target, ast.Name)
-        ]
-        out[node.name] = fields
-    return out
-
-
-def specced_kwargs() -> dict:
-    """Constructor-class name -> keyword-argument names used at its call
-    sites inside the spec builder functions of parallel/mesh.py.  Keyed
-    PER CLASS (the callee's name), not pooled: field names legitimately
-    collide across the tracked NamedTuples (FlowCache.ts vs
-    AffinityTable.ts, DimTable.bounds vs IsoTable.bounds), and a pooled
-    set would let a new field ride a same-named field of a DIFFERENT
-    class through the gate unspecced."""
-    tree = ast.parse(MESH.read_text())
-    by_class: dict[str, set] = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if node.name not in SPEC_BUILDERS:
-            continue
-        for call in ast.walk(node):
-            if not isinstance(call, ast.Call):
-                continue
-            fn = call.func
-            name = (fn.attr if isinstance(fn, ast.Attribute)
-                    else fn.id if isinstance(fn, ast.Name) else None)
-            if name is None:
-                continue
-            by_class.setdefault(name, set()).update(
-                kw.arg for kw in call.keywords if kw.arg)
-    return by_class
-
-
-def allowlist() -> dict:
-    tree = ast.parse(MESH.read_text())
-    for node in ast.walk(tree):
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
-                                                           ast.Name):
-            targets = [node.target.id]
-        else:
-            continue
-        if "MESH_SPEC_ALLOWLIST" in targets and node.value is not None:
-            return ast.literal_eval(node.value)
-    raise ValueError("parallel/mesh.py defines no MESH_SPEC_ALLOWLIST literal")
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-    try:
-        waived = allowlist()
-    except (OSError, ValueError) as e:
-        return [str(e)]
-    specced = specced_kwargs()
-    if not specced:
-        return ["parallel/mesh.py spec builders "
-                f"{SPEC_BUILDERS} name no fields at all"]
-
-    qualified: set[str] = set()  # "Class.field" of every tracked field
-    for path, classes in TRACKED.items():
-        fields_by_class = namedtuple_fields(path, classes)
-        for cls in classes:
-            if cls not in fields_by_class:
-                problems.append(
-                    f"{path.relative_to(REPO)} no longer defines {cls} — "
-                    f"update tools/check_mesh.py's TRACKED table")
-                continue
-            for field in fields_by_class[cls]:
-                qualified.add(f"{cls}.{field}")
-                if (field in specced.get(cls, ())
-                        or f"{cls}.{field}" in waived):
-                    continue
-                problems.append(
-                    f"{cls}.{field} ({path.relative_to(REPO)}) has no "
-                    f"explicit PartitionSpec at a {cls}(...) call in "
-                    f"parallel/mesh.py {SPEC_BUILDERS} and no "
-                    f"MESH_SPEC_ALLOWLIST waiver — it would ship on the "
-                    f"mesh with an accidental layout")
-
-    for key, reason in waived.items():
-        cls, _, field = key.partition(".")
-        if key not in qualified:
-            problems.append(
-                f"MESH_SPEC_ALLOWLIST waives {key!r} (expected "
-                f"'Class.field' of a tracked NamedTuple) — stale waiver")
-        elif field in specced.get(cls, ()):
-            problems.append(
-                f"MESH_SPEC_ALLOWLIST waives {key!r}, but it IS specced "
-                f"in the builders — drop the stale waiver")
-        if not (isinstance(reason, str) and reason.strip()):
-            problems.append(
-                f"MESH_SPEC_ALLOWLIST waiver {key!r} carries no reason")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    n = sum(len(namedtuple_fields(p, c)) for p, c in TRACKED.items())
-    specced = specced_kwargs()
-    print(f"mesh specs covered: {n} pytree classes, "
-          f"{sum(len(v) for v in specced.values())} specced fields "
-          f"across {len(specced)} constructors, "
-          f"{len(allowlist())} waivers")
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("mesh", sys.argv[1:]))

@@ -1,100 +1,26 @@
 #!/usr/bin/env python
 """Metric-name drift check: registry == emissions == README table.
 
-Three-way consistency over the `antrea_tpu_*` metric namespace:
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/metrics.py as pass `metrics` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-  1. every name in the METRICS registry
-     (antrea_tpu/observability/metrics.py) appears in README.md's
-     "Observability" metric inventory, and vice versa — the README table
-     is the operator contract;
-  2. every `antrea_tpu_*` literal anywhere under antrea_tpu/ resolves to
-     a registered family (histogram `_bucket`/`_sum`/`_count` suffixes
-     fold to their family), so nothing can be emitted unregistered.
-
-Dependency-free on purpose (no jax, no package import — metrics.py is
-loaded directly from its path, and it must stay importable that way):
-runnable standalone in any CI step and invoked from the tier-1 suite
-(tests/test_prom_exposition.py).  No cryptography imports here, gated or
-otherwise — this tool must run on images without the wheel.
-
-Exit 0 = consistent; 1 = drift (diff printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import importlib.util
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-NAME_RE = re.compile(r"antrea_tpu_[a-z0-9_]+")
-_SUFFIXES = ("_bucket", "_sum", "_count")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def load_registry() -> dict:
-    """METRICS from observability/metrics.py WITHOUT importing the
-    package (keeps this tool jax-free; metrics.py depends only on the
-    stdlib by design)."""
-    path = REPO / "antrea_tpu" / "observability" / "metrics.py"
-    spec = importlib.util.spec_from_file_location("_metrics_standalone", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return dict(mod.METRICS)
-
-
-def readme_names(registry: dict) -> set:
-    """Every antrea_tpu_* token mentioned in README.md."""
-    text = (REPO / "README.md").read_text()
-    return {_family(n, registry) for n in NAME_RE.findall(text)}
-
-
-def _family(name: str, registry: dict) -> str:
-    """Fold histogram sample suffixes onto their family name."""
-    if name in registry:
-        return name
-    for suf in _SUFFIXES:
-        if name.endswith(suf) and name[: -len(suf)] in registry:
-            return name[: -len(suf)]
-    return name
-
-
-def source_names(registry: dict) -> set:
-    """Every antrea_tpu_* literal under antrea_tpu/ (emissions + the
-    comments that cite them — citing an unregistered name is drift too)."""
-    out = set()
-    for p in (REPO / "antrea_tpu").rglob("*.py"):
-        for n in NAME_RE.findall(p.read_text()):
-            out.add(_family(n, registry))
-    return out
-
-
-def check() -> list[str]:
-    registry = load_registry()
-    reg = set(registry)
-    readme = readme_names(registry)
-    src = source_names(registry)
-    problems = []
-    for n in sorted(reg - readme):
-        problems.append(f"registered but missing from README.md: {n}")
-    for n in sorted(readme - reg):
-        problems.append(f"in README.md but not registered: {n}")
-    for n in sorted(src - reg):
-        problems.append(f"referenced in source but not registered: {n}")
-    # The registry itself lives in source, so reg - src only flags names
-    # nobody renders NOR documents in code — dead registry entries.
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    print(f"metrics consistent: {len(load_registry())} families")
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("metrics", sys.argv[1:]))

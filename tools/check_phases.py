@@ -1,179 +1,26 @@
 #!/usr/bin/env python
 """Phase-mask drift check: pipeline PH_* == profile chains == bench_profile.
 
-The churn profiler's honesty rests on three surfaces staying in lockstep:
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/phases.py as pass `phases` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-  1. the PH_* mask bits defined in antrea_tpu/models/pipeline.py (the
-     compile-time phase gates of the slow path), with PH_ALL their OR;
-  2. the cumulative chains in antrea_tpu/models/profile.py (PHASE_CHAIN
-     for the synchronous regime, ASYNC_PHASE_CHAIN for the decoupled
-     drain regime, OVERLAP_PHASE_CHAIN for the double-buffered overlap
-     regime, MAINT_PHASE_CHAIN for the unified maintenance-scheduler
-     cadence) — each chain must start at 0, grow by exactly one PH_ bit
-     per entry, end at PH_ALL, and carry unique names;
-  3. bench_profile.py, which must report its phase list FROM the chain
-     (importing PHASE_CHAIN), not from a hand-copied name list.
-
-A new PH_ bit added to the pipeline without a chain entry (or a renamed
-phase that bench_profile would silently mis-report) fails here.
-
-Dependency-free on purpose (no jax, no package import): the three files
-are parsed textually and the mask expressions evaluated over the parsed
-PH_ constants, so this runs in any CI step and from the tier-1 suite
-(tests/test_profile.py).  Exit 0 = consistent; 1 = drift (diff printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PIPELINE = REPO / "antrea_tpu" / "models" / "pipeline.py"
-PROFILE = REPO / "antrea_tpu" / "models" / "profile.py"
-BENCH = REPO / "bench_profile.py"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-_PH_DEF = re.compile(r"^(PH_[A-Z0-9_]+)\s*=\s*(.+?)\s*(?:#.*)?$", re.M)
-_CHAIN = re.compile(
-    r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN|OVERLAP_PHASE_CHAIN"
-    r"|MAINT_PHASE_CHAIN|PRUNE_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
-    re.M | re.S,
-)
-_ENTRY = re.compile(r'\(\s*"([a-z0-9_]+)"\s*,\s*([^)]*?)\s*\)', re.S)
-
-
-def parse_ph_bits() -> dict:
-    """PH_* constants from pipeline.py, numerically evaluated in
-    definition order (later definitions may reference earlier ones)."""
-    text = PIPELINE.read_text()
-    bits: dict[str, int] = {}
-    for name, expr in _PH_DEF.findall(text):
-        try:
-            bits[name] = eval(expr, {"__builtins__": {}}, dict(bits))
-        except Exception:
-            continue  # not a constant definition (e.g. inside a function)
-    return bits
-
-
-def parse_chains() -> dict:
-    """{chain name: [(entry name, mask int), ...]} from profile.py."""
-    text = PROFILE.read_text()
-    bits = parse_ph_bits()
-    env = {f"pl.{k}": v for k, v in bits.items()} | dict(bits)
-    chains: dict[str, list] = {}
-    for cname, body in _CHAIN.findall(text):
-        entries = []
-        for ename, expr in _ENTRY.findall(body):
-            expr = expr.strip().rstrip(",")
-            try:
-                mask = eval(expr.replace("pl.", ""), {"__builtins__": {}},
-                            dict(bits))
-            except Exception as e:
-                entries.append((ename, None))
-                continue
-            entries.append((ename, mask))
-        chains[cname] = entries
-    return chains
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-    bits = parse_ph_bits()
-    phase_bits = {k: v for k, v in bits.items() if k != "PH_ALL"}
-    if "PH_ALL" not in bits:
-        return ["pipeline.py defines no PH_ALL"]
-    union = 0
-    for v in phase_bits.values():
-        union |= v
-    if union != bits["PH_ALL"]:
-        problems.append(
-            f"PH_ALL ({bits['PH_ALL']:#x}) != OR of phase bits ({union:#x})"
-        )
-    for a, va in phase_bits.items():
-        if va & (va - 1):
-            problems.append(f"{a} ({va:#x}) is not a single bit")
-        for b, vb in phase_bits.items():
-            if a < b and va & vb:
-                problems.append(f"{a} and {b} overlap ({va:#x} & {vb:#x})")
-
-    chains = parse_chains()
-    for required in ("PHASE_CHAIN", "ASYNC_PHASE_CHAIN",
-                     "OVERLAP_PHASE_CHAIN", "MAINT_PHASE_CHAIN",
-                     "PRUNE_PHASE_CHAIN"):
-        if required not in chains:
-            problems.append(f"profile.py defines no {required}")
-    seen_names: set[str] = set()
-    for cname, entries in chains.items():
-        if not entries:
-            problems.append(f"{cname} parsed empty")
-            continue
-        names = [n for n, _m in entries]
-        dup = {n for n in names if names.count(n) > 1}
-        if dup:
-            problems.append(f"{cname}: duplicate phase names {sorted(dup)}")
-        overlap = seen_names & set(names)
-        if overlap:
-            problems.append(
-                f"{cname}: phase names {sorted(overlap)} reused across "
-                f"chains (bench/profile consumers key on the name)"
-            )
-        seen_names |= set(names)
-        prev = None
-        covered = 0
-        for ename, mask in entries:
-            if mask is None:
-                problems.append(f"{cname}.{ename}: unparseable mask")
-                continue
-            if prev is None:
-                if mask != 0:
-                    problems.append(f"{cname} must start at mask 0")
-            else:
-                added = mask & ~prev
-                if mask & prev != prev:
-                    problems.append(
-                        f"{cname}.{ename}: mask {mask:#x} is not a "
-                        f"superset of its predecessor {prev:#x}"
-                    )
-                if added == 0 or added & (added - 1):
-                    problems.append(
-                        f"{cname}.{ename}: must add exactly one PH_ bit "
-                        f"(adds {added:#x})"
-                    )
-            prev = mask
-            covered |= mask
-        if prev != bits["PH_ALL"]:
-            problems.append(
-                f"{cname} ends at {prev:#x}, not PH_ALL "
-                f"({bits['PH_ALL']:#x}) — a PH_ bit has no phase entry"
-            )
-
-    bench = BENCH.read_text()
-    if not re.search(r"from antrea_tpu\.models\.profile import .*PHASE_CHAIN",
-                     bench):
-        problems.append("bench_profile.py does not import PHASE_CHAIN")
-    if not re.search(r'"phase_chain":.*PHASE_CHAIN', bench):
-        problems.append(
-            "bench_profile.py does not derive its reported phase_chain "
-            "from profile.PHASE_CHAIN"
-        )
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    bits = parse_ph_bits()
-    chains = parse_chains()
-    print(
-        f"phases consistent: {len(bits) - 1} PH_ bits, "
-        + ", ".join(f"{c} x{len(e)}" for c, e in sorted(chains.items()))
-    )
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("phases", sys.argv[1:]))

@@ -1,125 +1,26 @@
 #!/usr/bin/env python
 """Reshard-manifest drift check: every (D,)-sharded state field migrates.
 
-The elastic resharding plane (parallel/reshard.py) moves the stateful
-tables — the pytree fields `parallel/mesh._state_specs` shards with a
-leading ``data`` axis — to their new home shards when the data axis
-resizes.  A NEW stateful field that nobody taught the migrator is a
-silent flow-loss bug: the field would ship sharded (tools/check_mesh.py
-forces the spec), survive every parity suite on a fixed mesh, and then
-silently zero out on the first live resize.
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/reshard.py as pass `reshard` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-This tool fails the build when any field specced `P(DATA, ...)` in
-`_state_specs` has no migration rule in `reshard.RESHARD_MANIFEST` — and
-when the manifest itself goes stale (names a field that is not
-(D,)-sharded, or carries no rule text).  The migrator copies rows
-field-generically from `FlowCache._fields`/`AffinityTable._fields`, so
-manifest coverage here is the load-bearing gate.
-
-Dependency-free on purpose (stdlib ast only, no jax, no package import):
-runnable standalone in any CI step and invoked from the tier-1 suite
-(tests/test_reshard.py).  Exit 0 = covered; 1 = drift (printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
-MESH = PKG / "parallel" / "mesh.py"
-RESHARD = PKG / "parallel" / "reshard.py"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-STATE_BUILDER = "_state_specs"
-
-
-def data_sharded_fields() -> set:
-    """'Class.field' for every kwarg of a constructor call inside
-    _state_specs whose value is a P(DATA, ...) spec — the fields that
-    carry a leading data axis and therefore must migrate on resize."""
-    tree = ast.parse(MESH.read_text())
-    out: set[str] = set()
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.FunctionDef)
-                and node.name == STATE_BUILDER):
-            continue
-        for call in ast.walk(node):
-            if not isinstance(call, ast.Call):
-                continue
-            fn = call.func
-            cls = (fn.attr if isinstance(fn, ast.Attribute)
-                   else fn.id if isinstance(fn, ast.Name) else None)
-            if cls is None:
-                continue
-            for kw in call.keywords:
-                v = kw.value
-                if (isinstance(v, ast.Call)
-                        and isinstance(v.func, ast.Name)
-                        and v.func.id == "P"
-                        and v.args
-                        and isinstance(v.args[0], ast.Name)
-                        and v.args[0].id == "DATA"):
-                    out.add(f"{cls}.{kw.arg}")
-    return out
-
-
-def manifest() -> dict:
-    tree = ast.parse(RESHARD.read_text())
-    for node in ast.walk(tree):
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
-                                                           ast.Name):
-            targets = [node.target.id]
-        else:
-            continue
-        if "RESHARD_MANIFEST" in targets and node.value is not None:
-            return ast.literal_eval(node.value)
-    raise ValueError(
-        "parallel/reshard.py defines no RESHARD_MANIFEST literal")
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-    try:
-        rules = manifest()
-    except (OSError, ValueError) as e:
-        return [str(e)]
-    sharded = data_sharded_fields()
-    if not sharded:
-        return [f"parallel/mesh.py {STATE_BUILDER} names no P(DATA, ...) "
-                f"fields at all — the parse is broken or the specs moved"]
-
-    for key in sorted(sharded - set(rules)):
-        problems.append(
-            f"{key} is (D,)-sharded in parallel/mesh.py {STATE_BUILDER} "
-            f"but has NO migration rule in reshard.RESHARD_MANIFEST — a "
-            f"live resize would silently zero it (flow loss); teach the "
-            f"migrator and document the rule")
-    for key in sorted(set(rules) - sharded):
-        problems.append(
-            f"RESHARD_MANIFEST names {key!r}, which is not a (D,)-sharded "
-            f"field of {STATE_BUILDER} — stale manifest row")
-    for key, rule in rules.items():
-        if not (isinstance(rule, str) and rule.strip()):
-            problems.append(f"RESHARD_MANIFEST[{key!r}] carries no rule "
-                            f"text")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    print(f"reshard manifest covered: {len(data_sharded_fields())} "
-          f"(D,)-sharded state fields, {len(manifest())} migration rules")
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("reshard", sys.argv[1:]))

@@ -1,211 +1,26 @@
 #!/usr/bin/env python
-"""Tenant-id drift check: every 5-tuple-keyed or per-world surface must
-carry the tenant id (datapath/tenancy.py).
+"""Tenant-id drift check: every 5-tuple-keyed or per-world surface carries the tenant id.
 
-A multi-tenant datapath is only isolated if NO surface that hashes,
-keys, or commits on the 5-tuple can silently drop the owning world:
-one dropped tenant id turns "isolated policy worlds" into cross-tenant
-verdict/state bleed.  Checked:
+Thin CLI shim over the unified static-analysis plane: the logic lives
+in antrea_tpu/analysis/tenant.py as pass `tenant` (one shared AST
+engine, typed findings, reasoned allowlists, BASELINE.analysis.json
+suppressions — see antrea_tpu/analysis/core.py).  This entry point
+keeps every existing invocation working, verdict-identical to the
+pre-migration standalone tool (pinned by
+tests/test_static_analysis.py); tier-1 runs the FULL pass suite once
+via that test instead of one subprocess per gate.  Accepts an optional
+`--root PATH` to analyze another tree (the parity harness).
 
-  1. the miss-queue schema carries the tenant column
-     (datapath/slowpath/queue.COLUMNS) and the one admission-column
-     builder produces it (datapath/interface._queue_cols);
-  2. every `_queue_cols(` CALL site under antrea_tpu/ passes `tenant=`
-     — an admit path that drops it would queue tenant rows as
-     default-world rows and classify them under the wrong policy;
-  3. every `shard_of_tuples(` call site under antrea_tpu/ passes
-     `tenant=` or is allowlisted with a reason (the shard hash is the
-     mesh's 5-tuple home map — without the salt two tenants' identical
-     tuples would collide onto one home's cache semantics);
-  4. each engine's `_TENANT_WORLD_FIELDS` literal covers the required
-     per-world members (generation, state/interpreter estate, the
-     quota/eviction meters) — a field missing from the swap list leaks
-     one tenant's state into the next world swapped in;
-  5. the commit plane's per-world slice (tenancy.COMMIT_WORLD_FIELDS)
-     names real CommitPlane attributes and includes the
-     degraded/LKG pair — the tenant-scoped-rollback contract;
-  6. every `antrea_tpu_tenant_*` family in the metrics registry is
-     rendered with a `tenant=` label (observability/metrics.py) —
-     unlabeled tenant meters would aggregate worlds together.
-
-Dependency-free on purpose (textual parsing only): runnable standalone
-and invoked from the tier-1 suite (tests/test_tenancy.py).
-
-Exit 0 = consistent; 1 = drift (diff printed).
-"""
+Exit 0 = consistent; 1 = drift (printed)."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "antrea_tpu"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# shard_of_tuples call sites allowed WITHOUT a tenant= kwarg, with the
-# reason each is default-world-only by construction.
-SHARD_ALLOWLIST = {
-    "parallel/mesh.py":
-        "the definition site (tenant defaults to 0 = the default world)",
-    "parallel/reshard.py":
-        "migration/cutover routing walks the DEFAULT world's tables only "
-        "— reshard_begin refuses to start while tenant worlds exist "
-        "(parallel/meshpath.reshard_begin)",
-}
-
-# _queue_cols call sites allowed WITHOUT tenant= (the definition).
-QUEUE_ALLOWLIST = {
-    "datapath/interface.py":
-        "the definition site (tenant defaults to 0)",
-}
-
-REQUIRED_WORLD_FIELDS = {
-    "datapath/tpuflow.py": {
-        "_ps", "_cps", "_drs", "_meta", "_meta_step", "_state", "_gen",
-        "_stats_in", "_stats_out", "_evictions", "_state_mutations",
-        "_pipe_kw",
-    },
-    "datapath/oracle_dp.py": {
-        "_ps", "_oracle", "_gen", "_stats_in", "_stats_out",
-        "_state_mutations",
-    },
-}
-
-REQUIRED_COMMIT_FIELDS = {"degraded", "last_error", "lkg_generation",
-                          "lkg_at"}
-
-
-def _literal_tuple(path: pathlib.Path, name: str):
-    text = path.read_text()
-    m = re.search(rf"^\s*{name}\s*(?::[^=]+)?=\s*(\(.*?\))", text,
-                  re.M | re.S)
-    if m is None:
-        raise ValueError(
-            f"{path.relative_to(REPO)} defines no {name} literal")
-    return ast.literal_eval(m.group(1))
-
-
-def _call_sites(pattern: str) -> list[tuple[str, int, str]]:
-    """(relpath, lineno, full call text) of every `pattern(` site —
-    the call text spans to the balanced closing paren."""
-    out = []
-    rx = re.compile(re.escape(pattern) + r"\(")
-    for p in sorted(PKG.rglob("*.py")):
-        text = p.read_text()
-        rel = str(p.relative_to(PKG)).replace("\\", "/")
-        for m in rx.finditer(text):
-            start = m.end() - 1
-            depth = 0
-            for i in range(start, min(len(text), start + 2000)):
-                if text[i] == "(":
-                    depth += 1
-                elif text[i] == ")":
-                    depth -= 1
-                    if depth == 0:
-                        break
-            line = text.count("\n", 0, m.start()) + 1
-            out.append((rel, line, text[m.start():i + 1]))
-    return out
-
-
-def check() -> list[str]:
-    problems: list[str] = []
-
-    # 1. queue schema + builder.
-    qtext = (PKG / "datapath" / "slowpath" / "queue.py").read_text()
-    m = re.search(r"^COLUMNS\s*=\s*(\(.*?\))", qtext, re.M | re.S)
-    cols = ast.literal_eval(m.group(1)) if m else ()
-    if "tenant" not in cols:
-        problems.append(
-            "datapath/slowpath/queue.COLUMNS has no 'tenant' column — "
-            "queued misses cannot be classified in their owner's world")
-    itext = (PKG / "datapath" / "interface.py").read_text()
-    if '"tenant"' not in itext:
-        problems.append(
-            "datapath/interface._queue_cols does not produce the "
-            "'tenant' column")
-
-    # 2./3. call sites must pass tenant=.
-    for pattern, allow, why in (
-        ("_queue_cols", QUEUE_ALLOWLIST,
-         "queued rows would land in the default world"),
-        ("shard_of_tuples", SHARD_ALLOWLIST,
-         "two tenants' identical tuples would share one home"),
-    ):
-        for rel, line, call in _call_sites(pattern):
-            if rel in allow:
-                continue
-            if re.search(r"def\s+" + pattern, call):
-                continue
-            if "tenant=" not in call:
-                problems.append(
-                    f"{rel}:{line}: {pattern}(...) drops the tenant id "
-                    f"({why}) — pass tenant= or allowlist with a reason")
-
-    # 4. world-field coverage.
-    for rel, required in REQUIRED_WORLD_FIELDS.items():
-        try:
-            fields = set(_literal_tuple(REPO / "antrea_tpu" / rel,
-                                        "_TENANT_WORLD_FIELDS"))
-        except ValueError as e:
-            problems.append(str(e))
-            continue
-        for name in sorted(required - fields):
-            problems.append(
-                f"antrea_tpu/{rel}: _TENANT_WORLD_FIELDS is missing "
-                f"{name!r} — that state would leak across world swaps")
-
-    # 5. commit-plane slice.
-    tenancy = PKG / "datapath" / "tenancy.py"
-    try:
-        cw = set(_literal_tuple(tenancy, "COMMIT_WORLD_FIELDS"))
-    except ValueError as e:
-        problems.append(str(e))
-        cw = set()
-    for name in sorted(REQUIRED_COMMIT_FIELDS - cw):
-        problems.append(
-            f"datapath/tenancy.COMMIT_WORLD_FIELDS is missing {name!r} — "
-            f"a tenant rollback would not be tenant-scoped")
-    commit_text = (PKG / "datapath" / "commit.py").read_text()
-    for name in sorted(cw):
-        if not re.search(rf"self\.{name}\b", commit_text):
-            problems.append(
-                f"COMMIT_WORLD_FIELDS names {name!r} but CommitPlane has "
-                f"no such attribute — the swap would silently no-op")
-
-    # 6. tenant metric families render tenant-labeled.
-    mpath = PKG / "observability" / "metrics.py"
-    mtext = mpath.read_text()
-    m = re.search(r"^METRICS\s*(?::[^=]+)?=\s*(\{.*?^\})", mtext,
-                  re.M | re.S)
-    registry = ast.literal_eval(m.group(1)) if m else {}
-    tenant_fams = [n for n in registry
-                   if n.startswith("antrea_tpu_tenant_")
-                   and n != "antrea_tpu_tenant_worlds"]
-    if not tenant_fams:
-        problems.append(
-            "no antrea_tpu_tenant_* families in the metrics registry")
-    if "_labels(tenant=tid, node=node)" not in mtext:
-        problems.append(
-            "observability/metrics.py renders no tenant-labeled sample "
-            "lines (_labels(tenant=...)) — tenant meters would "
-            "aggregate worlds together")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(f"DRIFT: {p}")
-        return 1
-    print("tenant surfaces consistent: queue schema, admit/shard call "
-          "sites, world-field coverage, commit slice, tenant-labeled "
-          "metrics")
-    return 0
-
+from antrea_tpu.analysis import run_cli  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli("tenant", sys.argv[1:]))
