@@ -18,13 +18,15 @@ from .core import Finding, SourceCache, analysis_pass
 _PH_DEF = re.compile(r"^(PH_[A-Z0-9_]+)\s*=\s*(.+?)\s*(?:#.*)?$", re.M)
 _CHAIN = re.compile(
     r"^(PHASE_CHAIN|ASYNC_PHASE_CHAIN|OVERLAP_PHASE_CHAIN"
-    r"|MAINT_PHASE_CHAIN|PRUNE_PHASE_CHAIN)\s*:.*?=\s*\((.*?)^\)",
+    r"|MAINT_PHASE_CHAIN|PRUNE_PHASE_CHAIN|FUSED_PHASE_CHAIN)"
+    r"\s*:.*?=\s*\((.*?)^\)",
     re.M | re.S,
 )
 _ENTRY = re.compile(r'\(\s*"([a-z0-9_]+)"\s*,\s*([^)]*?)\s*\)', re.S)
 
 REQUIRED_CHAINS = ("PHASE_CHAIN", "ASYNC_PHASE_CHAIN", "OVERLAP_PHASE_CHAIN",
-                   "MAINT_PHASE_CHAIN", "PRUNE_PHASE_CHAIN")
+                   "MAINT_PHASE_CHAIN", "PRUNE_PHASE_CHAIN",
+                   "FUSED_PHASE_CHAIN")
 
 
 def parse_ph_bits(src: SourceCache) -> dict:

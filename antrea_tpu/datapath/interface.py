@@ -289,13 +289,18 @@ class Datapath(ABC):
                        miss_queue_slots: int, admission: str,
                        drain_batch: int, autotune_drain: bool = False,
                        autotune_bounds=None,
-                       overlap_commits: bool = False) -> None:
+                       overlap_commits: bool = False,
+                       miss_source_rate=None,
+                       miss_source_burst=None) -> None:
         """Constructor hook: validate + build the engine (async mode is
         v4-only for now, like profile() probes — the queue columns are
         narrow).  autotune_drain replaces the fixed drain_batch with the
         queue-pressure hysteresis controller (drain_batch seeds the
         starting rung); overlap_commits enables the two-slot deferred
-        drain-commit staging (the double-buffered churn datapath)."""
+        drain-commit staging (the double-buffered churn datapath);
+        miss_source_rate/_burst arm the per-source-/24 admission token
+        buckets (datapath/slowpath — the reference's per-category
+        rate-limited packet-in dispatchers, applied per source prefix)."""
         from ..config import ConfigError
 
         if async_slowpath and dual_stack:
@@ -310,6 +315,21 @@ class Datapath(ABC):
                 "synchronous datapath has no drain pipeline to overlap "
                 "or retune)"
             )
+        if (miss_source_rate is not None or miss_source_burst is not None):
+            if not async_slowpath:
+                raise ConfigError(
+                    "miss_source_rate/_burst configure the async "
+                    "slow-path admission; pass async_slowpath=True (the "
+                    "synchronous walk classifies every miss in-line, "
+                    "there is no admission to rate-limit)")
+            if miss_source_rate is None or miss_source_rate <= 0:
+                raise ConfigError(
+                    f"miss_source_rate must be a positive tokens/second "
+                    f"rate, got {miss_source_rate!r}")
+            if miss_source_burst is not None and miss_source_burst <= 0:
+                raise ConfigError(
+                    f"miss_source_burst must be positive, got "
+                    f"{miss_source_burst!r}")
         self._async = async_slowpath
         self._overlap = bool(overlap_commits)
         if async_slowpath:
@@ -318,6 +338,8 @@ class Datapath(ABC):
                 drain_batch=drain_batch, autotune=autotune_drain,
                 autotune_bounds=autotune_bounds,
                 overlap_commits=overlap_commits,
+                source_rate=miss_source_rate,
+                source_burst=miss_source_burst,
             )
 
     def _make_slowpath(self, **kw):

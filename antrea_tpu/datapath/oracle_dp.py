@@ -115,6 +115,10 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         realization_slots: int = 256,
         prune_budget: int = 0,
         autotune_prune: bool = False,
+        fused: bool = False,
+        second_chance: bool = False,
+        miss_source_rate=None,
+        miss_source_burst=None,
     ):
         from ..features import DEFAULT_GATES
 
@@ -142,11 +146,20 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
                 "autotune_prune retunes the aggregate-prune K budget, but "
                 "prune_budget=0 disables the aggregate layer — set an "
                 "initial prune_budget (e.g. 4) to autotune from")
+        # fused is inert on the scalar walk (there is no pallas kernel to
+        # fuse) but validated mode-for-mode with the kernel twin so the
+        # differential harness constructs both engines from one kwarg set.
+        if fused and dual_stack and prune_budget > 0:
+            raise ConfigError(
+                "the one-kernel fast path (fused=True with prune_budget "
+                "> 0) is v4-only; dual-stack instances use the staged "
+                "kernel (drop fused or prune_budget, or dual_stack)")
         if autotune_prune:
             from ..ops.match import PruneAutotuner
 
             prune_budget = PruneAutotuner(prune_budget).budget
         self._prune_budget = int(prune_budget)
+        self._fused = bool(fused)
         self._gates = feature_gates or DEFAULT_GATES
         self._dual_stack = dual_stack
         self._node_ips = list(node_ips or [])
@@ -158,7 +171,8 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         # stay diffable counter-for-counter.
         self._init_slowpath(async_slowpath, dual_stack, miss_queue_slots,
                             admission, drain_batch, autotune_drain,
-                            autotune_bounds, overlap_commits)
+                            autotune_bounds, overlap_commits,
+                            miss_source_rate, miss_source_burst)
         self._flow_stats = self._gates.enabled("FlowExporter")
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -178,6 +192,7 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
             node_ips=list(node_ips or []), node_name=node_name,
             dual_stack=dual_stack,
             count_flow_stats=self._gates.enabled("FlowExporter"),
+            second_chance=second_chance,
         )
         self._oracle = PipelineOracle(
             self._ps, self._services,
@@ -754,7 +769,8 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         no aggregate layer (its per-packet AND is already O(matched
         rules)), so its candidate-gather number IS its classify number —
         the honest twin statement, kept mode-for-mode."""
-        if mode not in ("sync", "async", "overlap", "maintenance", "prune"):
+        if mode not in ("sync", "async", "overlap", "maintenance", "prune",
+                        "fused"):
             raise ValueError(f"unknown profile mode {mode!r}")
         if mode == "prune" and self._prune_budget <= 0:
             # Twin-parity with TpuflowDatapath.profile: both engines
@@ -762,6 +778,20 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
             raise ValueError(
                 "profile(mode='prune') needs prune_budget > 0 "
                 "(the two-level kernel is compiled out at 0)")
+        if mode == "prune" and self._fused and self._prune_budget > 0:
+            # Twin-parity: a one-pass-capable instance serves the fused
+            # kernel — staged-prune labels would misattribute it.
+            raise ValueError(
+                "profile(mode='prune') attributes the STAGED pruned "
+                "kernel, but this instance serves the one-pass fast "
+                "path — use mode='fused' (or construct with "
+                "fused=False) for an honest attribution")
+        if mode == "fused" and not (self._fused and self._prune_budget > 0):
+            # Twin-parity: both engines refuse the mode unless the
+            # instance is one-pass-capable (fused + pruned).
+            raise ValueError(
+                "profile(mode='fused') needs the one-kernel fast path "
+                "(construct with fused=True and prune_budget > 0)")
         from ..models.pipeline import GEN_ETERNAL
 
         o = self._oracle
@@ -839,6 +869,14 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
                 "prune_candidate_gather": t_cls,
                 "prune_commit_residual": max(total - t_fast - t_cls, 0.0),
             }
+        elif mode == "fused":
+            # The scalar walk has no kernel to fuse: its classify time IS
+            # its one-pass time — the honest twin statement, mode-for-mode.
+            phases = {
+                "fused_fast_path": t_fast,
+                "fused_onepass": t_cls,
+                "fused_commit_residual": max(total - t_fast - t_cls, 0.0),
+            }
         else:
             phases = {
                 "fast_path": t_fast,
@@ -861,6 +899,9 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
             out["maintenance_fraction"] = t_maint / max(total, 1e-9)
         elif mode == "prune":
             out["mode"] = "prune"
+            out["prune_budget"] = self._prune_budget
+        elif mode == "fused":
+            out["mode"] = "fused"
             out["prune_budget"] = self._prune_budget
         return out
 

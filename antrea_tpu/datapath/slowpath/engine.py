@@ -117,6 +117,24 @@ EARLY_DROP_FLOOR = 0.5
 _EARLY_DROP_SALT = np.uint32(
     int.from_bytes(os.urandom(4), "little"))
 
+# Per-source rate limiting (round 8, ROADMAP item 4's slow-path half —
+# the reference's per-category rate-limited packet-in dispatchers,
+# agent/packetin.py, applied per SOURCE instead of per category): miss
+# ADMISSIONS are token-bucketed per source /24 (v4) BEFORE the
+# admission="drop" depth ramp, so one scanning source exhausts its own
+# bucket while everyone else's misses keep admitting at full rate even
+# when the aggregate queue is calm.  Buckets refill on the packet clock
+# the engine already observes (the maintenance scheduler's tick domain
+# — datapath/maintenance.py drives its clock from the same `now`), so
+# shedding is DETERMINISTIC: both engine twins shed the identical lanes
+# and verdict parity stays provable under gen_syn_flood.  A shed flow
+# keeps its provisional verdict and simply re-tries on its next miss.
+SOURCE_PREFIX_SHIFT = 8  # /24 aggregation of the v4 source address
+# Bucket-table bound: at the cap, buckets at full tokens (idle sources)
+# are evicted first — the active attackers' buckets are precisely the
+# non-full ones, so pressure can never wash out the limiter itself.
+SOURCE_BUCKET_CAP = 8192
+
 # Drain-batch sizes are packet counts, not seconds: dedicated bounds.
 _DRAIN_BOUNDS = (16, 64, 256, 1024, 4096, 16384, 65536)
 
@@ -201,6 +219,8 @@ class SlowPathEngine:
         autotune: bool = False,
         autotune_bounds: Optional[tuple[int, int]] = None,
         overlap_commits: bool = False,
+        source_rate: Optional[float] = None,
+        source_burst: Optional[int] = None,
     ):
         if admission not in (ADMIT_FORWARD, ADMIT_HOLD, ADMIT_DROP):
             raise ValueError(
@@ -222,6 +242,14 @@ class SlowPathEngine:
             self.drain_batch = int(drain_batch)
         self._overflows_seen = 0  # autotune: overflow delta baseline
         self.early_drops_total = 0  # admission="drop": shed admissions
+        # Per-source-/24 admission token buckets (None = disabled):
+        # prefix -> [tokens, last_refill] on the packet clock.
+        self.source_rate = None if source_rate is None else float(source_rate)
+        self.source_burst = (int(source_burst) if source_burst is not None
+                             else (None if source_rate is None
+                                   else max(1, int(2 * source_rate))))
+        self._source_buckets: dict[int, list] = {}
+        self.source_limited_total = 0  # admissions shed by a source bucket
         self.overlap = bool(overlap_commits)
         # Two-slot pending-commit ring: (finalize, staged packet-clock).
         self._staged: deque[tuple[Callable[[], None], int]] = deque()
@@ -289,6 +317,78 @@ class SlowPathEngine:
         self.early_drops_total += n
         return mask & ~shed, n
 
+    def _source_limit(self, cols: dict, mask: np.ndarray, now: int
+                      ) -> np.ndarray:
+        """Per-source-/24 token-bucket admission gate (see the module
+        constants) -> kept mask.  Deterministic on (batch order, now):
+        within a prefix, the earliest lanes take the tokens — both
+        engine twins therefore shed the identical lanes.  No-op when
+        miss_source_rate is unset."""
+        mask = np.asarray(mask, bool)
+        if self.source_rate is None or not mask.any():
+            return mask
+        src = np.asarray(cols["src_ip"]).astype(np.uint64)
+        pfx = (src >> SOURCE_PREFIX_SHIFT).astype(np.int64)
+        idx = np.nonzero(mask)[0]
+        kept = mask.copy()
+        now = int(now)
+        shed = 0
+        # Group miss lanes by prefix in O(M log M): a stable argsort of
+        # the unique-inverse keeps batch order WITHIN each prefix, so the
+        # earliest lanes still take the tokens (the determinism both
+        # twins rely on) without rescanning the miss set per prefix.
+        uniq, inv = np.unique(pfx[idx], return_inverse=True)
+        order = np.argsort(inv, kind="stable")
+        bounds = np.searchsorted(inv[order], np.arange(uniq.size + 1))
+        swept = False   # at most ONE idle sweep per batch (amortized)
+        stale = None    # lazy stalest-first order for the full-table case
+        for u, p in enumerate(uniq):
+            b = self._source_buckets.get(int(p))
+            if b is None:
+                if len(self._source_buckets) >= SOURCE_BUCKET_CAP:
+                    # Evict idle (full-token) buckets first: an evicted
+                    # idle bucket re-seeds at full burst, which is what
+                    # it held anyway.  One sweep per batch bounds the
+                    # host cost under a spoofed-prefix flood.
+                    if not swept:
+                        swept = True
+                        for key in [k for k, v in
+                                    self._source_buckets.items()
+                                    if v[0] >= self.source_burst]:
+                            self._source_buckets.pop(key)
+                    if len(self._source_buckets) >= SOURCE_BUCKET_CAP:
+                        # Every bucket is mid-interval: shed the ones
+                        # refilled longest ago (stalest prefixes — under
+                        # a flood, churned attack prefixes; an active
+                        # source loses at most its sub-burst deficit).
+                        # Rebuilt when exhausted: a batch can carry more
+                        # new prefixes than one snapshot holds.
+                        if not stale:
+                            stale = sorted(
+                                self._source_buckets,
+                                key=lambda k: (self._source_buckets[k][1],
+                                               k))
+                        self._source_buckets.pop(stale.pop(0))
+                b = self._source_buckets[int(p)] = [
+                    float(self.source_burst), now]
+            # Refill on the packet clock, clamped monotonic: a batch
+            # carrying an older `now` must neither drive tokens negative
+            # nor rewind the refill stamp (which would over-refill the
+            # next in-order batch).
+            dt = now - b[1]
+            if dt > 0:
+                b[0] = min(float(self.source_burst),
+                           b[0] + dt * self.source_rate)
+                b[1] = now
+            lanes = idx[order[bounds[u]:bounds[u + 1]]]
+            take = min(lanes.size, int(b[0]))
+            b[0] -= take
+            if take < lanes.size:
+                kept[lanes[take:]] = False
+                shed += lanes.size - take
+        self.source_limited_total += shed
+        return kept
+
     def admit(self, cols: dict, miss_mask, now: int) -> tuple[int, int]:
         """Admit the fast step's miss lanes -> (admitted, dropped)."""
         self._seen_now = max(self._seen_now, int(now))
@@ -297,7 +397,11 @@ class SlowPathEngine:
             # first one, anchor to the first traffic the engine sees so
             # the gauge reports time-since-birth, not the raw clock.
             self._published_at = int(now)
-        kept, _shed = self._early_drop(cols, miss_mask, self.queue)
+        # Per-source rate limiting runs AHEAD of the depth-proportional
+        # early-drop ramp: a single scanning source is clamped by its
+        # own bucket before it can push the shared queue into the ramp.
+        kept = self._source_limit(cols, miss_mask, now)
+        kept, _shed = self._early_drop(cols, kept, self.queue)
         admitted, dropped = self.queue.admit(cols, kept, self.epoch,
                                              int(now))
         if dropped:
@@ -485,6 +589,7 @@ class SlowPathEngine:
             "capacity": q.capacity,
             "admitted_total": q.admitted_total,
             "early_drops_total": self.early_drops_total,
+            "source_limited_total": self.source_limited_total,
             "overflows_total": q.overflows_total,
             "drained_total": q.drained_total,
             "drains_total": self.drains_total,

@@ -121,6 +121,9 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         realization_slots: int = 256,
         prune_budget: int = 0,
         autotune_prune: bool = False,
+        second_chance: bool = False,
+        miss_source_rate: Optional[float] = None,
+        miss_source_burst: Optional[int] = None,
     ):
         from ..features import DEFAULT_GATES
 
@@ -154,11 +157,25 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                 "autotune_prune retunes the aggregate-prune K budget, but "
                 "prune_budget=0 disables the aggregate layer — set an "
                 "initial prune_budget (e.g. 4) to autotune from")
+        # One-kernel fast path (round 8): fused=True over an aggregate-
+        # pruned (prune_budget > 0) v4 world upgrades the slow path to
+        # the one-pass pallas kernel (models/pipeline meta.onepass).
+        # fused without the aggregate layer keeps the staged consumer
+        # fusion — the kernel's prune stage IS the aggregate layer, so
+        # there is nothing to fuse it with; fused + dual_stack + pruning
+        # is rejected outright (the one-pass kernel is v4-only, like the
+        # async slow path), rather than silently downgrading.
+        if fused and dual_stack and prune_budget > 0:
+            raise ConfigError(
+                "the one-kernel fast path (fused=True with prune_budget "
+                "> 0) is v4-only; dual-stack instances use the staged "
+                "kernel (drop fused or prune_budget, or dual_stack)")
         self._prune_tuner = None
         if autotune_prune:
             self._prune_tuner = PruneAutotuner(prune_budget)
             prune_budget = self._prune_tuner.budget  # snap to the ladder
         self._prune_budget = int(prune_budget)
+        self._fused = bool(fused)
         self._prune_skips = 0
         self._prune_fallbacks = 0
         self._prune_classified = 0
@@ -188,7 +205,8 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         # batch N+1 dispatches before blocking on the commit of batch N.
         self._init_slowpath(async_slowpath, dual_stack, miss_queue_slots,
                             admission, drain_batch, autotune_drain,
-                            autotune_bounds, overlap_commits)
+                            autotune_bounds, overlap_commits,
+                            miss_source_rate, miss_source_burst)
         # Node identity: NodePort frontends bind to these addresses and
         # externalTrafficPolicy=Local filters endpoints to this node
         # (ref proxier.go nodePortAddresses / externalPolicyLocal).
@@ -204,8 +222,14 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             # Cache misses classify through the fused pallas consumer
             # (ops/match cold-path study) — the production switch for the
             # path bench.py measures; off by default so CPU-bound suites
-            # avoid interpret-mode pallas.
+            # avoid interpret-mode pallas.  With prune_budget > 0 this
+            # upgrades to the one-kernel fast path (round 8; the combo
+            # check above already rejected dual_stack).
             fused=fused,
+            # Thrash-resistant replacement (the 2-bit second-chance
+            # counter, models/pipeline CHANCE_SHIFT); off by default so
+            # the compiled step stays bit-identical.
+            second_chance=second_chance,
         )
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -1047,6 +1071,12 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             cls = pl.classify_batch(
                 self._drs, src_f, dst_f, proto, dport,
                 meta=self._meta.match,
+                # The canary certifies the SERVING consumer: a fused
+                # instance's probes walk the same pallas consumer the
+                # step kernel uses, not the shadow XLA path (the round-8
+                # discipline _pipeline_trace already applies for the
+                # dual-stack/audit walks below).
+                fused=self._meta.fused,
             )
             return np.asarray(cls["code"])
         o = pl._pipeline_trace(
@@ -1365,7 +1395,33 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                 raise ValueError(
                     "profile(mode='prune') needs prune_budget > 0 "
                     "(the two-level kernel is compiled out at 0)")
+            if self._meta.onepass:
+                # The chain's candidate-gather entry would silently
+                # measure the whole one-pass kernel (resolve + commit
+                # pack included) under staged-prune labels — the
+                # bench_profile --mode prune harness pins onepass=False
+                # for exactly this reason.
+                raise ValueError(
+                    "profile(mode='prune') attributes the STAGED pruned "
+                    "kernel, but this instance serves the one-pass fast "
+                    "path — use mode='fused' (or construct with "
+                    "fused=False) for an honest attribution")
             return prof.profile_churn_prune(
+                self._meta, self._state, self._drs, self._dsvc, hot, pool,
+                n_new=n_new, now0=now, gen=self._gen,
+                k_small=k_small, k_big=k_big, repeats=repeats,
+            )
+        if mode == "fused":
+            # One-kernel regime attribution (FUSED_PHASE_CHAIN): the
+            # async drain cadence over the one-pass meta — requires a
+            # fused + pruned instance (there is no one-pass kernel to
+            # attribute otherwise).
+            if not (self._meta.onepass):
+                raise ValueError(
+                    "profile(mode='fused') needs the one-kernel fast "
+                    "path (construct with fused=True and prune_budget "
+                    "> 0)")
+            return prof.profile_churn_fused(
                 self._meta, self._state, self._drs, self._dsvc, hot, pool,
                 n_new=n_new, now0=now, gen=self._gen,
                 k_small=k_small, k_big=k_big, repeats=repeats,
@@ -1549,6 +1605,13 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             fused=self._pipe_kw["fused"],
             key_words=10 if self._dual_stack else 4,
             count_flow_stats=self._flow_stats,
+            # Round 8: the one-pass kernel engages when the consumer
+            # fusion AND the aggregate layer are both on (v4 layout
+            # guaranteed by the constructor combo check).
+            onepass=bool(self._pipe_kw["fused"]
+                         and match_meta.prune_budget > 0
+                         and not self._dual_stack),
+            second_chance=bool(self._pipe_kw["second_chance"]),
         )
         # Async-mode step/drain variants of the meta: the FAST step masks
         # the whole slow path out (phases=0 — misses keep the admission

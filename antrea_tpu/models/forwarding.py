@@ -308,6 +308,7 @@ def _pipeline_step_full(
     v6=None,
     valid=None,
     no_commit=None,
+    prune_exclude=None,
 ):
     """Full per-packet walk: SpoofGuard/ARP -> (IGMP punt) -> policy/
     service pipeline -> forwarding -> Output; one jit, one dispatch.
@@ -372,6 +373,7 @@ def _pipeline_step_full(
         state, drs, dsvc, src_f, dst_f, proto, sport, dport, now, gen,
         meta=meta, hit_combine=hit_combine, valid=valid_l,
         no_commit=no_commit_l, flags=flags, v6=v6, lens=lens,
+        prune_exclude=prune_exclude,
     )
     code = jnp.where(spoof, ACT_DROP, out["code"]).astype(jnp.int32)
     # Forward toward the packet's effective destination: the DNAT-resolved

@@ -63,6 +63,7 @@ from ..apis.controlplane import PROTO_TCP
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT, CompiledPolicySet
 from ..compiler.services import ServiceTables
 from ..ops import hashing
+from ..ops import match as _m
 from ..ops.match import (PRUNE_HIST_BOUNDS, DeviceRuleSet, StaticMeta,
                          classify_batch, to_device, to_host)
 
@@ -88,6 +89,24 @@ REPLY_BIT = -(2**31)
 PREF_MASK = (1 << 29) - 1
 CONF_BIT = 1 << 29
 DSR_BIT = 1 << 30
+
+# Thrash-resistant replacement (round 8, opt-in via second_chance): a
+# 2-bit saturating collision counter in meta3 bits 27-28.  A live,
+# CONFIRMED (two-way-traffic) established entry survives a colliding
+# insert while its counter is below CHANCE_MAX — the challenger simply
+# stays uncached (cache semantics: it re-classifies on its next packet)
+# and the counter bumps once per commit pass; the entry's own next hit
+# resets it.  An ACTIVE established flow therefore cannot be evicted by
+# a gen_cache_thrash storm (its hits keep resetting the counter), while
+# an idle-but-unconfirmed or silent entry yields after CHANCE_MAX
+# collisions — bounded protection, never a wedged slot.  With the knob
+# on, the partner-refresh stamp narrows to bits 0-26 (mod-2^27 age
+# arithmetic, exact for any live entry); off (the default) keeps the
+# full PREF_MASK layout and the compiled step bit-identical.
+CHANCE_SHIFT = 27
+CHANCE_MAX = 3
+CHANCE_MASK = CHANCE_MAX << CHANCE_SHIFT
+PREF_MASK_CHANCE = (1 << CHANCE_SHIFT) - 1
 
 # REJECT synthesis kinds (ref pkg/agent/controller/networkpolicy/reject.go:
 # TCP gets an RST, everything else an ICMP port-unreachable).
@@ -318,6 +337,22 @@ class PipelineMeta(NamedTuple):
     # maintain_scan run only on epoch-stale heal.  Off (False) for
     # synchronous steps so their compiled program is unchanged.
     drain_reclaim: bool = False
+    # One-kernel fast path (round 8): the slow path runs as ONE pallas
+    # pass over the full batch (probe decode + aggregate prune +
+    # candidate DMA + first-match + resolve + commit-row packing in
+    # VMEM) instead of the chunked round loop — requires the aggregate
+    # layer (match.prune_budget > 0) and the narrow (v4) key layout.
+    # False keeps the staged program bit-identical.
+    onepass: bool = False
+    # Thrash-resistant replacement (the 2-bit second-chance counter, see
+    # CHANCE_SHIFT above).  False keeps the compiled step bit-identical.
+    second_chance: bool = False
+
+    @property
+    def pref_mask(self) -> int:
+        """Effective partner-refresh stamp mask: the second-chance
+        counter (bits 27-28) narrows it; off keeps the full layout."""
+        return PREF_MASK_CHANCE if self.second_chance else PREF_MASK
 
     @property
     def timeouts(self) -> tuple[int, int, int, int]:
@@ -451,6 +486,56 @@ def _scatter_last_rows(arr, slots, rows, mask, dump):
     return arr.at[jnp.where(is_winner, slots, dump)].set(rows)
 
 
+def _second_chance_guard(flow: FlowCache, slot2, keys2, ins2, now, meta, A,
+                         dump):
+    """Thrash-resistant replacement (meta.second_chance): suppress
+    inserts whose direct-mapped target is a LIVE, CONFIRMED established
+    entry still under its 2-bit collision budget, and bump that entry's
+    counter once per commit pass (winner-deduplicated).  The challenger
+    stays uncached — cache semantics, it re-classifies on its next
+    packet — so a gen_cache_thrash storm cannot evict an active
+    established flow on first collision.  -> (flow', ins2').
+
+    Known divergence (cache-topology observable, verdict-safe): the
+    chunked sync path runs one commit pass PER ROUND, so a step whose
+    misses span multiple miss_chunk rounds can bump a slot once per
+    round while the scalar twin bumps once per step — colliding
+    challengers in a later round may then evict an entry the oracle
+    keeps.  The evicted flow re-misses and re-classifies to the same
+    verdict (the PR 6 lost-update discipline); the one-pass kernel and
+    single-round passes match the oracle exactly."""
+    ZC = _meta_cols(A)[3]
+    tgt2 = jnp.where(ins2, slot2, dump)
+    okr = flow.keys[tgt2]
+    om3 = flow.meta[tgt2, ZC]
+    id3 = 0xFF | REPLY_BIT
+    tuple_differs = (
+        (okr[:, : A + 1] != keys2[:, : A + 1]).any(axis=1)
+        | ((okr[:, A + 1] & id3) != (keys2[:, A + 1] & id3))
+    )
+    ogen = (okr[:, A + 1] >> 9) & GEN_ETERNAL
+    otmo = entry_timeout((om3 >> 29) & 1, okr[:, A + 1] & 0xFF,
+                         meta.timeouts)
+    cnt = (om3 >> CHANCE_SHIFT) & CHANCE_MAX
+    protected = (
+        ins2
+        & (okr[:, A + 1] != 0)
+        & tuple_differs
+        & (ogen == GEN_ETERNAL)
+        & (((om3 >> 29) & 1) != 0)
+        & ((now - flow.ts[tgt2]) <= otmo)
+        & (cnt < CHANCE_MAX)
+    )
+    ins2 = ins2 & ~protected
+    # One counter bump per protected slot per pass.
+    win = _winner_mask(flow.keys.shape[0] - 1, slot2, protected, dump)
+    bt = jnp.where(win, slot2, dump)
+    cur = flow.meta[bt, ZC]
+    newc = jnp.minimum(((cur >> CHANCE_SHIFT) & CHANCE_MAX) + 1, CHANCE_MAX)
+    meta_col = (cur & ~CHANCE_MASK) | (newc << CHANCE_SHIFT)
+    return flow._replace(meta=flow.meta.at[bt, ZC].set(meta_col)), ins2
+
+
 def _pack_meta1(code, svc_idx, dnat_port):
     return code | ((svc_idx + 1) << 2) | (dnat_port << 16)
 
@@ -472,6 +557,42 @@ def _pack_rules(rule_in, rule_out):
 
 def _unpack_rules(rp):
     return (rp & 0xFFFF) - 1, ((rp >> 16) & 0xFFFF) - 1
+
+
+def _fused_pack_rows(src_f, dst_f, proto, sport, dport, pp, f_code, svc_idx,
+                     dnat_ip, dnat_port, snat_m, dsr_m, f_ri, f_ro,
+                     miss_m, nc_m, now, gen_w, n_slots, pmask):
+    """XLA twin of the one-pass kernel's commit-row packing (round 8):
+    the same _pack_meta1/_pack_rules/flow-hash formulas, producing the
+    interleave-ready forward + reply rows for a set of lanes.  Used by
+    the rule-sharded one-pass (rows pack post-pmin) and the fallback-
+    lane override; the in-kernel pack mirrors it field for field
+    (parity-pinned by tests/test_match_fused.py).  -> dict(committed,
+    ins, rev_ins, rev_slot, keys8, meta8)."""
+    committed = miss_m & (f_code == ACT_ALLOW) & ~nc_m
+    ins = miss_m & ~nc_m
+    rev_ins = ins & committed & (dsr_m == 0)
+    egen = jnp.where(committed, GEN_ETERNAL, gen_w)
+    pg_ins = proto | 0x100 | (egen << 9)
+    m1 = _pack_meta1(f_code, svc_idx, dnat_port)
+    rules_p = _pack_rules(f_ri, f_ro)
+    pref_col = jnp.zeros_like(proto) + (now & pmask)
+    zcol = (pref_col
+            | jnp.where(snat_m > 0, REPLY_BIT, 0)
+            | jnp.where(dsr_m > 0, DSR_BIT, 0))
+    rev_h = hashing.flow_hash(_raw_bits(dnat_ip), _raw_bits(src_f), proto,
+                              dnat_port, sport, xp=jnp)
+    rev_slot = (rev_h & jnp.uint32(n_slots - 1)).astype(jnp.int32)
+    rev_pg = proto | 0x100 | (GEN_ETERNAL << 9) | REPLY_BIT
+    keys8 = jnp.stack(
+        [src_f, dst_f, pp, pg_ins,
+         dnat_ip, src_f, (dnat_port << 16) | sport, rev_pg], axis=1)
+    meta8 = jnp.stack(
+        [dnat_ip, m1, rules_p, zcol,
+         dst_f, _pack_meta1(f_code, svc_idx, dport), rules_p, pref_col],
+        axis=1)
+    return dict(committed=committed, ins=ins, rev_ins=rev_ins,
+                rev_slot=rev_slot, keys8=keys8, meta8=meta8)
 
 
 class PolicyCapacityError(ValueError):
@@ -514,6 +635,8 @@ def make_pipeline(
     dual_stack: bool = False,
     count_flow_stats: bool = False,
     prune_budget: int = 0,
+    second_chance: bool = False,
+    onepass: Optional[bool] = None,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -547,6 +670,14 @@ def make_pipeline(
         fused=fused,
         key_words=10 if dual_stack else 4,
         count_flow_stats=count_flow_stats,
+        # fused=True over an aggregate-pruned v4 world upgrades to the
+        # one-kernel fast path (round 8); fused without the aggregate
+        # layer (or with wide keys) keeps the staged consumer fusion.
+        # An explicit onepass=False pins the staged kernel (the
+        # bench_profile --mode prune regime); onepass=True demands it.
+        onepass=(bool(fused and prune_budget > 0 and not dual_stack)
+                 if onepass is None else bool(onepass)),
+        second_chance=second_chance,
     )
     state = init_state(flow_slots, aff_slots, xp=np if host else jnp,
                        key_words=meta.key_words)
@@ -726,10 +857,12 @@ def _cache_lookup(flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta):
     dst_f]) in v4-only worlds, A=8 (wide word form) in dual-stack worlds;
     key rows are [addr..., pp, pg].
 
-    -> (hit, est, rpl, meta_row (B,4)) where meta_row is the gathered meta
-    rows.  rpl flags reply-direction (reverse-tuple) hits: their meta row
-    carries the un-DNAT rewrite (original service frontend ip/port) instead
-    of a DNAT resolution.
+    -> (hit, est, rpl, meta_row (B,4), key_row, ts_col) where meta_row/
+    key_row/ts_col are the gathered cache rows (the one-pass kernel
+    re-derives the probe from the SAME gathered rows, so the two probe
+    decodes cannot diverge).  rpl flags reply-direction (reverse-tuple)
+    hits: their meta row carries the un-DNAT rewrite (original service
+    frontend ip/port) instead of a DNAT resolution.
 
     Freshness is per-state (entry_timeout): half-open TCP and non-TCP
     entries can carry shorter lifetimes than confirmed connections.  With
@@ -755,7 +888,7 @@ def _cache_lookup(flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta):
     hit = key_hit & fresh
     est = hit & ((kpg == pg_est) | (kpg == pg_rpl))
     rpl = hit & (kpg == pg_rpl)
-    return hit, est, rpl, mr
+    return hit, est, rpl, mr, kr, flow.ts[slot]
 
 
 def _pipeline_step(
@@ -777,6 +910,7 @@ def _pipeline_step(
     flags=None,
     v6=None,
     lens=None,
+    prune_exclude=None,
 ):
     flow, aff = state.flow, state.aff
     B = src_f.shape[0]
@@ -784,6 +918,10 @@ def _pipeline_step(
     M = meta.miss_chunk
     dump = N
     A = meta.key_words - 2  # address columns: 2 (v4) / 8 (dual-stack wide)
+    if meta.onepass and (A != 2 or meta.match.prune_budget <= 0):
+        raise ValueError(
+            "the one-kernel fast path (onepass) requires the narrow v4 "
+            "key layout and an aggregate-pruned meta (prune_budget > 0)")
 
     src_raw = _raw_bits(src_f)
     dst_raw = _raw_bits(dst_f)
@@ -817,7 +955,7 @@ def _pipeline_step(
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    hit, est, rpl, mr = _cache_lookup(
+    hit, est, rpl, mr, kr0, ts0 = _cache_lookup(
         flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta
     )
     if valid is not None:
@@ -838,6 +976,15 @@ def _pipeline_step(
 
     # Idle-timeout refresh for hits.
     flow = flow._replace(ts=flow.ts.at[jnp.where(hit, slot, dump)].set(now))
+
+    if meta.second_chance:
+        # Second-chance reset: a hit is the entry's "referenced" event —
+        # clear the 2-bit collision counter so active flows keep their
+        # protection (the CLOCK-algorithm reference bit, see CHANCE_SHIFT).
+        ZC_ = _meta_cols(A)[3]
+        tgt_h = jnp.where(hit, slot, dump)
+        flow = flow._replace(meta=flow.meta.at[tgt_h, ZC_].set(
+            flow.meta[tgt_h, ZC_] & ~CHANCE_MASK))
 
     if meta.count_flow_stats:
         # Per-direction traffic counters (conntrack OriginalPackets/
@@ -886,11 +1033,13 @@ def _pipeline_step(
     #   fwd est hit:  partner = reply entry (dnat_ip, src, dnat_port, sport)
     #   reply hit:    partner = fwd entry (dst=client, frontend ip/port)
     p_half = max(1, meta.ct_timeout_s // 2)
-    c_pref = mr[:, ZC] & PREF_MASK  # strip the cached snat/dsr bits
+    pmask = meta.pref_mask
+    c_pref = mr[:, ZC] & pmask  # strip the cached snat/dsr(/chance) bits
     # Age in mod-2^29 arithmetic (PREF_MASK; bits 0-28 carry pref, bit 29
-    # is CONFIRMED in the meta3 layout): exact whenever the true age
-    # < 2^29 s, which the idle timeout guarantees for any live entry.
-    p_need = est & (((now - c_pref) & PREF_MASK) >= p_half)
+    # is CONFIRMED in the meta3 layout; under second_chance the stamp
+    # narrows to bits 0-26): exact whenever the true age < the mask
+    # width, which the idle timeout guarantees for any live entry.
+    p_need = est & (((now - c_pref) & pmask) >= p_half)
 
     def partner_probe(keys, mask):
         """Derive each lane's PARTNER tuple (the other conntrack direction
@@ -934,13 +1083,25 @@ def _pipeline_step(
 
     def partner_refresh(flow):
         p_slot, p_live = partner_probe(flow.keys, p_need)
+        if meta.second_chance:
+            # Read the CURRENT meta for the preserved high bits: the
+            # hit-path reset above already cleared the chance counter on
+            # this very slot, and re-stamping from the start-of-batch
+            # snapshot would resurrect it.
+            tgt_p = jnp.where(p_need, slot, dump)
+            return flow._replace(
+                ts=flow.ts.at[jnp.where(p_live, p_slot, dump)].set(now),
+                meta=flow.meta.at[tgt_p, ZC].set(
+                    (now & pmask) | (flow.meta[tgt_p, ZC] & ~pmask)
+                ),
+            )
         return flow._replace(
             ts=flow.ts.at[jnp.where(p_live, p_slot, dump)].set(now),
             # Attempt-time update even when the partner is gone, so an
             # evicted partner doesn't drag the walk into every batch.
             # Preserve the cached snat/dsr bits alongside the new stamp.
             meta=flow.meta.at[jnp.where(p_need, slot, dump), ZC].set(
-                (now & PREF_MASK) | (mr[:, ZC] & ~PREF_MASK)
+                (now & pmask) | (mr[:, ZC] & ~pmask)
             ),
         )
 
@@ -1160,12 +1321,20 @@ def _pipeline_step(
             if prune_on and cls is not None:
                 # Prune observability (valid lanes only — padding lanes
                 # classify garbage tuples and must not meter).
-                pr_sk = pr_sk + (cls["prune_skip"] & valid).sum(
+                # prune_exclude (round 8): lanes another dispatch owns
+                # the evidence for — the mesh's spilled lanes, whose
+                # HOME-routed retry re-walks them — are excluded here so
+                # the PruneAutotuner band sees each lane's SERVING walk
+                # exactly once (parallel/meshpath._spill_retry).
+                pv = valid
+                if prune_exclude is not None:
+                    pv = pv & ~prune_exclude[safe]
+                pr_sk = pr_sk + (cls["prune_skip"] & pv).sum(
                     dtype=jnp.int32)
-                pr_fb = pr_fb + (cls["prune_fb"] & valid).sum(
+                pr_fb = pr_fb + (cls["prune_fb"] & pv).sum(
                     dtype=jnp.int32)
                 pr_hist = pr_hist + _prune_bucket_counts(
-                    cls["prune_cand"], valid)
+                    cls["prune_cand"], pv)
 
             # no_commit lanes (multicast dst — the reference's multicast
             # pipeline bypasses conntrack entirely, pkg/agent/openflow/
@@ -1206,7 +1375,7 @@ def _pipeline_step(
                 # freshens both directions; the frontend SNAT mark and the
                 # DSR delivery mark are pinned here for the connection's
                 # lifetime).
-                pref_col = jnp.full((M,), now & PREF_MASK, jnp.int32)
+                pref_col = jnp.full((M,), now & pmask, jnp.int32)
                 zcol = (pref_col
                         | jnp.where(snat_m > 0, REPLY_BIT, 0)
                         | jnp.where(dsr_m > 0, DSR_BIT, 0))
@@ -1287,6 +1456,10 @@ def _pipeline_step(
                 meta2 = jnp.stack([meta_rows, rev_meta], axis=1).reshape(
                     2 * M, MC)
                 ins2 = jnp.stack([ins, rev_ins], axis=1).reshape(2 * M)
+
+                if meta.second_chance:
+                    flow, ins2 = _second_chance_guard(
+                        flow, slot2, keys2, ins2, now, meta, A, dump)
 
                 if meta.phases & PH_EVICT:
                     # Eviction accounting (round-2 verdict weak #5:
@@ -1403,6 +1576,426 @@ def _pipeline_step(
                            out_snat, out_dsr, n_evict, n_reclaim) + tuple(
                            carry[14:14 + n_extra])
 
+    def slow_onepass(args):
+        """Round-8 one-kernel slow path (meta.onepass): the whole miss
+        walk — probe decode, aggregate prune, candidate DMA, first
+        match, resolve, commit-row packing — runs as ONE pallas pass
+        over the full batch (ops/match._onepass_call) instead of the
+        chunked round loop; only the gathers feeding it, the fallback
+        redispatch and the commit scatters remain XLA (the study-note
+        walls: gather/scatter engines are XLA-only on this toolchain).
+        v4 + prune_budget > 0 only (make_pipeline gates)."""
+        flow, aff, outs = args
+        (out_code0, out_svc0, out_dnat0, out_dport0, out_ri0, out_ro0,
+         out_cmt0, out_snat0, out_dsr0, n_evict, n_reclaim) = outs[:11]
+        pr_sk0, pr_fb0, pr_hist0 = outs[11:14]
+        aff_snap = aff
+        validm = jnp.ones(B, bool) if valid is None else (valid != 0)
+        ncm = (jnp.zeros(B, bool) if no_commit is None
+               else (no_commit != 0))
+        z = jnp.zeros(B, jnp.int32)
+        BIGS = jnp.full((B,), _m.BIG, jnp.int32)
+
+        # ---- ServiceLB over the full batch (PH_LB) --------------------
+        if meta.phases & PH_LB:
+            (svc_idx, no_ep, dnat_ip, dnat_port, snat_m, dsr_m, _dw,
+             learn) = _service_lb(aff_snap, dsvc, h, src_f, dst_f, proto,
+                                  dport, now, meta.aff_slots)
+        else:
+            svc_idx = jnp.full((B,), MISS, jnp.int32)
+            no_ep = jnp.zeros((B,), bool)
+            dnat_ip, dnat_port = dst_f, dport
+            snat_m = dsr_m = z
+            learn = {"mask": jnp.zeros((B,), bool), "aslot": z,
+                     "client": src_f, "svc": svc_idx, "ep": z}
+
+        # ---- classification probes on the POST-DNAT tuple -------------
+        ing, eg = drs.ingress, drs.egress
+        svc_key = (proto << 16) | dnat_port
+        sref = _svc_ref_of(svc_idx, dsvc) if meta.match.svcref else None
+
+        def midx(tab, x):
+            # Miss-masked interval rows: hit/invalid lanes gather the hot
+            # row 0 (the steady-state volume guard) and spawn nothing.
+            return jnp.where(miss, _m._dim_index(tab, x, None, None), 0)
+
+        iv6 = (midx(ing.at, dnat_ip), midx(ing.peer, src_f),
+               midx(ing.svc, svc_key), midx(eg.at, src_f),
+               midx(eg.peer, dnat_ip), midx(eg.svc, svc_key))
+        iv_ref = (midx(eg.svc, _m._svcref_key(svc_key, sref))
+                  if meta.match.svcref else z)
+        iso_in = drs.iso_in.val[midx(drs.iso_in, dnat_ip)]
+        iso_out = drs.iso_out.val[midx(drs.iso_out, src_f)]
+
+        d = drs.ip_delta if meta.match.delta_slots > 0 else None
+        delta_fb = jnp.zeros(B, bool)
+        if d is not None:
+            iso_in = _m._patch_iso(iso_in, dnat_ip, d, 0)
+            iso_out = _m._patch_iso(iso_out, src_f, d, 1)
+
+        aggs = [ing.at.agg[iv6[0]], ing.peer.agg[iv6[1]],
+                ing.svc.agg[iv6[2]], eg.at.agg[iv6[3]],
+                eg.peer.agg[iv6[4]], eg.svc.agg[iv6[5]]]
+        if meta.match.svcref:
+            aggs[5] = aggs[5] | eg.svc.agg[iv_ref]
+        if d is not None:
+            aggs[0] = _m._patch_agg(aggs[0], dnat_ip, d, d.at_in)
+            aggs[1] = _m._patch_agg(aggs[1], src_f, d, d.peer_in)
+            aggs[3] = _m._patch_agg(aggs[3], src_f, d, d.at_out)
+            aggs[4] = _m._patch_agg(aggs[4], dnat_ip, d, d.peer_out)
+
+            # Delta-affected lanes force the full-width fallback: SET
+            # slots are conservative in the aggregate (patched above),
+            # but CLEAR slots only resolve at full precision — the
+            # candidate words the kernel DMAs are unpatched, so a lane a
+            # pending delta touches must never trust them (exactness
+            # before speed; deltas are the rare between-recompiles case).
+            def dfb(i, acc):
+                return (acc | _m._delta_lane_match(src_f, d, i, None)
+                        | _m._delta_lane_match(dnat_ip, d, i, None))
+
+            delta_fb = jax.lax.fori_loop(0, d.n, dfb, delta_fb)
+
+        K = meta.match.prune_budget
+        sharded = hit_combine is not None
+        resolve = not sharded
+        if meta.match.fused_interpret is not None:
+            interp = meta.match.fused_interpret
+        else:
+            interp = jax.devices()[0].platform == "cpu"
+        s_in = aggs[0].shape[1]
+        s_out = aggs[3].shape[1]
+        w0i = ing.word_idx[0]
+        w0o = eg.word_idx[0]
+        run_kernel = bool(meta.phases & PH_CLS)
+        summary = (not run_kernel) and bool(meta.phases & PH_CLS_SUM)
+
+        def full_hits(safe):
+            """Full-width (exact) re-walk of compacted fallback lanes —
+            the `_classify_pruned` fallback discipline, delta patches
+            applied at full precision."""
+            ra = ing.at.inc[iv6[0][safe]]
+            rp = ing.peer.inc[iv6[1][safe]]
+            rs = ing.svc.inc[iv6[2][safe]]
+            oa = eg.at.inc[iv6[3][safe]]
+            opr = eg.peer.inc[iv6[4][safe]]
+            osv = eg.svc.inc[iv6[5][safe]]
+            if meta.match.svcref:
+                osv = osv | eg.svc.inc[iv_ref[safe]]
+            if d is not None:
+                ra = _m._patch_rows(ra, dnat_ip[safe], d, d.at_in)
+                rp = _m._patch_rows(rp, src_f[safe], d, d.peer_in)
+                oa = _m._patch_rows(oa, src_f[safe], d, d.at_out)
+                opr = _m._patch_rows(opr, dnat_ip[safe], d, d.peer_out)
+            return (_m._phase_hits(ra & rp & rs, ing.word_idx,
+                                   meta.match.in_phases)
+                    + _m._phase_hits(oa & opr & osv, eg.word_idx,
+                                     meta.match.out_phases))
+
+        def fb_switch(fbb, carried, fixup):
+            """Pow2-rung compacted redispatch of the fallback lanes (the
+            in-jit _spill_retry shape shared with _classify_pruned)."""
+            fb_idx = jnp.nonzero(fbb, size=B, fill_value=B)[0].astype(
+                jnp.int32)
+            n_fb = fbb.sum(dtype=jnp.int32)
+            rungs = []
+            r = _m._FB_MIN
+            while r < B:
+                rungs.append(r)
+                r *= 4
+            rungs = sorted(set(min(x, B) for x in rungs + [B]))
+
+            def apply_rung(r):
+                def go(c):
+                    idx = fb_idx[:r]
+                    safe = jnp.minimum(idx, B - 1)
+                    tgt = jnp.where(idx < B, idx, B)
+                    return fixup(c, safe, tgt)
+
+                return go
+
+            branches = [lambda c: c] + [apply_rung(r) for r in rungs]
+            sel = jnp.where(
+                n_fb == 0, 0,
+                1 + sum(((n_fb > r).astype(jnp.int32)
+                         for r in rungs[:-1]), start=jnp.int32(0)))
+            return jax.lax.switch(sel, branches, carried)
+
+        def resolve_fresh(hits6, iso_i, iso_o, noep):
+            """Shared hit->fresh-image resolution (the slow-path verdict
+            overlay: SvcReject precedes the policy tables)."""
+            in_code, in_rule = _m._resolve(ing.action, hits6[:3], iso_i)
+            out_code, out_rule = _m._resolve(eg.action, hits6[3:], iso_o)
+            cls_code = jnp.where(out_code != ACT_ALLOW, out_code, in_code)
+            f_code = jnp.where(noep, ACT_REJECT, cls_code).astype(jnp.int32)
+            f_ri = jnp.where(noep, MISS, in_rule)
+            f_ro = jnp.where(noep, MISS, out_rule)
+            return f_code, f_ri, f_ro
+
+        # Cached-image decode (start-of-batch rows — the merge source).
+        c_code, c_svc, c_dport = _unpack_meta1(mr[:, 1])
+        c_dnat = mr[:, 0]
+        c_ri, c_ro = _unpack_rules(mr[:, 2])
+        c_snat_b = (mr[:, 3] >> 31) & 1
+        c_dsr_b = (mr[:, 3] >> 30) & 1
+
+        def merged_images(f_code, f_ri, f_ro):
+            o_code = jnp.where(hit, c_code,
+                               jnp.where(miss, f_code, ACT_ALLOW))
+            o_svc = jnp.where(hit, c_svc, jnp.where(miss, svc_idx, MISS))
+            o_dnat = jnp.where(hit, c_dnat, jnp.where(miss, dnat_ip, dst_f))
+            o_dport = jnp.where(hit, c_dport,
+                                jnp.where(miss, dnat_port, dport))
+            o_ri = jnp.where(hit, c_ri, jnp.where(miss, f_ri, MISS))
+            o_ro = jnp.where(hit, c_ro, jnp.where(miss, f_ro, MISS))
+            o_snat = jnp.where(hit & ~rpl, c_snat_b,
+                               jnp.where(miss, snat_m, 0))
+            o_dsr = jnp.where(hit & ~rpl, c_dsr_b,
+                              jnp.where(miss, dsr_m, 0))
+            return (o_code, o_svc, o_dnat, o_dport, o_ri, o_ro, o_snat,
+                    o_dsr)
+
+        skipv = z
+        fbv = z
+        candv = z
+        if run_kernel:
+            # ---- the one-pass kernel --------------------------------------
+            pad = (-B) % _m._FUSE_TB
+
+            def padr(x):
+                if not pad:
+                    return x
+                return jnp.pad(x, ((0, pad), (0, 0)))
+
+            pkt = padr(jnp.stack(
+                [src_f, dst_f, proto, sport, dport, pp, z, z], axis=1))
+            prb = padr(jnp.stack([ts0, iso_in, iso_out, z], axis=1))
+            mskm = padr(jnp.stack(
+                [validm.astype(jnp.int32), ncm.astype(jnp.int32),
+                 delta_fb.astype(jnp.int32), z], axis=1))
+            lbm = padr(jnp.stack(
+                [svc_idx, no_ep.astype(jnp.int32), dnat_ip, dnat_port,
+                 snat_m, dsr_m, z, z], axis=1))
+            ivm = padr(jnp.stack(list(iv6) + [iv_ref, z], axis=1))
+            scal = jnp.stack([
+                jnp.asarray(now, jnp.int32), gen_w,
+                jnp.asarray(w0i, jnp.int32), jnp.asarray(w0o, jnp.int32),
+            ]).reshape(1, 4)
+            inc_tabs = (ing.at, ing.peer, ing.svc, eg.at, eg.peer, eg.svc)
+            inc2 = [t.inc.reshape(-1, _m.AGG_BLOCK) for t in inc_tabs]
+            if meta.match.svcref:
+                inc2.append(eg.svc.inc.reshape(-1, _m.AGG_BLOCK))
+            acts = (ing.action, eg.action) if resolve else ()
+            call = _m._onepass_call(
+                B + pad, s_in, s_out, K, K, meta.match.in_phases,
+                meta.match.out_phases, meta.match.svcref, resolve,
+                meta.timeouts, N, pmask, interp)
+            res = call(pkt, padr(kr0), prb, padr(mr), mskm, lbm,
+                       *[padr(a) for a in aggs], ivm, scal, *inc2, *acts)
+            res = [x[:B] for x in res]
+            if resolve:
+                main, keys8, meta8, aux = res
+                o_code, o_ri, o_ro = main[:, 0], main[:, 1], main[:, 2]
+                o_svc, o_dnat, o_dport = main[:, 3], main[:, 4], main[:, 5]
+                o_snat, o_dsr = main[:, 6], main[:, 7]
+                committed = main[:, 8] != 0
+                rev_ins = main[:, 9] != 0
+                rev_slot = main[:, 10]
+                ins = main[:, 14] != 0
+                skipv, fbv, candv = aux[:, 0], aux[:, 1], aux[:, 2]
+
+                def fix_resolve(c, safe, tgt):
+                    (o_code, o_ri, o_ro, committed, rev_ins, keys8,
+                     meta8) = c
+                    h6 = full_hits(safe)
+                    f_code, f_ri, f_ro = resolve_fresh(
+                        h6, iso_in[safe], iso_out[safe], no_ep[safe])
+                    rows = _fused_pack_rows(
+                        src_f[safe], dst_f[safe], proto[safe], sport[safe],
+                        dport[safe], pp[safe], f_code, svc_idx[safe],
+                        dnat_ip[safe], dnat_port[safe], snat_m[safe],
+                        dsr_m[safe], f_ri, f_ro, miss[safe], ncm[safe],
+                        now, gen_w, N, pmask)
+                    return (
+                        o_code.at[tgt].set(f_code, mode="drop"),
+                        o_ri.at[tgt].set(f_ri, mode="drop"),
+                        o_ro.at[tgt].set(f_ro, mode="drop"),
+                        committed.at[tgt].set(rows["committed"],
+                                              mode="drop"),
+                        rev_ins.at[tgt].set(rows["rev_ins"], mode="drop"),
+                        keys8.at[tgt].set(rows["keys8"], mode="drop"),
+                        meta8.at[tgt].set(rows["meta8"], mode="drop"),
+                    )
+
+                (o_code, o_ri, o_ro, committed, rev_ins, keys8,
+                 meta8) = fb_switch(
+                    fbv > 0,
+                    (o_code, o_ri, o_ro, committed, rev_ins, keys8, meta8),
+                    fix_resolve)
+                images = (o_code, o_svc, o_dnat, o_dport, o_ri, o_ro,
+                          o_snat, o_dsr)
+                rows = dict(committed=committed, ins=ins, rev_ins=rev_ins,
+                            rev_slot=rev_slot, keys8=keys8, meta8=meta8)
+            else:
+                hits8, aux = res
+                hits6 = tuple(hits8[:, i] for i in range(6))
+
+                def fix_hits(c, safe, tgt):
+                    h6 = full_hits(safe)
+                    return tuple(
+                        cur.at[tgt].set(new, mode="drop")
+                        for cur, new in zip(c, h6))
+
+                hits6 = fb_switch(aux[:, 1] > 0, hits6, fix_hits)
+                in_hits = tuple(hit_combine(x) for x in hits6[:3])
+                out_hits = tuple(hit_combine(x) for x in hits6[3:])
+                # Shard-local prune observables -> the replicated view
+                # (the _classify_pruned min-combine discipline).
+                skipv = hit_combine(aux[:, 0])
+                fbv = 1 - hit_combine(1 - aux[:, 1])
+                candv = -hit_combine(-aux[:, 2])
+                f_code, f_ri, f_ro = resolve_fresh(
+                    in_hits + out_hits, iso_in, iso_out, no_ep)
+                images = merged_images(f_code, f_ri, f_ro)
+                rows = _fused_pack_rows(
+                    src_f, dst_f, proto, sport, dport, pp, f_code, svc_idx,
+                    dnat_ip, dnat_port, snat_m, dsr_m, f_ri, f_ro, miss,
+                    ncm, now, gen_w, N, pmask)
+        else:
+            if summary:
+                # PH_CLS_SUM tier: aggregate AND + short-circuit only —
+                # live lanes take the default-verdict image (the
+                # profiling surface, never a production path).
+                g_in = aggs[0] & aggs[1] & aggs[2]
+                g_out = aggs[3] & aggs[4] & aggs[5]
+                nc_in = jnp.where(miss, (g_in != jnp.uint32(0)).sum(
+                    axis=1, dtype=jnp.int32), 0)
+                nc_out = jnp.where(miss, (g_out != jnp.uint32(0)).sum(
+                    axis=1, dtype=jnp.int32), 0)
+                skipv = (miss & (nc_in == 0) & (nc_out == 0)).astype(
+                    jnp.int32)
+                candv = jnp.maximum(nc_in, nc_out)
+                if hit_combine is not None:
+                    skipv = hit_combine(skipv)
+                    candv = -hit_combine(-candv)
+            f_code, f_ri, f_ro = resolve_fresh(
+                (BIGS,) * 6, iso_in, iso_out, no_ep)
+            if not summary:
+                # Neither classify bit: the staged default-allow image.
+                f_code = jnp.where(no_ep, ACT_REJECT, ACT_ALLOW).astype(
+                    jnp.int32)
+                f_ri = jnp.full((B,), MISS, jnp.int32)
+                f_ro = jnp.full((B,), MISS, jnp.int32)
+            images = merged_images(f_code, f_ri, f_ro)
+            rows = _fused_pack_rows(
+                src_f, dst_f, proto, sport, dport, pp, f_code, svc_idx,
+                dnat_ip, dnat_port, snat_m, dsr_m, f_ri, f_ro, miss, ncm,
+                now, gen_w, N, pmask)
+
+        (o_code, o_svc, o_dnat, o_dport, o_ri, o_ro, o_snat,
+         o_dsr) = images
+        committed = rows["committed"]
+        ins = rows["ins"]
+        rev_ins = rows["rev_ins"]
+        rev_slot = rows["rev_slot"]
+        keys8 = rows["keys8"]
+        meta8 = rows["meta8"]
+
+        # ---- prune observability (exactly-once per lane; the mesh's
+        # spilled lanes are excluded — their home retry owns the evidence).
+        pv = validm if prune_exclude is None else (validm & ~prune_exclude)
+        pr_sk = pr_sk0 + ((skipv > 0) & pv).sum(dtype=jnp.int32)
+        pr_fb = pr_fb0 + ((fbv > 0) & pv).sum(dtype=jnp.int32)
+        if run_kernel or summary:
+            pr_hist = pr_hist0 + _prune_bucket_counts(candv, miss & pv)
+        else:
+            pr_hist = pr_hist0
+
+        # ---- commit: interleaved [fwd, rev] scatters off the packed rows
+        if meta.phases & PH_COMMIT:
+            slot2 = jnp.stack([slot, rev_slot], axis=1).reshape(2 * B)
+            keys2 = jnp.stack([keys8[:, :4], keys8[:, 4:]],
+                              axis=1).reshape(2 * B, 4)
+            meta2 = jnp.stack([meta8[:, :4], meta8[:, 4:]],
+                              axis=1).reshape(2 * B, 4)
+            ins2 = jnp.stack([ins, rev_ins], axis=1).reshape(2 * B)
+
+            if meta.second_chance:
+                flow, ins2 = _second_chance_guard(
+                    flow, slot2, keys2, ins2, now, meta, A, dump)
+
+            if meta.phases & PH_EVICT:
+                tgt2 = jnp.where(ins2, slot2, dump)
+                okr = flow.keys[tgt2]
+                id3 = 0xFF | REPLY_BIT
+                tuple_differs = (
+                    (okr[:, : A + 1] != keys2[:, : A + 1]).any(axis=1)
+                    | ((okr[:, A + 1] & id3) != (keys2[:, A + 1] & id3))
+                )
+                overwrote = ins2 & (okr[:, A + 1] != 0) & tuple_differs
+                if meta.drain_reclaim:
+                    om3 = flow.meta[tgt2, 3]
+                    otmo = entry_timeout(
+                        (om3 >> 29) & 1, okr[:, A + 1] & 0xFF,
+                        meta.timeouts)
+                    ogen = (okr[:, A + 1] >> 9) & GEN_ETERNAL
+                    dead = ((now - flow.ts[tgt2]) > otmo) | (
+                        (ogen != GEN_ETERNAL) & (ogen != gen_w))
+                    n_reclaim = n_reclaim + (overwrote & dead).sum(
+                        dtype=jnp.int32)
+                    overwrote = overwrote & ~dead
+                n_evict = n_evict + overwrote.sum(dtype=jnp.int32)
+
+            if meta.count_flow_stats:
+                lv = (jnp.zeros(B, jnp.int32) if lens is None
+                      else jnp.maximum(lens, 0))
+                pk2 = jnp.stack([jnp.ones(B, jnp.int32), z],
+                                axis=1).reshape(2 * B)
+                oc2 = jnp.stack([lv, z], axis=1).reshape(2 * B)
+                z2 = jnp.zeros(2 * B, jnp.int32)
+                new_pkts = _scatter_last(flow.pkts, slot2, pk2, ins2, dump)
+                new_octets = _scatter_last(flow.octets, slot2, oc2, ins2,
+                                           dump)
+                new_pkts_hi = _scatter_last(flow.pkts_hi, slot2, z2, ins2,
+                                            dump)
+                new_octets_hi = _scatter_last(flow.octets_hi, slot2, z2,
+                                              ins2, dump)
+            else:
+                new_pkts, new_octets = flow.pkts, flow.octets
+                new_pkts_hi, new_octets_hi = flow.pkts_hi, flow.octets_hi
+            flow = FlowCache(
+                keys=_scatter_last_rows(flow.keys, slot2, keys2, ins2,
+                                        dump),
+                meta=_scatter_last_rows(flow.meta, slot2, meta2, ins2,
+                                        dump),
+                ts=_scatter_last(flow.ts, slot2,
+                                 jnp.full((2 * B,), now, jnp.int32), ins2,
+                                 dump),
+                pkts=new_pkts,
+                octets=new_octets,
+                pkts_hi=new_pkts_hi,
+                octets_hi=new_octets_hi,
+            )
+            lm = learn["mask"] & miss
+            adump = meta.aff_slots
+            aff = AffinityTable(
+                key_client=_scatter_last(aff.key_client, learn["aslot"],
+                                         learn["client"], lm, adump),
+                key_svc=_scatter_last(aff.key_svc, learn["aslot"],
+                                      learn["svc"], lm, adump),
+                ep=_scatter_last(aff.ep, learn["aslot"], learn["ep"], lm,
+                                 adump),
+                ts=_scatter_last(aff.ts, learn["aslot"],
+                                 jnp.full((B,), now, jnp.int32), lm,
+                                 adump),
+            )
+
+        return flow, aff, (
+            outbuf(o_code), outbuf(o_svc), outbuf(o_dnat), outbuf(o_dport),
+            outbuf(o_ri), outbuf(o_ro),
+            outbuf(committed.astype(jnp.int32)), outbuf(o_snat),
+            outbuf(o_dsr), n_evict, n_reclaim, pr_sk, pr_fb, pr_hist)
+
     def noop(args):
         return args
 
@@ -1415,7 +2008,9 @@ def _pipeline_step(
                              jnp.zeros(len(PRUNE_HIST_BOUNDS) + 2,
                                        jnp.int32)) if prune_on else ()))
     if meta.phases & PH_SLOW:
-        flow, aff, outs = jax.lax.cond(n_miss > 0, slow, noop, slow_init)
+        slow_body = slow_onepass if meta.onepass else slow
+        flow, aff, outs = jax.lax.cond(n_miss > 0, slow_body, noop,
+                                       slow_init)
     else:
         # Slow path masked out entirely (profiling floor): misses keep the
         # fast-path default image and commit nothing.
@@ -1757,7 +2352,7 @@ def _pipeline_trace(
     slot = (h & jnp.uint32(N - 1)).astype(jnp.int32)
     pg_cur = proto | 0x100 | (gen_w << 9)
     pg_est = proto | 0x100 | (GEN_ETERNAL << 9)
-    hit, est, rpl, mr = _cache_lookup(
+    hit, est, rpl, mr, _kr, _ts = _cache_lookup(
         flow, slot, addr, pp, pg_cur, pg_est, now, proto, meta
     )
     DC, M1C, _RC, _ZC = _meta_cols(A)
@@ -1770,6 +2365,11 @@ def _pipeline_trace(
     cls = classify_batch(
         drs, src_f, dnat_ip, proto, dnat_port,
         meta=meta.match, hit_combine=hit_combine,
+        # The twin walk carries the instance's fused meta (round 8): a
+        # fused datapath's canary/audit probes then exercise the SAME
+        # pallas consumers the serving kernel uses, so the PR 4/5 planes
+        # certify the serving configuration, not a shadow XLA path.
+        fused=meta.fused,
         v6=None if A == 2 else (saddr, dnat_w, is6),
         svc_ref=_svc_ref_of(svc_idx, dsvc),
     )

@@ -133,6 +133,29 @@ PRUNE_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
 )
 
 
+# One-kernel (fused) regime chain (round 8, ROADMAP item 1): the async
+# drain cadence over a meta.onepass=True meta.  The phases honor the
+# same PH_ bits — the LB probe chain, the aggregate (summary) gathers,
+# the commit scatters and the eviction audit are still maskable XLA
+# stages around the kernel — but `fused_onepass` (the PH_CLS add) is
+# deliberately ONE entry: probe decode, candidate DMA, first-match,
+# resolve and commit-row packing have no interior dispatch boundaries
+# left to telescope, which is the point of the fusion.  Diffing this
+# chain against PRUNE_PHASE_CHAIN attributes exactly what the one-pass
+# removed (the staged kernel's classify/commit materialization
+# boundaries); the ±15% gate applies via bench_profile.py --mode fused.
+FUSED_PHASE_CHAIN: tuple[tuple[str, int], ...] = (
+    ("fused_fast_path", 0),
+    ("fused_miss_detect", pl.PH_SLOW),
+    ("fused_service_lb", pl.PH_SLOW | pl.PH_LB),
+    ("fused_summary_gather", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM),
+    ("fused_onepass", pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS),
+    ("fused_commit",
+     pl.PH_SLOW | pl.PH_LB | pl.PH_CLS_SUM | pl.PH_CLS | pl.PH_COMMIT),
+    ("fused_evict", pl.PH_ALL),
+)
+
+
 def _dev_cols(batch) -> tuple:
     """PacketBatch -> the pipeline's flipped/typed device columns."""
     from ..utils import ip as iputil
@@ -632,5 +655,42 @@ def profile_churn_prune(
         k_small=k_small, k_big=k_big, repeats=repeats, chain=chain,
     )
     out["mode"] = "prune"
+    out["prune_budget"] = meta.match.prune_budget
+    return out
+
+
+def profile_churn_fused(
+    meta: pl.PipelineMeta,
+    state: pl.PipelineState,
+    drs,
+    dsvc,
+    hot: tuple,
+    pool: tuple,
+    *,
+    n_new: Optional[int] = None,
+    now0: int = 1000,
+    gen: int = 0,
+    k_small: int = 2,
+    k_big: int = 8,
+    repeats: int = 2,
+    chain: tuple = FUSED_PHASE_CHAIN,
+) -> dict:
+    """Per-phase breakdown of the ONE-KERNEL churn regime (round 8): the
+    async drain cadence (profile_churn_async's exact body) over a
+    meta.onepass=True meta, attributed on FUSED_PHASE_CHAIN.  The
+    `fused_onepass` entry is the whole in-VMEM pass (probe decode +
+    candidate DMA + first-match + resolve + commit-row packing) — one
+    number by design, since the fusion removed the interior stage
+    boundaries the staged chains telescope.  Same telescoped-sum honesty
+    property; the ±15% gate applies via bench_profile.py --mode fused."""
+    if not meta.onepass:
+        raise ValueError(
+            "profile_churn_fused needs a one-pass meta (fused=True with "
+            "prune_budget > 0)")
+    out = profile_churn_async(
+        meta, state, drs, dsvc, hot, pool, n_new=n_new, now0=now0, gen=gen,
+        k_small=k_small, k_big=k_big, repeats=repeats, chain=chain,
+    )
+    out["mode"] = "fused"
     out["prune_budget"] = meta.match.prune_budget
     return out

@@ -57,6 +57,7 @@ METRICS: dict[str, str] = {
     "antrea_tpu_miss_queue_admitted_total": "counter",
     "antrea_tpu_miss_queue_overflows_total": "counter",
     "antrea_tpu_miss_queue_early_drops_total": "counter",
+    "antrea_tpu_miss_queue_source_limited_total": "counter",
     "antrea_tpu_slowpath_drained_total": "counter",
     "antrea_tpu_slowpath_stale_reclassified_total": "counter",
     "antrea_tpu_slowpath_drain_batch_size": "histogram",
@@ -449,6 +450,10 @@ def render_metrics(datapath, node: str = "") -> str:
             # admission="drop": depth-proportional early-shed admissions
             # (0 under the other policies — mode-stable scrape surface).
             ("antrea_tpu_miss_queue_early_drops_total", "early_drops_total"),
+            # Per-source-/24 admission token buckets (miss_source_rate;
+            # 0 when the limiter is off — mode-stable scrape surface).
+            ("antrea_tpu_miss_queue_source_limited_total",
+             "source_limited_total"),
             ("antrea_tpu_slowpath_drained_total", "drained_total"),
             ("antrea_tpu_slowpath_stale_reclassified_total",
              "stale_reclassified_total"),

@@ -33,6 +33,7 @@ from ..apis.service import ServiceEntry
 from ..apis.controlplane import PROTO_TCP
 from ..compiler.compile import ACT_ALLOW, ACT_REJECT
 from ..models.pipeline import (
+    CHANCE_MAX,
     GEN_ETERNAL,
     REJECT_ICMP_UNREACH,
     REJECT_NONE,
@@ -197,6 +198,7 @@ class PipelineOracle:
         ct_other_est_s: int | None = None,
         dual_stack: bool = False,
         count_flow_stats: bool = False,
+        second_chance: bool = False,
     ):
         # Dual-stack mode mirrors the device's wide (10-column) flow-cache
         # keys: addresses hash/compare as 4-word v4-mapped quadruples and
@@ -232,6 +234,12 @@ class PipelineOracle:
         # — the scalar twin of the device's n_reclaim split (counted only
         # when step() runs with reclaim=True, the overlapped drain mode).
         self.reclaims = 0
+        # Thrash-resistant replacement (the device twin's second_chance
+        # knob, models/pipeline CHANCE_SHIFT): a live CONFIRMED
+        # established entry survives colliding inserts while its 2-bit
+        # counter is under CHANCE_MAX; its own hit resets the counter.
+        self.second_chance = bool(second_chance)
+        self.chance_suppressed = 0
 
     def _set_services(self, services):
         self.services = services
@@ -465,6 +473,7 @@ class PipelineOracle:
         outs: list[ScalarOutcome] = []
         inserts: list[tuple[int, dict]] = []
         refreshes: list[int] = []
+        hit_resets: list[int] = []  # second_chance: hit lanes' own slots
         confirms: list[int] = []
         pref_updates: list[int] = []
         learns: list[tuple[int, dict]] = []
@@ -512,6 +521,7 @@ class PipelineOracle:
                     )
                 )
                 refreshes.append(slot)
+                hit_resets.append(slot)
                 if self.count_flow_stats:
                     # Unbounded Python ints — the scalar twin of the
                     # device's two-limb 64-bit accumulation (the old i32
@@ -639,16 +649,39 @@ class PipelineOracle:
         for slot in confirms:
             if slot in self.flow:
                 self.flow[slot]["conf"] = True
+        # Second-chance hit resets land BEFORE the insert guard reads the
+        # counters (device order: the fast-path reset precedes the commit
+        # pass's meta read).
+        if self.second_chance:
+            for slot in hit_resets:
+                if slot in self.flow:
+                    self.flow[slot]["chance"] = 0
         # Teardowns BEFORE inserts (the device clears keys before the slow
         # path scatters — a miss lane may legitimately re-occupy the slot).
         for slot in teardowns:
             self.flow.pop(slot, None)
+        # Second-chance decisions snapshot the counter at pass start (the
+        # device evaluates every challenger against the same pre-pass
+        # meta and bumps once per slot via the winner mask).
+        chance_seen: dict[int, int] = {}
         for slot, entry in inserts:
             old = self.flow.get(slot)
             if old is not None and (
                 (old["key"], old.get("rpl", False))
                 != (entry["key"], entry.get("rpl", False))
             ):
+                if self.second_chance and old["gen"] is None \
+                        and old.get("conf", False) \
+                        and (now - old["ts"]) <= self.timeout_of(
+                            old, old["key"][3]):
+                    cnt = chance_seen.get(slot)
+                    if cnt is None:
+                        cnt = chance_seen[slot] = old.get("chance", 0)
+                        if cnt < CHANCE_MAX:
+                            old["chance"] = min(CHANCE_MAX, cnt + 1)
+                    if cnt < CHANCE_MAX:
+                        self.chance_suppressed += 1
+                        continue  # challenger stays uncached
                 old_dead = reclaim and (
                     (now - old["ts"]) > self.timeout_of(old, old["key"][3])
                     or (old["gen"] is not None and old["gen"] != gen)

@@ -189,7 +189,8 @@ def _mesh_step_full_fn(mesh, meta: pl.PipelineMeta, has_arp: bool):
     lane = P(DATA)
 
     def body(state, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
-             in_port, now, gen, flags, arp_op, valid, no_commit, lens):
+             in_port, now, gen, flags, arp_op, valid, no_commit, lens,
+             prune_excl):
         local = jax.tree.map(lambda x: x[0], state)
         local, out = fw._pipeline_step_full(
             local, drs, dsvc, dft, src_f, dst_f, proto, sport, dport,
@@ -197,7 +198,7 @@ def _mesh_step_full_fn(mesh, meta: pl.PipelineMeta, has_arp: bool):
             arp_op if has_arp else None,
             lens if meta.count_flow_stats else None,
             meta=meta, hit_combine=_pmin_rule, valid=valid,
-            no_commit=no_commit,
+            no_commit=no_commit, prune_exclude=prune_excl,
         )
         # scalar per shard -> (D,) vector of per-data-shard counts (the
         # prune keys exist iff the meta carries a prune budget)
@@ -214,23 +215,27 @@ def _mesh_step_full_fn(mesh, meta: pl.PipelineMeta, has_arp: bool):
                   _drs_specs(agg=meta.match.prune_budget > 0),
                   _svc_specs(), _fwd_specs(),
                   lane, lane, lane, lane, lane, lane, P(), P(),
-                  lane, lane, lane, lane, lane),
+                  lane, lane, lane, lane, lane, lane),
         out_specs=(_state_specs(), P(DATA)),
     ))
 
 
 @lru_cache(maxsize=8)
-def _mesh_canary_fn(mesh, match_meta):
+def _mesh_canary_fn(mesh, match_meta, fused):
     """Per-replica canary classify: probes tiled over the data axis, each
     replica's devices walking their own physical table copies; verdicts
     land (D * n,) and reshape to (D, n) for datapath/commit.py's
     replica-resolved diff.  One XLA compile per rule-table SHAPE (probes
     are padded to a fixed lane count by the commit plane, so repeated
-    installs of same-shaped bundles share the program)."""
+    installs of same-shaped bundles share the program).  `fused` carries
+    the instance's serving-consumer discipline — a fused engine's probes
+    must certify the pallas consumer the step kernel uses, not the
+    shadow XLA path (the fused consumer is shard-aware, so it composes
+    with the pmin seam like the serving dispatch)."""
     def body(drs, src_f, dst_f, proto, dport):
         return m.classify_batch(
             drs, src_f, dst_f, proto, dport, meta=match_meta,
-            hit_combine=_pmin_rule,
+            hit_combine=_pmin_rule, fused=fused,
         )["code"]
 
     return jax.jit(_shard_map(
@@ -360,11 +365,13 @@ class MeshSlowPath(SlowPathEngine):
     published, never a mix."""
 
     def __init__(self, owner, n_data: int, *, capacity: int,
-                 admission: str, drain_batch: int):
+                 admission: str, drain_batch: int,
+                 source_rate=None, source_burst=None):
         # capacity=1 seed: the base queue is immediately replaced by the
         # per-replica set below (its buffer would be dead weight).
         super().__init__(owner, capacity=1, admission=admission,
-                         drain_batch=drain_batch)
+                         drain_batch=drain_batch, source_rate=source_rate,
+                         source_burst=source_burst)
         self.n_data = int(n_data)
         self._q_capacity = int(capacity)  # per-replica; resize() reuses it
         self.queues = [MissQueue(capacity) for _ in range(self.n_data)]
@@ -381,6 +388,11 @@ class MeshSlowPath(SlowPathEngine):
         if self._published_at == 0:
             self._published_at = int(now)
         mask = np.asarray(miss_mask, bool)
+        # Per-source rate limiting is replica-independent (the bucket
+        # keys on the source prefix, not the home shard): ONE batch-wide
+        # pass ahead of the per-replica early-drop ramps, mirroring the
+        # single-chip admission order.
+        mask = self._source_limit(cols, mask, now)
         # admission="drop": the hash coin is replica-independent — one
         # batch-wide compute, thresholded per replica below (each
         # replica's OWN queue depth drives its early-drop ramp; capacity
@@ -598,11 +610,14 @@ class MeshDatapath(TpuflowDatapath):
             dt, _drs_specs().ip_delta)
 
     def _make_slowpath(self, *, capacity, admission, drain_batch,
+                       source_rate=None, source_burst=None,
                        **_single_chip_knobs):
         # autotune/overlap were rejected as ConfigError in __init__, so
         # the ignored kwargs here are always their inert defaults.
         return MeshSlowPath(self, self._n_data, capacity=capacity,
-                            admission=admission, drain_batch=drain_batch)
+                            admission=admission, drain_batch=drain_batch,
+                            source_rate=source_rate,
+                            source_burst=source_burst)
 
     # -- unsupported single-chip surfaces ------------------------------------
 
@@ -646,7 +661,7 @@ class MeshDatapath(TpuflowDatapath):
             iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
             in_ports[perm], jnp.int32(now), jnp.int32(self._gen),
             pflags, arp[perm], np.ones(B, bool), spill,
-            lens[perm].astype(np.int32),
+            lens[perm].astype(np.int32), spill,
         )
         self._state = state
         self._state_mutations += 1
@@ -654,6 +669,12 @@ class MeshDatapath(TpuflowDatapath):
         o.pop("n_miss")
         self._evictions += int(o.pop("n_evict").sum())
         self._reclaims += int(o.pop("n_reclaim").sum())
+        # Spilled lanes are EXCLUDED from this dispatch's prune evidence
+        # (prune_exclude=spill above): their foreign-shard walk is not
+        # the serving walk, and the home-routed retry below accounts
+        # them instead — each lane feeds the PruneAutotuner band exactly
+        # once, from the walk production actually serves (round 8; the
+        # PR 10 dedupe kept the foreign evidence instead).
         self._prune_account(o)
         for k in ("n_prune_skips", "n_prune_fb", "prune_cand_hist"):
             o.pop(k, None)
@@ -765,7 +786,7 @@ class MeshDatapath(TpuflowDatapath):
             batch.dst_port[idx].astype(np.int32),
             in_ports[idx], jnp.int32(now), jnp.int32(self._gen),
             rflags, arp[idx], valid, np.zeros(idx.size, bool),
-            lens[idx].astype(np.int32),
+            lens[idx].astype(np.int32), ~valid,
         )
         self._state = state
         self._state_mutations += 1
@@ -773,10 +794,15 @@ class MeshDatapath(TpuflowDatapath):
         self._evictions += int(o2.pop("n_evict").sum())
         self._reclaims += int(o2.pop("n_reclaim").sum())
         o2.pop("n_miss")
-        # NOT _prune_account'ed: every spilled lane was already metered by
-        # the main dispatch (counts-exactly-once, like _count_metrics —
-        # the retry is a re-dispatch of the same packets, and feeding the
-        # K autotuner the same lanes twice would double their evidence).
+        # The retry owns the retried lanes' prune evidence (the main
+        # dispatch excluded them via prune_exclude=spill): each lane is
+        # metered exactly once, from its HOME (serving) walk — counting
+        # both walks would double a retried lane's evidence and skew the
+        # PruneAutotuner band toward the foreign always-miss shape
+        # (regression-pinned by the skew-batch case in
+        # tests/test_match_fused.py).  Padding lanes are excluded via
+        # prune_exclude=~valid above.
+        self._prune_account(o2)
         for k in ("n_prune_skips", "n_prune_fb", "prune_cand_hist"):
             o2.pop(k, None)
         sel = np.nonzero(valid)[0]
@@ -908,7 +934,7 @@ class MeshDatapath(TpuflowDatapath):
         else:
             mesh, drs, mm, D = tgt
         n = batch.size
-        fn = _mesh_canary_fn(mesh, mm)
+        fn = _mesh_canary_fn(mesh, mm, self._meta.fused)
         got = fn(drs,
                  np.tile(iputil.flip_u32(batch.src_ip), D),
                  np.tile(iputil.flip_u32(batch.dst_ip), D),

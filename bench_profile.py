@@ -33,9 +33,11 @@ import numpy as np
 from antrea_tpu.compiler.compile import compile_policy_set
 from antrea_tpu.compiler.services import compile_services
 from antrea_tpu.models import pipeline as pl
-from antrea_tpu.models.profile import (MAINT_PHASE_CHAIN,
+from antrea_tpu.models.profile import (FUSED_PHASE_CHAIN,
+                                       MAINT_PHASE_CHAIN,
                                        OVERLAP_PHASE_CHAIN, PHASE_CHAIN,
                                        PRUNE_PHASE_CHAIN, profile_churn,
+                                       profile_churn_fused,
                                        profile_churn_maintenance,
                                        profile_churn_overlap,
                                        profile_churn_prune)
@@ -80,7 +82,8 @@ def main() -> int:
     ap.add_argument("--k-big", type=int, default=16)
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument(
-        "--mode", choices=("sync", "overlap", "maintenance", "prune"),
+        "--mode", choices=("sync", "overlap", "maintenance", "prune",
+                           "fused"),
         default="sync",
         help="sync = the inline slow-path chain (PHASE_CHAIN); overlap = "
              "the round-6 double-buffered regime (OVERLAP_PHASE_CHAIN: "
@@ -92,10 +95,13 @@ def main() -> int:
              "attributed cost; prune = the round-7 two-level kernel's "
              "regime (PRUNE_PHASE_CHAIN: the async cadence over a "
              "prune_budget>0 meta, classify split into summary-gather vs "
-             "candidate-gather)",
+             "candidate-gather); fused = the round-8 one-kernel regime "
+             "(FUSED_PHASE_CHAIN: the async cadence over a one-pass "
+             "meta — the fused_onepass entry is the whole in-VMEM pass)",
     )
     ap.add_argument("--prune-budget", type=int, default=4,
-                    help="K budget for --mode prune (PRUNE_LADDER rung)")
+                    help="K budget for --mode prune/fused "
+                         "(PRUNE_LADDER rung)")
     args = ap.parse_args()
     out_path = args.out or _next_out(os.path.dirname(os.path.abspath(__file__)))
 
@@ -114,7 +120,12 @@ def main() -> int:
                        one_per_flow=True)
     step, state, (drs, dsvc) = pl.make_pipeline(
         cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=4096, fused=True,
-        prune_budget=args.prune_budget if args.mode == "prune" else 0,
+        prune_budget=(args.prune_budget
+                      if args.mode in ("prune", "fused") else 0),
+        # --mode prune pins the STAGED pruned kernel (fused=True +
+        # prune_budget>0 would otherwise auto-upgrade to the one-pass,
+        # which --mode fused profiles instead).
+        onepass=args.mode == "fused",
     )
     hot_c, pool_c = _cols(hot), _cols(pool)
     n_new = B // CHURN_DIV
@@ -143,6 +154,20 @@ def main() -> int:
         # Independent full-step measurement of the SAME maintenance
         # cadence (rider included): fresh dispatches, different K values.
         indep = profile_churn_maintenance(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
+            repeats=args.repeats,
+            chain=(("base", 0), ("full", pl.PH_ALL)),
+        )
+    elif args.mode == "fused":
+        chain = FUSED_PHASE_CHAIN
+        prof = profile_churn_fused(
+            step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
+            k_small=args.k_small, k_big=args.k_big, repeats=args.repeats,
+        )
+        # Independent full-step measurement of the SAME one-kernel
+        # cadence: fresh dispatches, different K values.
+        indep = profile_churn_fused(
             step.meta, state, drs, dsvc, hot_c, pool_c, n_new=n_new,
             k_small=max(2, args.k_small // 2), k_big=2 * args.k_big,
             repeats=args.repeats,

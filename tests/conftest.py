@@ -16,6 +16,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 # occasionally be validated on real hardware (e.g. =tpu).
 os.environ["JAX_PLATFORMS"] = os.environ.get("ANTREA_TPU_TEST_PLATFORM", "cpu")
 
+# Persistent XLA compilation cache: the suite's wall clock is dominated by
+# program compiles (every engine/world/batch-shape variant is its own
+# executable), so repeat runs in one container — the developer loop and the
+# CI re-run — skip straight to execution.  Cache entries are keyed by
+# program + compiler version, so a stale dir can only miss, never serve a
+# wrong executable.  ANTREA_TPU_TEST_NO_COMPILE_CACHE=1 opts out (e.g. when
+# bisecting compile-time itself).
+if not os.environ.get("ANTREA_TPU_TEST_NO_COMPILE_CACHE"):
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/antrea_tpu_xla_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 
 def cpu_devices():
     import jax
