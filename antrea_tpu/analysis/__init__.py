@@ -10,9 +10,10 @@ Public surface:
 
 The nine legacy `tools/check_*.py` gates live here as passes (the tools
 remain as thin CLI shims, verdict-identical — pinned by
-tests/test_static_analysis.py), joined by the four semantic passes that
+tests/test_static_analysis.py), joined by the five semantic passes that
 pin the hand-caught bug classes: `thread-safety`, `bounded-cache`,
-`jit-purity`, `donation-safety`.  Everything is stdlib-only (ast/re/
+`jit-purity`, `donation-safety`, `bounded-buffer`.  Everything is
+stdlib-only (ast/re/
 json): importing this subpackage never pulls jax, so every gate runs on
 any CI image.  See core.py for the engine contract (SourceCache,
 Finding, allowlists, BASELINE.analysis.json)."""
@@ -46,6 +47,7 @@ from . import (  # noqa: E402,F401
     caches,
     jit_purity,
     donation,
+    bounded_buffer,
 )
 
 __all__ = [

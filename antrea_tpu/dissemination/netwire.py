@@ -37,11 +37,28 @@ import ssl
 import subprocess
 import threading
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Optional
 
 from . import serde
-from .store import RamStore, Watcher
+from ..observability.flightrec import emit_into
+from .store import RamStore, ResyncCursor, Watcher
+
+# bounded-buffer analysis-pass contract (analysis/bounded_buffer.py): every
+# buffer-shaped attribute in this package declares its cap here.
+BUFFER_CAPS = {
+    "_LineConn._buf": "holds at most one partial frame; the framing loops "
+                      "bound a line at 64KiB (hello) / 1MiB (iter_json_"
+                      "lines) and recv_ready drains complete lines "
+                      "immediately",
+}
+
+
+def _min_opt(*vals: Optional[int]) -> Optional[int]:
+    """Smallest non-None bound (None = unbounded)."""
+    present = [v for v in vals if v is not None]
+    return min(present) if present else None
 
 
 # -- PKI ---------------------------------------------------------------------
@@ -214,13 +231,27 @@ def issue_cert(dirpath: str, cn: str, *, server: bool = False) -> tuple[str, str
 
 class Backoff:
     """Capped exponential backoff with jitter — the reconnect discipline
-    of the reference's client-go watch retry (wait.Backoff).  Jitter keeps
-    a fleet that lost one controller from re-handshaking in lockstep."""
+    of the reference's client-go watch retry (wait.Backoff).
 
-    def __init__(self, base: float = 0.05, cap: float = 2.0, rng=None):
+    Two jitter layers keep a fleet that lost one controller from
+    re-handshaking in lockstep: a per-attempt random factor, and a
+    DETERMINISTIC per-node factor derived from the node name — so even
+    clients constructed with identical (or identically-seeded) rngs
+    spread out.  After a controller restart, 10k agents redial on 10k
+    distinct schedules, each still bounded by `cap`."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0, rng=None,
+                 node: Optional[str] = None):
         self.base = base
         self.cap = cap
         self._rng = rng if rng is not None else random.Random()
+        # Node-name hash -> factor in [0.6, 1.0]: scales EVERY delay (cap
+        # included, so delays never exceed cap) and differs node-to-node.
+        if node:
+            h = zlib.crc32(node.encode())
+            self.node_factor = 0.6 + 0.4 * ((h % 4096) / 4095.0)
+        else:
+            self.node_factor = 1.0
         self.attempt = 0
 
     def next_delay(self) -> float:
@@ -228,10 +259,15 @@ class Backoff:
         # outage, and 2**~1030 overflows float — the cap wins long before.
         d = min(self.cap, self.base * (2 ** min(self.attempt, 30)))
         self.attempt += 1
-        return d * (0.5 + 0.5 * self._rng.random())
+        return d * self.node_factor * (0.5 + 0.5 * self._rng.random())
 
     def reset(self) -> None:
         self.attempt = 0
+
+
+# The reconnect-policy name used by docs/tests; Backoff is the
+# implementation class.
+BackoffPolicy = Backoff
 
 
 class _LineConn:
@@ -317,14 +353,16 @@ def recv_one_json(sock, buf: bytes, max_line: int = 1 << 20):
 
 @dataclass
 class _ConnState:
-    """One registered agent connection.  fresh=True until the first pump
-    ships the initial snapshot (bracketed in resync markers so the agent
-    can retract state a previous connection left behind)."""
+    """One registered agent connection.  fresh=True until the first resync
+    completes (bracketed in resync markers so the agent can retract state
+    a previous connection left behind); cursor holds the in-flight
+    chunked resync, if any."""
 
     conn: _LineConn
     watcher: Watcher
     seq: int
     fresh: bool = True
+    cursor: Optional[ResyncCursor] = None
 
 
 class DisseminationServer:
@@ -336,19 +374,50 @@ class DisseminationServer:
     "resync_end"} markers — the reference's watch re-list semantics — so
     the agent can reconcile away anything stale.  Per-agent watcher queues
     are bounded by watcher_max_pending: a consumer that falls behind the
-    cap costs one full resync, never unbounded controller memory."""
+    cap costs one resync, never unbounded controller memory.
+
+    Storm disciplines (all opt-in; None = the permissive legacy behavior):
+      * resync_chunk — a resync ships at most this many events per pump
+        round, via a resumable ResyncCursor, interleaved with other
+        agents' live drains (no head-of-line blocking behind a big
+        snapshot).  Live churn arriving mid-resync lands in the watcher's
+        coalescing queue and ships inside the SAME resync window.
+      * resync_concurrency — at most this many watchers mid-resync at
+        once; the rest are shed to later rounds (their gated queues hold
+        no memory while parked), so a fleet-wide overflow storm becomes a
+        metered trickle of re-lists, never a replay storm.
+      * drain_max / send_budget — per-watcher and per-round send bounds so
+        one hot agent cannot dominate a round (the 2s send timeout +
+        identity-aware prune stays the backstop for wedged peers)."""
 
     def __init__(self, store: RamStore, certdir: str, *,
                  host: str = "127.0.0.1", port: int = 0,
                  status_aggregator=None,
-                 watcher_max_pending: Optional[int] = None):
+                 watcher_max_pending: Optional[int] = None,
+                 resync_chunk: Optional[int] = None,
+                 resync_concurrency: Optional[int] = None,
+                 drain_max: Optional[int] = None,
+                 send_budget: Optional[int] = None,
+                 flightrec=None):
         self._store = store
         self._status = status_aggregator
         self._watcher_max_pending = watcher_max_pending
+        self._resync_chunk = resync_chunk
+        self._resync_concurrency = resync_concurrency
+        self._drain_max = drain_max
+        self._send_budget = send_budget
+        self._flightrec = flightrec
         # Dissemination-health counters (scraped by
         # observability.metrics.render_dissemination_metrics).
-        self.resyncs_total = 0      # full snapshots served (incl. hellos)
+        self.resyncs_total = 0      # completed resyncs (incl. hellos)
         self.reconnects_total = 0   # re-handshakes replacing a live node
+        self.resync_chunks_total = 0   # non-empty cursor chunks shipped
+        self.resyncs_shed_total = 0    # admission-gate deferrals
+        # Coalesce counts of retired watchers (stop/replace) fold in here
+        # so dissemination_stats' total survives reconnect churn.
+        self._coalesced_retired = 0
+        # Round-robin rotation so budget exhaustion starves fairly.
+        self._rr = 0
         cert, key = issue_cert(certdir, "controller", server=True)
         self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         self._ctx.load_cert_chain(cert, key)
@@ -466,18 +535,16 @@ class DisseminationServer:
                 # the agent abandoned.
                 tls.close()
                 return
-            self._conns[node] = _ConnState(
-                conn,
+            w = self._store.watch_queue(
                 # replay=False: fresh=True already forces a full resync on
                 # the first pump — buffering the snapshot here would be
                 # discarded work and could spuriously count an overflow.
-                self._store.watch_queue(
-                    node, max_pending=self._watcher_max_pending,
-                    replay=False),
-                seq,
-            )
+                node, max_pending=self._watcher_max_pending, replay=False)
+            w._flightrec = self._flightrec
+            self._conns[node] = _ConnState(conn, w, seq)
             if old is not None:
                 self.reconnects_total += 1
+                self._coalesced_retired += old.watcher.coalesced
         if old is not None:
             # Reconnect: retire the previous registration — an
             # un-stopped watcher would buffer events forever.
@@ -500,36 +567,101 @@ class DisseminationServer:
             time.sleep(0.01)
         raise TimeoutError(f"{n} agents not connected within {timeout}s")
 
+    def _emit(self, kind: str, **fields) -> None:
+        emit_into(self, kind, **fields)
+
     def pump(self) -> int:
         """Stream queued events, consume status reports -> events shipped.
 
         A fresh connection (hello or reconnect) and a watcher whose
-        bounded queue overflowed are served a FULL RESYNC: the node's
+        bounded queue overflowed are served a RESYNC: the node's
         span-filtered snapshot bracketed in resync markers, bypassing the
-        queue (so a snapshot larger than the cap still converges)."""
+        queue (so a snapshot larger than the cap still converges).  With
+        resync_chunk set, the snapshot ships cursor-chunked across rounds,
+        interleaved with other agents' live traffic; resync_concurrency
+        bounds how many such cursors run at once; drain_max/send_budget
+        bound per-watcher and per-round send work (class docstring)."""
         shipped = 0
+        budget = self._send_budget
         with self._lock:
             conns = list(self._conns.items())
+        inflight = sum(1 for _n, st in conns if st.cursor is not None)
+        if conns:
+            # Rotate the serving order so a budget that runs out mid-round
+            # starves a DIFFERENT tail next round.
+            self._rr = (self._rr + 1) % len(conns)
+            conns = conns[self._rr:] + conns[:self._rr]
         dead: list[tuple[str, _LineConn]] = []
         live = []
         for node, st in conns:
             conn = st.conn
+            if budget is not None and shipped >= budget:
+                live.append((node, conn))  # still select it for statuses
+                continue
             try:
                 # Bounded send: an agent that stopped reading (full TCP
                 # buffer) must not block the pump forever — a timed-out
                 # sendall raises and the agent is pruned as dead.
                 conn.sock.settimeout(2.0)
-                if st.fresh or st.watcher.needs_resync:
+                if st.cursor is None and (st.fresh
+                                          or st.watcher.needs_resync):
+                    if (self._resync_concurrency is not None
+                            and inflight >= self._resync_concurrency):
+                        # Admission gate: defer this re-list to a later
+                        # round.  The parked watcher stays gated
+                        # (needs_resync drops live events), so waiting
+                        # costs no memory.
+                        self.resyncs_shed_total += 1
+                        self._emit("resync-shed", node=node,
+                                   inflight=inflight)
+                        conn.sock.setblocking(False)
+                        live.append((node, conn))
+                        continue
+                    st.cursor = self._store.resync(st.watcher)
+                    inflight += 1
                     conn.send({"ctl": "resync_begin"})
-                    for ev in self._store.resync(st.watcher):
+                    self._emit("resync-begin", node=node,
+                               objects=st.cursor.total)
+                elif st.cursor is not None and st.watcher.needs_resync:
+                    # The coalescing queue overflowed AGAIN mid-resync
+                    # (distinct-key churn past the cap): restart the
+                    # cursor inside the same window — a repeated begin
+                    # marker resets the consumer's seen-set.
+                    st.cursor = self._store.resync(st.watcher)
+                    conn.send({"ctl": "resync_begin"})
+                    self._emit("resync-begin", node=node,
+                               objects=st.cursor.total, restart=True)
+                if st.cursor is not None:
+                    room = None if budget is None else budget - shipped
+                    chunk = st.cursor.take(
+                        _min_opt(self._resync_chunk, room))
+                    for ev in chunk:
                         conn.send({"ev": serde.encode_event(ev)})
                         shipped += 1
-                    conn.send({"ctl": "resync_end"})
-                    st.fresh = False
-                    with self._lock:
-                        self.resyncs_total += 1
+                    if chunk:
+                        self.resync_chunks_total += 1
+                    # Live churn that landed mid-resync ships INSIDE the
+                    # open window (the consumer's resync seen-set covers
+                    # it), under the same drain bound as healthy traffic.
+                    room = None if budget is None else budget - shipped
+                    for ev in st.watcher.drain(
+                            _min_opt(self._drain_max, room)):
+                        conn.send({"ev": serde.encode_event(ev)})
+                        shipped += 1
+                    if st.cursor.done and not st.watcher.needs_resync:
+                        conn.send({"ctl": "resync_end"})
+                        self._emit("resync-end", node=node,
+                                   chunks=st.cursor.chunks,
+                                   events=st.cursor.sent)
+                        st.cursor = None
+                        st.fresh = False
+                        inflight -= 1
+                        with self._lock:
+                            self.resyncs_total += 1
                 else:
-                    for ev in st.watcher.drain():
+                    room = None if budget is None else budget - shipped
+                    for ev in st.watcher.drain(
+                            _min_opt(self._drain_max, room)):
                         conn.send({"ev": serde.encode_event(ev)})
                         shipped += 1
                 conn.sock.setblocking(False)
@@ -569,6 +701,7 @@ class DisseminationServer:
                     entry = None
                 else:
                     del self._conns[node]
+                    self._coalesced_retired += entry.watcher.coalesced
             if entry is not None:
                 entry.watcher.stop()
                 try:
@@ -584,20 +717,30 @@ class DisseminationServer:
 
     def dissemination_stats(self) -> dict:
         """Health snapshot for the metrics surface: per-node watcher depth
-        / overflow / resync-pending state plus the server counters."""
+        / overflow / coalesce / resync-pending state plus the server
+        counters (chunks shipped, resyncs in flight, admission shedding)."""
         with self._lock:
             return {
                 "watchers": {
                     node: {
                         "pending": st.watcher.pending(),
                         "overflows": st.watcher.overflows,
+                        "coalesced": st.watcher.coalesced,
                         "needs_resync": bool(
-                            st.fresh or st.watcher.needs_resync),
+                            st.fresh or st.watcher.needs_resync
+                            or st.cursor is not None),
                     }
                     for node, st in self._conns.items()
                 },
                 "resyncs_total": self.resyncs_total,
                 "reconnects_total": self.reconnects_total,
+                "resync_chunks_total": self.resync_chunks_total,
+                "resyncs_inflight": sum(
+                    1 for st in self._conns.values()
+                    if st.cursor is not None),
+                "resyncs_shed_total": self.resyncs_shed_total,
+                "coalesced_total": self._coalesced_retired + sum(
+                    st.watcher.coalesced for st in self._conns.values()),
             }
 
     def close(self) -> None:
@@ -610,6 +753,15 @@ class DisseminationServer:
         for st in conns:
             st.watcher.stop()
             st.conn.sock.close()
+        # shutdown() BEFORE close(): closing an fd does not wake a thread
+        # blocked in accept() — the acceptor would stay parked on the
+        # stale fd number, and once the kernel reuses it for a NEW
+        # server's listener, the dead server's acceptor steals that
+        # server's connections and answers with the wrong certificate.
+        try:
+            self._lsock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # never-connected listener on some platforms
         self._lsock.close()
         self._acceptor.join(timeout=2)
 
@@ -654,7 +806,9 @@ class ReconnectingClient:
         self._certdir = certdir
         self._client_cn = client_cn
         self._reconnect_enabled = reconnect
-        self._backoff = backoff if backoff is not None else Backoff()
+        # Default backoff carries the node's deterministic jitter factor so
+        # a herd of default-constructed clients never redials in lockstep.
+        self._backoff = backoff if backoff is not None else Backoff(node=node)
         self._clock = clock
         self._fault_wrap = fault_wrap
         self._next_attempt = 0.0

@@ -20,13 +20,18 @@ when the object's span GROWS to include its node (synthesized ADDED), and
 gets a DELETED when the span shrinks away from it — the span diff IS the
 subscription filter.
 
-Robustness: a queued watcher may carry a depth cap (max_pending).  When a
-consumer falls so far behind that its buffer hits the cap, the buffer is
+Robustness: a queued watcher may carry a depth cap (max_pending).  The
+queue COALESCES latest-wins per (obj_type, name) — a storm rewriting the
+same object 500× occupies one slot, in its original arrival position —
+so only churn across DISTINCT keys can fill it.  When a consumer falls
+so far behind that distinct-key churn hits the cap anyway, the buffer is
 DROPPED and the watcher flips to needs_resync — the reference's "watch
 channel full -> client must re-list" semantics (store.go:230 drops the
-watcher; here the transport converts the flag into a full replay via
+watcher; here the transport converts the flag into a re-list via
 RamStore.resync, so a slow agent costs one snapshot, never unbounded
-memory)."""
+memory).  resync() returns a resumable ResyncCursor rather than a
+materialized list, so the transport can ship the snapshot in bounded
+chunks interleaved with other agents' live traffic."""
 
 from __future__ import annotations
 
@@ -36,6 +41,19 @@ from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
 from ..controller.networkpolicy import WatchEvent
+from ..observability.flightrec import emit_into
+
+# bounded-buffer analysis-pass contract (analysis/bounded_buffer.py): every
+# buffer-shaped attribute in this package declares its cap here.
+BUFFER_CAPS = {
+    "Watcher._queue": "holds at most max_pending distinct keys; overflow "
+                      "drops the buffer and flips needs_resync",
+    "Watcher._latest": "one entry per key queued in Watcher._queue — the "
+                       "same max_pending cap",
+    "ResyncCursor._keys": "span-filtered key snapshot taken at cursor "
+                          "birth (<= store size), strictly drained by "
+                          "take(), never refilled",
+}
 
 
 @dataclass
@@ -46,13 +64,21 @@ class _Stored:
 
 class Watcher:
     """One node subscription.  cb-mode delivers inline; queue-mode buffers
-    until drain()/pop() — never blocking the store's apply()."""
+    until drain()/pop() — never blocking the store's apply().
+
+    The queue is KEY-COALESCING: `_queue` keeps arrival order of distinct
+    (obj_type, name) keys and `_latest` the newest event per key.  A
+    re-delivery for a queued key replaces the buffered event in place
+    (latest-wins, order preserved) — safe because events are full-object
+    replacements, not diffs: ADDED then UPDATED collapses to one upsert,
+    ADDED then DELETED to a DELETE the consumer's tolerant pop absorbs."""
 
     def __init__(self, node: str, cb: Optional[Callable[[WatchEvent], None]],
                  max_pending: Optional[int] = None):
         self.node = node
         self._cb = cb
-        self._queue: deque[WatchEvent] = deque()
+        self._queue: deque[tuple[str, str]] = deque()
+        self._latest: dict[tuple[str, str], WatchEvent] = {}
         self._known: set = set()
         self._stopped = False
         # Bounded-queue mode: cap the buffer; overflow invalidates the
@@ -60,6 +86,13 @@ class Watcher:
         self.max_pending = max_pending
         self.needs_resync = False
         self.overflows = 0
+        self.coalesced = 0
+        # Optional FlightRecorder wired in by the transport that owns this
+        # watcher (emit_into no-ops while unset).
+        self._flightrec = None
+
+    def _emit(self, kind: str, **fields) -> None:
+        emit_into(self, kind, **fields)
 
     def _deliver(self, ev: WatchEvent) -> None:
         if self._cb is not None:
@@ -69,21 +102,44 @@ class Watcher:
             # Stream already invalidated: every buffered/new event is
             # superseded by the coming full resync — don't re-grow.
             return
+        key = (ev.obj_type, ev.name)
+        if key in self._latest:
+            # Latest-wins coalescing: the key keeps its queue slot (and
+            # ordering), only the payload is replaced.
+            self._latest[key] = ev
+            self.coalesced += 1
+            return
         if self.max_pending is not None and len(self._queue) >= self.max_pending:
-            self._queue.clear()
+            dropped = len(self._queue)
+            self._clear_queue()
             self._known.clear()
             self.needs_resync = True
             self.overflows += 1
+            self._emit("watcher-overflow", node=self.node, dropped=dropped,
+                       overflows=self.overflows)
             return
-        self._queue.append(ev)
+        self._queue.append(key)
+        self._latest[key] = ev
+
+    def _clear_queue(self) -> None:
+        self._queue.clear()
+        self._latest.clear()
 
     def pop(self) -> Optional[WatchEvent]:
-        return self._queue.popleft() if self._queue else None
+        if not self._queue:
+            return None
+        return self._latest.pop(self._queue.popleft())
 
-    def drain(self) -> list[WatchEvent]:
-        out = list(self._queue)
-        self._queue.clear()
-        return out
+    def drain(self, limit: Optional[int] = None) -> list[WatchEvent]:
+        """Dequeue buffered events in arrival order; `limit` bounds the
+        batch (None = everything) so the transport can budget per-watcher
+        send work in one pump round."""
+        if limit is None or limit >= len(self._queue):
+            out = [self._latest[k] for k in self._queue]
+            self._clear_queue()
+            return out
+        return [self._latest.pop(self._queue.popleft())
+                for _ in range(max(0, limit))]
 
     def pending(self) -> int:
         return len(self._queue)
@@ -91,7 +147,7 @@ class Watcher:
     def stop(self) -> None:
         """Unsubscribe: the store drops this watcher on its next pass."""
         self._stopped = True
-        self._queue.clear()
+        self._clear_queue()
 
 
 class RamStore:
@@ -180,27 +236,76 @@ class RamStore:
         self._watchers.append(w)
         return w
 
-    def resync(self, w: Watcher) -> list[WatchEvent]:
-        """Full re-list for a queued watcher whose stream was invalidated
-        (overflow or reconnect): rebuilds the watcher's known-set from the
-        CURRENT store state and returns the snapshot as ADDED events —
+    def resync(self, w: Watcher) -> "ResyncCursor":
+        """Re-list for a queued watcher whose stream was invalidated
+        (overflow or reconnect): clears the watcher's known-set and returns
+        a resumable ResyncCursor over the CURRENT span-filtered state —
         bypassing the bounded queue, so a resync always completes even when
-        the snapshot exceeds max_pending.  The transport brackets these
-        events with resync markers so the consumer can retract anything it
-        holds that is absent from the snapshot (re-list semantics)."""
-        w._queue.clear()
-        w._known.clear()
-        w.needs_resync = False
-        out: list[WatchEvent] = []
-        for (obj_type, name), st in sorted(self._objs.items()):
-            if w.node in st.span:
-                w._known.add((obj_type, name))
-                out.append(WatchEvent(
-                    kind="ADDED", obj_type=obj_type, name=name,
-                    obj=st.obj, span=set(st.span),
-                ))
-        return out
+        the snapshot exceeds max_pending.  Iterating the cursor yields the
+        whole snapshot (list-compatible with the old API); take(n) lets a
+        transport ship it in bounded chunks across pump rounds.  The
+        transport brackets the emitted events with resync markers so the
+        consumer can retract anything it holds that is absent from the
+        snapshot (re-list semantics)."""
+        return ResyncCursor(self, w)
 
     @property
     def n_watchers(self) -> int:
         return sum(1 for w in self._watchers if not w._stopped)
+
+
+class ResyncCursor:
+    """Resumable span-filtered re-list for ONE watcher.
+
+    Construction atomically re-arms the watcher: queue and known-set are
+    cleared and needs_resync drops, so live churn arriving MID-resync lands
+    in the (coalescing) queue instead of invalidating the stream again.
+    The cursor snapshots only the KEYS in the watcher's span; take() reads
+    the live store at emission time, so a key deleted or span-shrunk while
+    the cursor was parked is silently skipped (never replayed stale) and a
+    key the live queue already delivered (now in the known-set) is not sent
+    twice — the snapshot degrades into a known-set diff as live traffic
+    overtakes it.  Emitted events are unstamped: a resync replays state of
+    unknowable age, so realization tracing meters them separately instead
+    of inventing a latency."""
+
+    def __init__(self, store: RamStore, w: Watcher):
+        self._store = store
+        self._w = w
+        w._clear_queue()
+        w._known.clear()
+        w.needs_resync = False
+        self._keys: deque[tuple[str, str]] = deque(sorted(
+            key for key, st in store._objs.items() if w.node in st.span))
+        self.total = len(self._keys)
+        self.sent = 0
+        self.chunks = 0
+
+    @property
+    def done(self) -> bool:
+        return not self._keys
+
+    def take(self, n: Optional[int] = None) -> list[WatchEvent]:
+        """Emit up to `n` snapshot events (None = all remaining), marking
+        each key known as it ships."""
+        w = self._w
+        out: list[WatchEvent] = []
+        while self._keys and (n is None or len(out) < n):
+            key = self._keys.popleft()
+            st = self._store._objs.get(key)
+            if st is None or w.node not in st.span:
+                continue  # deleted / span-shrunk while the cursor was parked
+            if key in w._known:
+                continue  # the live queue already delivered a fresher event
+            w._known.add(key)
+            out.append(WatchEvent(
+                kind="ADDED", obj_type=key[0], name=key[1],
+                obj=st.obj, span=set(st.span),
+            ))
+        if out:
+            self.sent += len(out)
+            self.chunks += 1
+        return out
+
+    def __iter__(self):
+        return iter(self.take())

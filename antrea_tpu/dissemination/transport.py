@@ -39,6 +39,14 @@ from typing import Optional
 from . import serde
 from .store import RamStore, Watcher
 
+# bounded-buffer analysis-pass contract (analysis/bounded_buffer.py): every
+# buffer-shaped attribute in this package declares its cap.
+BUFFER_CAPS = {
+    "SubprocessAgent._rdbuf": "holds at most one partial response line; "
+                              "_read_response_line consumes a complete "
+                              "line per RPC under the RPC deadline",
+}
+
 
 class AgentDiedError(RuntimeError):
     """The agent subprocess is gone (crashed, killed, or wedged past the
@@ -149,7 +157,7 @@ class SubprocessAgent:
             return 0
         if self._watcher.needs_resync:
             self._send_frame({"ctl": "resync_begin"})
-            events = self._store.resync(self._watcher)
+            events = list(self._store.resync(self._watcher))
             for ev in events:
                 self.send_event(ev)
             self._send_frame({"ctl": "resync_end"})

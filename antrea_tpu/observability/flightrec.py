@@ -135,6 +135,20 @@ EVENT_KINDS = {
                        "its transaction (canary veto / compile fault) "
                        "and rolled back ONLY that tenant's world; every "
                        "other tenant's generation is untouched",
+    "watcher-overflow": "dissemination/store.py — distinct-key churn "
+                        "filled a bounded watcher queue past max_pending "
+                        "even after coalescing: the buffer dropped and "
+                        "the stream flipped to needs_resync",
+    "resync-begin": "dissemination/netwire.py — the server opened a "
+                    "resync window for a node (objects = snapshot size; "
+                    "restart=True when a mid-resync overflow re-listed "
+                    "inside the same window)",
+    "resync-end": "dissemination/netwire.py — a node's resync window "
+                  "closed (chunks + events actually shipped after "
+                  "known-set dedup)",
+    "resync-shed": "dissemination/netwire.py — the admission gate "
+                   "deferred a watcher's resync because "
+                   "resync_concurrency cursors were already in flight",
 }
 
 

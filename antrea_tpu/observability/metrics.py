@@ -36,6 +36,9 @@ METRICS: dict[str, str] = {
     "antrea_tpu_dissemination_watcher_needs_resync": "gauge",
     "antrea_tpu_dissemination_resyncs_total": "counter",
     "antrea_tpu_dissemination_reconnects_total": "counter",
+    "antrea_tpu_dissemination_queue_coalesced_total": "counter",
+    "antrea_tpu_dissemination_resync_chunks_total": "counter",
+    "antrea_tpu_dissemination_resyncs_inflight": "gauge",
     "antrea_tpu_agent_reconnects_total": "counter",
     "antrea_tpu_agent_resyncs_total": "counter",
     "antrea_tpu_agent_sync_failures_total": "counter",
@@ -344,12 +347,25 @@ def render_dissemination_metrics(server=None, agents=()) -> str:
                 f"antrea_tpu_dissemination_watcher_needs_resync"
                 f"{_labels(node=node)} {int(w['needs_resync'])}"
             )
+        lines.append(
+            _type_line("antrea_tpu_dissemination_queue_coalesced_total"))
+        for node, w in watchers:
+            lines.append(
+                f"antrea_tpu_dissemination_queue_coalesced_total"
+                f"{_labels(node=node)} {w.get('coalesced', 0)}"
+            )
         lines += [
             _type_line("antrea_tpu_dissemination_resyncs_total"),
             f"antrea_tpu_dissemination_resyncs_total {stats['resyncs_total']}",
             _type_line("antrea_tpu_dissemination_reconnects_total"),
             f"antrea_tpu_dissemination_reconnects_total "
             f"{stats['reconnects_total']}",
+            _type_line("antrea_tpu_dissemination_resync_chunks_total"),
+            f"antrea_tpu_dissemination_resync_chunks_total "
+            f"{stats.get('resync_chunks_total', 0)}",
+            _type_line("antrea_tpu_dissemination_resyncs_inflight"),
+            f"antrea_tpu_dissemination_resyncs_inflight "
+            f"{stats.get('resyncs_inflight', 0)}",
         ]
     agents = list(agents)
 

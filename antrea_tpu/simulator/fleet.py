@@ -26,6 +26,7 @@ primary mode mirrors it:
 from __future__ import annotations
 
 import time
+from typing import Optional
 
 from ..controller.networkpolicy import WatchEvent
 from ..dissemination.netwire import ReconnectingClient
@@ -151,10 +152,11 @@ class NetFakeAgent(_AgentTables, ReconnectingClient):
     re-handshake."""
 
     def __init__(self, node: str, address, certdir: str, *,
-                 reconnect: bool = True, backoff=None):
+                 reconnect: bool = True, backoff=None, fault_wrap=None):
         self._init_tables()
         self._init_wire(node, address, certdir,
-                        reconnect=reconnect, backoff=backoff)
+                        reconnect=reconnect, backoff=backoff,
+                        fault_wrap=fault_wrap)
 
     # Short first-wait: FakeAgentFleet.pump() ships events BEFORE draining
     # agents, so loopback frames are already buffered — a long per-agent
@@ -204,7 +206,8 @@ class FakeAgentFleet:
     certdir); inproc mode needs the RamStore."""
 
     def __init__(self, store, nodes: list[str], status_reporter=None, *,
-                 transport: str = "inproc", server=None, certdir: str = ""):
+                 transport: str = "inproc", server=None, certdir: str = "",
+                 max_pending=None, fault_plan=None, backoff_factory=None):
         self.transport = transport
         self._server = server
         if transport == "netwire":
@@ -219,13 +222,31 @@ class FakeAgentFleet:
                     "statuses flow to the server's StatusAggregator over "
                     "the sockets"
                 )
+
+            def _wrap(node):
+                # Chaos hook: interpose FaultySocket per agent so the plan's
+                # {node}.send / {node}.recv sites fire on the live fleet.
+                if fault_plan is None:
+                    return None
+                from ..dissemination.faults import FaultySocket
+                return lambda sock, _n=node: FaultySocket(
+                    sock, fault_plan, _n)
+
             self.agents = {
-                n: NetFakeAgent(n, server.address, certdir) for n in nodes
+                n: NetFakeAgent(
+                    n, server.address, certdir,
+                    backoff=backoff_factory(n) if backoff_factory else None,
+                    fault_wrap=_wrap(n))
+                for n in nodes
             }
-            server.wait_connected(len(nodes))
+            # TLS bring-up is serial per agent: scale the registration
+            # deadline with fleet size (soaks run 10^2-10^4 agents).
+            server.wait_connected(len(nodes),
+                                  timeout=max(5.0, 0.05 * len(nodes)))
         elif transport == "inproc":
             self.agents = {
-                n: FakeAgent(store, n, status_reporter=status_reporter)
+                n: FakeAgent(store, n, status_reporter=status_reporter,
+                             max_pending=max_pending)
                 for n in nodes
             }
         else:
@@ -287,6 +308,155 @@ class FakeAgentFleet:
     def policies_on(self, node: str) -> set:
         return set(self.agents[node].policies)
 
+    def queue_stats(self) -> dict:
+        """Per-node watcher depth/overflow/coalesce view, transport-blind:
+        netwire reads the server's dissemination_stats(); inproc reads the
+        store watchers directly.  The storm soak polls this every cycle to
+        assert boundedness."""
+        if self.transport == "netwire":
+            return self._server.dissemination_stats()
+        watchers = {
+            n: {
+                "pending": a._watcher.pending(),
+                "overflows": a._watcher.overflows,
+                "coalesced": a._watcher.coalesced,
+                "needs_resync": a._watcher.needs_resync,
+            }
+            for n, a in self.agents.items()
+        }
+        return {
+            "watchers": watchers,
+            "resyncs_total": sum(a.resyncs_seen
+                                 for a in self.agents.values()),
+            "reconnects_total": 0,
+            "resync_chunks_total": 0,
+            "resyncs_inflight": 0,
+            "resyncs_shed_total": 0,
+            "coalesced_total": sum(w["coalesced"]
+                                   for w in watchers.values()),
+        }
+
+    def resyncs_seen_total(self) -> int:
+        return sum(a.resyncs_seen for a in self.agents.values())
+
     def stop(self) -> None:
         for a in self.agents.values():
             a.stop()
+
+
+# -- policy-churn storm soak -------------------------------------------------
+
+
+def _storm_policy(uid: str, cidr: str, priority: float = 5.0):
+    """One storm policy: applied to app=web (so its span covers every node
+    hosting a web pod — the soak worlds place one per node), denying one
+    rotating ip_block.  Rewrites churn the cidr: same key, new payload."""
+    from ..apis import controlplane as cp
+    from ..apis import crd
+
+    return crd.AntreaNetworkPolicy(
+        uid=uid, name=uid, namespace="", tier_priority=250,
+        priority=priority,
+        applied_to=[crd.AntreaAppliedTo(
+            pod_selector=crd.LabelSelector.make({"app": "web"}),
+            ns_selector=crd.LabelSelector.make())],
+        rules=[crd.AntreaNPRule(
+            direction=cp.Direction.IN, action=cp.RuleAction.DROP,
+            peers=[crd.AntreaPeer(ip_block=crd.IPBlock(cidr))])],
+    )
+
+
+def fleet_converged(ctl, fleet, nodes) -> bool:
+    """Span-exact convergence against the controller's policy_set_for_node
+    oracle: per node, the agent's uid/group-name sets AND per-policy
+    generations match (generation parity pins latest-wins coalescing —
+    a stale buffered payload would show as a lagging generation)."""
+    for node in nodes:
+        want = ctl.policy_set_for_node(node)
+        a = fleet.agents[node]
+        if {p.uid: getattr(p, "generation", 0) for p in want.policies} != {
+                u: getattr(p, "generation", 0)
+                for u, p in a.policies.items()}:
+            return False
+        if set(a.address_groups) != set(want.address_groups):
+            return False
+        if set(a.applied_to_groups) != set(want.applied_to_groups):
+            return False
+    return True
+
+
+def run_churn_storm(ctl, fleet, nodes, *, rounds: int, churn: int,
+                    rewrites: Optional[int] = None,
+                    cap: Optional[int] = None,
+                    resync_concurrency: Optional[int] = None,
+                    max_cycles: int = 400) -> dict:
+    """Drive `rounds` policy-churn storms through a live fleet and pump to
+    span-exact convergence after each, asserting boundedness EVERY cycle.
+
+    One round = `churn` upserts across DISTINCT policy uids (distinct
+    watcher-queue keys — when churn > the watcher cap this forces a
+    fleet-wide overflow, the designed-to-kill case) followed by `rewrites`
+    rewrites of ONE policy (same-key churn a coalescing queue must absorb
+    in one slot).  After injecting, the fleet pumps until every node in
+    `nodes` matches the policy_set_for_node oracle; each cycle asserts
+    that no watcher's pending exceeds `cap` and that the server never runs
+    more than `resync_concurrency` resync cursors at once.
+
+    -> meters dict (cycle counts, coalesce/overflow/resync/chunk/shed
+    totals, realization p99) for the bench JSON line / test assertions."""
+    rewrites = churn * 4 if rewrites is None else rewrites
+    meters = {
+        "rounds": rounds, "churn": churn, "rewrites": rewrites,
+        "cycles": 0, "max_pending_seen": 0, "max_resyncs_inflight": 0,
+        "round_cycles": [],
+    }
+    for r in range(rounds):
+        for k in range(churn):
+            ctl.upsert_antrea_policy(_storm_policy(
+                f"storm-{k}", f"198.{(r + 1) % 8}.{k % 250}.0/24"))
+        for j in range(rewrites):
+            ctl.upsert_antrea_policy(_storm_policy(
+                "storm-0", f"203.0.{j % 250}.0/24"))
+        cycles = 0
+        while True:
+            fleet.pump()
+            cycles += 1
+            meters["cycles"] += 1
+            qs = fleet.queue_stats()
+            pend = max((w["pending"] for w in qs["watchers"].values()),
+                       default=0)
+            meters["max_pending_seen"] = max(
+                meters["max_pending_seen"], pend)
+            meters["max_resyncs_inflight"] = max(
+                meters["max_resyncs_inflight"], qs["resyncs_inflight"])
+            if cap is not None and pend > cap:
+                raise AssertionError(
+                    f"watcher pending {pend} exceeded cap {cap} "
+                    f"(round {r}, cycle {cycles})")
+            if (resync_concurrency is not None
+                    and qs["resyncs_inflight"] > resync_concurrency):
+                raise AssertionError(
+                    f"{qs['resyncs_inflight']} resyncs in flight exceeds "
+                    f"bound {resync_concurrency} (round {r})")
+            if fleet_converged(ctl, fleet, nodes):
+                break
+            if cycles >= max_cycles:
+                raise AssertionError(
+                    f"storm round {r} did not converge within "
+                    f"{max_cycles} pump cycles")
+        meters["round_cycles"].append(cycles)
+    qs = fleet.queue_stats()
+    meters.update({
+        "coalesced_total": qs["coalesced_total"],
+        "overflows_total": sum(w["overflows"]
+                               for w in qs["watchers"].values()),
+        "resyncs_total": qs["resyncs_total"],
+        "resync_chunks_total": qs["resync_chunks_total"],
+        "resyncs_shed_total": qs["resyncs_shed_total"],
+        "reconnects_total": qs["reconnects_total"],
+        "agent_resyncs_seen": fleet.resyncs_seen_total(),
+        "events_total": fleet.total_events(),
+        "realization_p99_s": fleet.realization_p99_s(),
+        "realization_unstamped_total": fleet.realization_unstamped_total(),
+    })
+    return meters

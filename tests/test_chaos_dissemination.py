@@ -380,9 +380,12 @@ def test_chaos_cache_corruption_detected_repaired_reconverges():
 
 def test_bounded_watcher_overflow_forces_resync():
     """A consumer that stops pumping must cost one resync, never unbounded
-    controller memory: the queue caps, overflow flips needs_resync, and
-    the next pump re-lists — including retracting objects deleted during
-    the outage (events the dropped buffer never delivered)."""
+    controller memory — with the coalescing discipline layered in: churn
+    that rewrites the SAME key occupies one slot (latest-wins, metered),
+    so only DISTINCT-key churn can hit the cap; when it does, the buffer
+    drops, needs_resync flips, and the next pump re-lists — including
+    retracting objects deleted during the outage (events the dropped
+    buffer never delivered)."""
     cap = 8
     ctl = NetworkPolicyController()
     store = RamStore()
@@ -401,11 +404,24 @@ def test_bounded_watcher_overflow_forces_resync():
     assert set(agent.policies) == {"np-web"}
 
     w = agent._watcher
-    # Outage: 20 group-churn events with no pump — more than the cap.
+    # Outage phase 1: 20 member-churn events with no pump, ALL rewriting
+    # one AddressGroup (np-web's client peer) — a storm a pre-coalescing
+    # queue would have overflowed at the cap.  Latest-wins absorbs it in
+    # one slot, metered, stream intact.
     for i in range(20):
         ctl.upsert_pod(crd.Pod(namespace="default", name=f"c{i}",
                                ip=f"10.0.2.{i + 1}", node="n2",
                                labels={"app": "client"}))
+    assert w.pending() == 1  # one queued key, 19 re-deliveries coalesced
+    assert w.coalesced == 19
+    assert not w.needs_resync and w.overflows == 0
+
+    # Outage phase 2: DISTINCT-key churn (each policy mints its own
+    # NetworkPolicy + AddressGroup keys) — the case coalescing cannot
+    # absorb.  The queue caps, drops, and invalidates the stream.
+    for i in range(cap):
+        ctl.upsert_antrea_policy(
+            _policy(f"burst-{i}", cidr=f"198.51.{i}.0/24"))
         assert w.pending() <= cap  # never grows past the cap
     assert w.needs_resync and w.overflows == 1
     assert w.pending() == 0  # overflowed buffer was dropped, not kept
@@ -415,10 +431,12 @@ def test_bounded_watcher_overflow_forces_resync():
     agent.pump()
     assert agent.resyncs_seen == 1
     assert not w.needs_resync
-    # Tables now mirror the span-filtered snapshot exactly (empty: the
-    # policy and its groups are gone, nothing else spans n1).
+    # Tables now mirror the span-filtered snapshot exactly: np-web and
+    # its groups are gone, the burst policies span n1 via its web pod.
     ps = ctl.policy_set_for_node("n1")
-    assert set(agent.policies) == {p.uid for p in ps.policies} == set()
+    want_uids = {p.uid for p in ps.policies}
+    assert "np-web" not in want_uids
+    assert set(agent.policies) == want_uids
     assert set(agent.address_groups) == set(ps.address_groups)
     assert set(agent.applied_to_groups) == set(ps.applied_to_groups)
     agent.stop()
@@ -507,13 +525,15 @@ def test_wire_overflow_resync_over_mtls(tmp_path):
         _converge(ctl, srv, agents, pkts, cap=cap)
         base = {n: a.resyncs_total for n, a in agents.items()}
 
-        # Burst: each upsert moves both policies' address groups; well
-        # past the cap before any pump runs.
-        ctl.upsert_antrea_policy(_policy("P2", cidr="198.51.100.0/24"))
+        # Burst: DISTINCT-key churn (each policy mints its own
+        # NetworkPolicy + AddressGroup keys, spanning both nodes' web
+        # pods) — well past the cap before any pump runs.  Same-key
+        # churn would coalesce; distinct keys are the overflow case.
+        # B0's CIDR covers a probe source (198.51.100.9), so oracle
+        # parity is only reachable THROUGH the re-list.
         for i in range(12):
-            ctl.upsert_pod(crd.Pod(
-                namespace="default", name=f"w{i}", ip=f"10.9.0.{i + 1}",
-                node=nodes[i % 2], labels={"app": "web"}))
+            ctl.upsert_antrea_policy(
+                _policy(f"B{i}", cidr=f"198.51.{100 + i}.0/24"))
         stats = srv.dissemination_stats()
         assert any(w["overflows"] > 0 for w in stats["watchers"].values())
         assert all(w["pending"] <= cap for w in stats["watchers"].values())

@@ -14,6 +14,8 @@ from antrea_tpu.controller.status import StatusAggregator
 from antrea_tpu.datapath import OracleDatapath
 from antrea_tpu.dissemination import RamStore
 from antrea_tpu.dissemination.netwire import (
+    Backoff,
+    BackoffPolicy,
     DisseminationServer,
     NetAgent,
     make_ca,
@@ -46,6 +48,43 @@ def _policy(uid="P"):
                                 peers=[crd.AntreaPeer(
                                     ip_block=crd.IPBlock("192.0.2.0/24"))])],
     )
+
+
+def test_backoff_jitter_diverges_per_node():
+    """The thundering-herd regression: 10k agents that lost the controller
+    at the same instant must not redial in lockstep.  Two clients with
+    IDENTICALLY seeded rngs (the worst case — fleet processes forked from
+    one image can share PRNG state) but different node names must produce
+    elementwise-diverging schedules, each still capped; and reset() must
+    restart the exponential ladder without touching the node factor."""
+    import random
+
+    base, cap = 0.05, 2.0
+    b1 = BackoffPolicy(base=base, cap=cap, rng=random.Random(7), node="n1")
+    b2 = BackoffPolicy(base=base, cap=cap, rng=random.Random(7), node="n2")
+    assert BackoffPolicy is Backoff  # the policy name is the class
+    s1 = [b1.next_delay() for _ in range(12)]
+    s2 = [b2.next_delay() for _ in range(12)]
+    # Same seed, same attempt, same base — ONLY the node factor differs:
+    # every element must diverge (pre-fix, these schedules were equal and
+    # the whole fleet redialed on the same tick).
+    assert all(a != b for a, b in zip(s1, s2))
+    # Deterministic per node: rebuilding the policy reproduces the factor.
+    assert Backoff(node="n1").node_factor == b1.node_factor
+    assert b1.node_factor != b2.node_factor
+    # Every delay respects the cap regardless of jitter (the factor only
+    # shrinks or holds: nobody waits longer than an un-jittered client).
+    for s in (s1, s2):
+        assert all(0.0 < d <= cap for d in s)
+    # The ladder still grows before the cap bites, and reset() restarts
+    # it deterministically for the same rng state.
+    b3 = Backoff(base=base, cap=cap, rng=random.Random(3), node="n1")
+    first = b3.next_delay()
+    later = [b3.next_delay() for _ in range(8)]
+    assert max(later) > first  # exponential growth happened
+    b3.reset()
+    assert b3.attempt == 0
+    assert b3.next_delay() <= base * b3.node_factor  # back to rung 0
 
 
 def test_mtls_stream_and_status_roundtrip(tmp_path):

@@ -1,6 +1,6 @@
 """Unified static-analysis plane (ISSUE 14 tentpole): one AST engine
-(antrea_tpu/analysis/), the nine migrated drift gates, the four new
-semantic passes, and the baseline discipline.
+(antrea_tpu/analysis/), the nine migrated drift gates, the semantic
+passes, and the baseline discipline.
 
 Tier-1 invokes the FULL pass suite exactly ONCE here — the nine
 scattered per-test subprocess invocations (test_profile/test_selfheal/
@@ -9,7 +9,7 @@ tools/check_*.py CLIs remain as thin shims whose verdict parity with
 the pass-based engine is pinned below, clean tree AND synthetically
 broken tree per tool.
 
-Each of the four new semantic passes additionally proves it FIRES on a
+Each of the semantic passes additionally proves it FIRES on a
 seeded violation (a minimal synthetic tree carrying exactly the bug
 class the pass pins), so a future refactor that silently lobotomizes a
 pass fails here, not in review."""
@@ -32,6 +32,7 @@ ALL_PASSES = (
     "mesh", "metrics", "phases", "events", "commit-plane", "audit-plane",
     "maintenance", "reshard", "tenant",
     "thread-safety", "bounded-cache", "jit-purity", "donation-safety",
+    "bounded-buffer",
 )
 
 
@@ -324,6 +325,39 @@ def test_donation_safety_pass_fires_on_seeded_violation(tmp_path):
     assert "z.py:caller_same_line:self._state" in objs
     assert not any("caller_loop_ok" in o for o in objs)
     assert not any("caller_ok" in o for o in objs)
+
+
+def test_bounded_buffer_pass_fires_on_seeded_violations(tmp_path):
+    root = _mini_tree(tmp_path, {
+        "antrea_tpu/dissemination/wild.py": (
+            "from collections import deque\n\n"
+            'BUFFER_CAPS = {\n'
+            '    "W.good_queue": "bounded at max_pending",\n'
+            '    "W.ghost_buf": "names a buffer nobody assigns",\n'
+            "}\n\n\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self.good_queue = deque()  # declared: no finding\n"
+            "        self.evil_backlog = []  # undeclared buffer\n"
+            "        self._rdbuf: bytes = b''  # AnnAssign form, undeclared\n"
+            "        self.count = 0  # not buffer-shaped: no finding\n"
+        ),
+        # Buffers OUTSIDE dissemination/ are out of scope for this pass.
+        "antrea_tpu/datapath/elsewhere.py": (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self.free_queue = []\n"
+        ),
+    })
+    objs = {f.obj for f in run(root, ["bounded-buffer"]).findings}
+    assert "dissemination/wild.py:W.evil_backlog" in objs
+    assert "dissemination/wild.py:W._rdbuf" in objs
+    # Stale declarations are findings too: a cap row cannot outlive the
+    # buffer it excuses.
+    assert "dissemination/wild.py:W.ghost_buf:stale" in objs
+    assert not any("good_queue" in o for o in objs)
+    assert not any("count" in o for o in objs)
+    assert not any("elsewhere" in o for o in objs)
 
 
 # ---------------------------------------------------------------------------
