@@ -46,6 +46,11 @@ Routes:
                         flightrec.py: ring stats + events in sequence
                         order); ?tail=N keeps the last N, ?kind= filters
                         by event kind
+  GET /telemetry        hot-path telemetry plane (observability/
+                        telemetry.py: in-kernel counter totals, per-scope
+                        per-regime step-latency summaries, sentinel
+                        window/baseline state); 404 when the datapath was
+                        built telemetry=False
   GET /memberlist       alive members of the gossip cluster
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
@@ -93,6 +98,10 @@ HANDLER_SAFE = (
     "realization_tracer.spans",
     "flightrecorder_stats",
     "flightrecorder_events",
+    "telemetry_stats",
+    # /metrics: the histogram rows are snapshot tuples; Histogram reads
+    # are monotonic-counter fetches like step_hist's.
+    "telemetry_plane",
     "trace",
     # /agentinfo collector (observability/agentinfo.collect_agent_info
     # receives the live object; generation/datapath_type are single
@@ -312,6 +321,12 @@ class AgentApiServer:
                         f"{', '.join(sorted(EVENT_KINDS))})")
             body["events"] = self._dp.flightrecorder_events(tail=tail,
                                                             kind=kind)
+            return body
+        if route == "/telemetry":
+            tl = getattr(self._dp, "telemetry_stats", None)
+            body = tl() if tl is not None else None
+            if body is None:
+                raise KeyError(route)  # datapath built telemetry=False
             return body
         if route == "/memberlist":
             if self._memberlist is None:

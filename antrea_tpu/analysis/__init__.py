@@ -48,6 +48,7 @@ from . import (  # noqa: E402,F401
     jit_purity,
     donation,
     bounded_buffer,
+    telemetry,
 )
 
 __all__ = [

@@ -366,6 +366,33 @@ def _cmd_flightrecorder(args) -> int:
     return 0
 
 
+def _cmd_telemetry(args) -> int:
+    """Hot-path telemetry plane over the live agent API
+    (observability/telemetry.py; route GET /telemetry)."""
+    body = json.loads(_fetch(args.server, "/telemetry"))
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    print("counters: " + " ".join(
+        f"{k}={v}" for k, v in body["counters"].items()))
+    print(f"steps={body['steps_total']} sweeps={body['sweeps_total']} "
+          f"regressions={body['regressions_total']}")
+    rows = []
+    for scope, regs in body["regimes"].items():
+        for regime, row in regs.items():
+            rows.append([scope, regime, str(row["count"]),
+                         f"{row['p50_seconds']:.6f}",
+                         f"{row['p99_seconds']:.6f}"])
+    _print_table(["SCOPE", "REGIME", "STEPS", "P50-S", "P99-S"], rows)
+    srows = [
+        [regime, str(row["window_samples"]), str(row["baseline_samples"]),
+         f"{row['baseline_p99_seconds']:.6f}"]
+        for regime, row in body["sentinel"].items()
+    ]
+    _print_table(["REGIME", "WINDOW", "BASELINE", "BASE-P99-S"], srows)
+    return 0
+
+
 def _print_table(header: list, rows: list) -> None:
     """Fixed-width column table (the reference antctl's output shape)."""
     widths = [len(h) for h in header]
@@ -500,6 +527,14 @@ def main(argv=None) -> int:
                     help="filter by event kind (see EVENT_KINDS)")
     fr.add_argument("--json", action="store_true", help="raw JSON body")
     fr.set_defaults(fn=_cmd_flightrecorder)
+
+    tl = sub.add_parser(
+        "telemetry",
+        help="hot-path telemetry counters / regime latencies / sentinel",
+    )
+    tl.add_argument("--server", required=True, help="live agent API base URL")
+    tl.add_argument("--json", action="store_true", help="raw JSON body")
+    tl.set_defaults(fn=_cmd_telemetry)
 
     c = sub.add_parser("check", help="installation self-diagnostics")
     c.set_defaults(fn=_cmd_check)

@@ -273,6 +273,139 @@ class Datapath(ABC):
         return ([] if self._flightrec is None
                 else self._flightrec.events(tail=tail, kind=kind))
 
+    # -- hot-path telemetry (observability/telemetry.py) --------------------
+    # Engines with telemetry=True build a TelemetryPlane at construction
+    # and call _telemetry_account from _step + observe_step from the
+    # step's timing bracket; instances built without the knob keep
+    # _telemetry = None and every accessor inert.
+
+    _telemetry = None
+
+    @property
+    def telemetry_plane(self):
+        """The hot-path telemetry accumulator (None when the datapath was
+        built with telemetry=False): in-kernel counter totals, per-regime
+        step histograms and the sentinel's window/baseline state."""
+        return self._telemetry
+
+    def telemetry_stats(self) -> Optional[dict]:
+        """Counter totals + regime latency summaries + sentinel state —
+        the payload GET /telemetry, antctl and the support bundle serve.
+        None when telemetry is off."""
+        return None if self._telemetry is None else self._telemetry.stats()
+
+    def _shed_total(self) -> int:
+        """Cumulative lanes the async admission plane has shed (early
+        drops + per-source buckets + queue overflows) — the attack-shed
+        classification input.  0 on synchronous instances (they classify
+        every miss in-line; nothing sheds)."""
+        eng = self._slowpath
+        if eng is None:
+            return 0
+        return int(eng.early_drops_total + eng.source_limited_total
+                   + eng.queue.overflows_total)
+
+    def _telemetry_account(self, o: dict, batch_size: int) -> Optional[str]:
+        """Fold one step's telemetry: counter outputs, then classify the
+        batch into its regime (from the batch's OWN outputs — n_miss plus
+        sheds attributable to this batch) and queue the engine/tenant
+        scope notes for the timing bracket to fold.  Returns the regime
+        (the mesh extends with per-replica notes) or None when off."""
+        tp = self._telemetry
+        if tp is None:
+            return None
+        from ..observability.telemetry import classify_regime
+
+        tp.account(o)
+        shed = tp.note_shed(self._shed_total())
+        n_miss = int(np.asarray(o["n_miss"]).sum())
+        regime = classify_regime(batch_size, n_miss, shed)
+        tp.note_regime("engine", regime)
+        tid = self._tenant_id()
+        if tid:
+            tp.note_regime(f"tenant:{tid}", regime)
+        return regime
+
+    # -- deny export plane (observability/flowexport.py) --------------------
+    # Off by default; attaching a FlowExporter (or calling
+    # enable_deny_export directly) arms it.  Policy-DROP verdicts and
+    # shed admissions then land in a bounded drop-oldest ring the
+    # exporter drains into event="deny" flow records — denied traffic is
+    # visible as records, not only counters (the reference's deny
+    # connection store, pkg/agent/flowexporter/connections).
+
+    _deny = None  # DenyRing once armed
+
+    @property
+    def deny_ring(self):
+        return self._deny
+
+    def enable_deny_export(self, capacity: int = 4096):
+        """Arm the deny plane (idempotent): build the bounded ring and
+        hook the slow path's admission sheds into it."""
+        if self._deny is None:
+            from ..observability.flowexport import DenyRing
+
+            self._deny = DenyRing(capacity)
+            eng = self._slowpath
+            if eng is not None:
+                eng.deny_sink = self._deny_shed_record
+        return self._deny
+
+    def deny_drain(self) -> list[dict]:
+        """Pop every pending deny record (FlowExporter.poll's feed)."""
+        return [] if self._deny is None else self._deny.drain()
+
+    def _deny_shed_record(self, cols: dict, mask, reason: str,
+                          now: int) -> None:
+        """SlowPathEngine deny sink: record the masked admission columns
+        as deny events.  `reason` names which shed gate fired
+        (source-limit / early-drop / queue-overflow)."""
+        from ..utils import ip as iputil
+
+        ring = self._deny
+        if ring is None:
+            return
+        src = np.asarray(cols["src_ip"])
+        dst = np.asarray(cols["dst_ip"])
+        sport = np.asarray(cols["src_port"])
+        dport = np.asarray(cols["dst_port"])
+        proto = np.asarray(cols["proto"])
+        for i in np.nonzero(np.asarray(mask, bool))[0]:
+            ring.record({
+                "src": iputil.u32_to_ip(int(src[i]) & 0xFFFFFFFF),
+                "dst": iputil.u32_to_ip(int(dst[i]) & 0xFFFFFFFF),
+                "sport": int(sport[i]), "dport": int(dport[i]),
+                "proto": int(proto[i]), "reply": False,
+                "reason": reason, "at": int(now),
+            })
+
+    def _deny_verdicts(self, batch: PacketBatch, code, pending,
+                       now: int) -> None:
+        """Record this step's policy-DROP lanes (reason="policy").
+        Pending lanes are excluded: their DROP is the hold-admission's
+        PROVISIONAL verdict, not a policy decision — if the drain
+        classifies the flow DROP, its next packet records here as a
+        cache-hit drop."""
+        ring = self._deny
+        if ring is None:
+            return
+        from ..compiler.compile import ACT_DROP
+        from ..utils import ip as iputil
+
+        mask = np.asarray(code) == ACT_DROP
+        if pending is not None:
+            mask &= np.asarray(pending) == 0
+        for i in np.nonzero(mask)[0]:
+            ring.record({
+                "src": iputil.u32_to_ip(int(batch.src_ip[i])),
+                "dst": iputil.u32_to_ip(int(batch.dst_ip[i])),
+                "sport": int(batch.src_port[i]),
+                "dport": int(batch.dst_port[i]),
+                "proto": int(batch.proto[i]), "reply": False,
+                "reason": "policy", "at": int(now),
+            })
+
     # -- async slow-path surface (datapath/slowpath; both engines) ----------
     # Shared plumbing: each engine implements the CLASSIFY callbacks
     # (_drain_classify/_epoch_revalidate/_epoch_age_scan) and calls

@@ -78,6 +78,10 @@ MAINT_TASKS = {
                        "tenant world per granted unit, rotating over "
                        "worlds; registered on first tenant_create only — "
                        "untenanted engines keep the original task set)",
+    "telemetry-sentinel": "observability/telemetry.py (budgeted rolling "
+                          "p99-vs-baseline regime sweep; journals "
+                          "perf-regression, never acts — registered only "
+                          "on telemetry=True engines)",
 }
 
 # A starved task's deficit keeps accumulating so it can eventually afford
@@ -511,6 +515,15 @@ class MaintainableDatapath:
             sched.register(MaintenanceTask(
                 "observability", self._maint_observability, budget=64,
                 priority=5))
+        # Telemetry sentinel (observability/telemetry.py): budgeted
+        # regime sweep comparing rolling-window p99 against the rolling
+        # baseline, journaling perf-regression.  Cosmetic while degraded
+        # — a degraded engine is ALREADY in recovery; a latency verdict
+        # adds nothing the commit plane doesn't know.
+        if getattr(self, "_telemetry", None) is not None:
+            sched.register(MaintenanceTask(
+                "telemetry-sentinel", self._maint_telemetry_sentinel,
+                budget=2, priority=7, shed_when_degraded=True))
 
     # -- public surface ------------------------------------------------------
 
@@ -612,6 +625,24 @@ class MaintainableDatapath:
         spent = min(backlog, int(budget))
         self._obs_cost_backlog = backlog - spent
         return spent
+
+    def _maint_telemetry_sentinel(self, now: int, budget: int) -> int:
+        """Perf-regression sentinel (observability/telemetry.py): spend =
+        regimes judged this grant.  One unit buys one regime's
+        window-vs-baseline verdict; the round-robin cursor inside the
+        plane guarantees every regime is reached across ticks.  Findings
+        are journaled (kind `perf-regression`, clocked by the scheduler
+        tick so FaultClock drives reproduction deterministically) and
+        metered — NEVER acted on: latency regressions are an operator
+        signal, not a correctness fault the commit plane should roll
+        back."""
+        tp = getattr(self, "_telemetry", None)
+        if tp is None:
+            return 0
+        checked, events = tp.sentinel_sweep(budget)
+        for ev in events:
+            self._emit("perf-regression", at=now, **ev)
+        return checked
 
     def _maint_recompile(self, now: int, budget: int) -> int:
         """Degraded-mode recovery, paced by a capped exponential backoff

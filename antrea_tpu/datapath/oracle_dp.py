@@ -43,6 +43,7 @@ from ..compiler.topology import (
 )
 from ..compiler.compile import ACT_ALLOW, ACT_DROP
 from ..observability.metrics import Histogram
+from ..observability.telemetry import TelemetryPlane
 from ..oracle.interpreter import Oracle
 from ..oracle.pipeline import PipelineOracle, _reject_kind
 from ..utils import ip as iputil
@@ -117,6 +118,7 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         autotune_prune: bool = False,
         fused: bool = False,
         second_chance: bool = False,
+        telemetry: bool = False,
         miss_source_rate=None,
         miss_source_burst=None,
     ):
@@ -211,6 +213,14 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         # Observability plane BEFORE the commit/audit planes — same
         # contract as the kernel twin (flight recorder + span tracer).
         self._init_observability(flightrec_slots, realization_slots)
+        # Hot-path telemetry accumulator — same plane as the kernel twin
+        # (observability/telemetry.py), built before the maintenance
+        # scheduler so the sentinel task registers.  The scalar walk has
+        # no DMA half-blocks and no generation-stale probe split, so
+        # those counters stay 0 here (documented divergence; hit/miss
+        # and the regime histograms are twin-parity).
+        if telemetry:
+            self._telemetry = TelemetryPlane()
         # Commit plane LAST (datapath/commit.py): boot state is the LKG
         # baseline — same contract as the kernel twin.
         self._init_commit_plane(canary_probes=canary_probes)
@@ -442,6 +452,8 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
             return self._tenant_drain_dispatch(split, now)
         from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
 
+        t0 = time.perf_counter()
+        tel_tid = self._tenant_id() if self._telemetry is not None else 0
         batch = PacketBatch(
             src_ip=block["src_ip"].astype(np.uint32),
             dst_ip=block["dst_ip"].astype(np.uint32),
@@ -467,6 +479,15 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
 
         def finalize():
             self._count_outcomes(outs, lens)
+            if self._telemetry is not None:
+                # Drains fold into the "drain" regime directly, scope
+                # captured at dispatch — same contract as the kernel
+                # twin's finalize.
+                dt = time.perf_counter() - t0
+                self._telemetry.observe_scoped("engine", "drain", dt)
+                if tel_tid:
+                    self._telemetry.observe_scoped(
+                        f"tenant:{tel_tid}", "drain", dt)
 
         if self._overlap:
             return finalize
@@ -770,7 +791,7 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         rules)), so its candidate-gather number IS its classify number —
         the honest twin statement, kept mode-for-mode."""
         if mode not in ("sync", "async", "overlap", "maintenance", "prune",
-                        "fused"):
+                        "fused", "telemetry"):
             raise ValueError(f"unknown profile mode {mode!r}")
         if mode == "prune" and self._prune_budget <= 0:
             # Twin-parity with TpuflowDatapath.profile: both engines
@@ -796,6 +817,30 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
 
         o = self._oracle
         gen_w = self._gen % GEN_ETERNAL
+        if mode == "telemetry":
+            # Telemetry-counter structure check — the scalar twin of
+            # TpuflowDatapath.profile(mode="telemetry"): read-only cache
+            # lookups of the probe batch split into the same
+            # TELEMETRY_COUNTERS keys (probe_stale / chance_bumps /
+            # dma_hb stay 0: no generation-stale split, no replacement
+            # counter, no DMA on the scalar walk).  State untouched.
+            n_hit = 0
+            for i in range(batch.size):
+                p = batch.packet(i)
+                _slot, e = o.lookup(o.flow, p, o._flow_hash(p), now, gen_w)
+                if e is not None:
+                    n_hit += 1
+            return {
+                "mode": "telemetry",
+                "batch": batch.size,
+                "counters": {
+                    "probe_hit": n_hit,
+                    "probe_stale": 0,
+                    "probe_miss": batch.size - n_hit,
+                    "chance_bumps": 0,
+                    "dma_hb": 0,
+                },
+            }
         probes = [batch] + ([fresh] if fresh is not None else [])
         packets = [b.packet(i) for b in probes for i in range(b.size)]
         misses = []
@@ -989,7 +1034,10 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         try:
             return self._step(batch, now)
         finally:
-            self.step_hist.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.step_hist.observe(dt)
+            if self._telemetry is not None:
+                self._telemetry.observe_step(dt)
 
     def _step(self, batch: PacketBatch, now: int) -> StepResult:
         from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
@@ -1049,10 +1097,27 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
                     self._tenant_admit_mask(pend), now,
                 )
                 self._tenant_note_admitted(admitted, _dropped)
+        if self._telemetry is not None:
+            # Scalar probe split: a lane either found its flow row (hit)
+            # or walked the tables (miss); the scalar cache is a dict, so
+            # there is no generation-stale rejection to split out —
+            # probe_stale stays 0 (documented twin divergence).  Skipped
+            # lanes (SpoofGuard) probe nothing, like the kernel's
+            # valid-masked lanes.
+            n_miss = sum(1 for o in outs if not (o.hit or o.skipped))
+            n_hit = sum(1 for o in outs if o.hit and not o.skipped)
+            self._telemetry_account(
+                {"n_miss": n_miss,
+                 "tel_probe_hit": n_hit,
+                 "tel_probe_miss": n_miss},
+                batch.size)
         fwd = self._forward_fields(batch, outs, in_ports, lane_modes,
                                    arp_ops)
         self._count_outcomes(outs, lens)
-        return self._to_result(outs, fwd)
+        res = self._to_result(outs, fwd)
+        if self._deny is not None:
+            self._deny_verdicts(batch, res.code, res.pending, now)
+        return res
 
     def _count_outcomes(self, outs, lens) -> None:
         """NetworkPolicyStats accounting shared by step() and the drain

@@ -250,6 +250,11 @@ class SlowPathEngine:
                                    else max(1, int(2 * source_rate))))
         self._source_buckets: dict[int, list] = {}
         self.source_limited_total = 0  # admissions shed by a source bucket
+        # Deny-export hook (owner.enable_deny_export wires it): called as
+        # deny_sink(cols, shed_mask, reason, now) for every shed gate so
+        # shed traffic exports as event="deny" flow records, not only
+        # counters.  None = the plane is off and sheds cost nothing extra.
+        self.deny_sink: Optional[Callable] = None
         self.overlap = bool(overlap_commits)
         # Two-slot pending-commit ring: (finalize, staged packet-clock).
         self._staged: deque[tuple[Callable[[], None], int]] = deque()
@@ -400,13 +405,25 @@ class SlowPathEngine:
         # Per-source rate limiting runs AHEAD of the depth-proportional
         # early-drop ramp: a single scanning source is clamped by its
         # own bucket before it can push the shared queue into the ramp.
-        kept = self._source_limit(cols, miss_mask, now)
-        kept, _shed = self._early_drop(cols, kept, self.queue)
-        admitted, dropped = self.queue.admit(cols, kept, self.epoch,
+        base = np.asarray(miss_mask, bool)
+        kept = self._source_limit(cols, base, now)
+        if self.deny_sink is not None and kept.sum() < base.sum():
+            self.deny_sink(cols, base & ~kept, "source-limit", now)
+        kept2, _shed = self._early_drop(cols, kept, self.queue)
+        if self.deny_sink is not None and _shed:
+            self.deny_sink(cols, kept & ~kept2, "early-drop", now)
+        admitted, dropped = self.queue.admit(cols, kept2, self.epoch,
                                              int(now))
         if dropped:
             self._emit("queue-overflow", dropped=int(dropped),
                        depth=int(self.queue.depth), at=int(now))
+            if self.deny_sink is not None:
+                # The ring keeps arrival order and tail-drops: the
+                # overflowed lanes are exactly the LAST `dropped` kept
+                # lanes.
+                over = np.zeros(kept2.shape, bool)
+                over[np.nonzero(kept2)[0][admitted:]] = True
+                self.deny_sink(cols, over, "queue-overflow", now)
         return admitted, dropped
 
     # -- epoch plane ---------------------------------------------------------

@@ -47,6 +47,7 @@ from ..models import forwarding as fwd
 from ..models import pipeline as pl
 from ..observability.flightrec import emit_into
 from ..observability.metrics import Histogram
+from ..observability.telemetry import TelemetryPlane
 from ..ops.match import (PRUNE_HIST_BOUNDS, PRUNE_LADDER, DeltaTable,
                          PruneAutotuner, to_host)
 from ..packet import Packet, PacketBatch
@@ -122,6 +123,7 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         prune_budget: int = 0,
         autotune_prune: bool = False,
         second_chance: bool = False,
+        telemetry: bool = False,
         miss_source_rate: Optional[float] = None,
         miss_source_burst: Optional[int] = None,
     ):
@@ -230,6 +232,9 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             # counter, models/pipeline CHANCE_SHIFT); off by default so
             # the compiled step stays bit-identical.
             second_chance=second_chance,
+            # Hot-path telemetry counters (observability/telemetry.py);
+            # off by default — telemetry=False lowers bit-identical.
+            telemetry=telemetry,
         )
         self._ps = ps if ps is not None else PolicySet()
         self._services = list(services or [])
@@ -271,6 +276,12 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         # both are pure host-side state, so the compiled step HLO is
         # bit-identical either way (latch = one int compare per step).
         self._init_observability(flightrec_slots, realization_slots)
+        # Hot-path telemetry accumulator (observability/telemetry.py):
+        # pairs with the telemetry kernel knob above; built BEFORE the
+        # maintenance scheduler so _init_maintenance can register the
+        # sentinel sweep against it.
+        if telemetry:
+            self._telemetry = TelemetryPlane()
         # Commit plane LAST: the boot state (possibly persistence-restored)
         # is the last-known-good baseline every later commit retains.
         self._init_commit_plane(canary_probes=canary_probes)
@@ -535,7 +546,13 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         try:
             return self._step(batch, now)
         finally:
-            self.step_hist.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.step_hist.observe(dt)
+            if self._telemetry is not None:
+                # Fold the SAME wall seconds into every (scope, regime)
+                # the batch classified under (_telemetry_account queued
+                # them during _step).
+                self._telemetry.observe_step(dt)
 
     def _step(self, batch: PacketBatch, now: int) -> StepResult:
         # One materialization of the per-lane byte lengths, clamped
@@ -584,9 +601,15 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                 self._tenant_admit_mask(pending != 0), now,
             )
             self._tenant_note_admitted(admitted, _dropped)
+        # Telemetry AFTER the admission block: sheds this batch just
+        # caused (early-drop / source-limit / overflow) classify IT as
+        # attack-shed, not the next one.
+        self._telemetry_account(o, batch.size)
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
+        if self._deny is not None:
+            self._deny_verdicts(batch, o["code"], pending, now)
 
         unflip = iputil.unflip_u32_array
 
@@ -854,6 +877,11 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         split = self._tenant_drain_split(block)
         if split is not None:
             return self._tenant_drain_dispatch(split, now)
+        t0 = time.perf_counter()
+        # Scope captured at DISPATCH time: a deferred finalize must fold
+        # under the tenant world that classified it, not whichever world
+        # is active when the staged commit lands.
+        tel_tid = self._tenant_id() if self._telemetry is not None else 0
         k = len(block["src_ip"])
         D = self._slowpath.drain_batch
         if k > D:
@@ -921,6 +949,17 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                  for key in ("code", "ingress_rule", "egress_rule")},
                 in_ids, out_ids, lens[sel],
             )
+            if self._telemetry is not None:
+                # A drain is its own dispatch, not a traffic batch: fold
+                # its counters and its dispatch-to-materialization wall
+                # seconds straight into the "drain" regime (the fifth
+                # regime classify_regime never produces).
+                self._telemetry.account(o)
+                dt = time.perf_counter() - t0
+                self._telemetry.observe_scoped("engine", "drain", dt)
+                if tel_tid:
+                    self._telemetry.observe_scoped(
+                        f"tenant:{tel_tid}", "drain", dt)
 
         if self._overlap:
             return finalize
@@ -1363,6 +1402,27 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             )
         hot = prof._dev_cols(batch)
         pool = prof._dev_cols(fresh) if fresh is not None else None
+        if mode == "telemetry":
+            # Telemetry-counter structure check (observability/
+            # telemetry.py): ONE instrumented step over the live state —
+            # the counters compiled in via a meta variant regardless of
+            # how the instance was built, and the step purely functional
+            # (no donation), so the served state, meters and histograms
+            # are untouched.  Returns the tel_* split of the probe batch
+            # keyed by TELEMETRY_COUNTERS name — the bench_profile
+            # --mode telemetry harness pins both twins' key sets.
+            _, out = pl._pipeline_step(
+                self._state, self._drs, self._dsvc, *hot,
+                jnp.int32(now), jnp.int32(self._gen),
+                meta=self._meta._replace(telemetry=True),
+            )
+            return {
+                "mode": "telemetry",
+                "batch": batch.size,
+                "counters": {k[4:]: int(np.asarray(v))
+                             for k, v in out.items()
+                             if k.startswith("tel_")},
+            }
         if mode == "async":
             return prof.profile_churn_async(
                 self._meta, self._state, self._drs, self._dsvc, hot, pool,
@@ -1612,6 +1672,7 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                          and match_meta.prune_budget > 0
                          and not self._dual_stack),
             second_chance=bool(self._pipe_kw["second_chance"]),
+            telemetry=bool(self._pipe_kw["telemetry"]),
         )
         # Async-mode step/drain variants of the meta: the FAST step masks
         # the whole slow path out (phases=0 — misses keep the admission

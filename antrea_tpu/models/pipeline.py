@@ -347,6 +347,16 @@ class PipelineMeta(NamedTuple):
     # Thrash-resistant replacement (the 2-bit second-chance counter, see
     # CHANCE_SHIFT above).  False keeps the compiled step bit-identical.
     second_chance: bool = False
+    # Hot-path telemetry (observability/telemetry.py): the step emits
+    # cheap in-kernel counter outputs — cache probe hit/stale/miss
+    # splits, DMA half-blocks issued by the one-pass kernel, and
+    # second-chance protection bumps — as tel_* keys in the output dict.
+    # Everything is derived XLA-side from values the step already
+    # gathers (kr0/ts0 from _cache_lookup, the guard's protected mask),
+    # so False compiles the whole plane out: no extra gathers, no extra
+    # outputs, HLO bit-identical — the same discipline as every knob
+    # above.
+    telemetry: bool = False
 
     @property
     def pref_mask(self) -> int:
@@ -503,7 +513,11 @@ def _second_chance_guard(flow: FlowCache, slot2, keys2, ins2, now, meta, A,
     challengers in a later round may then evict an entry the oracle
     keeps.  The evicted flow re-misses and re-classifies to the same
     verdict (the PR 6 lost-update discipline); the one-pass kernel and
-    single-round passes match the oracle exactly."""
+    single-round passes match the oracle exactly.
+
+    -> (flow', ins2', n_protected) — n_protected is the lane count the
+    guard suppressed this pass (the telemetry `chance_bumps` counter),
+    None unless meta.telemetry so the off path traces no extra ops."""
     ZC = _meta_cols(A)[3]
     tgt2 = jnp.where(ins2, slot2, dump)
     okr = flow.keys[tgt2]
@@ -527,13 +541,16 @@ def _second_chance_guard(flow: FlowCache, slot2, keys2, ins2, now, meta, A,
         & (cnt < CHANCE_MAX)
     )
     ins2 = ins2 & ~protected
+    n_protected = (protected.sum(dtype=jnp.int32) if meta.telemetry
+                   else None)
     # One counter bump per protected slot per pass.
     win = _winner_mask(flow.keys.shape[0] - 1, slot2, protected, dump)
     bt = jnp.where(win, slot2, dump)
     cur = flow.meta[bt, ZC]
     newc = jnp.minimum(((cur >> CHANCE_SHIFT) & CHANCE_MAX) + 1, CHANCE_MAX)
     meta_col = (cur & ~CHANCE_MASK) | (newc << CHANCE_SHIFT)
-    return flow._replace(meta=flow.meta.at[bt, ZC].set(meta_col)), ins2
+    return (flow._replace(meta=flow.meta.at[bt, ZC].set(meta_col)), ins2,
+            n_protected)
 
 
 def _pack_meta1(code, svc_idx, dnat_port):
@@ -637,6 +654,7 @@ def make_pipeline(
     prune_budget: int = 0,
     second_chance: bool = False,
     onepass: Optional[bool] = None,
+    telemetry: bool = False,
 ):
     """-> (step fn, initial PipelineState, (DeviceRuleSet, DeviceServiceTables)).
 
@@ -678,6 +696,7 @@ def make_pipeline(
         onepass=(bool(fused and prune_budget > 0 and not dual_stack)
                  if onepass is None else bool(onepass)),
         second_chance=second_chance,
+        telemetry=telemetry,
     )
     state = init_state(flow_slots, aff_slots, xp=np if host else jnp,
                        key_words=meta.key_words)
@@ -966,6 +985,31 @@ def _pipeline_step(
         hit = hit & valid
         est = est & valid
         rpl = rpl & valid
+    tel_on = meta.telemetry
+    if tel_on:
+        # Probe-split telemetry (hit / stale / miss), recomputed XLA-side
+        # from the SAME gathered key rows the probe decoded (kr0), so it
+        # costs three reductions and zero extra gathers.  `stale` = the
+        # key matched but the entry aged out (the megaflow-revalidation
+        # signal: the flow was cached and expired under traffic);
+        # generation-stale denials count as plain misses — they are
+        # invisible to lookups by design, not aged occupancy.  Lanes
+        # another dispatch owns (mesh spill retries, prune_exclude) and
+        # valid-masked lanes are excluded, the exactly-once discipline
+        # prune metering already follows.
+        tv = jnp.ones(B, bool) if valid is None else (valid != 0)
+        if prune_exclude is not None:
+            tv = tv & ~prune_exclude
+        kpg0 = kr0[:, A + 1]
+        key_hit0 = (
+            (kr0[:, :A] == addr).all(axis=1)
+            & (kr0[:, A] == pp)
+            & ((kpg0 == pg_cur) | (kpg0 == pg_est)
+               | (kpg0 == (pg_est | REPLY_BIT)))
+        )
+        tel_probe_hit = (hit & tv).sum(dtype=jnp.int32)
+        tel_probe_stale = (key_hit0 & ~hit & tv).sum(dtype=jnp.int32)
+        tel_probe_miss = (~key_hit0 & tv).sum(dtype=jnp.int32)
     DC, M1C, RC, ZC = _meta_cols(A)
     c_code, c_svc, c_dport = _unpack_meta1(mr[:, M1C])
     # Narrow dnat view: the v4 value (wide worlds: word 3, the v4-mapped
@@ -1194,7 +1238,11 @@ def _pipeline_step(
     # Round-7 prune observability (python-static: zero ops, zero extra
     # outputs when the budget is 0 — the HLO-identity contract).
     prune_on = meta.match.prune_budget > 0
-    n_extra = (1 if A == 8 else 0) + (3 if prune_on else 0)
+    # Telemetry appends two slow-path counters LAST (tel_dma_hb,
+    # tel_chance_bumps) — after the wide-DNAT image and the prune trio —
+    # so every existing position is unchanged when the knob is off.
+    n_extra = ((1 if A == 8 else 0) + (3 if prune_on else 0)
+               + (2 if tel_on else 0))
 
     # ---- slow path: ServiceLB + classify + commit, misses only -------------
     def slow(args):
@@ -1209,6 +1257,9 @@ def _pipeline_step(
             pos += 1
         if prune_on:
             pr_sk0, pr_fb0, pr_hist0 = outs[pos:pos + 3]
+            pos += 3
+        if tel_on:
+            tel_hb0, tel_sc0 = outs[pos:pos + 2]
         # Batch semantics: affinity LOOKUPS see start-of-batch state even
         # across slow-path rounds; learns land in the carried table.
         aff_snap = aff
@@ -1225,6 +1276,9 @@ def _pipeline_step(
                 pos += 1
             if prune_on:
                 pr_sk, pr_fb, pr_hist = carry[pos:pos + 3]
+                pos += 3
+            if tel_on:
+                tel_hb, tel_sc = carry[pos:pos + 2]
             idx = jax.lax.dynamic_slice(
                 jnp.concatenate([midx, jnp.full((M,), B, jnp.int32)]),
                 (r * M,),
@@ -1366,7 +1420,7 @@ def _pipeline_step(
             # Phase-gated (PH_COMMIT; the eviction audit additionally
             # requires PH_COMMIT since it reads the insert targets) so the
             # profiler can isolate the commit scatters' cost.
-            def do_commit(flow, aff, n_evict, n_reclaim):
+            def do_commit(flow, aff, n_evict, n_reclaim, tel_sc):
                 egen = jnp.where(committed_m, GEN_ETERNAL, gen_w)
                 pg_ins = p_m | 0x100 | (egen << 9)
                 m1 = _pack_meta1(code, svc_idx, dnat_port)
@@ -1458,8 +1512,10 @@ def _pipeline_step(
                 ins2 = jnp.stack([ins, rev_ins], axis=1).reshape(2 * M)
 
                 if meta.second_chance:
-                    flow, ins2 = _second_chance_guard(
+                    flow, ins2, sc_n = _second_chance_guard(
                         flow, slot2, keys2, ins2, now, meta, A, dump)
+                    if tel_on:
+                        tel_sc = tel_sc + sc_n
 
                 if meta.phases & PH_EVICT:
                     # Eviction accounting (round-2 verdict weak #5:
@@ -1547,16 +1603,18 @@ def _pipeline_step(
                     ep=_scatter_last(aff.ep, learn["aslot"], learn["ep"], lm, adump),
                     ts=_scatter_last(aff.ts, learn["aslot"], jnp.full((M,), now, jnp.int32), lm, adump),
                 )
-                return flow, aff, n_evict, n_reclaim
+                return flow, aff, n_evict, n_reclaim, tel_sc
 
             if meta.phases & PH_COMMIT:
-                flow, aff, n_evict, n_reclaim = do_commit(
-                    flow, aff, n_evict, n_reclaim)
+                flow, aff, n_evict, n_reclaim, tel_sc = do_commit(
+                    flow, aff, n_evict, n_reclaim,
+                    tel_sc if tel_on else None)
             return (r + 1, n_evict, n_reclaim, flow, aff, out_code, out_svc,
                     out_dnat_ip, out_dnat_port, out_rule_in, out_rule_out,
                     out_committed, out_snat, out_dsr) + (
                     (out_dnat_w,) if A == 8 else ()) + (
-                    (pr_sk, pr_fb, pr_hist) if prune_on else ())
+                    (pr_sk, pr_fb, pr_hist) if prune_on else ()) + (
+                    (tel_hb, tel_sc) if tel_on else ())
 
         def round_cond(carry):
             r = carry[0]
@@ -1566,7 +1624,8 @@ def _pipeline_step(
                  out_svc, out_dnat_ip, out_dnat_port, out_rule_in,
                  out_rule_out, out_committed, out_snat, out_dsr) + (
                  (out_dnat_w,) if A == 8 else ()) + (
-                 (pr_sk0, pr_fb0, pr_hist0) if prune_on else ())
+                 (pr_sk0, pr_fb0, pr_hist0) if prune_on else ()) + (
+                 (tel_hb0, tel_sc0) if tel_on else ())
         carry = jax.lax.while_loop(round_cond, round_body, carry)
         (_, n_evict, n_reclaim, flow, aff, out_code, out_svc, out_dnat_ip,
          out_dnat_port, out_rule_in, out_rule_out, out_committed,
@@ -1589,6 +1648,18 @@ def _pipeline_step(
         (out_code0, out_svc0, out_dnat0, out_dport0, out_ri0, out_ro0,
          out_cmt0, out_snat0, out_dsr0, n_evict, n_reclaim) = outs[:11]
         pr_sk0, pr_fb0, pr_hist0 = outs[11:14]
+        if tel_on:
+            tel_hb, tel_sc = outs[14:16]
+            if meta.phases & PH_CLS:
+                # DMA half-blocks the one-pass kernel issues for this
+                # dispatch: its main loop walks EVERY _OP_HB half-block
+                # of the padded batch unconditionally (the double-buffer
+                # schedule, ops/match round-8 study note), so the count
+                # is a physical constant of the batch shape — replicated
+                # -safe, and the denominator the candidate-hist numbers
+                # are read against.
+                tel_hb = tel_hb + jnp.int32(
+                    (B + (-B) % _m._FUSE_TB) // _m._OP_HB)
         aff_snap = aff
         validm = jnp.ones(B, bool) if valid is None else (valid != 0)
         ncm = (jnp.zeros(B, bool) if no_commit is None
@@ -1921,8 +1992,10 @@ def _pipeline_step(
             ins2 = jnp.stack([ins, rev_ins], axis=1).reshape(2 * B)
 
             if meta.second_chance:
-                flow, ins2 = _second_chance_guard(
+                flow, ins2, sc_n = _second_chance_guard(
                     flow, slot2, keys2, ins2, now, meta, A, dump)
+                if tel_on:
+                    tel_sc = tel_sc + sc_n
 
             if meta.phases & PH_EVICT:
                 tgt2 = jnp.where(ins2, slot2, dump)
@@ -1994,7 +2067,8 @@ def _pipeline_step(
             outbuf(o_code), outbuf(o_svc), outbuf(o_dnat), outbuf(o_dport),
             outbuf(o_ri), outbuf(o_ro),
             outbuf(committed.astype(jnp.int32)), outbuf(o_snat),
-            outbuf(o_dsr), n_evict, n_reclaim, pr_sk, pr_fb, pr_hist)
+            outbuf(o_dsr), n_evict, n_reclaim, pr_sk, pr_fb, pr_hist) + (
+            (tel_hb, tel_sc) if tel_on else ())
 
     def noop(args):
         return args
@@ -2006,7 +2080,9 @@ def _pipeline_step(
                              (out_dnat_w,) if A == 8 else ()) + ((
                              jnp.int32(0), jnp.int32(0),
                              jnp.zeros(len(PRUNE_HIST_BOUNDS) + 2,
-                                       jnp.int32)) if prune_on else ()))
+                                       jnp.int32)) if prune_on else ()) + (
+                             (jnp.int32(0), jnp.int32(0))
+                             if tel_on else ()))
     if meta.phases & PH_SLOW:
         slow_body = slow_onepass if meta.onepass else slow
         flow, aff, outs = jax.lax.cond(n_miss > 0, slow_body, noop,
@@ -2076,6 +2152,18 @@ def _pipeline_step(
         # consumers (forwarding, StepResult) read; v4 lanes' word 3 equals
         # dnat_ip_f.  Reply hits carry the un-DNAT frontend words.
         out["dnat_w_f"] = out_dnat_w[:B]
+    if tel_on:
+        # Hot-path telemetry counters (observability/telemetry.py
+        # TELEMETRY_COUNTERS): keys exist iff meta.telemetry, so the off
+        # path's output pytree — and its compiled HLO — is unchanged.
+        # The prune trio above doubles as the telemetry candidate-hist /
+        # skip / fallback source when prune_budget > 0.
+        pos_t = 11 + (1 if A == 8 else 0) + (3 if prune_on else 0)
+        out["tel_probe_hit"] = tel_probe_hit
+        out["tel_probe_stale"] = tel_probe_stale
+        out["tel_probe_miss"] = tel_probe_miss
+        out["tel_dma_hb"] = outs[pos_t]
+        out["tel_chance_bumps"] = outs[pos_t + 1]
     return PipelineState(flow=flow, aff=aff), out
 
 

@@ -149,6 +149,12 @@ EVENT_KINDS = {
     "resync-shed": "dissemination/netwire.py — the admission gate "
                    "deferred a watcher's resync because "
                    "resync_concurrency cursors were already in flight",
+    "perf-regression": "observability/telemetry.py — the telemetry "
+                       "sentinel found a regime's rolling-window p99 "
+                       "burning past ratio x its rolling baseline "
+                       "(payload: regime, p99, baseline_p99, samples, "
+                       "ratio) — journal-and-meter only, never an "
+                       "automatic rollback",
 }
 
 

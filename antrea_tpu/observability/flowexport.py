@@ -18,6 +18,7 @@ directions and fans out to sinks).  The wire format here is JSON lines
 from __future__ import annotations
 
 import json
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -27,11 +28,45 @@ def _key(rec: dict) -> tuple:
             rec["reply"])
 
 
+class DenyRing:
+    """Bounded ring of deny events (policy-DROP verdicts and shed
+    admissions) awaiting export — the denied-connection store of the
+    reference exporter (pkg/agent/flowexporter/exporter.go polls a deny
+    connection store alongside conntrack, so dropped traffic is visible
+    in flow records, not only as counters).  Drop-OLDEST on overflow,
+    never backpressure: losing the oldest unexported deny event is the
+    observability failure mode; stalling the datapath step is not."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._buf: deque = deque(maxlen=self.capacity)
+        self.recorded_total = 0
+        self.dropped_total = 0  # overwritten-before-export (drop-oldest)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def record(self, rec: dict) -> None:
+        if len(self._buf) == self.capacity:
+            self.dropped_total += 1
+        self._buf.append(rec)
+        self.recorded_total += 1
+
+    def drain(self) -> list[dict]:
+        out = list(self._buf)
+        self._buf.clear()
+        return out
+
+
 @dataclass
 class _Conn:
     first_seen: int
     last_seen: int
     last_export: int
+    # Last-known cumulative volumes, carried so the final idle-end record
+    # reports them — the live dump no longer holds the entry by then.
+    packets: int = 0
+    bytes: int = 0
 
 
 class FlowExporter:
@@ -60,6 +95,12 @@ class FlowExporter:
         self.path = path
         # path= is sugar for a JSONL log sink (one format, one place).
         self._path_sink = JsonlFileSink(path) if path is not None else None
+        # Attaching an exporter turns the datapath's deny plane on (the
+        # ring only costs anything once someone will drain it); datapaths
+        # without one (test doubles) simply export no deny records.
+        enable = getattr(datapath, "enable_deny_export", None)
+        if enable is not None:
+            enable()
 
     def _emit(self, rec: dict) -> None:
         if self._keep:
@@ -78,7 +119,8 @@ class FlowExporter:
             seen.add(k)
             st = self._conns.get(k)
             if st is None:
-                self._conns[k] = _Conn(rec["last_seen"], rec["last_seen"], now)
+                st = self._conns[k] = _Conn(rec["last_seen"],
+                                            rec["last_seen"], now)
                 self._emit({**rec, "node": self.node, "event": "new",
                             "export_ts": now})
                 emitted += 1
@@ -89,6 +131,14 @@ class FlowExporter:
                     self._emit({**rec, "node": self.node, "event": "active",
                                 "export_ts": now})
                     emitted += 1
+            # Carry the cumulative volumes on EVERY poll, not only export
+            # polls — the final idle-end record must report the last-known
+            # counters, and by then the entry has left the live dump.
+            # Max-fold: an evicted-and-recreated entry restarts its
+            # cumulative counters (same reasoning as the aggregator's
+            # fold), so pre-eviction volume is a floor, never regressed.
+            st.packets = max(st.packets, rec.get("packets", 0))
+            st.bytes = max(st.bytes, rec.get("bytes", 0))
         # Connections that left the live dump ended (idle timeout/evicted).
         for k in [k for k in self._conns if k not in seen]:
             st = self._conns.pop(k)
@@ -97,9 +147,20 @@ class FlowExporter:
                 "src": src, "dst": dst, "sport": sport, "dport": dport,
                 "proto": proto, "reply": reply, "node": self.node,
                 "event": "end", "reason": "idle-end",
-                "last_seen": st.last_seen, "export_ts": now,
+                "last_seen": st.last_seen,
+                "packets": st.packets, "bytes": st.bytes,
+                "export_ts": now,
             })
             emitted += 1
+        # Deny plane: policy-DROP verdicts and shed admissions recorded by
+        # the datapath since the last poll export as event="deny" records
+        # (the reference's deny connection store export path).
+        drain = getattr(self.datapath, "deny_drain", None)
+        if drain is not None:
+            for rec in drain():
+                self._emit({**rec, "node": self.node, "event": "deny",
+                            "export_ts": now})
+                emitted += 1
         return emitted
 
 
@@ -122,7 +183,7 @@ class TableSink:
 
     COLUMNS = (
         "src", "dst", "sport", "dport", "proto", "node", "event",
-        "export_ts",
+        "reason", "reply", "export_ts",
     )
 
     def __init__(self):

@@ -148,6 +148,33 @@ METRICS: dict[str, str] = {
     "antrea_tpu_tenant_evictions_total": "counter",
     "antrea_tpu_tenant_quota_clamps_total": "counter",
     "antrea_tpu_tenant_rollbacks_total": "counter",
+    # hot-path telemetry plane (observability/telemetry.py; rendered when
+    # the datapath exposes telemetry_stats()) — one counter family per
+    # TELEMETRY_COUNTERS name (family names resolve via
+    # _TELEMETRY_FAMILIES below; the telemetry-registry analysis pass
+    # pins that map against TELEMETRY_COUNTERS and this registry), the
+    # regime-labeled step-latency histogram, and the sentinel's verdict
+    # meter
+    "antrea_tpu_telemetry_probe_hit_total": "counter",
+    "antrea_tpu_telemetry_probe_stale_total": "counter",
+    "antrea_tpu_telemetry_probe_miss_total": "counter",
+    "antrea_tpu_telemetry_chance_bumps_total": "counter",
+    "antrea_tpu_telemetry_dma_hb_total": "counter",
+    "antrea_tpu_telemetry_regime_step_seconds": "histogram",
+    "antrea_tpu_telemetry_perf_regressions_total": "counter",
+}
+
+# TELEMETRY_COUNTERS name -> its registered family.  An explicit literal
+# map (not an f-string build) so every family name in this module is a
+# greppable registered literal; the telemetry-registry analysis pass
+# fails the build if the keys drift from TELEMETRY_COUNTERS or a value
+# is not in METRICS.
+_TELEMETRY_FAMILIES = {
+    "probe_hit": "antrea_tpu_telemetry_probe_hit_total",
+    "probe_stale": "antrea_tpu_telemetry_probe_stale_total",
+    "probe_miss": "antrea_tpu_telemetry_probe_miss_total",
+    "chance_bumps": "antrea_tpu_telemetry_chance_bumps_total",
+    "dma_hb": "antrea_tpu_telemetry_dma_hb_total",
 }
 
 
@@ -707,6 +734,24 @@ def render_metrics(datapath, node: str = "") -> str:
             for tid, row in ts.items():
                 lines.append(
                     f"{fam}{_labels(tenant=tid, node=node)} {_num(row[key])}")
+    tel = getattr(datapath, "telemetry_stats", None)
+    tel = tel() if tel is not None else None
+    if tel is not None:
+        # Hot-path telemetry plane (observability/telemetry.py): the
+        # in-kernel counter totals (one family per TELEMETRY_COUNTERS
+        # name), the sentinel's verdict meter, and the {scope, regime}-
+        # labeled step-latency histograms.
+        for name, v in tel["counters"].items():
+            fam = _TELEMETRY_FAMILIES[name]
+            lines += [_type_line(fam),
+                      f"{fam}{_labels(node=node)} {v}"]
+        fam = "antrea_tpu_telemetry_perf_regressions_total"
+        lines += [_type_line(fam),
+                  f"{fam}{_labels(node=node)} {tel['regressions_total']}"]
+        plane = getattr(datapath, "telemetry_plane", None)
+        rows = plane.hist_rows(node) if plane is not None else []
+        if rows:
+            lines.extend(_render_histograms(rows))
     sh = getattr(datapath, "step_hist", None)
     if sh is not None and sh.count:
         lines.extend(_render_histograms(

@@ -74,6 +74,13 @@ def collect_bundle(
         body["events"] = datapath.flightrecorder_events()
         return body
 
+    def _telemetry():
+        tl = getattr(datapath, "telemetry_stats", None)
+        body = tl() if tl is not None else None
+        if body is None:
+            raise ValueError("datapath has no telemetry plane")
+        return body
+
     def _realization():
         rz = getattr(datapath, "realization_stats", None)
         body = rz() if rz is not None else None
@@ -89,6 +96,7 @@ def collect_bundle(
         ("maintenance.json", _maintenance),
         ("flightrecorder.json", _flightrecorder),
         ("realization.json", _realization),
+        ("telemetry.json", _telemetry),
         ("metrics.prom", lambda: render_metrics(datapath, node=node)),
     ):
         try:

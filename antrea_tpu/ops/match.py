@@ -1069,9 +1069,20 @@ def _phase_hits(match: jax.Array, word_idx: jax.Array, phases: tuple[int, int, i
 # per lane behind the AND/scan compute, and a large K x rung product can
 # exceed VMEM scratch; both failure modes FALL BACK to the staged
 # kernel (construct with fused=False — bit-identical verdicts, the
-# parity suite pins it), never to a wrong verdict.  Interpret mode
-# (fused_interpret / CPU platform) runs the whole kernel on the CPU
-# tier, which is what tests/test_match_fused.py certifies.
+# parity suite pins it), never to a wrong verdict.  WHAT THE COUNTERS
+# DECIDE (hot-path telemetry, observability/telemetry.py): with
+# PipelineMeta.telemetry on, every dispatch emits tel_dma_hb — the
+# _OP_HB half-blocks this schedule walked, a physical constant of the
+# padded batch shape (models/pipeline.py derives it next to the probe
+# hit/stale/miss split) — and those counters are the PRODUCTION inputs
+# to the batching call above: dma_hb x (6K+4) x ~200ns is the fixed DMA
+# cost the double buffer must currently be hiding, so a steady-regime
+# p99 that climbs (the sentinel's perf-regression verdict) while
+# dma_hb/step holds flat means the overlap stopped covering the
+# descriptor cost — the operator reads that as "fall back to
+# fused=False" from the journal, before any bench run reproduces it.
+# Interpret mode (fused_interpret / CPU platform) runs the whole kernel
+# on the CPU tier, which is what tests/test_match_fused.py certifies.
 
 
 def _resolve(action: jax.Array, hits, pod_iso: jax.Array):

@@ -90,6 +90,7 @@ Known mesh limits (documented, test-pinned):
 
 from __future__ import annotations
 
+import time
 from functools import lru_cache, partial
 from typing import Optional
 
@@ -104,6 +105,7 @@ from ..datapath.interface import StepResult
 from ..datapath.maintenance import MaintenanceTask
 from ..datapath.slowpath import ADMIT_DROP, MissQueue, SlowPathEngine
 from ..datapath.tpuflow import TpuflowDatapath, _rid
+from ..observability.telemetry import classify_regime
 from ..models import forwarding as fw
 from ..models import pipeline as pl
 from ..ops import hashing
@@ -159,7 +161,9 @@ def _mesh_step_fn(mesh, meta: pl.PipelineMeta):
         # scalar per shard -> (D,) vector of per-data-shard counts (the
         # prune keys exist iff the meta carries a prune budget)
         for k in ("n_miss", "n_evict", "n_reclaim", "n_prune_skips",
-                  "n_prune_fb", "prune_cand_hist"):
+                  "n_prune_fb", "prune_cand_hist",
+                  "tel_probe_hit", "tel_probe_stale", "tel_probe_miss",
+                  "tel_dma_hb", "tel_chance_bumps"):
             if k in out:
                 out[k] = out[k][None]
         return jax.tree.map(lambda x: x[None], local), out
@@ -203,7 +207,9 @@ def _mesh_step_full_fn(mesh, meta: pl.PipelineMeta, has_arp: bool):
         # scalar per shard -> (D,) vector of per-data-shard counts (the
         # prune keys exist iff the meta carries a prune budget)
         for k in ("n_miss", "n_evict", "n_reclaim", "n_prune_skips",
-                  "n_prune_fb", "prune_cand_hist"):
+                  "n_prune_fb", "prune_cand_hist",
+                  "tel_probe_hit", "tel_probe_stale", "tel_probe_miss",
+                  "tel_dma_hb", "tel_chance_bumps"):
             if k in out:
                 out[k] = out[k][None]
         return jax.tree.map(lambda x: x[None], local), out
@@ -392,7 +398,10 @@ class MeshSlowPath(SlowPathEngine):
         # keys on the source prefix, not the home shard): ONE batch-wide
         # pass ahead of the per-replica early-drop ramps, mirroring the
         # single-chip admission order.
+        base = mask
         mask = self._source_limit(cols, mask, now)
+        if self.deny_sink is not None and mask.sum() < base.sum():
+            self.deny_sink(cols, base & ~mask, "source-limit", now)
         # admission="drop": the hash coin is replica-independent — one
         # batch-wide compute, thresholded per replica below (each
         # replica's OWN queue depth drives its early-drop ramp; capacity
@@ -404,7 +413,10 @@ class MeshSlowPath(SlowPathEngine):
             mr = mask & (np.asarray(shard) == r)
             if not mr.any():
                 continue
+            mr0 = mr
             mr, _shed = self._early_drop(cols, mr, self.queues[r], coin=coin)
+            if self.deny_sink is not None and _shed:
+                self.deny_sink(cols, mr0 & ~mr, "early-drop", now)
             if not mr.any():
                 continue
             a, d = self.queues[r].admit(cols, mr, self.epoch, int(now))
@@ -413,6 +425,10 @@ class MeshSlowPath(SlowPathEngine):
             if d:
                 self._emit("queue-overflow", replica=int(r), dropped=int(d),
                            depth=int(self.queues[r].depth), at=int(now))
+                if self.deny_sink is not None:
+                    over = np.zeros(mr.shape, bool)
+                    over[np.nonzero(mr)[0][a:]] = True
+                    self.deny_sink(cols, over, "queue-overflow", now)
         return admitted, dropped
 
     # -- epoch plane: the mesh-wide swap -------------------------------------
@@ -678,6 +694,13 @@ class MeshDatapath(TpuflowDatapath):
         self._prune_account(o)
         for k in ("n_prune_skips", "n_prune_fb", "prune_cand_hist"):
             o.pop(k, None)
+        # Telemetry counters ride (D,) per-replica — pop them before the
+        # per-LANE reindex below.  Spilled lanes are excluded from this
+        # dispatch's counters too (same prune_exclude=spill mask): their
+        # serving probe is the home-routed retry's, which accounts them
+        # (each lane's probe is metered exactly once, from the walk that
+        # serves it).
+        tel_o = {k: o.pop(k) for k in tuple(o) if k.startswith("tel_")}
         o = {k: v[inv] for k, v in o.items()}  # back to packet order
         spilled = perm[np.nonzero(spill)[0]]  # packet indices off-home
         if spilled.size:
@@ -709,9 +732,24 @@ class MeshDatapath(TpuflowDatapath):
                                  tenant=self._tenant_id()),
                 self._tenant_admit_mask(pending != 0), now, shard=shard)
             self._tenant_note_admitted(admitted, _dropped)
+        if self._telemetry is not None:
+            # Engine/tenant scopes classify from the MERGED per-lane miss
+            # image (a retried lane's miss is its home-shard one); each
+            # replica additionally classifies from its own home lanes, so
+            # a single cold shard reads cold even when the mesh-wide
+            # regime is steady.
+            self._telemetry_account({**tel_o, "n_miss": n_miss}, B)
+            miss_rep = np.bincount(shard[o["miss"] != 0], minlength=D)
+            cnt_rep = np.bincount(shard, minlength=D)
+            for d in range(D):
+                self._telemetry.note_regime(
+                    f"replica{d}",
+                    classify_regime(int(cnt_rep[d]), int(miss_rep[d])))
         in_ids = self._cps.ingress.rule_ids
         out_ids = self._cps.egress.rule_ids
         self._count_metrics(o, in_ids, out_ids, lens, pending=pending)
+        if self._deny is not None:
+            self._deny_verdicts(batch, o["code"], pending, now)
         unflip = iputil.unflip_u32_array
         return StepResult(
             code=o["code"],
@@ -805,6 +843,11 @@ class MeshDatapath(TpuflowDatapath):
         self._prune_account(o2)
         for k in ("n_prune_skips", "n_prune_fb", "prune_cand_hist"):
             o2.pop(k, None)
+        if self._telemetry is not None:
+            # The retry owns the retried lanes' PROBE counters too (the
+            # main dispatch masked them out, same as the prune evidence);
+            # padding lanes ride excluded via prune_exclude=~valid.
+            self._telemetry.account(o2)
         sel = np.nonzero(valid)[0]
         pkts = idx[sel]
         for k in o:
@@ -832,6 +875,7 @@ class MeshDatapath(TpuflowDatapath):
         split = self._tenant_drain_split_blocks(blocks)
         if split is not None:
             return self._tenant_drain_dispatch_blocks(split, now, chunk)
+        t0 = time.perf_counter()
         sp = self._slowpath
         chunk = int(chunk) if chunk is not None else sp.drain_batch
         D = self._n_data
@@ -879,6 +923,14 @@ class MeshDatapath(TpuflowDatapath):
             {k: o[k][sel] for k in ("code", "ingress_rule", "egress_rule")},
             in_ids, out_ids, lens[sel],
         )
+        if self._telemetry is not None:
+            # One sharded dispatch drains every replica at once: fold its
+            # counters and its wall seconds into the engine's "drain"
+            # regime (never deferred here — overlap staging is
+            # single-chip).
+            self._telemetry.account(o)
+            self._telemetry.observe_scoped(
+                "engine", "drain", time.perf_counter() - t0)
         # Dirty-row tracking for an in-flight resize: a drain COMMITS
         # rows (both conntrack directions) after their migration window.
         if self._reshard is not None:

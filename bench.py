@@ -221,6 +221,45 @@ def measure_fused(cps, svc, src, dst, proto, sport, dport):
         return None, None
 
 
+def measure_telemetry(cps, svc, src, dst, proto, sport, dport):
+    """Telemetry-overhead line (observability/telemetry.py): the HEADLINE
+    steady regime with the in-kernel counters compiled IN
+    (telemetry=True) — same fused instance, same warmed all-hit loop —
+    so the on/off cost of the counter outputs is a pinned number beside
+    the unchanged keys.  The counters are a handful of masked reductions
+    over values the step already gathers, so this should sit within
+    noise of the headline; a real gap here fails the near-zero-cost
+    claim before a rollout ships it."""
+    try:
+        step, state, (drs, dsvc) = pl.make_pipeline(
+            cps, svc, flow_slots=FLOW_SLOTS, miss_chunk=MISS_CHUNK,
+            fused=True, telemetry=True,
+        )
+        assert step.meta.telemetry
+        state, _ = step(state, drs, dsvc, src, dst, proto, sport, dport,
+                        jnp.int32(100), jnp.int32(0))
+        state, _ = step(state, drs, dsvc, src, dst, proto, sport, dport,
+                        jnp.int32(101), jnp.int32(0))
+
+        def body(i, carry):
+            acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_ = carry
+            st, o = pl._pipeline_step(
+                st, drs_, dsvc_, s_, d_, p_, sp_, dp_, 102 + i, 0,
+                meta=step.meta,
+            )
+            acc = acc.at[:1].add(o["code"].sum(dtype=jnp.int32)
+                                 + o["n_miss"] + o["tel_probe_hit"])
+            return (acc, st, drs_, dsvc_, s_, d_, p_, sp_, dp_)
+
+        carry = (jnp.zeros(8, jnp.int32), state, drs, dsvc, src, dst,
+                 proto, sport, dport)
+        sec = device_loop_time(body, carry, k_small=8, k_big=K, repeats=3)
+        return B / sec
+    except Exception as e:  # report, never sink the bench
+        print(f"# telemetry overhead measurement failed: {e}", flush=True)
+        return None
+
+
 def measure_churn(cps, svc, pod_ips, services):
     """Steady-state throughput UNDER EVICTION PRESSURE (round-4 verdict
     weak #2: the headline is a never-miss cache number).  Flow universe ==
@@ -1032,6 +1071,9 @@ def main():
     steady_fused_pps, cold_fused_pps = measure_fused(
         cps, svc, src, dst, proto, sport, dport
     )
+    steady_telemetry_pps = measure_telemetry(
+        cps, svc, src, dst, proto, sport, dport
+    )
     sh_cold_pps = measure_sharded_cold_fused(cps, src, dst, proto, dport)
     sh_pps, sh_overhead = measure_shard_overhead(
         cps, svc, src, dst, proto, sport, dport, pps
@@ -1048,6 +1090,7 @@ def main():
                     prune_skip_rate=prune_skip_rate,
                     steady_fused_pps=steady_fused_pps,
                     cold_fused_pps=cold_fused_pps,
+                    steady_telemetry_pps=steady_telemetry_pps,
                     reshard=reshard, multitenant=multitenant)
 
 
@@ -1072,6 +1115,7 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     multichip=None, cold_pruned_pps=None,
                     prune_fb_rate=None, prune_skip_rate=None,
                     steady_fused_pps=None, cold_fused_pps=None,
+                    steady_telemetry_pps=None,
                     reshard=None, multitenant=None):
     maint_overhead_pct = None
     if maint_churn_pps and async_churn_pps:
@@ -1161,6 +1205,13 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
             else round(steady_fused_pps, 1),
             "cold_fused_pps": None if cold_fused_pps is None
             else round(cold_fused_pps, 1),
+            # Hot-path telemetry overhead (observability/telemetry.py):
+            # the headline steady regime with the in-kernel counters
+            # compiled in — expected within noise of the headline (a
+            # handful of masked reductions over already-gathered values);
+            # a real gap fails the near-zero-cost claim.
+            "steady_telemetry_pps": None if steady_telemetry_pps is None
+            else round(steady_telemetry_pps, 1),
         },
     }))
     # The multichip regime prints as its OWN json line (second), so the

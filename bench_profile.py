@@ -75,6 +75,51 @@ def _cols(tr):
     )
 
 
+def _telemetry_structure_check(out_path: str) -> int:
+    """--mode telemetry: the hot-path telemetry schema gate on BOTH
+    engines (observability/telemetry.py).  A toy world (this is a
+    structure check, not a measurement): each twin runs one instrumented
+    probe step via profile(mode="telemetry") and both counter key sets
+    must equal TELEMETRY_COUNTERS — the same invariant the
+    telemetry-registry analysis pass pins statically, checked here
+    against the LIVE kernels."""
+    from antrea_tpu.datapath.oracle_dp import OracleDatapath
+    from antrea_tpu.datapath.tpuflow import TpuflowDatapath
+    from antrea_tpu.observability.telemetry import TELEMETRY_COUNTERS
+
+    cluster = gen_cluster(1_000, n_nodes=8, pods_per_node=8, seed=1)
+    tr = gen_traffic(cluster.pod_ips, 1 << 10, n_flows=1 << 8, seed=3)
+    counters = {}
+    for name, dp in (
+        ("tpuflow", TpuflowDatapath(cluster.ps, flow_slots=1 << 12,
+                                    aff_slots=1 << 10)),
+        ("oracle", OracleDatapath(cluster.ps, flow_slots=1 << 12,
+                                  aff_slots=1 << 10)),
+    ):
+        p = dp.profile(tr, mode="telemetry")
+        counters[name] = p["counters"]
+    want = sorted(TELEMETRY_COUNTERS)
+    ok = all(sorted(c) == want for c in counters.values())
+    doc = {
+        "metric": "telemetry_structure_check",
+        "mode": "telemetry",
+        "expected_counters": want,
+        "engines": counters,
+        "ok": ok,
+    }
+    line = json.dumps(doc)
+    print(line)
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    print(f"# wrote {out_path}", flush=True)
+    if not ok:
+        raise SystemExit(
+            f"telemetry counter schema drifted from TELEMETRY_COUNTERS "
+            f"{want}: {({n: sorted(c) for n, c in counters.items()})}"
+        )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="output JSON path")
@@ -83,7 +128,7 @@ def main() -> int:
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument(
         "--mode", choices=("sync", "overlap", "maintenance", "prune",
-                           "fused"),
+                           "fused", "telemetry"),
         default="sync",
         help="sync = the inline slow-path chain (PHASE_CHAIN); overlap = "
              "the round-6 double-buffered regime (OVERLAP_PHASE_CHAIN: "
@@ -97,13 +142,20 @@ def main() -> int:
              "prune_budget>0 meta, classify split into summary-gather vs "
              "candidate-gather); fused = the round-8 one-kernel regime "
              "(FUSED_PHASE_CHAIN: the async cadence over a one-pass "
-             "meta — the fused_onepass entry is the whole in-VMEM pass)",
+             "meta — the fused_onepass entry is the whole in-VMEM pass); "
+             "telemetry = the hot-path counter STRUCTURE check "
+             "(observability/telemetry.py): one instrumented probe step "
+             "on BOTH engines, both twins' counter key sets pinned to "
+             "TELEMETRY_COUNTERS — a schema gate, not a measurement",
     )
     ap.add_argument("--prune-budget", type=int, default=4,
                     help="K budget for --mode prune/fused "
                          "(PRUNE_LADDER rung)")
     args = ap.parse_args()
     out_path = args.out or _next_out(os.path.dirname(os.path.abspath(__file__)))
+
+    if args.mode == "telemetry":
+        return _telemetry_structure_check(out_path)
 
     cluster = gen_cluster(N_RULES, n_nodes=64, pods_per_node=32, seed=1)
     cps = compile_policy_set(cluster.ps)

@@ -327,10 +327,14 @@ def test_engines_register_the_consolidated_task_set(dp_cls):
     # fqdn-ttl is the agent-side registration; reshard-migrate is the
     # mesh engine's, registered only while a resize is in flight;
     # tenant-maintain joins on the first tenant_create only
-    # (datapath/tenancy — untenanted engines keep this base set).
+    # (datapath/tenancy — untenanted engines keep this base set);
+    # telemetry-sentinel registers only on telemetry=True engines.
     assert (set(dpa.maintenance.task_names)
-            | {"fqdn-ttl", "reshard-migrate", "tenant-maintain"}
+            | {"fqdn-ttl", "reshard-migrate", "tenant-maintain",
+               "telemetry-sentinel"}
             == set(MAINT_TASKS))
+    tdp = _dp(dp_cls, ps, svcs, telemetry=True)
+    assert "telemetry-sentinel" in tdp.maintenance.task_names
     out = dpa.maintenance_tick(now=next(_NOW))
     assert set(out["ran"]) >= {"canary", "audit-cursor", "tensor-scrub",
                                "cache-maintain"}

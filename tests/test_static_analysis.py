@@ -32,7 +32,7 @@ ALL_PASSES = (
     "mesh", "metrics", "phases", "events", "commit-plane", "audit-plane",
     "maintenance", "reshard", "tenant",
     "thread-safety", "bounded-cache", "jit-purity", "donation-safety",
-    "bounded-buffer",
+    "bounded-buffer", "telemetry-registry",
 )
 
 
@@ -358,6 +358,49 @@ def test_bounded_buffer_pass_fires_on_seeded_violations(tmp_path):
     assert not any("good_queue" in o for o in objs)
     assert not any("count" in o for o in objs)
     assert not any("elsewhere" in o for o in objs)
+
+
+def test_telemetry_registry_pass_fires_on_seeded_violations(tree_template,
+                                                            tmp_path):
+    # Clean on the real tree (the tier-1 full-suite test pins this too;
+    # here it anchors the seeded deltas below).
+    clean = run(tree_template, ["telemetry-registry"])
+    assert clean.clean, [f.render() for f in clean.findings] + clean.errors
+
+    # A kernel counter output nobody declared: the plane would silently
+    # drop it on account().
+    broken = tmp_path / "undeclared"
+    shutil.copytree(tree_template, broken)
+    p = broken / "antrea_tpu" / "models" / "pipeline.py"
+    p.write_text(p.read_text()
+                 + '\n\ndef _seeded(out):\n'
+                   '    out["tel_bogus_counter"] = 0\n')
+    objs = {f.obj for f in run(broken, ["telemetry-registry"]).findings}
+    assert "undeclared:bogus_counter" in objs
+
+    # A declared counter with no kernel emit site, no metric family row
+    # and no README row: dead accumulator across every layer.
+    broken2 = tmp_path / "unmeasured"
+    shutil.copytree(tree_template, broken2)
+    t = broken2 / "antrea_tpu" / "observability" / "telemetry.py"
+    txt = t.read_text()
+    new = txt.replace('    "dma_hb",', '    "dma_hb",\n    "ghost_total",', 1)
+    assert new != txt
+    t.write_text(new)
+    objs2 = {f.obj for f in run(broken2, ["telemetry-registry"]).findings}
+    assert {"unmeasured:ghost_total", "family-unmapped:ghost_total",
+            "undocumented:ghost_total"} <= objs2
+
+    # A regime dropped from the README table is drift, not a doc nit.
+    broken3 = tmp_path / "undocumented-regime"
+    shutil.copytree(tree_template, broken3)
+    r = broken3 / "README.md"
+    rt = r.read_text()
+    new = rt.replace("| `attack-shed` |", "| attack shed |")
+    assert new != rt
+    r.write_text(new)
+    objs3 = {f.obj for f in run(broken3, ["telemetry-registry"]).findings}
+    assert "regime-undocumented:attack-shed" in objs3
 
 
 # ---------------------------------------------------------------------------

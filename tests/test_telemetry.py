@@ -1,0 +1,269 @@
+"""Hot-path telemetry plane (observability/telemetry.py): the tentpole's
+three acceptance bars.
+
+1. Knob discipline — `telemetry=False` lowers step HLO BIT-IDENTICAL to
+   the uninstrumented program across the default, fused+pruned, pruned,
+   second-chance and dual-stack variants (the counters are free unless
+   bought), and `telemetry=True` genuinely changes the program.
+2. Counter parity — the in-kernel tel_* counters match a host-side
+   recomputation by the scalar oracle twin EXACTLY across the cold,
+   steady and churn regimes, single chip and mesh (the oracle's
+   documented divergence: it has no probe-generation staleness, no
+   second-chance clock and no DMA engine, so those meters stay 0).
+3. The sentinel chaos case — a FaultClock-driven injected slowdown is
+   reconstructed as a `perf-regression` flight-recorder event from the
+   journal ALONE (regime, window p99, baseline p99, sample count, ratio,
+   scheduler-clock timestamps), and the verdict is journal-and-meter
+   only: the commit plane never degrades or rolls back on it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from antrea_tpu.compiler.compile import compile_policy_set
+from antrea_tpu.compiler.services import compile_services
+from antrea_tpu.datapath import OracleDatapath, TpuflowDatapath
+from antrea_tpu.dissemination.faults import FaultClock
+from antrea_tpu.models import pipeline as pl
+from antrea_tpu.observability.telemetry import (REGIMES, TELEMETRY_COUNTERS,
+                                                classify_regime)
+from antrea_tpu.packet import PacketBatch
+from antrea_tpu.simulator import gen_cluster, gen_traffic
+
+KW = dict(flow_slots=1 << 10, aff_slots=1 << 6, canary_probes=0)
+
+
+def _concat(a: PacketBatch, b: PacketBatch, na: int, nb: int) -> PacketBatch:
+    """First `na` lanes of batch a followed by the first `nb` of b."""
+    cut = lambda f: np.concatenate([getattr(a, f)[:na], getattr(b, f)[:nb]])
+    return PacketBatch(src_ip=cut("src_ip"), dst_ip=cut("dst_ip"),
+                       proto=cut("proto"), src_port=cut("src_port"),
+                       dst_port=cut("dst_port"))
+
+
+# ---------------------------------------------------------------------------
+# 1. Knob discipline: telemetry=False is bit-free
+# ---------------------------------------------------------------------------
+
+
+def test_step_hlo_bit_identical_with_telemetry_off():
+    """The trailing-knob contract every PipelineMeta flag honors: an
+    explicit telemetry=False lowers BIT-IDENTICALLY to the default
+    program on every knob variant the acceptance bar names (default,
+    fused+pruned one-pass, staged pruned, second-chance, dual-stack) —
+    so the instrumentation costs nothing unless bought — while
+    telemetry=True produces a genuinely different program."""
+    cluster = gen_cluster(300, seed=7)
+    cps = compile_policy_set(cluster.ps)
+    svc = compile_services([])
+
+    def lowered(**kw):
+        step, st, (drs, dsvc) = pl.make_pipeline(
+            cps, svc, flow_slots=1 << 8, aff_slots=1 << 4, miss_chunk=32,
+            **kw)
+        cols = (jnp.zeros(128, jnp.int32),) * 5
+        return jax.jit(
+            pl._pipeline_step, static_argnames=("meta",),
+        ).lower(st, drs, dsvc, *cols, jnp.int32(1), jnp.int32(0),
+                meta=step.meta).as_text()
+
+    variants = (
+        dict(),
+        dict(fused=True, prune_budget=2),
+        dict(prune_budget=2),
+        dict(second_chance=True),
+        dict(dual_stack=True),
+    )
+    for kw in variants:
+        assert lowered(telemetry=False, **kw) == lowered(**kw), kw
+    # The instrumented program is real: extra outputs, different HLO.
+    assert lowered(telemetry=True) != lowered()
+
+
+def test_telemetry_off_engine_is_inert():
+    """Engines built without the knob carry NO plane: the accessors the
+    API/bundle/antctl surfaces poll all answer None, so telemetry=False
+    deployments serve a 404, not zeros."""
+    dp = TpuflowDatapath(gen_cluster(60, seed=3).ps, **KW)
+    assert dp.telemetry_plane is None
+    assert dp.telemetry_stats() is None
+
+
+# ---------------------------------------------------------------------------
+# 2. Counter parity vs the host-side oracle recomputation
+# ---------------------------------------------------------------------------
+
+
+def test_classify_regime_precedence():
+    assert classify_regime(96, 0) == "steady"
+    assert classify_regime(96, 1) == "churn"
+    assert classify_regime(96, 47) == "churn"
+    assert classify_regime(96, 48) == "cold"   # >= half the batch missed
+    assert classify_regime(96, 96) == "cold"
+    assert classify_regime(96, 0, shed=1) == "attack-shed"  # wins over all
+    # "drain" never classifies from a step (observe_scoped only) but IS
+    # a declared regime the sentinel sweeps.
+    assert "drain" in REGIMES
+
+
+def test_counter_parity_vs_oracle_across_regimes():
+    """Kernel counters vs the scalar oracle twin on IDENTICAL traffic
+    through three regimes: cold (first sight of every flow), steady (the
+    same batch re-stepped — every lane hits), churn (a quarter of the
+    lanes new).  probe_hit/probe_miss must agree EXACTLY; the oracle's
+    stale/second-chance/DMA meters are 0 by construction (documented
+    divergence — the interpreter has no probe generations, no clock
+    hand, no DMA engine)."""
+    from antrea_tpu.compiler.compile import ACT_ALLOW
+
+    cluster = gen_cluster(300, seed=12)
+    tpu = TpuflowDatapath(cluster.ps, telemetry=True, miss_chunk=64, **KW)
+    orc = OracleDatapath(cluster.ps, telemetry=True, **KW)
+    t1 = gen_traffic(cluster.pod_ips, batch=96, seed=5)
+    t2 = gen_traffic(cluster.pod_ips, batch=96, seed=6)
+    mix = _concat(t1, t2, 72, 24)  # 24/96 new lanes at most => not cold
+    r1 = tpu.step(t1, now=1)
+    orc.step(t1, now=1)
+    # Allowed lanes are cached; deny verdicts are NOT (re-stepping the
+    # full batch would re-miss them, keeping churn).  A batch of only
+    # allowed lanes is the guaranteed all-hit steady probe.
+    ok = np.asarray(r1.code) == ACT_ALLOW  # sync engine: no pending lanes
+    assert r1.pending is None or not np.asarray(r1.pending).any()
+    assert ok.sum() >= 8
+    # The HIGHEST-index allowed lane: commit rows scatter in lane order
+    # (last write wins), so its entry cannot have been evicted by a
+    # same-step slot collision — tiled, it is the guaranteed all-hit
+    # steady batch.
+    i = int(np.nonzero(ok)[0][-1])
+    pick = lambda f: np.repeat(getattr(t1, f)[i:i + 1], 8)
+    steady = PacketBatch(src_ip=pick("src_ip"), dst_ip=pick("dst_ip"),
+                         proto=pick("proto"), src_port=pick("src_port"),
+                         dst_port=pick("dst_port"))
+    for now, b in ((2, steady), (3, mix)):
+        tpu.step(b, now=now)
+        orc.step(b, now=now)
+
+    st, so = tpu.telemetry_stats(), orc.telemetry_stats()
+    ct, co = st["counters"], so["counters"]
+    assert set(ct) == set(co) == set(TELEMETRY_COUNTERS)
+    assert ct["probe_hit"] == co["probe_hit"] > 0
+    assert ct["probe_miss"] == co["probe_miss"] > 0
+    assert (co["probe_stale"], co["chance_bumps"], co["dma_hb"]) == (0, 0, 0)
+    # Probe-split conservation: every lane of every step lands in exactly
+    # one of hit/stale/miss.
+    lanes = 2 * len(t1.proto) + 8
+    assert ct["probe_hit"] + ct["probe_stale"] + ct["probe_miss"] == lanes
+    # Both twins classified the same step sequence into the same regimes
+    # (classify_regime is history-free, shared by construction), and the
+    # three-step drive hit all three step-classifiable regimes.
+    assert st["regimes"]["engine"].keys() == so["regimes"]["engine"].keys()
+    assert set(st["regimes"]["engine"]) == {"cold", "steady", "churn"}
+    for regime, row in st["regimes"]["engine"].items():
+        assert row["count"] == so["regimes"]["engine"][regime]["count"]
+    assert st["steps_total"] == so["steps_total"] == 3
+
+
+def test_mesh_counter_parity_and_replica_scopes():
+    """Sharded dispatch vs single chip on identical traffic.  The
+    per-replica tel_* vectors are replica-additive, and per-step probe
+    conservation holds on BOTH engines; the one accounting difference is
+    by design — a spilled lane's probe counters belong to its home-shard
+    RETRY dispatch (meshpath masks spills out of the main dispatch, same
+    as the prune evidence), which probes AFTER the slow-path install, so
+    every retried first-sight lane moves from the single-chip miss column
+    to the mesh hit column, one for one.  The mesh also carries
+    per-replica regime scopes the single-chip plane does not."""
+    if len(jax.devices("cpu")) < 4:
+        pytest.skip("needs 4 virtual CPU devices")
+    from antrea_tpu.parallel import MeshDatapath, mesh as pm
+
+    mesh = pm.make_mesh(2, 2, devices=jax.devices("cpu")[:4])
+    cluster = gen_cluster(60, n_nodes=4, pods_per_node=8, seed=7)
+    mdp = MeshDatapath(cluster.ps, mesh=mesh, telemetry=True,
+                       flow_slots=1 << 10, aff_slots=1 << 8,
+                       canary_probes=16)
+    sdp = TpuflowDatapath(cluster.ps, telemetry=True,
+                          flow_slots=1 << 10, aff_slots=1 << 8,
+                          canary_probes=16)
+    batch = gen_traffic(cluster.pod_ips, 256, n_flows=96, seed=3)
+    for now in (1, 2):
+        rm, rs = mdp.step(batch, now=now), sdp.step(batch, now=now)
+        assert rm.code.tolist() == rs.code.tolist()  # verdict parity
+
+    mc = mdp.telemetry_stats()["counters"]
+    sc = sdp.telemetry_stats()["counters"]
+    lanes = 2 * len(batch.proto)
+    assert mc["probe_hit"] + mc["probe_stale"] + mc["probe_miss"] == lanes
+    assert sc["probe_hit"] + sc["probe_stale"] + sc["probe_miss"] == lanes
+    # Retry conversion: R spilled lanes re-probed post-install.
+    retried = mc["probe_hit"] - sc["probe_hit"]
+    assert retried >= 0
+    assert sc["probe_miss"] - mc["probe_miss"] == retried
+    assert mc["probe_hit"] > 0 and mc["probe_miss"] > 0
+    scopes = set(mdp.telemetry_stats()["regimes"])
+    assert "engine" in scopes
+    assert {"replica0", "replica1"} <= scopes, scopes
+    assert not any(s.startswith("replica")
+                   for s in sdp.telemetry_stats()["regimes"])
+
+
+# ---------------------------------------------------------------------------
+# 3. Sentinel chaos: injected slowdown, reconstructed from the journal
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_reconstructs_perf_regression_from_journal_alone():
+    """FaultClock-driven chaos case: 32 fast steady-regime steps build
+    the rolling baseline across budgeted sweeps, then an injected 20x
+    slowdown over the next window fires EXACTLY one `perf-regression`
+    event.  Everything the post-mortem needs — regime, window p99,
+    baseline p99, sample count, trip ratio, and WHEN on the scheduler's
+    fault-injectable clock — is reconstructed from the flight-recorder
+    journal alone, and the verdict is journal-and-meter only: the commit
+    plane stays healthy (no rollback, no degraded mode)."""
+    clk = FaultClock(start=100)
+    dp = TpuflowDatapath(gen_cluster(60, seed=3).ps, telemetry=True,
+                         maint_clock=clk, **KW)
+    plane = dp.telemetry_plane
+
+    def run(dt, steps=32, ticks=3):
+        for _ in range(steps):
+            plane.note_regime("engine", "steady")
+            plane.observe_step(dt)
+        # sentinel budget is 2 regimes/tick; 3 ticks cover all 5 and
+        # revisit steady, guaranteeing the window is judged.
+        for _ in range(ticks):
+            clk.advance(60)
+            dp.maintenance_tick()
+
+    run(0.001)  # baseline epoch: fast steps, window rolls into baseline
+    assert dp.flightrecorder_events(kind="perf-regression") == []
+    sent = plane.stats()["sentinel"]["steady"]
+    assert sent["baseline_samples"] == 32
+    assert sent["baseline_p99_seconds"] > 0
+
+    run(0.020)  # injected slowdown: 20x the baseline step time
+    evs = dp.flightrecorder_events(kind="perf-regression")
+    assert len(evs) == 1
+    ev = evs[0]
+    # The journal record alone reconstructs the regression.
+    assert ev["kind"] == "perf-regression"
+    assert ev["regime"] == "steady"
+    assert ev["samples"] == 32
+    assert ev["baseline_p99"] > 0
+    assert ev["p99"] > ev["ratio"] * ev["baseline_p99"]
+    # Clocked by the scheduler tick: both stamps are FaultClock values
+    # inside the second epoch's tick window.
+    assert 280 < ev["at"] <= clk.now
+    assert ev["ts"] == ev["at"]
+    # Journal-and-meter ONLY: metered, never acted on.
+    assert plane.stats()["regressions_total"] == 1
+    assert not dp._commit.degraded
+    assert dp.commit_stats()["rollbacks_total"] == 0
+    # A sustained slowdown keeps firing (the regressed window was
+    # quarantined, not merged into the baseline).
+    run(0.020)
+    assert len(dp.flightrecorder_events(kind="perf-regression")) == 2

@@ -169,6 +169,114 @@ def test_multi_sink_fanout(tmp_path):
     assert total == n
 
 
+def test_deny_records_policy_drops():
+    """Denied traffic is visible as flow RECORDS, not only counters (the
+    reference's deny connection store, pkg/agent/flowexporter): attaching
+    an exporter arms the datapath's deny ring, every policy-DROP verdict
+    lands in it, and poll() exports one event="deny" reason="policy" row
+    per denied lane.  The ring drains on export — no re-emission."""
+    import numpy as np
+
+    from antrea_tpu.compiler.compile import ACT_DROP
+    from antrea_tpu.datapath import TpuflowDatapath
+    from antrea_tpu.simulator import gen_cluster, gen_traffic
+
+    cluster = gen_cluster(300, seed=12)
+    dp = TpuflowDatapath(cluster.ps, flow_slots=1 << 10, aff_slots=1 << 8,
+                         miss_chunk=64)
+    assert dp.deny_ring is None  # off by default: zero cost unexported
+    table = TableSink()
+    exp = FlowExporter(dp, node="n1", sink=table)
+    assert dp.deny_ring is not None  # attach armed it
+
+    t = gen_traffic(cluster.pod_ips, batch=96, seed=5)
+    r = dp.step(t, now=10)
+    drops = int((np.asarray(r.code) == ACT_DROP).sum())
+    assert drops > 0
+    exp.poll(now=11)
+    rows = table.query(event="deny", reason="policy")
+    assert len(rows) == drops
+    idx = {c: i for i, c in enumerate(TableSink.COLUMNS)}
+    for row in rows:
+        assert row[idx["src"]].count(".") == 3  # real dotted-quad tuples
+        assert row[idx["proto"]] in (6, 17)
+        assert row[idx["reply"]] is False
+        assert row[idx["node"]] == "n1"
+        assert row[idx["export_ts"]] == 11
+    # Drained: a second poll exports no stale deny rows.
+    exp.poll(now=12)
+    assert len(table.query(event="deny")) == drops
+
+
+def test_deny_records_shed_reasons_match_engine_meters():
+    """The async slow path's three shed paths each stamp their reason on
+    the deny record, and the record counts equal the engine's meters
+    EXACTLY — the deny export is the meters, itemized."""
+    from antrea_tpu.datapath import TpuflowDatapath
+    from antrea_tpu.simulator import gen_cluster, gen_traffic
+
+    cluster = gen_cluster(300, seed=12)
+    dp = TpuflowDatapath(cluster.ps, flow_slots=1 << 10, aff_slots=1 << 8,
+                         miss_chunk=64, async_slowpath=True,
+                         miss_queue_slots=32, admission="drop",
+                         miss_source_rate=2.0)
+    table = TableSink()
+    exp = FlowExporter(dp, node="n1", sink=table)
+    # Fresh flows every step, never drained: the queue fills (overflow +
+    # early-drop) while per-source buckets exhaust (source-limit).
+    for i in range(8):
+        dp.step(gen_traffic(cluster.pod_ips, batch=96, seed=100 + i),
+                now=10 + i)
+    exp.poll(now=20)
+    eng = dp._slowpath
+    by_reason = {
+        "source-limit": int(eng.source_limited_total),
+        "early-drop": int(eng.early_drops_total),
+        "queue-overflow": int(eng.queue.overflows_total),
+    }
+    assert sum(by_reason.values()) > 0
+    for reason, n in by_reason.items():
+        assert len(table.query(event="deny", reason=reason)) == n, reason
+
+
+def test_idle_end_record_carries_final_counters():
+    """The idle-end record reports the connection's LAST-KNOWN cumulative
+    packets/bytes: by the ending poll the entry has left the live dump,
+    so the exporter's connection store carries the volumes across polls
+    (the reference's conn.OriginalPackets at deletion time)."""
+    import numpy as np
+
+    from antrea_tpu.datapath import TpuflowDatapath
+    from antrea_tpu.features import FeatureGates
+    from antrea_tpu.packet import Packet, PacketBatch
+    from antrea_tpu.utils import ip as iputil
+
+    dp = TpuflowDatapath(flow_slots=1 << 8, aff_slots=1 << 4, miss_chunk=16,
+                         ct_timeout_s=30,
+                         feature_gates=FeatureGates({"FlowExporter": True}))
+    table = TableSink()
+    exp = FlowExporter(dp, node="n1", sink=table, keep_records=True)
+    pkt = Packet(src_ip=iputil.ip_to_u32("10.0.1.7"),
+                 dst_ip=iputil.ip_to_u32("10.0.0.10"),
+                 proto=6, src_port=41000, dst_port=80)
+
+    def send(lens, now):
+        b = PacketBatch.from_packets([pkt] * len(lens))
+        b.pkt_len = np.asarray(lens, np.int32)
+        dp.step(b, now=now)
+
+    send([100], now=1)       # commit: 1 pkt / 100 B
+    exp.poll(now=2)          # event="new" — volumes enter the conn store
+    send([50, 70], now=3)    # est hits: totals now 3 pkts / 220 B
+    exp.poll(now=4)          # carry poll (no active export yet)
+    exp.poll(now=60)         # entry idled out of the dump -> end record
+    ends = [r for r in exp.records if r["event"] == "end"
+            and r["reply"] is False]
+    assert len(ends) == 1
+    assert ends[0]["reason"] == "idle-end"
+    assert (ends[0]["packets"], ends[0]["bytes"]) == (3, 220)
+
+
 def test_batch_sink_resumes_past_existing_objects(tmp_path):
     d = str(tmp_path / "objects")
     s1 = BatchDirSink(d, batch_size=1)
