@@ -51,6 +51,11 @@ Routes:
                         per-regime step-latency summaries, sentinel
                         window/baseline state); 404 when the datapath was
                         built telemetry=False
+  GET /serving          serving-batcher state (serving/batcher.py:
+                        canonical ladder + flush knobs, admission/shed/
+                        flush meters, per-world staged depth, starvation
+                        and staging-wait p99); 404 when the batcher was
+                        never materialized
   GET /memberlist       alive members of the gossip cluster
   GET /featuregates     feature gate states
   GET /traceflow?src=IP&dst=IP[&proto=N&sport=N&dport=N&in_port=N&now=N]
@@ -99,9 +104,11 @@ HANDLER_SAFE = (
     "flightrecorder_stats",
     "flightrecorder_events",
     "telemetry_stats",
+    "serving_stats",
     # /metrics: the histogram rows are snapshot tuples; Histogram reads
     # are monotonic-counter fetches like step_hist's.
     "telemetry_plane",
+    "serving_plane",
     "trace",
     # /agentinfo collector (observability/agentinfo.collect_agent_info
     # receives the live object; generation/datapath_type are single
@@ -327,6 +334,12 @@ class AgentApiServer:
             body = tl() if tl is not None else None
             if body is None:
                 raise KeyError(route)  # datapath built telemetry=False
+            return body
+        if route == "/serving":
+            sv = getattr(self._dp, "serving_stats", None)
+            body = sv() if sv is not None else None
+            if body is None:
+                raise KeyError(route)  # batcher never materialized
             return body
         if route == "/memberlist":
             if self._memberlist is None:

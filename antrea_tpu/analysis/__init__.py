@@ -10,10 +10,10 @@ Public surface:
 
 The nine legacy `tools/check_*.py` gates live here as passes (the tools
 remain as thin CLI shims, verdict-identical — pinned by
-tests/test_static_analysis.py), joined by the five semantic passes that
+tests/test_static_analysis.py), joined by the semantic passes that
 pin the hand-caught bug classes: `thread-safety`, `bounded-cache`,
-`jit-purity`, `donation-safety`, `bounded-buffer`.  Everything is
-stdlib-only (ast/re/
+`jit-purity`, `donation-safety`, `bounded-buffer`, `canonical-shape`.
+Everything is stdlib-only (ast/re/
 json): importing this subpackage never pulls jax, so every gate runs on
 any CI image.  See core.py for the engine contract (SourceCache,
 Finding, allowlists, BASELINE.analysis.json)."""
@@ -49,6 +49,7 @@ from . import (  # noqa: E402,F401
     donation,
     bounded_buffer,
     telemetry,
+    canonical_shape,
 )
 
 __all__ = [

@@ -41,6 +41,13 @@ API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
         post-mortem event journal (GET /flightrecorder: drop-oldest ring,
         monotonic seq, tick-clock timestamps); default output is one
         line per event in sequence order, --json the raw body
+  telemetry --server URL [--json]
+        hot-path telemetry plane (GET /telemetry: counter totals,
+        per-scope per-regime latencies, sentinel state)
+  serving --server URL [--json]
+        serving-batcher state (GET /serving: canonical ladder + flush
+        knobs, admission/shed/flush meters, per-world staged depth and
+        staging-wait p99)
 """
 
 from __future__ import annotations
@@ -393,6 +400,33 @@ def _cmd_telemetry(args) -> int:
     return 0
 
 
+def _cmd_serving(args) -> int:
+    """Serving-batcher state over the live agent API
+    (serving/batcher.py; route GET /serving)."""
+    body = json.loads(_fetch(args.server, "/serving"))
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    sizes = ",".join(str(s) for s in body["canonical_sizes"])
+    print(f"ladder=[{sizes}] flush_depth={body['flush_depth']} "
+          f"flush_deadline={body['flush_deadline']} "
+          f"ring_slots={body['ring_slots']}")
+    print(f"submitted={body['submitted_lanes']} shed={body['shed_lanes']} "
+          f"flushed={body['flushed_lanes']} padded={body['padded_lanes']} "
+          f"dispatches={body['dispatches']} "
+          f"deadline_exceeded={body['deadline_exceeded']}")
+    print("flushes: " + " ".join(
+        f"{k}={v}" for k, v in sorted(body["flushes"].items())))
+    rows = [
+        [str(tid), str(row["staged_lanes"]), str(row["flushed_lanes"]),
+         str(row["starved"]), f"{row['wait_p99_ticks']:.1f}"]
+        for tid, row in body["worlds"].items()
+    ]
+    _print_table(["TENANT", "STAGED", "FLUSHED", "STARVED", "WAIT-P99-T"],
+                 rows)
+    return 0
+
+
 def _print_table(header: list, rows: list) -> None:
     """Fixed-width column table (the reference antctl's output shape)."""
     widths = [len(h) for h in header]
@@ -535,6 +569,14 @@ def main(argv=None) -> int:
     tl.add_argument("--server", required=True, help="live agent API base URL")
     tl.add_argument("--json", action="store_true", help="raw JSON body")
     tl.set_defaults(fn=_cmd_telemetry)
+
+    sv = sub.add_parser(
+        "serving",
+        help="serving-batcher ladder / flush meters / per-world wait p99",
+    )
+    sv.add_argument("--server", required=True, help="live agent API base URL")
+    sv.add_argument("--json", action="store_true", help="raw JSON body")
+    sv.set_defaults(fn=_cmd_serving)
 
     c = sub.add_parser("check", help="installation self-diagnostics")
     c.set_defaults(fn=_cmd_check)

@@ -82,6 +82,11 @@ MAINT_TASKS = {
                           "p99-vs-baseline regime sweep; journals "
                           "perf-regression, never acts — registered only "
                           "on telemetry=True engines)",
+    "serving-flush": "serving/batcher.py (depth-OR-deadline flush of the "
+                     "per-world staging rings onto the canonical batch "
+                     "ladder, DRR-fair with starvation aging; registered "
+                     "when the serving batcher materializes — unbatched "
+                     "engines keep the original task set)",
 }
 
 # A starved task's deficit keeps accumulating so it can eventually afford

@@ -121,6 +121,11 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         telemetry: bool = False,
         miss_source_rate=None,
         miss_source_burst=None,
+        serving_batcher: bool = False,
+        canonical_sizes=None,
+        flush_depth: Optional[int] = None,
+        flush_deadline: Optional[int] = None,
+        serving_ring_slots: Optional[int] = None,
     ):
         from ..features import DEFAULT_GATES
 
@@ -235,6 +240,14 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
                                maint_clock=maint_clock)
         # Tenancy plane — same contract as the kernel twin.
         self._init_tenancy()
+        # Serving batcher — same admission plane as the kernel twin
+        # (serving/batcher.py); lane-exact de-interleave keeps verdict
+        # parity regardless of how lanes were coalesced.
+        self._init_serving(serving_batcher,
+                           canonical_sizes=canonical_sizes,
+                           flush_depth=flush_depth,
+                           flush_deadline=flush_deadline,
+                           ring_slots=serving_ring_slots)
 
     def _rebuild_l7_ids(self) -> None:
         """Stable ids of rules carrying L7 protocols in the CURRENT policy
@@ -1022,7 +1035,7 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
         MulticastOutput bucket list, ref pkg/agent/openflow/multicast.go)."""
         return mcast_group_of(self._rt, idx)
 
-    def step(self, batch: PacketBatch, now: int) -> StepResult:
+    def step(self, batch: PacketBatch, now: int, *, valid=None) -> StepResult:
         t0 = time.perf_counter()
         # Traffic time drives the maintenance tick clock (one clock
         # domain: flow-cache aging and FQDN expiry stamp with THIS now).
@@ -1032,14 +1045,14 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
             # the tpuflow step latch, so span STRUCTURE is oracle-parity.
             self._realization.first_hit(self._gen, batch.size)
         try:
-            return self._step(batch, now)
+            return self._step(batch, now, valid=valid)
         finally:
             dt = time.perf_counter() - t0
             self.step_hist.observe(dt)
             if self._telemetry is not None:
                 self._telemetry.observe_step(dt)
 
-    def _step(self, batch: PacketBatch, now: int) -> StepResult:
+    def _step(self, batch: PacketBatch, now: int, valid=None) -> StepResult:
         from ..models.pipeline import _TEARDOWN_FLAGS, PROTO_TCP
 
         in_ports = batch.in_ports()
@@ -1051,10 +1064,16 @@ class OracleDatapath(TenantedDatapath, MaintainableDatapath,
                 "batch carries v6 lanes but this datapath is v4-only; "
                 "construct it with dual_stack=True"
             )
+        ext = None if valid is None else np.asarray(valid, bool)
         lane_modes = []
         no_commit = []
         for i in range(batch.size):
-            if oracle_spoof(self._rt, batch.src_key(i), int(in_ports[i])):
+            if ext is not None and not ext[i]:
+                # Serving-batcher padding lanes ride the spoof/skip
+                # discipline (the kernel twin's valid mask): nothing
+                # probed, committed, or counted.
+                lane_modes.append(O.LANE_SPOOF)
+            elif oracle_spoof(self._rt, batch.src_key(i), int(in_ports[i])):
                 lane_modes.append(O.LANE_SPOOF)
             elif int(arp_ops[i]) > 0:
                 # ARP lanes bypass the IP pipeline (handled in forwarding);

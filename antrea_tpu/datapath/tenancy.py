@@ -181,6 +181,9 @@ class TenantedDatapath:
     _tenant_building = False
     _tenant_maint_cursor = 0
     _tenant_task_registered = False
+    _serving = None
+    _serving_cfg: dict = {}
+    _serving_task_registered = False
 
     def _init_tenancy(self) -> None:
         self._tenants = TenantRegistry()
@@ -325,9 +328,10 @@ class TenantedDatapath:
                 "tenant worlds are v4-only (like the async slow path): "
                 "construct the engine with dual_stack=False")
         if getattr(self, "_reshard", None) is not None:
-            raise RuntimeError(
-                "a mesh resize is in flight; tenant worlds cannot be "
-                "created until its cutover or abort")
+            raise ConfigError(
+                "the elastic resharding plane has a mesh resize in "
+                "flight; tenant worlds cannot be created until its "
+                "cutover or abort")
         if not _is_pow2(quota):
             raise ConfigError(
                 f"tenant quota must be a power of two (the state-tensor "
@@ -397,57 +401,85 @@ class TenantedDatapath:
 
     # -- serving surface -----------------------------------------------------
 
-    def tenant_step(self, tid: int, batch, now: int):
+    def tenant_step(self, tid: int, batch, now: int, *, valid=None):
         with self._world_ctx(tid) as w:
             w.steps += 1
             w.packets += batch.size
-            return self.step(batch, now)
+            return self.step(batch, now, valid=valid)
 
     def step_tenants(self, tenant_ids, batch, now: int):
-        """Mixed-tenant batch: partition lanes by tenant id (0 = the
-        default world), dispatch each group through its world, merge the
-        results back in lane order.  Per-tenant lane counts become jit
-        batch shapes — callers batching many tenants should keep slice
-        sizes on a few values (the bench drives equal slices)."""
-        import dataclasses
-
+        """Mixed-tenant batch through the serving batcher: lanes stage
+        into per-world rings, force-flush onto the canonical pow2 size
+        ladder (padding masked via `valid`, so dispatch shapes — and the
+        XLA executable count — are bounded by rungs x ladder, never by
+        traffic), then de-interleave lane-exactly back into one
+        StepResult (`n_miss` summed once per dispatch)."""
         tids = np.asarray(tenant_ids, np.int64)
         if tids.shape[0] != batch.size:
             raise ValueError(
                 f"tenant_ids has {tids.shape[0]} lanes, batch has "
                 f"{batch.size}")
-        merged = None
-        fields = None
+        b = self.serving_batcher()
+        tickets = np.empty(batch.size, np.int64)
         for tid in np.unique(tids):
             sel = np.nonzero(tids == tid)[0]
-            sub = _sub_batch(batch, sel)
-            res = (self.step(sub, now) if tid == 0
-                   else self.tenant_step(int(tid), sub, now))
-            if merged is None:
-                fields = [f.name for f in dataclasses.fields(res)]
-                merged = {}
-                for name in fields:
-                    v = getattr(res, name)
-                    if name == "n_miss" or v is None:
-                        merged[name] = 0 if name == "n_miss" else None
-                    elif isinstance(v, list):
-                        merged[name] = [None] * batch.size
-                    else:
-                        merged[name] = np.zeros(
-                            (batch.size,) + np.asarray(v).shape[1:],
-                            np.asarray(v).dtype)
-            for name in fields:
-                v = getattr(res, name)
-                if name == "n_miss":
-                    merged[name] += int(v)
-                elif v is None or merged[name] is None:
-                    continue
-                elif isinstance(v, list):
-                    for i, lane in enumerate(sel):
-                        merged[name][lane] = v[i]
-                else:
-                    merged[name][sel] = np.asarray(v)
-        return type(res)(**merged)
+            tickets[sel] = b.submit(_sub_batch(batch, sel), now,
+                                    tenant=int(tid), shed=False)
+        b.flush_all(now)
+        return b.collect(tickets)
+
+    # -- serving batcher (canonical-shape admission plane) -------------------
+
+    def _init_serving(self, enabled: bool = False, **cfg) -> None:
+        """Engine-ctor hook (after `_init_tenancy`): stash the batcher
+        knobs; `serving_batcher=True` materializes the plane eagerly
+        (registering its flush task at boot), otherwise it builds
+        lazily on first `step_tenants`/`serving_batcher()` — plain
+        `step()` never touches it, so the unbatched path stays
+        bit-identical with the batcher off."""
+        self._serving = None
+        self._serving_cfg = {k: v for k, v in cfg.items() if v is not None}
+        self._serving_task_registered = False
+        if enabled:
+            self.serving_batcher()
+
+    def serving_batcher(self):
+        if getattr(self, "_serving", None) is None:
+            from ..serving.batcher import ServingBatcher
+
+            self._serving = ServingBatcher(
+                self, **getattr(self, "_serving_cfg", {}))
+            self._serving_register_maintenance()
+        return self._serving
+
+    def _serving_register_maintenance(self) -> None:
+        if getattr(self, "_serving_task_registered", False):
+            return
+        sched = getattr(self, "_maintenance", None)
+        if sched is None:
+            return
+        from .maintenance import MaintenanceTask
+
+        sched.register(MaintenanceTask(
+            "serving-flush", self._maint_serving, budget=4, priority=3,
+            shed_when_degraded=False))
+        self._serving_task_registered = True
+
+    def _maint_serving(self, now, budget) -> int:
+        s = getattr(self, "_serving", None)
+        return 0 if s is None else s.tick_flush(now, budget)
+
+    @property
+    def serving_plane(self):
+        """The live batcher or None — metrics renderer hook (hist_rows);
+        handlers must use `serving_stats()` (snapshot-only)."""
+        return getattr(self, "_serving", None)
+
+    def serving_stats(self):
+        """Counter/knob snapshot of the serving batcher (None when the
+        plane was never materialized) — plain dict, API-safe."""
+        s = getattr(self, "_serving", None)
+        return None if s is None else s.stats()
 
     def tenant_install_bundle(self, tid: int, ps=None) -> int:
         """Per-tenant transactional install: the full commit-plane walk

@@ -126,6 +126,11 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         telemetry: bool = False,
         miss_source_rate: Optional[float] = None,
         miss_source_burst: Optional[int] = None,
+        serving_batcher: bool = False,
+        canonical_sizes=None,
+        flush_depth: Optional[int] = None,
+        flush_deadline: Optional[int] = None,
+        serving_ring_slots: Optional[int] = None,
     ):
         from ..features import DEFAULT_GATES
 
@@ -297,6 +302,16 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         # Tenancy plane (datapath/tenancy.py): pure host-side registry —
         # an engine without tenant worlds serves bit-identically.
         self._init_tenancy()
+        # Serving batcher (serving/batcher.py): canonical-shape admission
+        # in front of the jitted step.  Off (the default) the plane is
+        # never touched and step() stays bit-identical; knobs apply when
+        # the batcher materializes (eagerly with serving_batcher=True,
+        # lazily on first step_tenants).
+        self._init_serving(serving_batcher,
+                           canonical_sizes=canonical_sizes,
+                           flush_depth=flush_depth,
+                           flush_deadline=flush_deadline,
+                           ring_slots=serving_ring_slots)
 
     # -- placement hooks (overridden by the mesh engine, parallel/meshpath) --
 
@@ -532,7 +547,7 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                 jnp.asarray(iputil.flip_u32(batch.dst_ip6)),
                 jnp.asarray(batch.is6))
 
-    def step(self, batch: PacketBatch, now: int) -> StepResult:
+    def step(self, batch: PacketBatch, now: int, *, valid=None) -> StepResult:
         t0 = time.perf_counter()
         # Traffic time drives the maintenance tick clock (one clock
         # domain: flow-cache aging and FQDN expiry stamp with THIS now).
@@ -544,7 +559,7 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             # so the compiled step HLO is bit-identical with tracing off.
             self._realization.first_hit(self._gen, batch.size)
         try:
-            return self._step(batch, now)
+            return self._step(batch, now, valid=valid)
         finally:
             dt = time.perf_counter() - t0
             self.step_hist.observe(dt)
@@ -554,7 +569,7 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
                 # them during _step).
                 self._telemetry.observe_step(dt)
 
-    def _step(self, batch: PacketBatch, now: int) -> StepResult:
+    def _step(self, batch: PacketBatch, now: int, valid=None) -> StepResult:
         # One materialization of the per-lane byte lengths, clamped
         # (negative pkt_len must never decrement a monotonic counter).
         lens = np.maximum(batch.lens(), 0)
@@ -578,6 +593,12 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
             jnp.asarray(lens) if self._flow_stats else None,
             meta=self._meta_step,
             v6=self._v6_lanes(batch),
+            # Serving-batcher padding mask: padded lanes ride the spoof
+            # discipline (no state commit / miss admission / counters);
+            # None traces the identical program, so the unbatched path
+            # stays HLO-bit-identical.
+            valid=(None if valid is None
+                   else jnp.asarray(np.asarray(valid, bool))),
         )
         self._state = state
         self._state_mutations += 1

@@ -155,6 +155,15 @@ EVENT_KINDS = {
                        "(payload: regime, p99, baseline_p99, samples, "
                        "ratio) — journal-and-meter only, never an "
                        "automatic rollback",
+    "batch-flush": "serving/batcher.py — a staging ring flushed onto the "
+                   "canonical batch ladder (payload: tenant, lanes, "
+                   "padded, dispatches, age_ticks, reason = depth / "
+                   "deadline / forced / overflow)",
+    "batch-deadline-exceeded": "serving/batcher.py — a ring flushed "
+                               "LATER than its flush_deadline (budget "
+                               "starvation or a stalled tick clock): "
+                               "the p99 contract was at risk for that "
+                               "world's staged lanes",
 }
 
 

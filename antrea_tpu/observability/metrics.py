@@ -148,6 +148,20 @@ METRICS: dict[str, str] = {
     "antrea_tpu_tenant_evictions_total": "counter",
     "antrea_tpu_tenant_quota_clamps_total": "counter",
     "antrea_tpu_tenant_rollbacks_total": "counter",
+    # serving batcher (serving/batcher.py; rendered when the datapath
+    # exposes serving_stats()) — admission/shed/flush meters for the
+    # canonical-shape batching plane plus the {tenant}-labeled staging-
+    # wait histogram (tick units; its p99 is the flush_deadline lever)
+    "antrea_tpu_serving_submitted_lanes_total": "counter",
+    "antrea_tpu_serving_shed_lanes_total": "counter",
+    "antrea_tpu_serving_flushed_lanes_total": "counter",
+    "antrea_tpu_serving_padded_lanes_total": "counter",
+    "antrea_tpu_serving_dispatches_total": "counter",
+    "antrea_tpu_serving_flushes_total": "counter",
+    "antrea_tpu_serving_deadline_exceeded_total": "counter",
+    "antrea_tpu_serving_results_dropped_total": "counter",
+    "antrea_tpu_serving_staged_lanes": "gauge",
+    "antrea_tpu_serving_wait_ticks": "histogram",
     # hot-path telemetry plane (observability/telemetry.py; rendered when
     # the datapath exposes telemetry_stats()) — one counter family per
     # TELEMETRY_COUNTERS name (family names resolve via
@@ -734,6 +748,34 @@ def render_metrics(datapath, node: str = "") -> str:
             for tid, row in ts.items():
                 lines.append(
                     f"{fam}{_labels(tenant=tid, node=node)} {_num(row[key])}")
+    sv = getattr(datapath, "serving_stats", None)
+    sv = sv() if sv is not None else None
+    if sv is not None:
+        # Serving batcher (serving/batcher.py): admission / shed / flush
+        # meters, the staged-lane gauge, and the {tenant}-labeled
+        # staging-wait histogram (tick units).
+        for fam, key in (
+            ("antrea_tpu_serving_submitted_lanes_total", "submitted_lanes"),
+            ("antrea_tpu_serving_shed_lanes_total", "shed_lanes"),
+            ("antrea_tpu_serving_flushed_lanes_total", "flushed_lanes"),
+            ("antrea_tpu_serving_padded_lanes_total", "padded_lanes"),
+            ("antrea_tpu_serving_dispatches_total", "dispatches"),
+            ("antrea_tpu_serving_deadline_exceeded_total",
+             "deadline_exceeded"),
+            ("antrea_tpu_serving_results_dropped_total", "results_dropped"),
+            ("antrea_tpu_serving_staged_lanes", "staged_lanes"),
+        ):
+            lines += [_type_line(fam),
+                      f"{fam}{_labels(node=node)} {_num(sv[key])}"]
+        fam = "antrea_tpu_serving_flushes_total"
+        lines.append(_type_line(fam))
+        for reason, v in sorted(sv["flushes"].items()):
+            lines.append(
+                f"{fam}{_labels(reason=reason, node=node)} {_num(v)}")
+        plane = getattr(datapath, "serving_plane", None)
+        rows = plane.hist_rows(node) if plane is not None else []
+        if rows:
+            lines.extend(_render_histograms(rows))
     tel = getattr(datapath, "telemetry_stats", None)
     tel = tel() if tel is not None else None
     if tel is not None:

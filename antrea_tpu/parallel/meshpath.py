@@ -644,12 +644,17 @@ class MeshDatapath(TpuflowDatapath):
 
     # -- the sharded step ----------------------------------------------------
 
-    def _step(self, batch: PacketBatch, now: int) -> StepResult:
+    def _step(self, batch: PacketBatch, now: int, valid=None) -> StepResult:
         D = self._n_data
         B = batch.size
         if B % D:
             raise ValueError(
                 f"batch size {B} is not divisible by the data-axis size {D}")
+        # Serving-batcher padding mask (canonical sizes are pow2 >= D, so
+        # divisibility holds): padded lanes join the kernel's per-lane
+        # validity in PERMUTED order and are excluded from the home-routed
+        # spill retry below — a padding lane never caches anywhere.
+        ext = None if valid is None else np.asarray(valid, bool)
         self._v6_lanes(batch)  # v4-only guard (dual_stack is always False)
         lens = np.maximum(batch.lens(), 0)
         flags = np.asarray(batch.flags()).astype(np.int32)
@@ -676,7 +681,8 @@ class MeshDatapath(TpuflowDatapath):
             self._state, self._drs, self._dsvc, self._dft,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
             in_ports[perm], jnp.int32(now), jnp.int32(self._gen),
-            pflags, arp[perm], np.ones(B, bool), spill,
+            pflags, arp[perm],
+            np.ones(B, bool) if ext is None else ext[perm], spill,
             lens[perm].astype(np.int32), spill,
         )
         self._state = state
@@ -702,7 +708,8 @@ class MeshDatapath(TpuflowDatapath):
         # serves it).
         tel_o = {k: o.pop(k) for k in tuple(o) if k.startswith("tel_")}
         o = {k: v[inv] for k, v in o.items()}  # back to packet order
-        spilled = perm[np.nonzero(spill)[0]]  # packet indices off-home
+        spilled = perm[np.nonzero(
+            spill if ext is None else spill & ext[perm])[0]]  # off-home
         if spilled.size:
             o = self._spill_retry(batch, o, spilled, shard, flags, in_ports,
                                   arp, has_arp, lens, now)
@@ -1257,11 +1264,14 @@ class MeshDatapath(TpuflowDatapath):
             # Tenant worlds hold their own (D,)-sharded state the
             # migrator does not walk; re-homing them under a resize is
             # an open item (datapath/tenancy.py residue) — refuse
-            # loudly rather than silently strand tenant rows.
-            raise RuntimeError(
-                f"{self.tenant_count} tenant world(s) exist; the elastic "
-                f"resharding plane migrates the default world only — "
-                f"drain tenants before resizing")
+            # loudly rather than silently strand tenant rows.  Typed
+            # like the mirror-image refusal (tenant_create under an
+            # in-flight reshard): both directions are a plane-exclusion
+            # config error, not an internal failure.
+            raise ConfigError(
+                f"the tenancy plane has {self.tenant_count} tenant "
+                f"world(s); the elastic resharding plane migrates the "
+                f"default world only — drain tenants before resizing")
         plane = ReshardPlane(self, int(n_data), devices=devices)
         self._reshard = plane
         self._maintenance.register(MaintenanceTask(

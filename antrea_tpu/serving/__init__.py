@@ -1,0 +1,14 @@
+"""Serving admission plane — canonical-shape batching in front of the
+jitted step dispatch.
+
+`ServingBatcher` stages per-tenant lane submissions in bounded per-world
+rings, packs them onto a small declared ladder of pow2 canonical batch
+sizes (compile count bounded by rungs x ladder, never by traffic), and
+flushes on a depth-OR-deadline policy driven by the maintenance
+scheduler's tick clock.  Padded lanes ride the engines' `valid` mask so
+padding is HLO-invisible and never mutates flow state.
+"""
+
+from .batcher import CANONICAL_SIZES, ServingBatcher
+
+__all__ = ["CANONICAL_SIZES", "ServingBatcher"]

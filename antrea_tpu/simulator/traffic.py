@@ -116,6 +116,81 @@ def gen_syn_flood(
     )
 
 
+def gen_bursty(
+    pod_ips: list[int],
+    n_ticks: int,
+    *,
+    tenants: list[int],
+    burst_lanes: int = 8,
+    p_start: float = 0.25,
+    p_stop: float = 0.5,
+    n_flows: int = 64,
+    seed: int = 0,
+) -> list:
+    """Per-tenant bursty/idle arrival schedule (the serving-batcher
+    driver: uneven, trickling per-world lane counts — the traffic shape
+    whose per-tenant sub-batch sizes are unbounded without the canonical
+    ladder).
+
+    Each tenant runs an independent two-state (idle/burst) Markov chain:
+    idle -> burst with `p_start`, burst -> idle with `p_stop`; while
+    bursting it emits 1..burst_lanes lanes per tick drawn from its OWN
+    repeat-flow pool (so flow-cache behavior per world is realistic).
+    Returns `n_ticks` entries, each None (fleet idle that tick) or a
+    `(tenant_ids, PacketBatch)` pair in submission order.  Deterministic
+    for a given seed: every draw comes from one seeded rng in a fixed
+    iteration order.
+    """
+    import dataclasses
+
+    rng = np.random.default_rng(seed)
+    if isinstance(tenants, (int, np.integer)):
+        tenants = range(int(tenants))  # a count: worlds 0..n-1
+    tenants = [int(t) for t in tenants]
+    pool_b = max(n_flows, 4 * burst_lanes)
+    pools = {
+        t: gen_traffic(pod_ips, pool_b, n_flows=n_flows,
+                       seed=seed * 1009 + 17 * i + 1)
+        for i, t in enumerate(tenants)
+    }
+    bursting = {t: False for t in tenants}
+    offs = {t: 0 for t in tenants}
+
+    def lanes_of(t: int, k: int) -> PacketBatch:
+        pool = pools[t]
+        idx = (offs[t] + np.arange(k)) % pool.size
+        offs[t] += k
+        kw = {}
+        for f in dataclasses.fields(pool):
+            v = getattr(pool, f.name)
+            kw[f.name] = None if v is None else np.asarray(v)[idx]
+        return PacketBatch(**kw)
+
+    out = []
+    for _ in range(n_ticks):
+        tids = []
+        segs = []
+        for t in tenants:
+            flip = rng.random()
+            bursting[t] = ((flip < p_start) if not bursting[t]
+                           else (flip >= p_stop))
+            if not bursting[t]:
+                continue
+            k = int(rng.integers(1, burst_lanes + 1))
+            tids.append(np.full(k, t, np.int64))
+            segs.append(lanes_of(t, k))
+        if not segs:
+            out.append(None)
+            continue
+        kw = {}
+        for f in dataclasses.fields(segs[0]):
+            cols = [getattr(s, f.name) for s in segs]
+            kw[f.name] = (None if any(c is None for c in cols)
+                          else np.concatenate([np.asarray(c) for c in cols]))
+        out.append((np.concatenate(tids), PacketBatch(**kw)))
+    return out
+
+
 def gen_cache_thrash(
     pod_ips: list[int],
     batch: int,

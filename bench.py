@@ -911,6 +911,115 @@ def _measure_multitenant():
     }
 
 
+def measure_serving_batched():
+    """The round-18 batched-serving regime: the same MT_TENANTS uneven
+    worlds, but driven by `gen_bursty` trickle arrivals THROUGH the
+    serving batcher — aggregate pps over the canonical pow2 ladder plus
+    the batching-delay price (per-tenant p99 wait, seconds) and the
+    compile evidence (XLA step executables vs rungs x ladder sizes).
+
+    On CPU platforms the worlds are toy-sized so the regime is
+    smoke-testable in CI — same JSON keys, `smoke: true`; the on-chip
+    numbers are the driver's to write.  -> the JSON dict, or None."""
+    try:
+        return _measure_serving_batched()
+    except Exception as e:  # report, never sink the bench
+        print(f"# serving-batched measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_serving_batched():
+    import time
+
+    from antrea_tpu.datapath.tpuflow import TpuflowDatapath
+    from antrea_tpu.models import forwarding as fwd_model
+    from antrea_tpu.simulator.traffic import gen_bursty
+
+    smoke = jax.devices()[0].platform == "cpu"
+    rng = np.random.default_rng(73)
+    n_tenants = 8 if smoke else MT_TENANTS
+    sizes = ((4, 7, 14, 28, 60) if smoke else (40, 90, 200, 450, 1000))
+    weights = (0.35, 0.30, 0.18, 0.12, 0.05)
+    rule_counts = rng.choice(sizes, size=n_tenants, p=weights)
+    ladder = (8, 32) if smoke else (16, 64, 256, 1024)
+    dp = TpuflowDatapath(flow_slots=1 << 12, aff_slots=1 << 8,
+                         canary_probes=8, flightrec_slots=256,
+                         realization_slots=0,
+                         serving_batcher=True, canonical_sizes=ladder,
+                         flush_deadline=4)
+    exec0 = fwd_model.pipeline_step_full._cache_size()
+    tids = []
+    pod_pool = None
+    for i, n in enumerate(rule_counts):
+        cl = gen_cluster(int(n), n_nodes=2, pods_per_node=8, seed=700 + i)
+        tids.append(dp.tenant_create(f"b{i}", cl.ps, quota=1 << 8))
+        pod_pool = pod_pool or cl.pod_ips
+    n_ticks = 24 if smoke else 256
+    sched = gen_bursty(pod_pool, n_ticks, tenants=len(tids),
+                       burst_lanes=(8 if smoke else 64), seed=91)
+    b = dp.serving_batcher()
+    # Warm round: touch every (rung, ladder-size) pair once so the
+    # timed loop measures serving, not tracing.
+    warm = gen_bursty(pod_pool, 8, tenants=len(tids),
+                      burst_lanes=(8 if smoke else 64), seed=92)
+    now = 100.0
+    for entry in warm:
+        now += 1
+        if entry is None:
+            continue
+        lane_tids, batch = entry
+        dp.step_tenants(np.asarray([tids[int(t)] for t in lane_tids]),
+                        batch, now)
+    # Timed region runs the REAL serving loop: stage arrivals into the
+    # rings, let depth-OR-deadline policy decide the flushes (the
+    # step_tenants wrapper force-flushes, which would hide the wait).
+    from antrea_tpu.datapath.tenancy import _sub_batch
+    flushed0 = dp.serving_stats()["flushed_lanes"]
+    t0 = time.perf_counter()
+    for entry in sched:
+        now += 1
+        if entry is not None:
+            lane_tids, batch = entry
+            for t in np.unique(lane_tids):
+                sel = np.nonzero(lane_tids == t)[0]
+                b.submit(_sub_batch(batch, sel), now,
+                         tenant=tids[int(t)], shed=False)
+        b.tick_flush(now, 8)
+    b.flush_all(now)
+    dt = time.perf_counter() - t0
+    pkts = dp.serving_stats()["flushed_lanes"] - flushed0
+    tick_s = dt / max(n_ticks, 1)
+    execs = fwd_model.pipeline_step_full._cache_size() - exec0
+    st = dp.serving_stats()
+    # Wait p99 in ticks per world, priced in wall seconds at the
+    # measured tick cadence — the deadline knob's observable cost.
+    p99_ticks = max((w["wait_p99_ticks"] for w in st["worlds"].values()),
+                    default=0.0)
+    return {
+        "metric": "multitenant_batched_pps",
+        "value": round(pkts / max(dt, 1e-9), 1),
+        "unit": "packets/s",
+        "extra": {
+            "tenant_batch_p99_s": round(p99_ticks * tick_s, 6),
+            "tenant_batch_p99_ticks": p99_ticks,
+            "n_tenants": n_tenants,
+            "canonical_sizes": list(ladder),
+            "flush_depth": st["flush_depth"],
+            "flush_deadline": st["flush_deadline"],
+            "rule_rungs_occupied": len(dp.tenant_rungs()),
+            "step_executables": int(execs),
+            "compile_bound": len(dp.tenant_rungs()) * len(ladder),
+            "submitted_lanes": st["submitted_lanes"],
+            "padded_lanes": st["padded_lanes"],
+            "dispatches": st["dispatches"],
+            "flushes": st["flushes"],
+            "busy_ticks": sum(e is not None for e in sched),
+            "n_ticks": n_ticks,
+            "smoke": smoke,
+        },
+    }
+
+
 def measure_reshard():
     """The round-8 elastic-mesh regime (ROADMAP item 3): a LIVE resize of
     the data axis — grow 2→4 then shrink 4→2 — executed on a serving
@@ -1081,6 +1190,7 @@ def main():
     multichip = measure_multichip(cps, svc, cluster.pod_ips, services)
     reshard = measure_reshard()
     multitenant = measure_multitenant()
+    serving_batched = measure_serving_batched()
     _print_and_gate(pps, cold_pps, sh_pps, sh_overhead, churn_pps,
                     sh_cold_pps, async_churn_pps, q_overflows,
                     overlap_churn_pps, maint_churn_pps,
@@ -1091,7 +1201,8 @@ def main():
                     steady_fused_pps=steady_fused_pps,
                     cold_fused_pps=cold_fused_pps,
                     steady_telemetry_pps=steady_telemetry_pps,
-                    reshard=reshard, multitenant=multitenant)
+                    reshard=reshard, multitenant=multitenant,
+                    serving_batched=serving_batched)
 
 
 # Regression floors (round-3 verdict weak #6: a silent 10x perf regression
@@ -1116,7 +1227,8 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     prune_fb_rate=None, prune_skip_rate=None,
                     steady_fused_pps=None, cold_fused_pps=None,
                     steady_telemetry_pps=None,
-                    reshard=None, multitenant=None):
+                    reshard=None, multitenant=None,
+                    serving_batched=None):
     maint_overhead_pct = None
     if maint_churn_pps and async_churn_pps:
         maint_overhead_pct = round(
@@ -1229,6 +1341,12 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
     # single-chip keys stay untouched for the r08 -> r09 comparison.
     if multitenant is not None:
         print(json.dumps(multitenant))
+    # The batched-serving regime prints fifth (round 18): aggregate pps
+    # through the canonical-ladder batcher + the per-tenant p99 wait
+    # price of the deadline knob — earlier keys stay untouched for the
+    # r17 -> r18 comparison.
+    if serving_batched is not None:
+        print(json.dumps(serving_batched))
     # Explicit raises (not assert): the gate must survive python -O.
     if pps < STEADY_FLOOR_PPS:
         raise SystemExit(

@@ -328,10 +328,11 @@ def test_engines_register_the_consolidated_task_set(dp_cls):
     # mesh engine's, registered only while a resize is in flight;
     # tenant-maintain joins on the first tenant_create only
     # (datapath/tenancy — untenanted engines keep this base set);
-    # telemetry-sentinel registers only on telemetry=True engines.
+    # telemetry-sentinel registers only on telemetry=True engines;
+    # serving-flush joins when the serving batcher materializes.
     assert (set(dpa.maintenance.task_names)
             | {"fqdn-ttl", "reshard-migrate", "tenant-maintain",
-               "telemetry-sentinel"}
+               "telemetry-sentinel", "serving-flush"}
             == set(MAINT_TASKS))
     tdp = _dp(dp_cls, ps, svcs, telemetry=True)
     assert "telemetry-sentinel" in tdp.maintenance.task_names

@@ -32,7 +32,7 @@ ALL_PASSES = (
     "mesh", "metrics", "phases", "events", "commit-plane", "audit-plane",
     "maintenance", "reshard", "tenant",
     "thread-safety", "bounded-cache", "jit-purity", "donation-safety",
-    "bounded-buffer", "telemetry-registry",
+    "bounded-buffer", "telemetry-registry", "canonical-shape",
 )
 
 
@@ -248,6 +248,30 @@ def test_bounded_cache_pass_fires_on_seeded_violations(tmp_path):
     })
     objs = {f.obj for f in run(root, ["bounded-cache"]).findings}
     assert objs == {"x.py:leaky", "x.py:leaky2", "x.py:leaky3"}
+
+
+def test_canonical_shape_pass_fires_on_seeded_violations(tmp_path):
+    root = _mini_tree(tmp_path, {
+        "antrea_tpu/datapath/bad.py": (
+            "from .tenancy import _sub_batch\n\n\n"
+            "class Dp:\n"
+            "    def step_groups(self, tids, batch, now):\n"
+            "        for tid in set(tids):\n"
+            "            sub = _sub_batch(batch, [0])\n"
+            "            self.step(sub, now)  # tainted name\n"
+            "        return self.tenant_step(1, _sub_batch(batch, [1]),\n"
+            "                                now)  # inline\n\n"
+            "    def staged(self, batch, now):\n"
+            "        # The sanctioned pattern: subsets go INTO the\n"
+            "        # batcher, which dispatches canonical shapes.\n"
+            "        t = self.batcher.submit(_sub_batch(batch, [0]), now)\n"
+            "        self.batcher.flush_all(now)\n"
+            "        return self.step(batch, now)\n"
+        ),
+    })
+    objs = {f.obj for f in run(root, ["canonical-shape"]).findings}
+    assert objs == {"datapath/bad.py:step_groups:step",
+                    "datapath/bad.py:step_groups:tenant_step"}
 
 
 def test_jit_purity_pass_fires_on_seeded_violations(tmp_path):
