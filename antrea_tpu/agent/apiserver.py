@@ -121,6 +121,11 @@ HANDLER_SAFE = (
     "prune_stats",
     "mesh_stats",
     "reshard_stats",
+    # /failover + /metrics: snapshot dicts off the failover plane; the
+    # readmit action delegates to the plane's single-threaded state
+    # machine (same operator-action shape as maintenance_tick).
+    "failover_stats",
+    "failover_readmit",
     "tenant_stats",
     "step_hist",
 )
@@ -303,6 +308,19 @@ class AgentApiServer:
                 tick = self._dp.maintenance_tick(now=now, budget=budget)
                 body = self._dp.maintenance_stats()
                 body["last_tick"] = tick
+            return body
+        if route == "/failover":
+            fs = getattr(self._dp, "failover_stats", None)
+            body = fs() if fs is not None else None
+            if body is None:
+                raise KeyError(route)  # datapath without a mesh/failover
+            if q.get("readmit", "0") not in ("", "0"):
+                # Operator-triggered certified re-admission (antctl
+                # failover --readmit): pre-flip heal unmasks; an
+                # evacuated replica rejoins via the ordinary certified
+                # grow-resize.  Report refreshed state.
+                body = self._dp.failover_readmit()
+                body["last_readmit"] = body.get("phase")
             return body
         if route == "/realization":
             rz = getattr(self._dp, "realization_stats", None)

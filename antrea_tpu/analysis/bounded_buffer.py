@@ -1,4 +1,4 @@
-"""Pass `bounded-buffer` — dissemination buffers must declare their cap.
+"""Pass `bounded-buffer` — scanned-module buffers must declare their cap.
 
 The bug class (the storm-soak round's structural lesson): the
 dissemination plane sits between an unbounded producer (controller
@@ -7,10 +7,14 @@ buffering structure in it — watcher queues, framing buffers, resync
 cursors — is a fleet-wide memory liability unless something bounds it.
 The watcher-overflow cap, the coalescing dict and the cursor snapshot
 each earned an explicit bound; this pass makes the discipline
-structural instead of reviewed-by-hand:
+structural instead of reviewed-by-hand.  The replica-loss failover
+plane (parallel/failover.py) joined the scan set: its probe-history
+ring sits between an unbounded producer (every maintenance tick for the
+engine's whole lifetime) and a consumer that may never read it
+(supportbundle/debug), the same liability class.
 
-  * every buffer-shaped instance attribute assigned in
-    `antrea_tpu/dissemination/` — `self.<attr> = <container builder>`
+  * every buffer-shaped instance attribute assigned in a scanned module
+    (SCANNED_PREFIXES) — `self.<attr> = <container builder>`
     where <attr> smells like a buffer (queue/buf/pending/backlog/
     latest/cursor/inbox/ring/keys) and the value constructs a
     container (call, list/dict/set literal or comprehension, bytes
@@ -36,6 +40,15 @@ BUFFER_RE = re.compile(
 
 #: obj key ("relpath:Class.attr") -> reason.
 BUFFER_ALLOWLIST: dict[str, str] = {}
+
+# Modules the pass scans: whole packages (trailing "/") or single files.
+# Growing this set is deliberate API — a new plane that buffers between
+# an unbounded producer and a maybe-never consumer earns its entry here,
+# and its module then owes BUFFER_CAPS rows.
+SCANNED_PREFIXES = (
+    "dissemination/",
+    "parallel/failover.py",
+)
 
 
 def _is_container_builder(value: ast.AST) -> bool:
@@ -94,7 +107,7 @@ def check(src: SourceCache) -> list[Finding]:
     problems: list[Finding] = []
     for p in src.pkg_files():
         pkg_rel = str(p.relative_to(src.pkg)).replace("\\", "/")
-        if not pkg_rel.startswith("dissemination/"):
+        if not pkg_rel.startswith(SCANNED_PREFIXES):
             continue
         tree = src.tree(p)
         if tree is None:
@@ -112,10 +125,10 @@ def check(src: SourceCache) -> list[Finding]:
                     problems.append(Finding(
                         "bounded-buffer", rel, line,
                         f"{key} builds a buffer with no declared cap — "
-                        f"between an unbounded producer and 10k slow "
-                        f"consumers every dissemination buffer needs an "
-                        f"explicit bound; add a reasoned BUFFER_CAPS row "
-                        f"naming what bounds it",
+                        f"between an unbounded producer and a slow (or "
+                        f"never-reading) consumer every scanned-module "
+                        f"buffer needs an explicit bound; add a reasoned "
+                        f"BUFFER_CAPS row naming what bounds it",
                         obj=f"{pkg_rel}:{key}"))
         for key in caps:
             if key not in seen:

@@ -33,6 +33,10 @@ API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
         unified background-plane scheduler state (GET /maintenance:
         per-task runs/budget-spent/deferrals/shed, scheduler lag);
         --tick runs one synchronous budgeted scheduler round first
+  failover --server URL [--readmit]
+        replica-loss failover state (GET /failover: phase, quarantined
+        shard, probe/evacuation/readmission totals); --readmit
+        re-admits a healed replica via the certified path
   realization --server URL [--uid POLICY] [--json]
         realization-tracing span table (GET /realization: per-policy
         stage timelines controller-commit -> first live hit); default
@@ -312,6 +316,19 @@ def _cmd_maintenance(args) -> int:
     return 0
 
 
+def _cmd_failover(args) -> int:
+    """Replica-loss failover status / operator re-admission over the
+    live agent API (parallel/failover.py; route GET /failover on
+    agent/apiserver).  --readmit triggers the certified re-admission:
+    a pre-flip heal unmasks, an evacuated replica rejoins via the
+    ordinary certified grow-resize — never a blind flip."""
+    path = "/failover"
+    if args.readmit:
+        path += "?readmit=1"
+    print(json.dumps(json.loads(_fetch(args.server, path)), indent=2))
+    return 0
+
+
 def _cmd_realization(args) -> int:
     """Realization span timelines over the live agent API
     (observability/tracing.py; route GET /realization)."""
@@ -540,6 +557,16 @@ def main(argv=None) -> int:
     mt.add_argument("--budget", type=int, default=None,
                     help="total budget units for the forced tick")
     mt.set_defaults(fn=_cmd_maintenance)
+
+    fo = sub.add_parser(
+        "failover",
+        help="replica-loss failover status / certified re-admission",
+    )
+    fo.add_argument("--server", required=True, help="live agent API base URL")
+    fo.add_argument("--readmit", action="store_true",
+                    help="re-admit the quarantined replica (certified "
+                         "grow-resize; pre-flip heal just unmasks)")
+    fo.set_defaults(fn=_cmd_failover)
 
     rz = sub.add_parser(
         "realization",

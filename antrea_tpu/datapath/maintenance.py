@@ -87,6 +87,11 @@ MAINT_TASKS = {
                      "ladder, DRR-fair with starvation aging; registered "
                      "when the serving batcher materializes — unbatched "
                      "engines keep the original task set)",
+    "replica-health": "parallel/failover.py (per-replica canary health "
+                      "probes + quarantine/evacuation/readmission state "
+                      "machine; registered on failover=True mesh engines "
+                      "only, and NEVER shed when degraded — a degraded "
+                      "mesh is exactly when replica loss must be seen)",
 }
 
 # A starved task's deficit keeps accumulating so it can eventually afford
@@ -407,7 +412,12 @@ class MaintenanceScheduler:
         if self._first_tick_at is None:
             return 0  # no round yet: nothing has been denied
         lag = 0
-        for st in self._tasks.values():
+        # One-shot snapshot: this renders on the agent handler thread
+        # (HANDLER_SAFE maintenance_stats) while the engine thread may be
+        # registering a late task (reshard-migrate, tenant-maintain,
+        # replica-health) — iterating the live dict would race a
+        # mid-iteration resize.
+        for st in list(self._tasks.values()):
             ref = (st.last_granted_at if st.last_granted_at >= 0
                    else self._first_tick_at)
             lag = max(lag, self._now - ref)
@@ -437,7 +447,12 @@ class MaintenanceScheduler:
                     "last_ran_at": int(st.last_ran_at),
                     "last_granted_at": int(st.last_granted_at),
                 }
-                for name, st in sorted(self._tasks.items())
+                # list() before sorted(): the handler thread renders this
+                # table while the engine thread may register a late task
+                # (reshard-migrate / tenant-maintain / replica-health) —
+                # snapshot once so the task table can never miss or race
+                # a registration mid-iteration.
+                for name, st in sorted(list(self._tasks.items()))
             },
         }
 

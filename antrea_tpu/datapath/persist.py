@@ -90,7 +90,7 @@ def _verify(body: dict) -> bool:
 
 def save_snapshot(
     persist_dir: str, ps: PolicySet, services: list[ServiceEntry], gen: int,
-    *, fault=None,
+    *, tenants: list | None = None, fault=None,
 ) -> None:
     """Two-slot rotating save: the previous LATEST (canary-certified when
     it was committed) is copied to the LKG slot, then the new snapshot
@@ -115,6 +115,13 @@ def save_snapshot(
         "policySet": serde.encode_policy_set(ps),
         "services": [serde.encode_service_entry(s) for s in services],
     }
+    if tenants:
+        # Per-tenant INPUT state (spec + policy set + generation) — the
+        # same persisted-unit rule as the default world: compiled tensors
+        # are a pure function of it, so restore recompiles each world.
+        # Still v2: the key is optional and covered by the checksum, so
+        # pre-tenant snapshots keep loading unchanged.
+        body["tenants"] = tenants
     body["checksum"] = _checksum(body)
     atomic_write_json(latest, body)
 
@@ -130,6 +137,23 @@ def _decode_snapshot(body: dict):
         return None
 
 
+def load_snapshot_body(persist_dir: str):
+    """-> the newest INTACT raw snapshot body (checksum-verified,
+    version-gated, decodable), else None.  `load_snapshot` decodes the
+    default-world triple out of it; the tenancy plane reads the optional
+    `tenants` list separately, because tenant worlds can only be rebuilt
+    AFTER the engine's compile machinery exists (end of the ctor)."""
+    for path in (snapshot_path(persist_dir), lkg_snapshot_path(persist_dir)):
+        body = read_json(path)
+        if body is None or body.get("v") not in (1, SNAPSHOT_VERSION):
+            continue
+        if not _verify(body):
+            continue
+        if _decode_snapshot(body) is not None:
+            return body
+    return None
+
+
 def load_snapshot(persist_dir: str):
     """-> (PolicySet, services, generation) from the newest INTACT slot:
     latest first, then the LKG slot when latest is absent, truncated,
@@ -138,16 +162,8 @@ def load_snapshot(persist_dir: str):
     are missing: new round, full reinstall.  (The cookie-round journal is
     consulted separately, so an LKG fallback never rolls the generation
     backwards — see PersistableDatapath.)"""
-    for path in (snapshot_path(persist_dir), lkg_snapshot_path(persist_dir)):
-        body = read_json(path)
-        if body is None or body.get("v") not in (1, SNAPSHOT_VERSION):
-            continue
-        if not _verify(body):
-            continue
-        got = _decode_snapshot(body)
-        if got is not None:
-            return got
-    return None
+    body = load_snapshot_body(persist_dir)
+    return None if body is None else _decode_snapshot(body)
 
 
 # Topology persists in its OWN small file, written per topology event —
@@ -212,9 +228,14 @@ class PersistableDatapath:
 
         self._conf_store = ConfigStore(os.path.join(persist_dir, "conf.db"))
         if ps is None and services is None:
-            snap = load_snapshot(persist_dir)
-            if snap is not None:
-                self._ps, self._services, self._gen = snap
+            body = load_snapshot_body(persist_dir)
+            if body is not None:
+                self._ps, self._services, self._gen = _decode_snapshot(body)
+                # Tenant worlds restore later (datapath/tenancy
+                # _restore_tenant_worlds, called from _init_tenancy at
+                # the END of the ctor): rebuilding a world is a full
+                # compile, impossible this early in construction.
+                self._pending_tenant_restore = body.get("tenants") or None
         # Topology restores independently of the rule snapshot; an
         # explicitly-passed topology wins (same contract as ps/services).
         if getattr(self, "_topo", None) is None:
@@ -241,10 +262,15 @@ class PersistableDatapath:
 
     def _persist(self) -> None:
         if self._persist_dir is not None:
+            # Tenant worlds ride the same two-slot snapshot (the tenancy
+            # mixin provides the encoder; engines without it save the
+            # pre-tenant body byte-for-byte).
+            enc = getattr(self, "_tenant_snapshot_worlds", None)
             # _persist_fault: optional crash-injection hook (tests) fired
             # between the two slot writes — see save_snapshot.
             save_snapshot(self._persist_dir, self._ps, self._services,
                           self._gen,
+                          tenants=None if enc is None else enc(),
                           fault=getattr(self, "_persist_fault", None))
             self._record_round()
         self._persist_dirty = False

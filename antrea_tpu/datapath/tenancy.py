@@ -67,9 +67,13 @@ to a tenancy-free build.  Shared, deliberately NOT per-tenant: the
 service view, topology/forwarding tables, the maintenance scheduler,
 flight recorder and the prune plane (tenant policies with toServices
 references are rejected — a shared-service recompile could not reach
-them; documented residue with per-tenant realization tracing, tenant
-snapshot persistence and the tensor scrub, which all serve the default
-world only).
+them; documented residue with per-tenant realization tracing and the
+tensor scrub, which serve the default world only).  Tenant worlds ARE
+restart-persistent: each world's INPUT state (spec + policy set +
+generation) rides the two-slot checksummed snapshot
+(datapath/persist.py) and the registry rebuilds — tids and generations
+preserved, tensors recompiled, caches re-classifying — at the end of a
+persist-dir boot (`_restore_tenant_worlds`).
 """
 
 from __future__ import annotations
@@ -189,6 +193,10 @@ class TenantedDatapath:
         self._tenants = TenantRegistry()
         self._tenant_maint_cursor = 0
         self._tenant_task_registered = False
+        # Worlds captured in the restart snapshot rebuild NOW — this hook
+        # runs at the very end of the engine ctor, the first point where
+        # the compile machinery a world rebuild needs exists.
+        self._restore_tenant_worlds()
 
     # -- flight recorder (literal-kind discipline, tools/check_events) -------
 
@@ -345,6 +353,25 @@ class TenantedDatapath:
         self._tenant_check_ps(ps)
         spec = TenantSpec(tid=0, name=str(name), quota=int(quota),
                           aff_quota=int(aff_quota), queue_quota=queue_quota)
+        world = self._tenant_build_world(spec, ps)
+        tid = self._tenants.add(world)
+        self._emit(
+            "tenant-create", tenant=tid, name=spec.name,
+            quota=spec.quota, queue_quota=spec.queue_quota,
+            words=world.words, word_off=world.word_off)
+        self._tenant_register_maintenance()
+        # A new world is durable state: snapshot immediately (same
+        # write-on-commit discipline as install_bundle; no-op without a
+        # persist dir).
+        if getattr(self, "_persist_dir", None) is not None:
+            self._persist()
+        return tid
+
+    def _tenant_build_world(self, spec: TenantSpec, ps) -> TenantWorld:
+        """Compile a fresh world for `spec` with the engine's own
+        machinery, leaving the active (default) world untouched — shared
+        by tenant_create and snapshot restore, whose registry wiring
+        differs (fresh tid vs. preserved tid)."""
         saved = self._world_export()
         self._tenant_building = True
         try:
@@ -356,7 +383,7 @@ class TenantedDatapath:
                     "(toServices): the service view is shared across "
                     "tenants and a later service change could not "
                     "recompile the tenant's svcref lowering")
-            world = TenantWorld(
+            return TenantWorld(
                 spec=spec,
                 fields=self._world_export(),
                 commit_state=(False, "", 0, self._commit._clock()),
@@ -366,13 +393,76 @@ class TenantedDatapath:
         finally:
             self._tenant_building = False
             self._world_import(saved)
-        tid = self._tenants.add(world)
-        self._emit(
-            "tenant-create", tenant=tid, name=spec.name,
-            quota=spec.quota, queue_quota=spec.queue_quota,
-            words=world.words, word_off=world.word_off)
-        self._tenant_register_maintenance()
-        return tid
+
+    # -- restart persistence (datapath/persist.py two-slot snapshot) ---------
+
+    def _tenant_snapshot_worlds(self) -> list:
+        """Per-tenant INPUT state for the restart snapshot: spec + policy
+        set + generation — the compiled tensors and flow-cache state are
+        a pure function of the first two and deliberately recompile /
+        re-classify on boot, exactly the default world's persisted-unit
+        rule.  Meters reset at boot like every other stats counter."""
+        from ..dissemination import serde
+
+        if self._tenants is None or not self._tenants.worlds:
+            return []
+        return [{
+            "tid": int(tid),
+            "name": w.spec.name,
+            "quota": int(w.spec.quota),
+            "affQuota": int(w.spec.aff_quota),
+            "queueQuota": int(w.spec.queue_quota),
+            "generation": int(w.fields["_gen"]),
+            "policySet": serde.encode_policy_set(w.fields["_ps"]),
+        } for tid, w in sorted(self._tenants.worlds.items())]
+
+    def _restore_tenant_worlds(self) -> None:
+        """Rebuild the registry from the snapshot's `tenants` list
+        (stashed by PersistableDatapath._init_persist): each world
+        recompiles from its persisted policy set with its tid and
+        generation preserved — tid because dissemination/admission paths
+        address tenants by id across the restart, generation because a
+        rolled-back tenant generation could alias a pre-crash cached
+        denial (the same monotonicity rule as the default world).  A
+        world that fails to rebuild is journaled and skipped: one torn
+        tenant must not take the whole engine boot down."""
+        raw = getattr(self, "_pending_tenant_restore", None)
+        self._pending_tenant_restore = None
+        if not raw:
+            return
+        from ..dissemination import serde
+
+        reg = self._tenants
+        for d in sorted(raw, key=lambda e: int(e.get("tid", 0))):
+            try:
+                tid = int(d["tid"])
+                spec = TenantSpec(
+                    tid=tid, name=str(d["name"]), quota=int(d["quota"]),
+                    aff_quota=int(d["affQuota"]),
+                    queue_quota=int(d["queueQuota"]))
+                gen = int(d.get("generation", 0))
+                ps = serde.decode_policy_set(d["policySet"])
+                self._tenant_check_ps(ps)
+                world = self._tenant_build_world(spec, ps)
+            except Exception as e:
+                self._emit(
+                    "tenant-rollback", tenant=int(d.get("tid", 0) or 0),
+                    error=("restore: " + f"{type(e).__name__}: {e}")[:200])
+                continue
+            world.fields["_gen"] = gen
+            # The restored boot state is the world's LKG baseline — the
+            # same contract as the engine's own commit plane at boot.
+            world.commit_state = (False, "", gen, self._commit._clock())
+            world.word_off = reg._next_word
+            reg._next_word += world.words
+            reg.worlds[tid] = world
+            reg._next_tid = max(reg._next_tid, tid + 1)
+            self._emit(
+                "tenant-create", tenant=tid, name=spec.name,
+                quota=spec.quota, queue_quota=spec.queue_quota,
+                words=world.words, word_off=world.word_off, restored=1)
+        if reg.worlds:
+            self._tenant_register_maintenance()
 
     def _tenant_rung_sig(self) -> tuple:
         """The shared-compile signature of the (just-built) world: the
@@ -492,7 +582,7 @@ class TenantedDatapath:
         with self._world_ctx(tid) as w:
             rb0 = self._commit.rollbacks_total
             try:
-                return self.install_bundle(ps, None)
+                gen = self.install_bundle(ps, None)
             except Exception as e:
                 if self._commit.rollbacks_total > rb0:
                     w.rollbacks += self._commit.rollbacks_total - rb0
@@ -500,14 +590,20 @@ class TenantedDatapath:
                         "tenant-rollback", tenant=int(tid),
                         error=f"{type(e).__name__}: {e}"[:200])
                 raise
+        # Snapshot AFTER the swap exits (persistence is neutralized
+        # inside _world_ctx): the committed tenant bundle reaches disk
+        # with the same write-on-commit discipline as the default world.
+        if getattr(self, "_persist_dir", None) is not None:
+            self._persist()
+        return gen
 
     def tenant_apply_group_delta(self, tid: int, group_name: str,
                                  added_ips, removed_ips) -> int:
         with self._world_ctx(tid) as w:
             rb0 = self._commit.rollbacks_total
             try:
-                return self.apply_group_delta(group_name, added_ips,
-                                              removed_ips)
+                gen = self.apply_group_delta(group_name, added_ips,
+                                             removed_ips)
             except Exception as e:
                 if self._commit.rollbacks_total > rb0:
                     w.rollbacks += self._commit.rollbacks_total - rb0
@@ -515,6 +611,13 @@ class TenantedDatapath:
                         "tenant-rollback", tenant=int(tid),
                         error=f"{type(e).__name__}: {e}"[:200])
                 raise
+        # Tenant generations have no per-tenant round journal; the delta
+        # bump dirties the shared snapshot so the next checkpoint()
+        # persists the new tenant generation (the delta path's documented
+        # weaker durability, scoped per tenant).
+        if getattr(self, "_persist_dir", None) is not None:
+            self._persist_dirty = True
+        return gen
 
     def tenant_trace(self, tid: int, batch, now: int) -> list[dict]:
         with self._world_ctx(tid):

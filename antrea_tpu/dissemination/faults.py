@@ -287,13 +287,23 @@ class FlakyDatapath:
     word — the canary-blind service-table class; any other kind flips a
     sampled cached verdict bit), and f"{name}.audit" forces a
     false-positive divergence finding — so the chaos tier can prove
-    corruption -> detection -> repair -> reconvergence deterministically."""
+    corruption -> detection -> repair -> reconvergence deterministically.
+
+    A mesh datapath with the replica-loss failover plane enabled
+    (parallel/failover.py) gets its health-probe sites armed too:
+    f"{name}.replica_dead" makes the targeted data replica's probe row
+    read as diverged (the rule KIND names the replica — "r1"; anything
+    else targets replica 0), and f"{name}.replica_wedge" rides the
+    rule's delay_s onto that replica's measured probe latency so it
+    blows the probe deadline — so replica death is deterministic in
+    chaos tests, never a real device kill."""
 
     def __init__(self, inner, plan: FaultPlan, name: str):
         self._inner = inner
         self._plan = plan
         self._name = name
-        for arm_name in ("arm_commit_faults", "arm_audit_faults"):
+        for arm_name in ("arm_commit_faults", "arm_audit_faults",
+                         "arm_failover_faults"):
             arm = getattr(inner, arm_name, None)
             if arm is not None:
                 arm(plan, name)

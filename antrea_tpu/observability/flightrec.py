@@ -164,6 +164,27 @@ EVENT_KINDS = {
                                "starvation or a stalled tick clock): "
                                "the p99 contract was at risk for that "
                                "world's staged lanes",
+    "replica-probe-fail": "parallel/failover.py — a data replica failed "
+                          "one health probe round (payload: replica, "
+                          "reason = mismatch / deadline / fault-dead, "
+                          "streak); probe_fails consecutive failures "
+                          "quarantine the replica",
+    "replica-quarantine": "parallel/failover.py — a replica was "
+                          "quarantined: masked out of serving "
+                          "immediately (lanes re-home onto the survivor "
+                          "ring host-side), its queued misses requeued "
+                          "verbatim to survivors, and the ring "
+                          "evacuation begins",
+    "replica-evacuate": "parallel/failover.py — the emergency shrink to "
+                        "the survivor topology CUT OVER "
+                        "(canary-certified like every resize): survivor "
+                        "rows migrated, the dead replica's flows "
+                        "re-miss and re-classify to identical verdicts "
+                        "(payload meters the re-miss burst)",
+    "replica-readmit": "parallel/failover.py — the quarantined replica "
+                       "rejoined (payload: mode = auto / operator, gate "
+                       "= unmask for a pre-flip heal, resize for the "
+                       "certified grow back over the boot device grid)",
 }
 
 

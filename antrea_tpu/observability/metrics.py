@@ -128,6 +128,18 @@ METRICS: dict[str, str] = {
     "antrea_tpu_reshard_cutovers_total": "counter",
     "antrea_tpu_reshard_aborts_total": "counter",
     "antrea_tpu_reshard_catchup_rows_total": "counter",
+    # replica-loss failover plane (parallel/failover.py; rendered when
+    # the datapath exposes failover_stats()) — the shard-labeled
+    # quarantined gauge plus probe/quarantine/evacuation/readmission
+    # totals and the evacuation re-miss burst meter
+    "antrea_tpu_failover_quarantined": "gauge",
+    "antrea_tpu_failover_probes_total": "counter",
+    "antrea_tpu_failover_probe_failures_total": "counter",
+    "antrea_tpu_failover_slow_dispatches_total": "counter",
+    "antrea_tpu_failover_quarantines_total": "counter",
+    "antrea_tpu_failover_evacuations_total": "counter",
+    "antrea_tpu_failover_readmissions_total": "counter",
+    "antrea_tpu_failover_remiss_total": "counter",
     # aggregated-bitmap match pruning (ops/match round 7; rendered when
     # the datapath exposes prune_stats())
     "antrea_tpu_match_prune_skips_total": "counter",
@@ -725,6 +737,32 @@ def render_metrics(datapath, node: str = "") -> str:
         ):
             lines += [_type_line(fam),
                       f"{fam}{_labels(node=node)} {_num(rs[key])}"]
+    fs = getattr(datapath, "failover_stats", None)
+    fs = fs() if fs is not None else None
+    if fs is not None and fs.get("enabled"):
+        # Replica-loss failover plane (parallel/failover.py): the
+        # quarantined gauge scrapes shard-for-shard over the boot grid
+        # (1 = masked/evacuated, awaiting readmission), beside the
+        # plane's cumulative probe and lifecycle totals.
+        lines.append(_type_line("antrea_tpu_failover_quarantined"))
+        for r in range(fs.get("n_shards", 0)):
+            q = int(fs.get("quarantined_shard") == r)
+            lines.append(f"antrea_tpu_failover_quarantined"
+                         f"{_labels(shard=r, node=node)} {q}")
+        for fam, key in (
+            ("antrea_tpu_failover_probes_total", "probes_total"),
+            ("antrea_tpu_failover_probe_failures_total",
+             "probe_failures_total"),
+            ("antrea_tpu_failover_slow_dispatches_total",
+             "slow_dispatches_total"),
+            ("antrea_tpu_failover_quarantines_total", "quarantines_total"),
+            ("antrea_tpu_failover_evacuations_total", "evacuations_total"),
+            ("antrea_tpu_failover_readmissions_total",
+             "readmissions_total"),
+            ("antrea_tpu_failover_remiss_total", "remiss_total"),
+        ):
+            lines += [_type_line(fam),
+                      f"{fam}{_labels(node=node)} {_num(fs[key])}"]
     ts = getattr(datapath, "tenant_stats", None)
     ts = ts() if ts is not None else None
     if ts:

@@ -64,6 +64,13 @@ def collect_bundle(
             raise ValueError("datapath has no maintenance scheduler")
         return body
 
+    def _failover():
+        fs = getattr(datapath, "failover_stats", None)
+        body = fs() if fs is not None else None
+        if body is None:
+            raise ValueError("datapath has no failover plane surface")
+        return body
+
     def _flightrecorder():
         # The whole retained journal: a support bundle IS the post-mortem
         # artifact, so it carries every event the ring still holds.
@@ -94,6 +101,7 @@ def collect_bundle(
         ("cache_stats.json", datapath.cache_stats),
         ("flows.json", lambda: datapath.dump_flows(now)),
         ("maintenance.json", _maintenance),
+        ("failover.json", _failover),
         ("flightrecorder.json", _flightrecorder),
         ("realization.json", _realization),
         ("telemetry.json", _telemetry),

@@ -126,6 +126,7 @@ from .mesh import (
     shard_of_tuples,
     shard_state,
 )
+from .failover import FailoverPlane
 from .reshard import ReshardPlane
 
 
@@ -516,6 +517,35 @@ class MeshSlowPath(SlowPathEngine):
                        depth=int(self.queue.depth), at=int(now))
         return requeued, dropped
 
+    def evacuate_replica(self, dead: int, home_fn, now: int
+                         ) -> tuple[int, int]:
+        """Requeue a quarantined replica's queued misses VERBATIM onto
+        the survivor queues (parallel/failover.py quarantine): same
+        re-route-not-re-admission contract as resize(), but the queue
+        set itself survives — only the dead replica's rows move, homed
+        by the survivor-ring map.  Overflow rows tail-drop with
+        accounting (the flow re-admits on its next miss) -> (requeued,
+        dropped)."""
+        q = self.queues[dead]
+        block = q.pop(q.depth)
+        if block is None:
+            return 0, 0
+        home = np.asarray(home_fn(block))
+        requeued = dropped = 0
+        for r in range(self.n_data):
+            if r == dead:
+                continue
+            idx = np.nonzero(home == r)[0]
+            if idx.size == 0:
+                continue
+            t, d = self.queues[r].requeue(block, idx)
+            requeued += t
+            dropped += d
+        if dropped:
+            self._emit("queue-overflow", dropped=int(dropped),
+                       depth=int(self.queue.depth), at=int(now))
+        return requeued, dropped
+
     def stats(self) -> dict:
         s = super().stats()
         s["replicas"] = self.n_data
@@ -534,7 +564,7 @@ class MeshDatapath(TpuflowDatapath):
 
     def __init__(self, ps=None, services=None, *, mesh=None, n_data: int = 2,
                  n_rule: int = 1, devices=None, reshard_budget: int = 256,
-                 **kw):
+                 failover: bool = False, failover_knobs=None, **kw):
         if kw.get("dual_stack"):
             raise ConfigError(
                 "the mesh datapath is v4-only (like the async slow path); "
@@ -570,7 +600,17 @@ class MeshDatapath(TpuflowDatapath):
         self._reshard_requeued_total = 0
         self._reshard_resident_rows = 0
         self._last_reshard_span = None
+        # Replica-loss failover plane (parallel/failover.py): None when
+        # disabled — every traffic-path touch is gated on the field, so
+        # the disabled engine's step HLO is bit-identical.
+        self._failover = None
         super().__init__(ps, services, **kw)
+        if failover:
+            self._failover = FailoverPlane(self, **(failover_knobs or {}))
+            self._maintenance.register(MaintenanceTask(
+                "replica-health", self._maint_replica_health,
+                budget=max(self._failover.probe_count * self._n_data, 1),
+                priority=4, shed_when_degraded=False))
 
     # -- placement hooks (the whole tensor estate lands on the mesh) ---------
 
@@ -665,6 +705,15 @@ class MeshDatapath(TpuflowDatapath):
         shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
                                 batch.src_port, batch.dst_port, D,
                                 self._topo_gen, tenant=self._tenant_id())
+        # Replica-loss failover (parallel/failover.py): lanes homed on a
+        # quarantined replica re-home HOST-SIDE onto the survivor ring —
+        # the step HLO is untouched (bit-identical with the plane off).
+        fo = self._failover
+        fo_masked = None
+        if fo is not None:
+            shard, fo_masked = fo.mask_shard(
+                batch.src_ip, batch.dst_ip, batch.proto, batch.src_port,
+                batch.dst_port, shard, tenant=self._tenant_id())
         perm, inv, spill = _shard_placement(shard, D)
         src = batch.src_ip[perm].astype(np.uint32)
         dst = batch.dst_ip[perm].astype(np.uint32)
@@ -677,6 +726,7 @@ class MeshDatapath(TpuflowDatapath):
         # the engine contributes only the spill rule — an off-home lane
         # classifies but never caches in a foreign shard.
         stepf = _mesh_step_full_fn(self._mesh, self._meta_step, has_arp)
+        t0 = time.perf_counter() if fo is not None else 0.0
         state, out = stepf(
             self._state, self._drs, self._dsvc, self._dft,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
@@ -688,6 +738,10 @@ class MeshDatapath(TpuflowDatapath):
         self._state = state
         self._state_mutations += 1
         o = {k: np.asarray(v) for k, v in out.items()}
+        if fo is not None:
+            # Dispatch-liveness deadline: a stalled sharded dispatch (the
+            # arrays above force materialization) is a wedge symptom.
+            fo.note_dispatch(time.perf_counter() - t0, now)
         o.pop("n_miss")
         self._evictions += int(o.pop("n_evict").sum())
         self._reclaims += int(o.pop("n_reclaim").sum())
@@ -716,6 +770,10 @@ class MeshDatapath(TpuflowDatapath):
         # Recomputed from the MERGED per-lane mask: a retried lane's miss
         # image is its home-shard one, not the foreign always-miss.
         n_miss = int(o["miss"].sum())
+        if fo_masked is not None:
+            # The evacuation re-miss burst: dead-resident flows pay one
+            # re-miss each on their survivor home (bounded, metered).
+            fo.note_remiss(np.count_nonzero((o["miss"] != 0)[fo_masked]))
         # Dirty-row tracking for an in-flight resize (parallel/reshard):
         # every lane's home (replica, slot) may be refreshed/committed/
         # torn down by this step after its migration window — record it
@@ -1177,6 +1235,12 @@ class MeshDatapath(TpuflowDatapath):
         shard = shard_of_tuples(batch.src_ip, batch.dst_ip, batch.proto,
                                 batch.src_port, batch.dst_port, D,
                                 self._topo_gen, tenant=self._tenant_id())
+        if self._failover is not None:
+            # Trace what serving serves: quarantined-home lanes re-home
+            # onto the survivor ring exactly like _step.
+            shard, _m = self._failover.mask_shard(
+                batch.src_ip, batch.dst_ip, batch.proto, batch.src_port,
+                batch.dst_port, shard, tenant=self._tenant_id())
         out: list = [None] * batch.size
         for r in range(D):
             idx = np.nonzero(shard == r)[0]
@@ -1273,12 +1337,21 @@ class MeshDatapath(TpuflowDatapath):
                 f"world(s); the elastic resharding plane migrates the "
                 f"default world only — drain tenants before resizing")
         plane = ReshardPlane(self, int(n_data), devices=devices)
+        self._install_reshard_plane(plane)
+        return plane.status()
+
+    def _install_reshard_plane(self, plane) -> None:
+        """Adopt a constructed ReshardPlane — the ordinary reshard_begin
+        above, or the failover plane's emergency evacuation/certified
+        readmission (which build their planes directly: the evacuation
+        must skip reshard_begin's tenant/degraded refusals by design —
+        see parallel/failover.py) — and register its budgeted migration
+        task."""
         self._reshard = plane
         self._maintenance.register(MaintenanceTask(
             "reshard-migrate", self._maint_reshard,
             budget=self._reshard_budget, priority=4,
             shed_when_degraded=True))
-        return plane.status()
 
     def _maint_reshard(self, now: int, budget: int) -> int:
         """The reshard plane's maintenance-task runner: budgeted
@@ -1308,6 +1381,10 @@ class MeshDatapath(TpuflowDatapath):
         if self._reshard is plane:
             self._reshard = None
             self._maintenance.unregister("reshard-migrate")
+        if self._failover is not None:
+            # Evacuation/readmission outcomes fold into the failover
+            # state machine; ordinary resizes pass through untouched.
+            self._failover.note_reshard_finished(plane)
 
     def reshard_stats(self) -> dict:
         """Elastic-mesh observability (schema-stable whether or not a
@@ -1361,3 +1438,49 @@ class MeshDatapath(TpuflowDatapath):
                              if cp is not None else ())},
             "replica_audit_entries": list(self._replica_audit_entries),
         }
+
+    # -- replica-loss failover plane (parallel/failover.py) ------------------
+
+    def _maint_replica_health(self, now: int, budget: int) -> int:
+        """The `replica-health` maintenance-task runner: one probe round
+        per grant, plus evacuation begin/retry and auto-readmission
+        (NOT shed when degraded — a degraded mesh is exactly when
+        replica loss must still be detected)."""
+        fo = self._failover
+        if fo is None:
+            return 0
+        return fo.advance(now, budget)
+
+    def arm_failover_faults(self, plan, name: str) -> None:
+        """FlakyDatapath hook: arm the f"{name}.replica_dead" /
+        f"{name}.replica_wedge" sites on the failover plane (no-op when
+        the plane is disabled)."""
+        if self._failover is not None:
+            self._failover.arm(plan, name)
+
+    def failover_stats(self) -> dict:
+        """Replica-loss failover observability (schema-stable whether or
+        not the plane is enabled; rendered as the failover metric
+        families in observability/metrics.py and GET /failover)."""
+        fo = self._failover
+        if fo is None:
+            return {"enabled": 0, "n_shards": 0, "phase": "disabled",
+                    "quarantined_shard": None, "mask_active": 0,
+                    "probes_total": 0, "probe_failures_total": 0,
+                    "slow_dispatches_total": 0, "quarantines_total": 0,
+                    "evacuations_total": 0, "readmissions_total": 0,
+                    "remiss_total": 0, "requeued_total": 0,
+                    "fail_streaks": {}, "probe_rounds": 0,
+                    "probe_history": []}
+        return {"enabled": 1, "n_shards": fo._orig_n, **fo.status()}
+
+    def failover_readmit(self) -> dict:
+        """Operator re-admission (GET /failover?readmit=1, `antctl
+        failover --readmit`): pre-flip heal unmasks; an evacuated
+        replica rejoins via the ordinary certified grow-resize — never
+        a blind flip.  -> refreshed failover stats."""
+        if self._failover is None:
+            raise RuntimeError(
+                "the failover plane is not enabled (failover=True)")
+        st = self._failover.readmit(mode="operator")
+        return {"enabled": 1, "n_shards": self._failover._orig_n, **st}

@@ -133,7 +133,8 @@ class ReshardPlane:
     the old topology serves every packet until the certified flip.
     """
 
-    def __init__(self, owner, n_data: int, devices=None):
+    def __init__(self, owner, n_data: int, devices=None,
+                 skip_replica=None):
         if n_data <= 0:
             raise ValueError(f"target data-axis size must be positive, "
                              f"got {n_data}")
@@ -141,6 +142,17 @@ class ReshardPlane:
             raise ValueError(
                 f"target data-axis size {n_data} equals the current one — "
                 f"nothing to reshard")
+        if skip_replica is not None and not (
+                0 <= int(skip_replica) < owner._n_data):
+            raise ValueError(
+                f"skip_replica {skip_replica} out of range for "
+                f"{owner._n_data} source replicas")
+        # Emergency-evacuation mode (parallel/failover.py): NO source
+        # migration from this quarantined source replica — its rows may
+        # be arbitrarily corrupt, and its established flows re-miss at
+        # their survivor-ring home and re-classify to the identical
+        # verdict (the PR 6 lost-update guard's verdict-safety argument).
+        self.skip = None if skip_replica is None else int(skip_replica)
         self.owner = owner
         self.src_n = int(owner._n_data)
         self.dst_n = int(n_data)
@@ -204,9 +216,10 @@ class ReshardPlane:
         # monotonic and telescope to total) on the commit plane's clock.
         self._clock = getattr(owner._commit, "_clock", None) or time.monotonic
         self._stamps = {"begin": float(self._clock())}
+        extra = {} if self.skip is None else {"skip_replica": self.skip}
         self._emit("reshard-begin", topo_gen_target=self.gen,
                    n_data_from=self.src_n, n_data_to=self.dst_n,
-                   slots=self.G)
+                   slots=self.G, **extra)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -290,6 +303,8 @@ class ReshardPlane:
         if k <= 0:
             return 0
         for r in range(D):
+            if r == self.skip:
+                continue  # quarantined source: nothing migrates from it
             first = cursor + ((r - cursor) % D)
             if first >= cursor + k:
                 continue
@@ -364,6 +379,11 @@ class ReshardPlane:
         t = self.aff_host
         moved = 0
         for r in range(self.src_n):
+            if r == self.skip:
+                # Sticky choices held only by the quarantined replica are
+                # lost — re-election is verdict-safe (affinity drift sits
+                # outside the certification veto by design).
+                continue
             cols = {name: np.asarray(getattr(aff, name)[r])
                     for name in pl.AffinityTable._fields}
             for i in np.nonzero(cols["ep"][:-1] > 0)[0]:
@@ -397,11 +417,16 @@ class ReshardPlane:
         S = self.G // self.src_n
         if self.dirty_all:
             for r in range(self.src_n):
+                if r == self.skip:
+                    continue
                 self._copy_rows(r, 0, S, now, catchup=True)
             self.catchup_scanned += self.G
             return self.G + self._migrate_affinity()
         scanned = 0
         for r in range(self.src_n):
+            if r == self.skip:
+                self.dirty[r] = False
+                continue
             slots = np.flatnonzero(self.dirty[r, :S])
             # Consecutive dirty slots coalesce into one decode window.
             for run in np.split(slots,

@@ -1020,6 +1020,81 @@ def _measure_serving_batched():
     }
 
 
+def measure_attack_floor(ps, services, pod_ips):
+    """ROADMAP item 1's pinned-floor satellite: sustained engine pps
+    under a pure SYN flood — gen_syn_flood's never-repeating 5-tuples
+    make every lane a miss-queue admission, the cache structurally
+    useless — with the full flood-defense stack ON: admission="drop"
+    (queue-depth early shed), per-source-/24 token buckets and the
+    second-chance flow cache.  Emitted beside cold_fused_pps: that is
+    the COOPERATIVE all-miss number (one flow universe re-classified),
+    this is the ADVERSARIAL one, so the gap between them is a pinned
+    number instead of folklore.  -> the JSON dict, or None."""
+    try:
+        return _measure_attack_floor(ps, services, pod_ips)
+    except Exception as e:  # report, never sink the bench
+        print(f"# attack-floor measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_attack_floor(ps, services, pod_ips):
+    import time
+
+    from antrea_tpu.datapath.tpuflow import TpuflowDatapath
+    from antrea_tpu.simulator.traffic import gen_syn_flood
+
+    smoke = jax.devices()[0].platform == "cpu"
+    Bf = 512 if smoke else B
+    dp = TpuflowDatapath(
+        ps, services,
+        flow_slots=1 << (10 if smoke else 18), aff_slots=1 << 8,
+        async_slowpath=True,
+        miss_queue_slots=1 << (10 if smoke else 14),
+        drain_batch=256,
+        admission="drop",
+        miss_source_rate=4.0, miss_source_burst=16,
+        second_chance=True,
+        canary_probes=8, flightrec_slots=256, realization_slots=0,
+    )
+    targets = list(pod_ips[: 1 << 8])
+    seq = 0
+    now = 100
+    for _ in range(2):  # warm: compile the flood-shaped step + drain
+        dp.step(gen_syn_flood(targets, Bf, start_seq=seq, seed=5), now)
+        dp.maintenance_tick(now=now)
+        seq += Bf
+        now += 1
+    rounds = 8 if smoke else 64
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        # The production cadence: fast step (all-miss admission) plus
+        # one maintenance tick (budgeted coalesced drains) per round.
+        dp.step(gen_syn_flood(targets, Bf, start_seq=seq, seed=5), now)
+        dp.maintenance_tick(now=now)
+        seq += Bf
+        now += 1
+    dt = time.perf_counter() - t0
+    st = dp.slowpath_stats()
+    return {
+        "metric": "attack_floor_pps",
+        "value": round(rounds * Bf / max(dt, 1e-9), 1),
+        "unit": "packets/s",
+        "extra": {
+            "flood_batch": Bf,
+            "rounds": rounds,
+            "admission": st["admission"],
+            "queue_capacity": st["capacity"],
+            "admitted_total": st["admitted_total"],
+            "early_drops_total": st["early_drops_total"],
+            "source_limited_total": st["source_limited_total"],
+            "overflows_total": st["overflows_total"],
+            "drained_total": st["drained_total"],
+            "second_chance": True,
+            "smoke": smoke,
+        },
+    }
+
+
 def measure_reshard():
     """The round-8 elastic-mesh regime (ROADMAP item 3): a LIVE resize of
     the data axis — grow 2→4 then shrink 4→2 — executed on a serving
@@ -1183,6 +1258,8 @@ def main():
     steady_telemetry_pps = measure_telemetry(
         cps, svc, src, dst, proto, sport, dport
     )
+    attack_floor = measure_attack_floor(cluster.ps, services,
+                                        cluster.pod_ips)
     sh_cold_pps = measure_sharded_cold_fused(cps, src, dst, proto, dport)
     sh_pps, sh_overhead = measure_shard_overhead(
         cps, svc, src, dst, proto, sport, dport, pps
@@ -1201,6 +1278,7 @@ def main():
                     steady_fused_pps=steady_fused_pps,
                     cold_fused_pps=cold_fused_pps,
                     steady_telemetry_pps=steady_telemetry_pps,
+                    attack_floor=attack_floor,
                     reshard=reshard, multitenant=multitenant,
                     serving_batched=serving_batched)
 
@@ -1226,7 +1304,7 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     multichip=None, cold_pruned_pps=None,
                     prune_fb_rate=None, prune_skip_rate=None,
                     steady_fused_pps=None, cold_fused_pps=None,
-                    steady_telemetry_pps=None,
+                    steady_telemetry_pps=None, attack_floor=None,
                     reshard=None, multitenant=None,
                     serving_batched=None):
     maint_overhead_pct = None
@@ -1317,6 +1395,14 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
             else round(steady_fused_pps, 1),
             "cold_fused_pps": None if cold_fused_pps is None
             else round(cold_fused_pps, 1),
+            # Round-19 pinned floor: the ADVERSARIAL all-miss regime — a
+            # never-repeating SYN flood through the engine with the full
+            # defense stack on (admission="drop", per-source-/24 buckets,
+            # second-chance cache) — beside cold_fused_pps (the
+            # cooperative all-miss number), so the flood gap is pinned.
+            # Full breakdown prints as its own JSON line below.
+            "attack_floor_pps": None if attack_floor is None
+            else attack_floor["value"],
             # Hot-path telemetry overhead (observability/telemetry.py):
             # the headline steady regime with the in-kernel counters
             # compiled in — expected within noise of the headline (a
@@ -1347,6 +1433,12 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
     # r17 -> r18 comparison.
     if serving_batched is not None:
         print(json.dumps(serving_batched))
+    # The attack-floor regime prints sixth (round 19): the adversarial
+    # SYN-flood floor with its defense-stack breakdown (early drops,
+    # source-bucket sheds, queue overflows) — earlier keys stay
+    # untouched for the r18 -> r19 comparison.
+    if attack_floor is not None:
+        print(json.dumps(attack_floor))
     # Explicit raises (not assert): the gate must survive python -O.
     if pps < STEADY_FLOOR_PPS:
         raise SystemExit(

@@ -329,10 +329,12 @@ def test_engines_register_the_consolidated_task_set(dp_cls):
     # tenant-maintain joins on the first tenant_create only
     # (datapath/tenancy — untenanted engines keep this base set);
     # telemetry-sentinel registers only on telemetry=True engines;
-    # serving-flush joins when the serving batcher materializes.
+    # serving-flush joins when the serving batcher materializes;
+    # replica-health is the mesh engine's failover probe loop
+    # (failover=True only — single-chip twins have no replicas to lose).
     assert (set(dpa.maintenance.task_names)
             | {"fqdn-ttl", "reshard-migrate", "tenant-maintain",
-               "telemetry-sentinel", "serving-flush"}
+               "telemetry-sentinel", "serving-flush", "replica-health"}
             == set(MAINT_TASKS))
     tdp = _dp(dp_cls, ps, svcs, telemetry=True)
     assert "telemetry-sentinel" in tdp.maintenance.task_names

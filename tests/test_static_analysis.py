@@ -372,6 +372,27 @@ def test_bounded_buffer_pass_fires_on_seeded_violations(tmp_path):
             "    def __init__(self):\n"
             "        self.free_queue = []\n"
         ),
+        # The failover plane is a SINGLE-FILE scan entry (its probe-
+        # history ring sits between an every-tick producer and a
+        # maybe-never supportbundle consumer): a declared ring passes,
+        # an undeclared buffer beside it fires.
+        "antrea_tpu/parallel/failover.py": (
+            "from collections import deque\n\n"
+            'BUFFER_CAPS = {\n'
+            '    "FailoverPlane.probe_ring": "deque(maxlen=PROBE_RING)",\n'
+            "}\n\n\n"
+            "class FailoverPlane:\n"
+            "    def __init__(self):\n"
+            "        self.probe_ring = deque(maxlen=64)\n"
+            "        self.sneaky_backlog = []  # undeclared buffer\n"
+        ),
+        # Sibling parallel/ modules stay OUT of scope: the entry names
+        # one file, not the package.
+        "antrea_tpu/parallel/meshpath.py": (
+            "class M:\n"
+            "    def __init__(self):\n"
+            "        self.replica_queue = []\n"
+        ),
     })
     objs = {f.obj for f in run(root, ["bounded-buffer"]).findings}
     assert "dissemination/wild.py:W.evil_backlog" in objs
@@ -379,9 +400,12 @@ def test_bounded_buffer_pass_fires_on_seeded_violations(tmp_path):
     # Stale declarations are findings too: a cap row cannot outlive the
     # buffer it excuses.
     assert "dissemination/wild.py:W.ghost_buf:stale" in objs
+    assert "parallel/failover.py:FailoverPlane.sneaky_backlog" in objs
     assert not any("good_queue" in o for o in objs)
+    assert not any("probe_ring" in o for o in objs)
     assert not any("count" in o for o in objs)
     assert not any("elsewhere" in o for o in objs)
+    assert not any("meshpath" in o for o in objs)
 
 
 def test_telemetry_registry_pass_fires_on_seeded_violations(tree_template,
