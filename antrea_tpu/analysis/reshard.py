@@ -7,7 +7,15 @@ leading ``data`` axis — to their new home shards when the data axis
 resizes.  A NEW stateful field that nobody taught the migrator is a
 silent flow-loss bug.  Fails when any field specced `P(DATA, ...)` in
 `_state_specs` has no migration rule in `reshard.RESHARD_MANIFEST` —
-and when the manifest itself goes stale."""
+and when the manifest itself goes stale.
+
+Tenant extension (PR 20): tenant worlds carry their OWN (D,)-sharded
+state — any `MeshDatapath._TENANT_WORLD_FIELDS` member assigned from
+the sharded-state builders (`shard_state` / `_pin_state` /
+`_init_pipeline_state`) is a per-world device table that a resize must
+walk under `_world_ctx`, so each such member must carry a rule in
+`reshard.WORLD_MIGRATION`.  A new per-world sharded field without one
+is the SAME silent flow-loss bug, scoped to every tenant at once."""
 
 from __future__ import annotations
 
@@ -16,6 +24,13 @@ import ast
 from .core import Finding, SourceCache, analysis_pass
 
 STATE_BUILDER = "_state_specs"
+
+# Call targets whose result is (D,)-sharded device state: a world field
+# assigned from one of these holds per-replica rows a resize must
+# migrate (the detection is assignment-shaped, not name-shaped, so a
+# new sharded world field cannot dodge the pass by picking a fresh
+# name).
+SHARDED_BUILDERS = {"shard_state", "_pin_state", "_init_pipeline_state"}
 
 
 def data_sharded_fields(src: SourceCache) -> set:
@@ -50,10 +65,10 @@ def data_sharded_fields(src: SourceCache) -> set:
     return out
 
 
-def manifest(src: SourceCache) -> dict:
-    tree = src.tree(src.pkg / "parallel" / "reshard.py")
+def _module_literal(src: SourceCache, path, name: str):
+    tree = src.tree(path)
     if tree is None:
-        raise ValueError("antrea_tpu/parallel/reshard.py is missing")
+        raise ValueError(f"{src.rel(path)} is missing")
     for node in ast.walk(tree):
         targets = []
         if isinstance(node, ast.Assign):
@@ -63,10 +78,49 @@ def manifest(src: SourceCache) -> dict:
             targets = [node.target.id]
         else:
             continue
-        if "RESHARD_MANIFEST" in targets and node.value is not None:
+        if name in targets and node.value is not None:
             return ast.literal_eval(node.value)
-    raise ValueError(
-        "parallel/reshard.py defines no RESHARD_MANIFEST literal")
+    raise ValueError(f"{src.rel(path)} defines no {name} literal")
+
+
+def manifest(src: SourceCache) -> dict:
+    return _module_literal(src, src.pkg / "parallel" / "reshard.py",
+                           "RESHARD_MANIFEST")
+
+
+def world_migration(src: SourceCache) -> dict:
+    return _module_literal(src, src.pkg / "parallel" / "reshard.py",
+                           "WORLD_MIGRATION")
+
+
+def sharded_world_fields(src: SourceCache) -> set:
+    """_TENANT_WORLD_FIELDS members of the mesh engine that are assigned
+    from a sharded-state builder anywhere in parallel/meshpath.py — the
+    per-world device tables a resize must migrate."""
+    path = src.pkg / "parallel" / "meshpath.py"
+    tree = src.tree(path)
+    if tree is None:
+        return set()
+    world_fields = set(
+        _module_literal(src, path, "_TENANT_WORLD_FIELDS"))
+    assigned: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        fn = v.func
+        callee = (fn.attr if isinstance(fn, ast.Attribute)
+                  else fn.id if isinstance(fn, ast.Name) else None)
+        if callee not in SHARDED_BUILDERS:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                assigned.add(t.attr)
+    return assigned & world_fields
 
 
 @analysis_pass("reshard", "every (D,)-sharded state field has a reshard "
@@ -105,4 +159,41 @@ def check(src: SourceCache) -> list[Finding]:
             problems.append(f(
                 f"RESHARD_MANIFEST[{key!r}] carries no rule text",
                 f"no-rule:{key}"))
+
+    # Tenant worlds: every _TENANT_WORLD_FIELDS member assigned from a
+    # sharded-state builder must carry a WORLD_MIGRATION rule (the
+    # per-world analog of the manifest check above).
+    meshpath_rel = "antrea_tpu/parallel/meshpath.py"
+    try:
+        wrules = world_migration(src)
+    except (OSError, ValueError) as e:
+        return problems + [f(str(e), "no-world-migration")]
+    try:
+        wsharded = sharded_world_fields(src)
+    except (OSError, ValueError) as e:
+        return problems + [f(str(e), "no-world-fields", meshpath_rel)]
+    if not wsharded:
+        problems.append(f(
+            "parallel/meshpath.py names no _TENANT_WORLD_FIELDS member "
+            "assigned from a sharded-state builder — the parse is broken "
+            "or the world state moved", "no-sharded-world-fields",
+            meshpath_rel))
+    for key in sorted(wsharded - set(wrules)):
+        problems.append(f(
+            f"{key} is a (D,)-sharded _TENANT_WORLD_FIELDS member "
+            f"(parallel/meshpath.py) but has NO rule in "
+            f"reshard.WORLD_MIGRATION — a live resize would silently "
+            f"zero EVERY tenant world's copy (flow loss); teach the "
+            f"per-world migrator and document the rule",
+            f"unmigrated-world:{key}"))
+    for key in sorted(set(wrules) - wsharded):
+        problems.append(f(
+            f"WORLD_MIGRATION names {key!r}, which is not a sharded "
+            f"_TENANT_WORLD_FIELDS member — stale rule",
+            f"stale-world:{key}"))
+    for key, rule in wrules.items():
+        if not (isinstance(rule, str) and rule.strip()):
+            problems.append(f(
+                f"WORLD_MIGRATION[{key!r}] carries no rule text",
+                f"no-rule-world:{key}"))
     return problems

@@ -23,10 +23,6 @@ from .core import Finding, SourceCache, analysis_pass
 SHARD_ALLOWLIST = {
     "parallel/mesh.py":
         "the definition site (tenant defaults to 0 = the default world)",
-    "parallel/reshard.py":
-        "migration/cutover routing walks the DEFAULT world's tables only "
-        "— reshard_begin refuses to start while tenant worlds exist "
-        "(parallel/meshpath.reshard_begin)",
 }
 
 # _queue_cols call sites allowed WITHOUT tenant= (the definition).

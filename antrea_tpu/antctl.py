@@ -35,8 +35,9 @@ API, docs/design/architecture.md:82-90; server: agent/apiserver.py):
         --tick runs one synchronous budgeted scheduler round first
   failover --server URL [--readmit]
         replica-loss failover state (GET /failover: phase, quarantined
-        shard, probe/evacuation/readmission totals); --readmit
-        re-admits a healed replica via the certified path
+        shard, probe/evacuation/readmission totals, tenant worlds
+        pending evacuation); --readmit re-admits a healed replica via
+        the certified path
   realization --server URL [--uid POLICY] [--json]
         realization-tracing span table (GET /realization: per-policy
         stage timelines controller-commit -> first live hit); default
@@ -319,8 +320,10 @@ def _cmd_maintenance(args) -> int:
 def _cmd_failover(args) -> int:
     """Replica-loss failover status / operator re-admission over the
     live agent API (parallel/failover.py; route GET /failover on
-    agent/apiserver).  --readmit triggers the certified re-admission:
-    a pre-flip heal unmasks, an evacuated replica rejoins via the
+    agent/apiserver).  The body includes `tenants_pending_evacuation`
+    — the tenant worlds still serving masked or latched behind the
+    fleet topology.  --readmit triggers the certified re-admission: a
+    pre-flip heal unmasks, an evacuated replica rejoins via the
     ordinary certified grow-resize — never a blind flip."""
     path = "/failover"
     if args.readmit:

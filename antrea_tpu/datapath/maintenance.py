@@ -72,8 +72,11 @@ MAINT_TASKS = {
                      "span bookkeeping, cost-accounted not smeared)",
     "reshard-migrate": "parallel/reshard.py (budgeted drain-and-migrate of "
                        "flow-cache rows to their target-topology home "
-                       "shards; registered by the mesh engine only while "
-                       "a live data-axis resize is in flight)",
+                       "shards; the grant splits evenly across the "
+                       "default world and every live tenant world, each "
+                       "migrated under its own _world_ctx; registered by "
+                       "the mesh engine only while a live data-axis "
+                       "resize is in flight)",
     "tenant-maintain": "datapath/tenancy.py (fused age+revalidate of one "
                        "tenant world per granted unit, rotating over "
                        "worlds; registered on first tenant_create only — "

@@ -125,6 +125,8 @@ class TenantWorld:
     queued: int = 0  # un-drained rows in the shared miss queue
     quota_clamps: int = 0
     rollbacks: int = 0
+    reshard_rows: int = 0  # rows migrated across certified resizes
+    reshard_vetoes: int = 0  # per-world canary vetoes (world latched)
     steps: int = 0
     packets: int = 0
     rung: tuple = ()
@@ -335,11 +337,6 @@ class TenantedDatapath:
             raise ConfigError(
                 "tenant worlds are v4-only (like the async slow path): "
                 "construct the engine with dual_stack=False")
-        if getattr(self, "_reshard", None) is not None:
-            raise ConfigError(
-                "the elastic resharding plane has a mesh resize in "
-                "flight; tenant worlds cannot be created until its "
-                "cutover or abort")
         if not _is_pow2(quota):
             raise ConfigError(
                 f"tenant quota must be a power of two (the state-tensor "
@@ -355,6 +352,12 @@ class TenantedDatapath:
                           aff_quota=int(aff_quota), queue_quota=queue_quota)
         world = self._tenant_build_world(spec, ps)
         tid = self._tenants.add(world)
+        # A resize in flight adopts the newborn world: its fresh state
+        # (zero rows) migrates trivially, but the plane must track it so
+        # the cutover flips/certifies it with the rest of the fleet.
+        plane = getattr(self, "_reshard", None)
+        if plane is not None and hasattr(plane, "note_world_created"):
+            plane.note_world_created(tid, world)
         self._emit(
             "tenant-create", tenant=tid, name=spec.name,
             quota=spec.quota, queue_quota=spec.queue_quota,
@@ -406,15 +409,32 @@ class TenantedDatapath:
 
         if self._tenants is None or not self._tenants.worlds:
             return []
-        return [{
-            "tid": int(tid),
-            "name": w.spec.name,
-            "quota": int(w.spec.quota),
-            "affQuota": int(w.spec.aff_quota),
-            "queueQuota": int(w.spec.queue_quota),
-            "generation": int(w.fields["_gen"]),
-            "policySet": serde.encode_policy_set(w.fields["_ps"]),
-        } for tid, w in sorted(self._tenants.worlds.items())]
+        rows = []
+        for tid, w in sorted(self._tenants.worlds.items()):
+            row = {
+                "tid": int(tid),
+                "name": w.spec.name,
+                "quota": int(w.spec.quota),
+                "affQuota": int(w.spec.aff_quota),
+                "queueQuota": int(w.spec.queue_quota),
+                "generation": int(w.fields["_gen"]),
+                "policySet": serde.encode_policy_set(w.fields["_ps"]),
+            }
+            # Mesh engines: the world's CERTIFIED topology, so a crash
+            # mid-resize restores each world to the generation its own
+            # canary certified, not the fleet's (`latched` computed at
+            # snapshot time — the restore can't reconstruct the
+            # pre-crash fleet topology).
+            if "_topo_gen" in w.fields:
+                tn = int(w.fields["_n_data"])
+                tg = int(w.fields["_topo_gen"])
+                fleet = (int(getattr(self, "_n_data", tn)),
+                         int(getattr(self, "_topo_gen", tg)))
+                row["topoN"] = tn
+                row["topoGen"] = tg
+                row["latched"] = int((tn, tg) != fleet)
+            rows.append(row)
+        return rows
 
     def _restore_tenant_worlds(self) -> None:
         """Rebuild the registry from the snapshot's `tenants` list
@@ -450,8 +470,24 @@ class TenantedDatapath:
                     error=("restore: " + f"{type(e).__name__}: {e}")[:200])
                 continue
             world.fields["_gen"] = gen
-            # The restored boot state is the world's LKG baseline — the
-            # same contract as the engine's own commit plane at boot.
+            # Topology latch (mesh engines): a world snapshotted as
+            # latched restores onto ITS certified generation only when
+            # the boot mesh still has that width — otherwise the latch
+            # is torn (the certified topology no longer exists) and the
+            # world boots fleet-aligned, journaled, never a wrong
+            # verdict (its state recompiles from the policy set anyway).
+            if int(d.get("latched", 0)):
+                tn = int(d.get("topoN", 0))
+                tg = int(d.get("topoGen", 0))
+                if ("_topo_gen" in world.fields
+                        and tn == int(getattr(self, "_n_data", 0))):
+                    world.fields["_topo_gen"] = tg
+                else:
+                    self._emit(
+                        "tenant-rollback", tenant=tid,
+                        error=(f"restore: torn topology latch "
+                               f"(n_data={tn} gen={tg}) — world boots "
+                               f"fleet-aligned")[:200])
             world.commit_state = (False, "", gen, self._commit._clock())
             world.word_off = reg._next_word
             reg._next_word += world.words
@@ -842,6 +878,18 @@ class TenantedDatapath:
                 "packets_total": int(w.packets),
                 "rule_words": int(w.words),
                 "word_off": int(w.word_off),
+                "reshard_rows_total": int(w.reshard_rows),
+                "reshard_vetoes_total": int(w.reshard_vetoes),
+                # Mesh engines only: the world's certified topology and
+                # whether it is latched behind the fleet (computed from
+                # the snapshot — scrape-thread safe like every field
+                # read above).
+                "topology_generation": int(fields.get("_topo_gen", 0)),
+                "latched": int(
+                    "_topo_gen" in fields
+                    and ((int(fields["_n_data"]), int(fields["_topo_gen"]))
+                         != (int(getattr(self, "_n_data", 0)),
+                             int(getattr(self, "_topo_gen", 0))))),
             }
         return out
 

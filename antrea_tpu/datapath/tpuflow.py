@@ -1293,6 +1293,13 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         XLA compile per scan); state untouched."""
         return self._audit_fresh_state(self._state, rows, now)
 
+    def _audit_dsvc(self):
+        """Service tables for the audit re-proof — a placement hook: the
+        mesh engine substitutes copies on the SERVING mesh when a
+        latched tenant world audits against rules still placed on its
+        own old mesh (parallel/meshpath._shared_tables)."""
+        return self._dsvc
+
     def _audit_fresh_state(self, state: pl.PipelineState, rows: list,
                            now: int) -> list[dict]:
         """_audit_fresh over an explicit state pytree (the mesh engine
@@ -1304,7 +1311,7 @@ class TpuflowDatapath(TenantedDatapath, MaintainableDatapath,
         o = pl._pipeline_trace(
             state,
             self._drs,
-            self._dsvc,
+            self._audit_dsvc(),
             jnp.asarray(iputil.flip_u32(batch.src_ip)),
             jnp.asarray(iputil.flip_u32(batch.dst_ip)),
             jnp.asarray(batch.proto.astype(np.int32)),

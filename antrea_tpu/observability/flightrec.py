@@ -135,6 +135,18 @@ EVENT_KINDS = {
                        "its transaction (canary veto / compile fault) "
                        "and rolled back ONLY that tenant's world; every "
                        "other tenant's generation is untouched",
+    "tenant-reshard-cutover": "parallel/reshard.py — one tenant world's "
+                              "state flipped to the target topology: its "
+                              "own replica-resolved canary + migrated-row "
+                              "audit certified the placement and its rows "
+                              "re-homed under the tenant-salted ring",
+    "tenant-reshard-veto": "parallel/reshard.py — one tenant world's "
+                           "target-placement certification failed "
+                           "(canary veto / audit divergence / placement "
+                           "fault): ONLY that world aborted and keeps "
+                           "serving its old topology via the per-world "
+                           "generation latch; certified worlds still "
+                           "flip",
     "watcher-overflow": "dissemination/store.py — distinct-key churn "
                         "filled a bounded watcher queue past max_pending "
                         "even after coalescing: the buffer dropped and "

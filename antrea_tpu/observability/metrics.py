@@ -128,6 +128,8 @@ METRICS: dict[str, str] = {
     "antrea_tpu_reshard_cutovers_total": "counter",
     "antrea_tpu_reshard_aborts_total": "counter",
     "antrea_tpu_reshard_catchup_rows_total": "counter",
+    "antrea_tpu_reshard_tenant_rows_total": "counter",
+    "antrea_tpu_reshard_tenant_vetoes_total": "counter",
     # replica-loss failover plane (parallel/failover.py; rendered when
     # the datapath exposes failover_stats()) — the shard-labeled
     # quarantined gauge plus probe/quarantine/evacuation/readmission
@@ -160,6 +162,10 @@ METRICS: dict[str, str] = {
     "antrea_tpu_tenant_evictions_total": "counter",
     "antrea_tpu_tenant_quota_clamps_total": "counter",
     "antrea_tpu_tenant_rollbacks_total": "counter",
+    "antrea_tpu_tenant_topology_generation": "gauge",
+    "antrea_tpu_tenant_latched": "gauge",
+    "antrea_tpu_tenant_reshard_rows_total": "counter",
+    "antrea_tpu_tenant_reshard_vetoes_total": "counter",
     # serving batcher (serving/batcher.py; rendered when the datapath
     # exposes serving_stats()) — admission/shed/flush meters for the
     # canonical-shape batching plane plus the {tenant}-labeled staging-
@@ -734,6 +740,9 @@ def render_metrics(datapath, node: str = "") -> str:
             ("antrea_tpu_reshard_cutovers_total", "cutovers_total"),
             ("antrea_tpu_reshard_aborts_total", "aborts_total"),
             ("antrea_tpu_reshard_catchup_rows_total", "catchup_rows_total"),
+            ("antrea_tpu_reshard_tenant_rows_total", "tenant_rows_total"),
+            ("antrea_tpu_reshard_tenant_vetoes_total",
+             "tenant_vetoes_total"),
         ):
             lines += [_type_line(fam),
                       f"{fam}{_labels(node=node)} {_num(rs[key])}"]
@@ -780,6 +789,12 @@ def render_metrics(datapath, node: str = "") -> str:
             ("antrea_tpu_tenant_evictions_total", "evictions_total"),
             ("antrea_tpu_tenant_quota_clamps_total", "quota_clamps_total"),
             ("antrea_tpu_tenant_rollbacks_total", "rollbacks_total"),
+            ("antrea_tpu_tenant_topology_generation",
+             "topology_generation"),
+            ("antrea_tpu_tenant_latched", "latched"),
+            ("antrea_tpu_tenant_reshard_rows_total", "reshard_rows_total"),
+            ("antrea_tpu_tenant_reshard_vetoes_total",
+             "reshard_vetoes_total"),
         )
         for fam, key in per:
             lines.append(_type_line(fam))

@@ -69,10 +69,22 @@ is the same discipline on the device mesh:
                         flows go stale and idle-expire — verdict-safe
                         by the same re-miss argument).
 
-Documented residue: tenant worlds hold their own (D,)-sharded state the
-migrator does not walk (ROADMAP item 3), so a quarantine on a tenanted
-mesh serves indefinitely in the masked regime (verdict-safe, metered)
-until the tenants drain and the evacuation can begin.
+Tenant worlds compose (PR 20): the evacuation shrink is a tenant-aware
+ReshardPlane, so quarantine on a tenanted mesh proceeds to a REAL
+certified evacuation — every world's rows migrate off the dead replica
+under `_world_ctx` and each world certifies its own survivor canary.  A
+single world's veto latches ONLY that world (its `_fo_mask` field pins
+the dead old-topology index + survivor ring so its lanes keep masking
+on its own generation) while certified worlds and the default world
+flip; the latched world readmits via `tenant_reshard_resync` or the
+next resize.  `tenants_pending_evacuation` in GET /failover names the
+worlds still latched or awaiting the evacuation flip.
+
+Documented residue: a SECOND quarantine while a world is still latched
+from an earlier veto masks only fleet-aligned worlds (the fleet mask's
+generation arithmetic is meaningless in a latched world's indexing);
+the latched world's dead-replica lanes re-miss at dispatch instead —
+verdict-safe by the same re-miss argument, just a colder path.
 
 Observability: flightrec kinds replica-probe-fail / replica-quarantine /
 replica-evacuate / replica-readmit, the failover metric families
@@ -228,8 +240,33 @@ class FailoverPlane:
         -> (shard, masked lane mask | None).  The slot hash is
         D-independent, so survivor-side commits stay valid across the
         evacuation flip."""
+        # A world latched by a per-tenant evacuation veto carries its
+        # OWN mask (dead old-topology index + survivor ring) in its
+        # `_fo_mask` world field — inside `_world_ctx` the owner
+        # attribute reads the world's latch, and its generation
+        # arithmetic is the world's, not the fleet's.
+        wm = getattr(self.owner, "_fo_mask", None)
+        if wm is not None:
+            wd, wn, wg = int(wm[0]), int(wm[1]), int(wm[2])
+            wmask = np.asarray(shard) == wd
+            if not wmask.any():
+                return shard, None
+            tgt = shard_of_tuples(
+                np.asarray(src)[wmask], np.asarray(dst)[wmask],
+                np.asarray(proto)[wmask], np.asarray(sport)[wmask],
+                np.asarray(dport)[wmask], wn, wg, tenant=tenant)
+            shard = np.array(shard, copy=True)
+            shard[wmask] = np.where(tgt >= wd, tgt + 1,
+                                    tgt).astype(shard.dtype)
+            return shard, wmask
         d = self.quarantined
         if d is None or not self._mask_active:
+            return shard, None
+        if self._mask_gen != int(self.owner._topo_gen) + 1:
+            # Latched world (its _topo_gen is pinned behind the fleet):
+            # the fleet mask's survivor arithmetic is meaningless in its
+            # indexing — let its dead-replica lanes re-miss at dispatch
+            # (documented residue, verdict-safe).
             return shard, None
         m = np.asarray(shard) == d
         if not m.any():
@@ -409,6 +446,17 @@ class FailoverPlane:
         self._emit("replica-quarantine", replica=int(r), reason=reason,
                    fail_streak=int(self._fail_streak.get(r, 0)),
                    n_survivors=int(self._mask_n), at=int(now))
+        # Per-world context rows: the masked regime is per-tenant
+        # observable (which worlds are serving masked, how much queued
+        # work each carries toward the evacuation).
+        reg = getattr(o, "_tenants", None)
+        if reg is not None:
+            for tid in sorted(reg.worlds):
+                w = reg.worlds[tid]
+                self._emit("replica-quarantine", replica=int(r),
+                           reason=reason, tenant=int(tid),
+                           queued=int(getattr(w, "queued", 0)),
+                           n_survivors=int(self._mask_n), at=int(now))
         if o._reshard is not None:
             # Emergency preempts: the in-flight ordinary resize may
             # target (or migrate from) the dead replica.
@@ -428,12 +476,10 @@ class FailoverPlane:
                 for d in o._mesh.devices[rr]]
 
     def _begin_evacuation(self, now: int) -> None:
+        # Tenant worlds ride the same shrink: ReshardPlane walks every
+        # world's rows under `_world_ctx` and certifies each world's own
+        # survivor canary (PR 20) — no tenanted-mesh refusal remains.
         o = self.owner
-        if o.tenant_count:
-            # Documented residue (module docstring): masking serves the
-            # tenanted mesh until the worlds drain; keep retrying.
-            self._retry_at = int(now) + self.retry_ticks
-            return
         plane = ReshardPlane(o, self._mask_n,
                              devices=self._survivor_devices(),
                              skip_replica=self.quarantined)
@@ -463,6 +509,8 @@ class FailoverPlane:
                 self._emit("replica-evacuate", replica=int(origin),
                            n_data=int(self.owner._n_data),
                            migrated_rows=int(plane.migrated_rows),
+                           tenant_rows=int(plane.tenant_rows()),
+                           tenants_pending=len(self._tenants_pending()),
                            requeued=int(self.requeued_total),
                            remiss=int(self.remiss_total), at=int(now))
             else:
@@ -549,9 +597,26 @@ class FailoverPlane:
 
     # -- observability -------------------------------------------------------
 
+    def _tenants_pending(self) -> list:
+        """World ids still awaiting a certified evacuation: every live
+        world while the fleet mask is active (the shrink has not
+        flipped), plus any world latched by its own evacuation veto
+        (`_fo_mask` pinned) after the fleet flipped around it."""
+        reg = getattr(self.owner, "_tenants", None)
+        if reg is None:
+            return []
+        pending = set()
+        if self.quarantined is not None and self._mask_active:
+            pending.update(int(t) for t in reg.worlds)
+        for tid, w in reg.worlds.items():
+            if w.fields.get("_fo_mask") is not None:
+                pending.add(int(tid))
+        return sorted(pending)
+
     def status(self) -> dict:
         return {
             "phase": self.phase,
+            "tenants_pending_evacuation": self._tenants_pending(),
             "quarantined_shard": self.quarantined_origin,
             "mask_active": int(self._mask_active),
             "probes_total": int(self.probes_total),

@@ -79,6 +79,13 @@ Round-8 additions (the PR 9 follow-ups + the elastic plane):
     ring homes); a replica-resolved canary + a migrated-row audit certify
     the target before `shard_of_tuples` flips generation in one
     mesh-wide epoch swap, and a veto aborts back to the old mesh.
+    TENANT worlds ride every resize (grow, shrink, failover evacuation):
+    each world migrates under its own `_world_ctx` with per-world dirty
+    tracking and a per-world certified cutover — one world's canary veto
+    latches only that world on its old topology (`_TENANT_WORLD_FIELDS`
+    carries `_mesh`/`_n_data`/`_topo_gen`, so a latched world SERVES on
+    its own mesh; `tenant_reshard_resync` re-homes it later), while the
+    fleet and every certified sibling flip.
 
 Known mesh limits (documented, test-pinned):
   * v4-only (like the async slow path); dual_stack raises ConfigError.
@@ -127,7 +134,7 @@ from .mesh import (
     shard_state,
 )
 from .failover import FailoverPlane
-from .reshard import ReshardPlane
+from .reshard import ReshardPlane, resync_world
 
 
 # --------------------------------------------------------------------------
@@ -562,6 +569,23 @@ class MeshDatapath(TpuflowDatapath):
     (global capacity = D × slots, which is what `cache_stats`/
     `audit_stats` report)."""
 
+    # The mesh engine's per-world swap set: the single-chip members plus
+    # the TOPOLOGY slice — a world serves on its OWN mesh at its own
+    # width/generation (the per-world topology latch of
+    # parallel/reshard.py), so _mesh/_n_data/_topo_gen/
+    # _replica_audit_entries/_fo_mask must swap with it.  Pure literal:
+    # the analysis tenant + reshard passes parse it dependency-free.
+    _TENANT_WORLD_FIELDS = (
+        "_ps", "_cps", "_drs", "_meta", "_meta_step", "_state", "_gen",
+        "_has_named_ports", "_n_deltas", "_delta_host", "_name_gids",
+        "_gid_ident", "_group_members", "_static_blocks", "_member_meta",
+        "_stats_in", "_stats_out", "_bytes_in", "_bytes_out",
+        "_default_allow", "_default_deny", "_evictions", "_reclaims",
+        "_state_mutations", "_pipe_kw", "_persist_dirty",
+        "_mesh", "_n_data", "_topo_gen", "_replica_audit_entries",
+        "_fo_mask",
+    )
+
     def __init__(self, ps=None, services=None, *, mesh=None, n_data: int = 2,
                  n_rule: int = 1, devices=None, reshard_budget: int = 256,
                  failover: bool = False, failover_knobs=None, **kw):
@@ -600,6 +624,17 @@ class MeshDatapath(TpuflowDatapath):
         self._reshard_requeued_total = 0
         self._reshard_resident_rows = 0
         self._last_reshard_span = None
+        self._reshard_tenant_rows_total = 0
+        self._reshard_tenant_vetoes = 0
+        # Chaos hook (arm_reshard_faults): (FaultPlan, site prefix) for
+        # the per-tenant forced-canary-veto sites.
+        self._reshard_faults = None
+        # Per-world survivor-mask latch (parallel/failover.mask_shard's
+        # world branch): (dead old-topology index, survivor width,
+        # survivor generation).  A WORLD field — _world_ctx swaps it —
+        # and always None on the default world (the fleet mask covers
+        # it).
+        self._fo_mask = None
         # Replica-loss failover plane (parallel/failover.py): None when
         # disabled — every traffic-path touch is gated on the field, so
         # the disabled engine's step HLO is bit-identical.
@@ -625,7 +660,13 @@ class MeshDatapath(TpuflowDatapath):
             lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
             state, _state_specs())
 
-    def _place_rules(self, cps):
+    def _place_rules_on(self, mesh, cps):
+        """Host build + rung padding + sharded placement onto `mesh`.
+        `_place_rules` calls it at the serving mesh; the reshard plane
+        calls it at the TARGET mesh to re-home a tenant world's
+        rung-packed rule window (parallel/reshard._ensure_world_rules),
+        so rung-shared shapes — and their XLA executables — survive a
+        resize."""
         host, meta = to_host(cps, word_multiple=self._n_rule,
                              delta_slots=self._delta_slots,
                              prune_budget=self._prune_budget)
@@ -638,21 +679,50 @@ class MeshDatapath(TpuflowDatapath):
         # (the default platform can differ — virtual-CPU mesh on a TPU
         # host), mirroring mesh.shard_rule_set.
         meta = meta._replace(
-            fused_interpret=(self._mesh.devices.flat[0].platform == "cpu"))
+            fused_interpret=(mesh.devices.flat[0].platform == "cpu"))
         drs = jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             drs, _drs_specs(agg=self._prune_budget > 0))
         return drs, meta
 
+    def _place_rules(self, cps):
+        return self._place_rules_on(self._mesh, cps)
+
     def _place_services(self, dsvc: pl.DeviceServiceTables):
         repl = NamedSharding(self._mesh, P())
+        self._shared_mesh = self._mesh  # where the shared tables live
+        self._shared_remap = None
         return jax.tree.map(lambda x: jax.device_put(x, repl), dsvc)
 
     def _place_forwarding(self, dft):
         # Forwarding tables are the small, read-mostly side (one node's
         # pods + routes): replicated whole, like the service tables.
         repl = NamedSharding(self._mesh, P())
+        self._shared_mesh = self._mesh
+        self._shared_remap = None
         return jax.tree.map(lambda x: jax.device_put(x, repl), dft)
+
+    def _shared_tables(self):
+        """(dsvc, dft) placed on the SERVING mesh.  The live copies sit
+        on the fleet mesh; a tenant world latched behind a resize (the
+        per-world topology latch) serves on its own old mesh, so the
+        replicated tables re-place there on first use — cached until
+        the fleet tables or the serving mesh change.  The default path
+        returns the live copies untouched (HLO pin)."""
+        if self._mesh is getattr(self, "_shared_mesh", self._mesh):
+            return self._dsvc, self._dft
+        hit = self._shared_remap
+        if (hit is not None and hit[0] is self._mesh
+                and hit[1] is self._dsvc and hit[2] is self._dft):
+            return hit[3], hit[4]
+        repl = NamedSharding(self._mesh, P())
+        dsvc = jax.tree.map(lambda x: jax.device_put(x, repl), self._dsvc)
+        dft = jax.tree.map(lambda x: jax.device_put(x, repl), self._dft)
+        self._shared_remap = (self._mesh, self._dsvc, self._dft, dsvc, dft)
+        return dsvc, dft
+
+    def _audit_dsvc(self):
+        return self._shared_tables()[0]
 
     def _place_delta(self, dt):
         # The O(delta) slot path works unchanged on the mesh: the host
@@ -664,6 +734,17 @@ class MeshDatapath(TpuflowDatapath):
         return jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self._mesh, s)),
             dt, _drs_specs().ip_delta)
+
+    # -- tenancy hooks (datapath/tenancy.TenantedDatapath) -------------------
+
+    def _tenant_init_world(self, spec, ps) -> None:
+        super()._tenant_init_world(spec, ps)
+        # A fresh world is fleet-aligned (its export carries the live
+        # _mesh/_n_data/_topo_gen as-is) but must own its OWN audit-entry
+        # list and mask latch — exporting the engine's list object would
+        # alias every world's counters to the fleet's.
+        self._replica_audit_entries = [0] * int(self._n_data)
+        self._fo_mask = None
 
     def _make_slowpath(self, *, capacity, admission, drain_batch,
                        source_rate=None, source_burst=None,
@@ -726,9 +807,10 @@ class MeshDatapath(TpuflowDatapath):
         # the engine contributes only the spill rule — an off-home lane
         # classifies but never caches in a foreign shard.
         stepf = _mesh_step_full_fn(self._mesh, self._meta_step, has_arp)
+        dsvc, dft = self._shared_tables()
         t0 = time.perf_counter() if fo is not None else 0.0
         state, out = stepf(
-            self._state, self._drs, self._dsvc, self._dft,
+            self._state, self._drs, dsvc, dft,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
             in_ports[perm], jnp.int32(now), jnp.int32(self._gen),
             pflags, arp[perm],
@@ -791,11 +873,19 @@ class MeshDatapath(TpuflowDatapath):
             # spilled lane's drain then classifies and commits it on the
             # shard that owns it.  Tenant worlds: quota-clamped admission
             # + the tenant id column (datapath/tenancy — no-ops on the
-            # default world).
+            # default world).  The queue set is SHARED at the FLEET
+            # width: a LATCHED world computes homes at its own width, and
+            # MeshSlowPath.admit silently never admits ids >= n_data —
+            # clamp onto the fleet's queues (the queue index is transport
+            # only; the drain re-splits per tenant and re-lays rows out
+            # on the world's own topology at classify time, so no
+            # verdict ever sees this index).
+            sp_n = self._slowpath.n_data
             admitted, _dropped = self._slowpath.admit(
                 self._queue_cols(batch, batch.flags(), lens,
                                  tenant=self._tenant_id()),
-                self._tenant_admit_mask(pending != 0), now, shard=shard)
+                self._tenant_admit_mask(pending != 0), now,
+                shard=shard if sp_n == D else shard % sp_n)
             self._tenant_note_admitted(admitted, _dropped)
         if self._telemetry is not None:
             # Engine/tenant scopes classify from the MERGED per-lane miss
@@ -882,8 +972,9 @@ class MeshDatapath(TpuflowDatapath):
         proto = batch.proto[idx].astype(np.int32)
         rflags = flags[idx]
         stepf = _mesh_step_full_fn(self._mesh, self._meta_step, has_arp)
+        dsvc, dft = self._shared_tables()
         state, out = stepf(
-            self._state, self._drs, self._dsvc, self._dft,
+            self._state, self._drs, dsvc, dft,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto,
             batch.src_port[idx].astype(np.int32),
             batch.dst_port[idx].astype(np.int32),
@@ -969,8 +1060,9 @@ class MeshDatapath(TpuflowDatapath):
         lens = np.maximum(col("lens"), 0)
         no_commit = pl.no_commit_mask(dst, proto, flags)
         drainf = _mesh_step_fn(self._mesh, self._drain_meta(chunk))
+        dsvc, _dft = self._shared_tables()
         state, out = drainf(
-            self._state, self._drs, self._dsvc,
+            self._state, self._drs, dsvc,
             iputil.flip_u32(src), iputil.flip_u32(dst), proto, sport, dport,
             jnp.int32(now), jnp.int32(self._gen),
             valid, no_commit, flags, lens,
@@ -1228,6 +1320,63 @@ class MeshDatapath(TpuflowDatapath):
         per = _vmapped_cache_stats()(fields["_state"])
         return int(np.asarray(per["occupied"]).sum())
 
+    def _tenant_drain_dispatch_blocks(self, split: dict, now: int,
+                                      chunk) -> None:
+        """Mesh override of the per-tenant drain dispatch: a LATCHED
+        world (per-world topology latch, parallel/reshard.py) serves on
+        its own mesh at its own width — the fleet-indexed per-replica
+        layout the queues popped is transport only, so such a world's
+        rows re-split onto the world's OWN topology before its drain
+        classifies (verdict-safe by construction: homes are re-derived
+        from the tuple columns the rows carry verbatim)."""
+        fleet = (self._n_data, self._topo_gen)
+        for tid, subs in sorted(split.items()):
+            n = sum(len(b["src_ip"]) for b in subs if b is not None)
+            if tid == 0:
+                self._drain_classify(subs, now, chunk=chunk)
+                continue
+            with self._world_ctx(tid) as w:
+                if (self._n_data, self._topo_gen) != fleet:
+                    wsubs, chunk_w = self._relayout_world_blocks(subs)
+                    self._drain_classify(wsubs, now, chunk=chunk_w)
+                else:
+                    self._drain_classify(subs, now, chunk=chunk)
+                w.queued = max(0, w.queued - n)
+        return None
+
+    def _relayout_world_blocks(self, subs: list):
+        """Concatenate a latched world's per-replica sub-blocks and
+        re-split them by the world's OWN affinity topology (the
+        tenant-salted ring at the world's width/generation, the world's
+        survivor mask applied) -> (blocks, chunk).  Runs inside the
+        world's ctx.  The chunk is pow2-rounded from the max per-replica
+        count so the drain's compile-variant set stays O(log), the spill
+        retry's rung discipline."""
+        rows = [b for b in subs if b is not None]
+        block = {c: np.concatenate([np.asarray(b[c]) for b in rows])
+                 for c in rows[0]}
+        cols = (block["src_ip"].astype(np.uint32),
+                block["dst_ip"].astype(np.uint32),
+                block["proto"].astype(np.int32),
+                block["src_port"].astype(np.int32),
+                block["dst_port"].astype(np.int32))
+        home = shard_of_tuples(*cols, self._n_data, self._topo_gen,
+                               tenant=self._tenant_id())
+        if self._failover is not None:
+            home, _m = self._failover.mask_shard(
+                *cols, home, tenant=self._tenant_id())
+        out = []
+        mx = 1
+        for r in range(self._n_data):
+            idx = np.nonzero(home == r)[0]
+            if idx.size == 0:
+                out.append(None)
+                continue
+            out.append({c: v[idx] for c, v in block.items()})
+            mx = max(mx, int(idx.size))
+        chunk = 1 << max(4, (mx - 1).bit_length())
+        return out, chunk
+
     def trace(self, batch: PacketBatch, now: int) -> list[dict]:
         if not self._gates.enabled("Traceflow"):
             raise RuntimeError("Traceflow feature gate is disabled")
@@ -1268,7 +1417,10 @@ class MeshDatapath(TpuflowDatapath):
         comes from cached meta) — a missed ts refresh is the documented
         verdict-safe staleness class, re-proved by the revalidator."""
         plane = self._reshard
-        if plane is None or plane.dirty_all:
+        if plane is None:
+            return
+        tid = self._tenant_id()
+        if plane.dirty_all_for(tid):
             return
         N = self._meta.flow_slots
         src = np.asarray(src).astype(np.uint32)
@@ -1278,7 +1430,8 @@ class MeshDatapath(TpuflowDatapath):
         dport = np.asarray(dport).astype(np.int32)
         h = hashing.flow_hash(src, dst, proto, sport, dport, xp=np)
         plane.note_touched(np.asarray(replica),
-                           (h & np.uint32(N - 1)).astype(np.int64))
+                           (h & np.uint32(N - 1)).astype(np.int64),
+                           tenant=tid)
         if committed is None or dnat_f is None:
             return
         com = np.asarray(committed) != 0
@@ -1289,7 +1442,8 @@ class MeshDatapath(TpuflowDatapath):
         rh = hashing.flow_hash(dnat.astype(np.uint32), src[com], proto[com],
                                dp, sport[com], xp=np)
         plane.note_touched(np.asarray(replica)[com],
-                           (rh & np.uint32(N - 1)).astype(np.int64))
+                           (rh & np.uint32(N - 1)).astype(np.int64),
+                           tenant=tid)
 
     def _remap_cached_attribution(self, old_in: list, old_out: list) -> None:
         # Same-ids-in-same-order is the base method's no-op fast path
@@ -1301,8 +1455,10 @@ class MeshDatapath(TpuflowDatapath):
         # A mid-resize bundle that REALLY remapped attribution touched
         # the WHOLE cache: no bounded dirty set covers that — fall back
         # to the full catch-up sweep (metered; the pre-tracking shape).
+        # Per-world: only the world that remapped degrades to the full
+        # walk.
         if changed and self._reshard is not None:
-            self._reshard.note_all_dirty()
+            self._reshard.note_all_dirty(tenant=self._tenant_id())
 
     def reshard_begin(self, n_data: int, devices=None) -> dict:
         """Begin a LIVE resize of the data axis to `n_data` replicas.
@@ -1311,10 +1467,13 @@ class MeshDatapath(TpuflowDatapath):
         (dual-topology serving: in-flight batches keep resolving against
         the old topology), and registers the budgeted `reshard-migrate`
         maintenance task that walks the flow-cache/conntrack tables and
-        re-commits rows to their target ring homes.  The cutover flips
-        only after the target passes its replica-resolved canary and a
-        migrated-row audit sweep; a veto aborts back to the old mesh
-        with the generation unchanged.  -> the plane's status dict."""
+        re-commits rows to their target ring homes — live TENANT worlds
+        included, each under its own `_world_ctx` with its own certified
+        per-world cutover.  The fleet flips only after the target passes
+        its replica-resolved canary and a migrated-row audit sweep; a
+        default-world veto aborts back to the old mesh with the
+        generation unchanged, while a tenant world's veto latches only
+        that world.  -> the plane's status dict."""
         if self._reshard is not None:
             raise RuntimeError(
                 "a reshard is already in flight; wait for its cutover or "
@@ -1324,18 +1483,6 @@ class MeshDatapath(TpuflowDatapath):
                 "datapath is degraded (serving last-known-good): the "
                 "cutover gate could never certify a target topology — "
                 "recover before resizing")
-        if self.tenant_count:
-            # Tenant worlds hold their own (D,)-sharded state the
-            # migrator does not walk; re-homing them under a resize is
-            # an open item (datapath/tenancy.py residue) — refuse
-            # loudly rather than silently strand tenant rows.  Typed
-            # like the mirror-image refusal (tenant_create under an
-            # in-flight reshard): both directions are a plane-exclusion
-            # config error, not an internal failure.
-            raise ConfigError(
-                f"the tenancy plane has {self.tenant_count} tenant "
-                f"world(s); the elastic resharding plane migrates the "
-                f"default world only — drain tenants before resizing")
         plane = ReshardPlane(self, int(n_data), devices=devices)
         self._install_reshard_plane(plane)
         return plane.status()
@@ -1344,8 +1491,8 @@ class MeshDatapath(TpuflowDatapath):
         """Adopt a constructed ReshardPlane — the ordinary reshard_begin
         above, or the failover plane's emergency evacuation/certified
         readmission (which build their planes directly: the evacuation
-        must skip reshard_begin's tenant/degraded refusals by design —
-        see parallel/failover.py) — and register its budgeted migration
+        must skip reshard_begin's degraded refusal by design — see
+        parallel/failover.py) — and register its budgeted migration
         task."""
         self._reshard = plane
         self._maintenance.register(MaintenanceTask(
@@ -1374,6 +1521,28 @@ class MeshDatapath(TpuflowDatapath):
         if self._reshard is None:
             raise RuntimeError("no reshard in flight")
         self._reshard.abort(reason)
+
+    def arm_reshard_faults(self, plan, name: str) -> None:
+        """Chaos hook (tests): arm the per-tenant forced-canary-veto
+        sites f"{name}.tenant_canary.t{tid}" consulted by the per-world
+        cutover certification (parallel/reshard._certify_world) — a
+        deterministic single-world veto without corrupting device
+        state."""
+        self._reshard_faults = (plan, str(name))
+        plan.bind_recorder(getattr(self, "_flightrec", None))
+
+    def tenant_reshard_resync(self, tid: int, now: int) -> dict:
+        """Re-home ONE latched tenant world onto the current fleet
+        topology (the readmission half of a per-world canary veto): the
+        full migrate + certify + flip walk for just that world, under
+        the same veto rules — a second veto re-latches, journaled.
+        Refused while a fleet resize is in flight (the plane's own
+        per-world migration would race this walk)."""
+        if self._reshard is not None:
+            raise RuntimeError(
+                "a reshard is in flight; the latched world re-certifies "
+                "at that plane's cutover — wait for it")
+        return resync_world(self, int(tid), int(now))
 
     def _finish_reshard(self, plane) -> None:
         """Plane lifecycle callback (cutover or abort): unregister the
@@ -1413,6 +1582,15 @@ class MeshDatapath(TpuflowDatapath):
             "cutovers_total": self._reshard_cutovers,
             "aborts_total": self._reshard_aborts,
             "last_span": self._last_reshard_span,
+            # Tenant-labeled resize observability: rows migrated into
+            # tenant worlds (folded at flip; the live plane's in-flight
+            # rows ride on top), per-world cutover vetoes, and the live
+            # plane's world count.
+            "tenant_rows_total": self._reshard_tenant_rows_total + (
+                plane.tenant_rows() if plane is not None else 0),
+            "tenant_vetoes_total": self._reshard_tenant_vetoes,
+            "tenant_worlds_migrating": (len(plane.worlds)
+                                        if plane is not None else 0),
         }
 
     def mesh_stats(self) -> dict:
@@ -1471,7 +1649,8 @@ class MeshDatapath(TpuflowDatapath):
                     "evacuations_total": 0, "readmissions_total": 0,
                     "remiss_total": 0, "requeued_total": 0,
                     "fail_streaks": {}, "probe_rounds": 0,
-                    "probe_history": []}
+                    "probe_history": [],
+                    "tenants_pending_evacuation": []}
         return {"enabled": 1, "n_shards": fo._orig_n, **fo.status()}
 
     def failover_readmit(self) -> dict:

@@ -66,14 +66,37 @@ migrator is a silent flow-loss bug).  The migrator itself copies rows
 field-generically from `FlowCache._fields`/`AffinityTable._fields`, so
 the manifest and the copy loop cannot drift apart.
 
-Documented residue (the README failure-model row): a row evicted or
+Tenant worlds (datapath/tenancy.py) ride the whole walk, per world: the
+tenant salt keeps `shard_of_tuples(tenant=)` generation-composable, so
+each world gets its own `_WorldMigration` record — host mirrors, dirty
+bitmap, striped cursor at the WORLD's width and slot rung — and the
+budgeted task splits its tick budget evenly over the default world and
+every live world, migrating each under `_world_ctx` (the world's own
+state/meta/mesh are the active ones).  Rule windows re-home through the
+owner's `_place_rules_on` hook on the target mesh (host build + rung
+padding + sharded placement), so rung-shared XLA executables stay
+shared post-resize.  The cutover certifies PER TENANT: each world runs
+its own replica-resolved canary + migrated-row audit on the target
+placement, and one world's veto latches ONLY that world (journaled
+`tenant-rollback` + `tenant-reshard-veto`; it keeps serving its old
+topology via the per-world `_mesh`/`_n_data`/`_topo_gen` latch in
+`_TENANT_WORLD_FIELDS`, re-homed later by `tenant_reshard_resync`)
+while certified worlds flip (`tenant-reshard-cutover`) — a fleet-wide
+abort only when the DEFAULT world's certification vetoes.
+
+Documented residue (the README failure-model rows): a row evicted or
 idle-expired in the OLD topology between its migration window and the
 cutover catch-up can survive in the target table.  This is verdict-safe
 by construction — liveness (idle timeout) and generation validity are
 re-checked at every lookup, so expired/stale-gen copies are dead on
 arrival, and a resurrected committed row serves exactly what it served
 before its capacity eviction — and the continuous revalidator re-proves
-the migrated table like any other cache.
+the migrated table like any other cache.  Second residue: a world still
+LATCHED from an earlier veto when the next resize begins migrates from
+its own (old) topology with no skip mapping onto the fleet's — its
+migration record walks every one of its own replicas, and any row that
+cannot land simply re-misses to an identical verdict (the same
+lost-update argument).
 """
 
 from __future__ import annotations
@@ -122,6 +145,80 @@ RESHARD_MANIFEST = {
                         "occupancy = ep > 0)",
     "AffinityTable.ts": "broadcast; newest-ts wins collisions",
 }
+
+# Migration rule per (D,)-sharded member of the mesh engine's
+# _TENANT_WORLD_FIELDS (parallel/meshpath.py) — the same pure-literal
+# contract as RESHARD_MANIFEST, one level up: the analysis reshard pass
+# (antrea_tpu/analysis/reshard.py) detects which world-swapped fields
+# are assigned from the (D,)-sharded state machinery and fails the build
+# when one ships without naming how a live resize re-homes it (a world
+# field nobody taught the migrator is a silent per-tenant flow-loss
+# bug).
+WORLD_MIGRATION = {
+    "_state": "per-world row-migrate under _world_ctx: the tenant salt "
+              "keeps shard_of_tuples(tenant=) generation-composable, so "
+              "each world's FlowCache rows re-home by the "
+              "RESHARD_MANIFEST rules with the world's own host mirrors "
+              "and dirty bitmap; AffinityTable rows broadcast",
+}
+
+
+class _WorldMigration:
+    """Per-tenant-world migration record: ONE world's host mirrors,
+    dirty bitmap and striped cursor, at the WORLD's width/generation and
+    slot rung (quota-rung tables are smaller than the fleet's).  The
+    plane's own migration methods take `mig=` and route all per-world
+    reads/writes through this record — the default world's record IS the
+    plane itself (identical attribute names), so the untenanted path is
+    provably the pre-existing one."""
+
+    def __init__(self, tenant: int, fields: dict, plane) -> None:
+        self.tenant = int(tenant)
+        self.src_n = int(fields["_n_data"])
+        self.src_gen = int(fields["_topo_gen"])
+        self.dst_n = int(plane.dst_n)
+        self.gen = int(plane.gen)
+        # Skip-replica (evacuation) index is TOPOLOGY-RELATIVE: a world
+        # latched behind its own survivor mask carries the dead index in
+        # its _fo_mask latch; a fleet-aligned world shares the plane's;
+        # a world latched from an EARLIER resize has no mapping (the
+        # module docstring's second residue) and migrates all replicas.
+        wm = fields.get("_fo_mask")
+        if wm is not None:
+            self.skip = int(wm[0])
+        elif (plane.skip is not None and self.src_n == plane.src_n
+                and self.src_gen == int(plane.owner._topo_gen)):
+            self.skip = int(plane.skip)
+        else:
+            self.skip = None
+        self.slots = int(fields["_meta"].flow_slots)
+        self.G = self.src_n * self.slots
+        self.covered = 0
+        self.dirty = np.zeros((self.src_n, self.slots), bool)
+        self.dirty_all = False
+        flow = fields["_state"].flow
+        self.flow_host = {
+            name: np.zeros((self.dst_n,) + tuple(
+                getattr(flow, name).shape[1:]), np.int32)
+            for name in pl.FlowCache._fields
+        }
+        aff = fields["_state"].aff
+        self.aff_host = {
+            name: np.zeros((self.dst_n,) + tuple(
+                getattr(aff, name).shape[1:]), np.int32)
+            for name in pl.AffinityTable._fields
+        }
+        self.t_drs = None
+        self.t_match_meta = None
+        self._t_rules_gen = -1
+        self.migrated_rows = 0
+        self.resident_rows = 0
+        self.catchup_rows = 0
+        self.catchup_scanned = 0
+        self.aff_rows = 0
+        self.certify_divergences = 0
+        self.vetoed = False
+        self.flipped = False
 
 
 class ReshardPlane:
@@ -204,6 +301,23 @@ class ReshardPlane:
             (self.src_n, int(owner._meta.flow_slots)), bool)
         self.dirty_all = False
         self.catchup_scanned = 0
+        # The default world's migration record IS the plane (the _copy_
+        # rows/_catchup family routes through `mig` attributes with these
+        # exact names — see _WorldMigration).
+        self.tenant = 0
+        self.slots = int(owner._meta.flow_slots)
+        self.vetoed = False
+        self.flipped = False
+        # One _WorldMigration per LIVE tenant world, built from the
+        # world's exported field snapshot (w.fields — no _world_ctx
+        # needed at begin time).  Worlds created mid-resize join via
+        # note_world_created.
+        self.worlds = {}
+        reg = getattr(owner, "_tenants", None)
+        if reg is not None:
+            for tid in sorted(reg.worlds):
+                self.worlds[int(tid)] = _WorldMigration(
+                    int(tid), reg.worlds[tid].fields, self)
         self.phase = "migrate"  # -> "ready" -> done/aborted
         self.done = False
         self.aborted = False
@@ -219,31 +333,66 @@ class ReshardPlane:
         extra = {} if self.skip is None else {"skip_replica": self.skip}
         self._emit("reshard-begin", topo_gen_target=self.gen,
                    n_data_from=self.src_n, n_data_to=self.dst_n,
-                   slots=self.G, **extra)
+                   slots=self.G, tenant_worlds=len(self.worlds), **extra)
 
     # -- plumbing ------------------------------------------------------------
 
     def _emit(self, kind: str, **fields) -> None:
         emit_into(self.owner, kind, **fields)
 
-    def note_touched(self, replica, slots) -> None:
+    def _mig_for(self, tenant: int):
+        """The migration record a tenant id routes to: the plane itself
+        for the default world, the world's _WorldMigration otherwise
+        (None for a world the plane does not track — begin-time race,
+        harmless: its rows re-miss to identical verdicts)."""
+        return self if tenant == 0 else self.worlds.get(int(tenant))
+
+    def note_touched(self, replica, slots, tenant: int = 0) -> None:
         """Record source-(replica, local slot) pairs a live dispatch may
         have written (conservative over-marking is harmless: the
         catch-up re-sweeps one already-synced row).  One masked
-        fancy-index write — this runs on the traffic path."""
-        if self.dirty_all:
+        fancy-index write — this runs on the traffic path.  Per-world
+        dispatches route to the world's own bitmap (replica/slot are in
+        the WORLD's indexing)."""
+        mig = self._mig_for(tenant)
+        if mig is None or mig.dirty_all:
             return
         rep = np.asarray(replica).ravel()
         sl = np.asarray(slots).ravel()
-        ok = ((rep >= 0) & (rep < self.src_n)
-              & (sl >= 0) & (sl < self.dirty.shape[1]))
-        self.dirty[rep[ok], sl[ok]] = True
+        ok = ((rep >= 0) & (rep < mig.dirty.shape[0])
+              & (sl >= 0) & (sl < mig.dirty.shape[1]))
+        mig.dirty[rep[ok], sl[ok]] = True
 
-    def note_all_dirty(self) -> None:
+    def note_all_dirty(self, tenant: int = 0) -> None:
         """Whole-cache write (attribution remap): bounded tracking can't
-        cover it — the catch-up falls back to the full sweep."""
-        self.dirty_all = True
-        self.dirty[:] = False
+        cover it — the catch-up falls back to the full sweep (for the
+        one world that remapped, not the fleet)."""
+        mig = self._mig_for(tenant)
+        if mig is None:
+            return
+        mig.dirty_all = True
+        mig.dirty[:] = False
+
+    def dirty_all_for(self, tenant: int = 0) -> bool:
+        """True when the tenant's catch-up already degraded to the full
+        walk (or the plane does not track the world) — the engine's
+        dirty-note fast-path check."""
+        mig = self._mig_for(tenant)
+        return True if mig is None else bool(mig.dirty_all)
+
+    def note_world_created(self, tid: int, world) -> None:
+        """A tenant world created MID-RESIZE joins the walk: its record
+        starts at zero coverage, and the cutover migrates it
+        synchronously if the budgeted windows don't reach it first."""
+        if self.done or self.aborted:
+            return
+        self.worlds[int(tid)] = _WorldMigration(int(tid), world.fields,
+                                                self)
+
+    def tenant_rows(self) -> int:
+        """Rows migrated across all tenant worlds so far (the fleet
+        meters the default world separately)."""
+        return sum(int(w.migrated_rows) for w in self.worlds.values())
 
     def _stamp(self, name: str) -> None:
         prev = max(self._stamps.values())
@@ -264,6 +413,10 @@ class ReshardPlane:
             "dirty_rows": int(self.dirty.sum()),
             "dirty_all": bool(self.dirty_all),
             "affinity_rows": int(self.aff_rows),
+            "tenant_worlds": len(self.worlds),
+            "tenant_rows": int(self.tenant_rows()),
+            "tenant_vetoes": sum(
+                1 for w in self.worlds.values() if w.vetoed),
         }
 
     # -- the maintenance-task entry point ------------------------------------
@@ -276,13 +429,35 @@ class ReshardPlane:
         if self.done or self.aborted:
             return 0
         if self.phase == "migrate":
-            spent = self._migrate_window(now, budget)
-            if self.covered >= self.G:
+            # The tick budget splits EVENLY over every world still
+            # migrating (default world first); each world's window runs
+            # under its _world_ctx so the world's own state/meta are the
+            # active ones.  max(1, ...) keeps tiny budgets progressing —
+            # the scheduler's overrun meter prices the spill honestly.
+            pend = []
+            if self.covered < self.G:
+                pend.append(None)
+            pend += [tid for tid in sorted(self.worlds)
+                     if self.worlds[tid].covered < self.worlds[tid].G]
+            spent = 0
+            o = self.owner
+            for i, tid in enumerate(pend):
+                share = max(
+                    1, (max(int(budget), 0) - spent) // (len(pend) - i))
+                if tid is None:
+                    spent += self._migrate_window(now, share)
+                else:
+                    mig = self.worlds[tid]
+                    with o._world_ctx(tid):
+                        spent += self._migrate_window(now, share, mig=mig)
+            if self.covered >= self.G and all(
+                    w.covered >= w.G for w in self.worlds.values()):
                 self.phase = "ready"
                 self._stamp("migrated")
                 self._emit("reshard-migrated", rows=int(self.migrated_rows),
                            resident=int(self.resident_rows),
-                           slots=int(self.G), at=int(now))
+                           slots=int(self.G),
+                           tenant_rows=int(self.tenant_rows()), at=int(now))
             return spent
         # phase == "ready": certified cutover.  Degradation pauses the
         # flip (shed_when_degraded on the task is the first gate; this is
@@ -294,27 +469,30 @@ class ReshardPlane:
 
     # -- drain-and-migrate ---------------------------------------------------
 
-    def _migrate_window(self, now: int, budget: int) -> int:
+    def _migrate_window(self, now: int, budget: int, mig=None) -> int:
         """Walk `budget` global slots from the striped cursor, migrating
-        every live row to its target-ring home -> slots scanned."""
-        D = self.src_n
-        cursor = self.covered
-        k = min(max(int(budget), 0), self.G - cursor)
+        every live row to its target-ring home -> slots scanned.  With
+        `mig`, the walk is one tenant world's (run under its _world_ctx
+        so `owner._state` is the world's)."""
+        mig = self if mig is None else mig
+        D = mig.src_n
+        cursor = mig.covered
+        k = min(max(int(budget), 0), mig.G - cursor)
         if k <= 0:
             return 0
         for r in range(D):
-            if r == self.skip:
+            if r == mig.skip:
                 continue  # quarantined source: nothing migrates from it
             first = cursor + ((r - cursor) % D)
             if first >= cursor + k:
                 continue
             count = (cursor + k - first + D - 1) // D
-            self._copy_rows(r, first // D, count, now)
-        self.covered += k
+            self._copy_rows(r, first // D, count, now, mig=mig)
+        mig.covered += k
         return k
 
     def _copy_rows(self, r: int, ls: int, count: int, now: int,
-                   catchup: bool = False) -> int:
+                   catchup: bool = False, mig=None) -> int:
         """Decode `count` consecutive local slots of source replica `r`
         and re-commit the live rows into the target host mirror.
 
@@ -324,6 +502,7 @@ class ReshardPlane:
         one fused window transfer + a vectorized (home, slot, ts)-sorted
         scatter — is an optimization residue noted in ROADMAP item 3
         beside the dirty-row catch-up tracking."""
+        mig = self if mig is None else mig
         o = self.owner
         flow = o._state.flow
         cols = {name: np.asarray(getattr(flow, name)[r, ls:ls + count])
@@ -345,11 +524,12 @@ class ReshardPlane:
         # The stored key IS the direction the packets arrive with (reply
         # rows are keyed on the reply tuple), and the affinity hash is
         # direction-symmetric — so hashing the stored tuple homes every
-        # row exactly where its own lookups will land.
+        # row exactly where its own lookups will land.  The tenant salt
+        # composes: a world's rows re-home on the world's OWN ring.
         home = shard_of_tuples(src_u, dst_u, proto, sport, dport,
-                               self.dst_n, self.gen)
+                               mig.dst_n, mig.gen, tenant=mig.tenant)
         moved = 0
-        t = self.flow_host
+        t = mig.flow_host
         for i in idx:
             i = int(i)
             r2, slot = int(home[i]), ls + i
@@ -362,24 +542,25 @@ class ReshardPlane:
                 if int(t["ts"][r2, slot]) > ts_new:
                     continue
             else:
-                self.resident_rows += 1
+                mig.resident_rows += 1
             for name in pl.FlowCache._fields:
                 t[name][r2, slot] = cols[name][i]
             moved += 1
-        self.migrated_rows += moved
+        mig.migrated_rows += moved
         if catchup:
-            self.catchup_rows += moved
+            mig.catchup_rows += moved
         return moved
 
-    def _migrate_affinity(self) -> int:
+    def _migrate_affinity(self, mig=None) -> int:
         """Broadcast every occupied affinity row to all target replicas
         at the same slot (see the manifest rationale) -> rows copied."""
+        mig = self if mig is None else mig
         o = self.owner
         aff = o._state.aff
-        t = self.aff_host
+        t = mig.aff_host
         moved = 0
-        for r in range(self.src_n):
-            if r == self.skip:
+        for r in range(mig.src_n):
+            if r == mig.skip:
                 # Sticky choices held only by the quarantined replica are
                 # lost — re-election is verdict-safe (affinity drift sits
                 # outside the certification veto by design).
@@ -389,16 +570,16 @@ class ReshardPlane:
             for i in np.nonzero(cols["ep"][:-1] > 0)[0]:
                 i = int(i)
                 ts_new = int(cols["ts"][i])
-                for r2 in range(self.dst_n):
+                for r2 in range(mig.dst_n):
                     if t["ep"][r2, i] > 0 and int(t["ts"][r2, i]) > ts_new:
                         continue
                     for name in pl.AffinityTable._fields:
                         t[name][r2, i] = cols[name][i]
                 moved += 1
-        self.aff_rows = moved
+        mig.aff_rows = moved
         return moved
 
-    def _catchup(self, now: int) -> int:
+    def _catchup(self, now: int, mig=None) -> int:
         """The final delta sweep, serialized with the flip (the
         scheduler's tick already excludes in-flight drains, and no
         traffic steps between this sweep and the generation flip in the
@@ -414,31 +595,32 @@ class ReshardPlane:
         write (dirty_all: the mid-resize attribution remap).  Swept
         volume is metered (catchup_scanned ->
         antrea_tpu_reshard_catchup_rows_total)."""
-        S = self.G // self.src_n
-        if self.dirty_all:
-            for r in range(self.src_n):
-                if r == self.skip:
+        mig = self if mig is None else mig
+        S = mig.slots
+        if mig.dirty_all:
+            for r in range(mig.src_n):
+                if r == mig.skip:
                     continue
-                self._copy_rows(r, 0, S, now, catchup=True)
-            self.catchup_scanned += self.G
-            return self.G + self._migrate_affinity()
+                self._copy_rows(r, 0, S, now, catchup=True, mig=mig)
+            mig.catchup_scanned += mig.G
+            return mig.G + self._migrate_affinity(mig=mig)
         scanned = 0
-        for r in range(self.src_n):
-            if r == self.skip:
-                self.dirty[r] = False
+        for r in range(mig.src_n):
+            if r == mig.skip:
+                mig.dirty[r] = False
                 continue
-            slots = np.flatnonzero(self.dirty[r, :S])
+            slots = np.flatnonzero(mig.dirty[r, :S])
             # Consecutive dirty slots coalesce into one decode window.
             for run in np.split(slots,
                                 np.flatnonzero(np.diff(slots) > 1) + 1):
                 if run.size == 0:
                     continue
                 self._copy_rows(r, int(run[0]), int(run.size), now,
-                                catchup=True)
+                                catchup=True, mig=mig)
                 scanned += int(run.size)
-            self.dirty[r] = False
-        self.catchup_scanned += scanned
-        return scanned + self._migrate_affinity()
+            mig.dirty[r] = False
+        mig.catchup_scanned += scanned
+        return scanned + self._migrate_affinity(mig=mig)
 
     # -- certification -------------------------------------------------------
 
@@ -530,27 +712,28 @@ class ReshardPlane:
             return False, cost
         return True, cost
 
-    def _audit_target(self, now: int) -> tuple[int, int]:
+    def _audit_target(self, now: int, mig=None) -> tuple[int, int]:
         """Re-prove every migrated row against a fresh walk through the
         current tables -> (divergences, rows audited)."""
+        mig = self if mig is None else mig
         o = self.owner
         div = rows_total = 0
-        for r2 in range(self.dst_n):
+        for r2 in range(mig.dst_n):
             rows = o._decode_audit_rows(
-                self.flow_host["keys"][r2, :-1],
-                self.flow_host["meta"][r2, :-1],
-                self.flow_host["ts"][r2, :-1],
+                mig.flow_host["keys"][r2, :-1],
+                mig.flow_host["meta"][r2, :-1],
+                mig.flow_host["ts"][r2, :-1],
                 now,
-                lambda i, r2=r2: i * self.dst_n + r2,
+                lambda i, r2=r2: i * mig.dst_n + r2,
             )
             if not rows:
                 continue
             local = pl.PipelineState(
                 flow=pl.FlowCache(**{
-                    n: jnp.asarray(self.flow_host[n][r2])
+                    n: jnp.asarray(mig.flow_host[n][r2])
                     for n in pl.FlowCache._fields}),
                 aff=pl.AffinityTable(**{
-                    n: jnp.asarray(self.aff_host[n][r2])
+                    n: jnp.asarray(mig.aff_host[n][r2])
                     for n in pl.AffinityTable._fields}),
             )
             fresh = o._audit_fresh_state(local, rows, now)
@@ -568,6 +751,143 @@ class ReshardPlane:
                     div += 1
         return div, rows_total
 
+    # -- per-world certification ---------------------------------------------
+
+    def _ensure_world_rules(self, mig) -> None:
+        """(Re)place ONE world's rule tensors on the target mesh — must
+        run inside the world's _world_ctx.  Goes through the owner's
+        `_place_rules_on` hook (host build + entry-axis RUNG padding +
+        sharded placement), so rung-shared shapes — and therefore the
+        rung-shared XLA executables — survive the resize.  Lazy and
+        generation-checked like the default world's."""
+        o = self.owner
+        if mig.t_drs is not None and mig._t_rules_gen == int(o._gen):
+            return
+        drs, _meta = o._place_rules_on(self.t_mesh, o._cps)
+        if o._n_deltas:
+            specs = _drs_specs(agg=o._prune_budget > 0)
+            drs = drs._replace(ip_delta=jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.t_mesh, s)),
+                o._build_delta_table(), specs.ip_delta))
+        mig.t_drs = drs
+        mig.t_match_meta = o._meta.match
+        mig._t_rules_gen = int(o._gen)
+
+    def _certify_world(self, mig, now: int) -> bool:
+        """ONE world's cutover gate — must run inside its _world_ctx.
+        The world's own replica-resolved canary runs on the target
+        placement (via the owner's `_reshard_canary` redirect, so probes
+        resolve against the world's policy set and scalar oracle) and
+        its migrated rows re-audit.  A veto latches ONLY this world
+        (`_veto_world`) — the fleet and every certified sibling flip
+        regardless.  A FaultPlan armed via `arm_reshard_faults` can
+        force a deterministic veto at site `{name}.tenant_canary.t{id}`
+        (the chaos tier's single-world abort)."""
+        o = self.owner
+        reason = None
+        try:
+            self._ensure_world_rules(mig)
+        except Exception as e:  # noqa: BLE001 — placement failure must
+            # veto the world, never strand the fleet cutover.
+            self._veto_world(
+                mig, f"target placement failed "
+                     f"({type(e).__name__}: {e})", now)
+            return False
+        pf = getattr(o, "_reshard_faults", None)
+        if pf is not None:
+            plan, name = pf
+            rule = plan.fire(f"{name}.tenant_canary.t{mig.tenant}")
+            if rule is not None:
+                reason = f"forced tenant-canary veto ({rule.kind})"
+        if reason is None:
+            cp = o._commit
+            if cp is not None and cp.probes > 0:
+                o._reshard_canary = (self.t_mesh, mig.t_drs,
+                                     mig.t_match_meta, mig.dst_n)
+                try:
+                    mism = cp._canary()
+                finally:
+                    o._reshard_canary = None
+                if mism:
+                    reason = (f"target-topology canary veto: "
+                              f"{mism[0]}")[:200]
+        if reason is None:
+            div, _rows = self._audit_target(now, mig=mig)
+            if div:
+                mig.certify_divergences = div
+                reason = (f"target-topology audit found {div} divergent "
+                          f"migrated row(s)")
+        if reason is not None:
+            self._veto_world(mig, reason, now)
+            return False
+        return True
+
+    def _veto_world(self, mig, reason: str, now: int) -> None:
+        """One world's certification failed: latch it (it keeps serving
+        its old topology — `_flip_world` pins the per-world survivor
+        mask on an evacuation) and journal the per-tenant rollback
+        chain.  Never aborts the fleet."""
+        o = self.owner
+        mig.vetoed = True
+        self.vetoed = True
+        w = o._tenants.world(mig.tenant)
+        w.rollbacks += 1
+        w.reshard_vetoes += 1
+        o._reshard_tenant_vetoes += 1
+        self._emit("tenant-rollback", tenant=int(mig.tenant),
+                   error=f"reshard: {reason}"[:200])
+        self._emit("tenant-reshard-veto", tenant=int(mig.tenant),
+                   reason=str(reason)[:200], topo_gen_target=int(mig.gen),
+                   n_data_to=int(mig.dst_n), at=int(now))
+
+    def _flip_world(self, mig, now: int) -> None:
+        """Flip ONE certified world onto the target topology (runs with
+        the FLEET already flipped, operating on the world's exported
+        field snapshot), or latch a vetoed one.  The latch is the
+        per-world topology generation: a vetoed world's `_mesh`/
+        `_n_data`/`_topo_gen` fields keep their old values, and on an
+        evacuation it additionally pins its own survivor mask
+        (`_fo_mask` — the dead index in the WORLD's indexing) so its
+        lanes keep avoiding the quarantined replica."""
+        o = self.owner
+        w = o._tenants.world(mig.tenant)
+        f = w.fields
+        if mig.vetoed:
+            # Evacuation veto: pin the world's own survivor mask only
+            # when the dead index is known in the WORLD's indexing
+            # (mig.skip) — a world latched from an earlier resize has no
+            # mapping (module-docstring residue) and keeps only the
+            # generation latch.
+            if mig.skip is not None and f.get("_fo_mask") is None:
+                f["_fo_mask"] = (int(mig.skip), int(mig.dst_n),
+                                 int(mig.gen))
+            return
+        f["_state"] = jax.tree.map(
+            lambda h, s: jax.device_put(
+                jnp.asarray(h), NamedSharding(self.t_mesh, s)),
+            pl.PipelineState(
+                flow=pl.FlowCache(**mig.flow_host),
+                aff=pl.AffinityTable(**mig.aff_host)),
+            _state_specs())
+        f["_drs"] = mig.t_drs
+        f["_mesh"] = self.t_mesh
+        f["_n_data"] = int(mig.dst_n)
+        f["_topo_gen"] = int(mig.gen)
+        f["_replica_audit_entries"] = [0] * int(mig.dst_n)
+        f["_fo_mask"] = None
+        f["_state_mutations"] = int(f.get("_state_mutations", 0)) + 1
+        with o._world_ctx(mig.tenant):
+            o._audit_refresh_golden()
+        mig.flipped = True
+        w.reshard_rows += int(mig.migrated_rows)
+        o._reshard_tenant_rows_total += int(mig.migrated_rows)
+        self._emit("tenant-reshard-cutover", tenant=int(mig.tenant),
+                   topo_gen=int(mig.gen), n_data_from=int(mig.src_n),
+                   n_data_to=int(mig.dst_n),
+                   migrated_rows=int(mig.migrated_rows),
+                   resident_rows=int(mig.resident_rows), at=int(now))
+
     # -- cutover / abort -----------------------------------------------------
 
     def _cutover(self, now: int) -> int:
@@ -576,6 +896,21 @@ class ReshardPlane:
         spent += cost
         if not ok:
             return spent  # _certify aborted; old mesh keeps serving
+        # Per-tenant certification: each world catches up and certifies
+        # under its own ctx.  A world's veto latches only that world
+        # (_veto_world) — the DEFAULT world's veto above is the only
+        # fleet-wide abort.
+        o = self.owner
+        for tid in sorted(self.worlds):
+            mig = self.worlds[tid]
+            with o._world_ctx(tid):
+                if mig.covered < mig.G:
+                    # Created mid-resize after the budgeted windows
+                    # finished: migrate synchronously now.
+                    spent += self._migrate_window(
+                        now, mig.G - mig.covered, mig=mig)
+                spent += self._catchup(now, mig=mig)
+                self._certify_world(mig, now)
         self._stamp("certified")
         self._flip(now)
         return spent
@@ -593,6 +928,11 @@ class ReshardPlane:
             "dft": o._dft, "replica_audit": o._replica_audit_entries,
             "queues": (None if sp is None
                        else (sp.n_data, sp.queues, sp.queue)),
+            # Shallow copies of every tracked world's field dict: world
+            # flips mutate those dicts in place, so a restore swaps the
+            # pre-flip copy back wholesale.
+            "worlds": {tid: dict(o._tenants.world(tid).fields)
+                       for tid in self.worlds},
         }
         try:
             o._mesh = self.t_mesh
@@ -616,6 +956,11 @@ class ReshardPlane:
             if o._audit is not None:
                 o._audit.cursor = 0  # the striping changed; restart
             o._audit_refresh_golden()
+            # Certified worlds flip with the fleet; vetoed ones latch
+            # (per-world topology generation + survivor mask).  Before
+            # the queue resize so an exception here restores everything.
+            for tid in sorted(self.worlds):
+                self._flip_world(self.worlds[tid], now)
             # Queue re-home LAST: every raise-capable step is behind us,
             # so a restored snapshot can never strand a resized queue set
             # against an unflipped data axis.
@@ -633,6 +978,8 @@ class ReshardPlane:
             o._dsvc = snap["dsvc"]
             o._dft = snap["dft"]
             o._replica_audit_entries = snap["replica_audit"]
+            for tid, fsnap in snap["worlds"].items():
+                o._tenants.world(tid).fields = fsnap
             if sp is not None:
                 # Belt for a raise INSIDE resize(): the queue set must
                 # match the restored data axis.  Rows already popped for
@@ -658,6 +1005,10 @@ class ReshardPlane:
                    migrated_rows=int(self.migrated_rows),
                    resident_rows=int(self.resident_rows),
                    requeued=int(requeued), dropped=int(dropped),
+                   tenant_worlds=len(self.worlds),
+                   tenant_rows=int(self.tenant_rows()),
+                   tenant_vetoes=sum(
+                       1 for w in self.worlds.values() if w.vetoed),
                    at=int(now))
         self.done = True
         o._reshard_cutovers += 1
@@ -686,14 +1037,27 @@ class ReshardPlane:
 
     def _home_of_block(self, block: dict) -> np.ndarray:
         """Target-ring homes for a popped miss-queue block (the queue
-        re-route at flip time)."""
-        return shard_of_tuples(
-            np.asarray(block["src_ip"]).astype(np.uint32),
-            np.asarray(block["dst_ip"]).astype(np.uint32),
-            np.asarray(block["proto"]).astype(np.int32),
-            np.asarray(block["src_port"]).astype(np.int32),
-            np.asarray(block["dst_port"]).astype(np.int32),
-            self.dst_n, self.gen)
+        re-route at flip time), per tenant: the tenant column rides the
+        queue rows verbatim, and each world's rows re-home on its OWN
+        salted ring.  A LATCHED world's rows get target-ring homes here
+        too — the queue index is a transport detail only; the drain
+        re-splits per tenant and re-lays rows out on the world's own
+        topology at classify time (meshpath._relayout_world_blocks), so
+        verdicts never see the fleet indexing."""
+        cols = (np.asarray(block["src_ip"]).astype(np.uint32),
+                np.asarray(block["dst_ip"]).astype(np.uint32),
+                np.asarray(block["proto"]).astype(np.int32),
+                np.asarray(block["src_port"]).astype(np.int32),
+                np.asarray(block["dst_port"]).astype(np.int32))
+        ten = np.asarray(block.get(
+            "tenant", np.zeros(cols[0].shape, np.int64)))
+        out = np.zeros(cols[0].shape, np.int32)
+        for t in np.unique(ten):
+            m = ten == t
+            out[m] = shard_of_tuples(*(c[m] for c in cols),
+                                     self.dst_n, self.gen,
+                                     tenant=int(t))
+        return out
 
     def _span(self) -> dict:
         """The resize span: stage durations clamped monotonic,
@@ -713,3 +1077,81 @@ class ReshardPlane:
         out["n_data_to"] = self.dst_n
         out["rows_migrated"] = int(self.migrated_rows)
         return out
+
+
+def resync_world(owner, tid: int, now: int) -> dict:
+    """Re-home ONE latched tenant world onto the owner's CURRENT fleet
+    topology — the readmission half of a per-world canary veto (the
+    world latched at cutover and kept serving its old topology behind
+    its generation latch / survivor mask).  A full synchronous
+    migrate + catch-up + certify + flip walk for just this world, under
+    the same veto rules: a second veto re-latches and journals, never a
+    wrong verdict.  `now` must be the live scheduler clock — the
+    liveness decode classifies rows against it.
+
+    Entry point: `MeshDatapath.tenant_reshard_resync` (which refuses
+    while a fleet resize is in flight — the plane would race this
+    walk)."""
+    w = owner._tenants.world(int(tid))
+    f = w.fields
+    if (int(f.get("_n_data", 0)) == int(owner._n_data)
+            and int(f.get("_topo_gen", -1)) == int(owner._topo_gen)
+            and f.get("_fo_mask") is None):
+        return {"tenant": int(tid), "resynced": 0,
+                "reason": "fleet-aligned"}
+    # A minimal plane shim: target = the CURRENT fleet topology, no
+    # fleet-side migration state (G=covered so the default record is
+    # inert), reusing the per-world machinery verbatim.
+    p = ReshardPlane.__new__(ReshardPlane)
+    p.owner = owner
+    p.skip = None
+    p.src_n = int(owner._n_data)
+    p.dst_n = int(owner._n_data)
+    p.gen = int(owner._topo_gen)
+    p.t_mesh = owner._mesh
+    p.t_drs = None
+    p.t_match_meta = None
+    p._t_rules_gen = -1
+    p.tenant = 0
+    p.slots = int(owner._meta.flow_slots)
+    p.vetoed = False
+    p.flipped = False
+    p.worlds = {}
+    p.G = 1
+    p.covered = 1
+    p.dirty = np.zeros((1, 1), bool)
+    p.dirty_all = False
+    p.flow_host = {}
+    p.aff_host = {}
+    p.migrated_rows = 0
+    p.resident_rows = 0
+    p.catchup_rows = 0
+    p.catchup_scanned = 0
+    p.aff_rows = 0
+    p.certify_divergences = 0
+    p.phase = "ready"
+    p.done = False
+    p.aborted = False
+    p._clock = getattr(owner._commit, "_clock", None) or time.monotonic
+    p._stamps = {"begin": float(p._clock())}
+    mig = _WorldMigration(int(tid), f, p)
+    with owner._world_ctx(tid):
+        p._migrate_window(now, mig.G, mig=mig)
+        p._catchup(now, mig=mig)
+        ok = p._certify_world(mig, now)
+    if not ok:
+        return {"tenant": int(tid), "resynced": 0, "reason": "veto",
+                "vetoed": 1}
+    fsnap = dict(f)
+    try:
+        p._flip_world(mig, now)
+    except Exception as e:  # noqa: BLE001 — restore; the world keeps
+        # its latch and old topology, journaled.
+        w.fields = fsnap
+        emit_into(owner, "tenant-rollback", tenant=int(tid),
+                  error=f"resync flip: {type(e).__name__}: {e}"[:200])
+        return {"tenant": int(tid), "resynced": 0,
+                "reason": "flip-failed"}
+    return {"tenant": int(tid), "resynced": 1,
+            "migrated_rows": int(mig.migrated_rows),
+            "topology_generation": int(p.gen), "n_data": int(p.dst_n)}

@@ -911,6 +911,194 @@ def _measure_multitenant():
     }
 
 
+def measure_multitenant_reshard():
+    """The round-20 tenant-elasticity regime: MT_TENANTS uneven tenant
+    worlds live on one failover-armed `MeshDatapath` while the data
+    axis grows 2->4 under round-robin traffic, then a replica is killed
+    and the PR 19 quarantine auto-proceeds to a certified skip-replica
+    evacuation 4->3 with the worlds still serving — measuring tenant
+    migration throughput (rows/s across every world's `_world_ctx`
+    walk), per-world cutover certify latency (maintenance ticks from
+    resize begin to each `tenant-reshard-cutover`), and per-tenant
+    established-flow continuity across both flips.
+
+    On CPU platforms the worlds are toy-sized so the regime is
+    smoke-testable in CI — same JSON keys, `smoke: true`; the on-chip
+    numbers are the driver's to write.  -> the JSON dict, or None."""
+    try:
+        return _measure_multitenant_reshard()
+    except Exception as e:  # report, never sink the bench
+        print(f"# multitenant reshard measurement failed: {e}", flush=True)
+        return None
+
+
+def _measure_multitenant_reshard():
+    import time
+
+    from antrea_tpu.dissemination.faults import FaultPlan
+    from antrea_tpu.parallel import MeshDatapath
+
+    D = jax.device_count()
+    if D < 4:
+        print(f"# multitenant reshard regime skipped: need >= 4 devices, "
+              f"have {D}", flush=True)
+        return None
+    smoke = jax.devices()[0].platform == "cpu"
+    rng = np.random.default_rng(79)
+    # The measure_multitenant SaaS shape: many small worlds, a few heavy
+    # ones — all on one quota rung so the windows share executables
+    # before, during and after every resize.
+    sizes = ((4, 7, 14, 28) if smoke else (40, 90, 200, 450))
+    rule_counts = rng.choice(sizes, size=MT_TENANTS,
+                             p=(0.40, 0.30, 0.20, 0.10))
+    cluster = gen_cluster(40 if smoke else 2000, n_nodes=4,
+                          pods_per_node=8, seed=61)
+    services = gen_services(8, cluster.pod_ips, seed=62)
+    dp = MeshDatapath(cluster.ps, services, n_data=2, n_rule=1,
+                      flow_slots=1 << (8 if smoke else 16),
+                      aff_slots=1 << 8, canary_probes=8,
+                      flightrec_slots=4096, reshard_budget=512,
+                      failover=True,
+                      failover_knobs=dict(probe_fails=2, readmit_passes=2,
+                                          retry_ticks=2))
+    quota = 1 << (6 if smoke else 12)
+    # Lane counts must divide every topology the arc serves (2, 4 and
+    # the post-evacuation 3) — multiples of 12.
+    Bt = 48 if smoke else 1536
+    t_build0 = time.perf_counter()
+    tids, tbs = [], {}
+    for i, n in enumerate(rule_counts):
+        cl = gen_cluster(int(n), n_nodes=2, pods_per_node=6, seed=700 + i)
+        tid = dp.tenant_create(f"t{i}", cl.ps, quota=quota)
+        tids.append(tid)
+        tbs[tid] = gen_traffic(cl.pod_ips, Bt, n_flows=max(Bt // 2, 16),
+                               seed=900 + i)
+    build_s = time.perf_counter() - t_build0
+    tr = gen_traffic(cluster.pod_ips, Bt, n_flows=Bt // 2, seed=63,
+                     services=services, svc_fraction=0.3)
+
+    # Establish flows in every world; the synchronous slow path commits
+    # in-step, so the second pass serves established with pinned codes.
+    t = 100
+    dp.step(tr, t)
+    for tid in tids:
+        dp.tenant_step(tid, tbs[tid], t)
+    t += 1
+    est0, code0 = {}, {}
+    for tid in tids:
+        r = dp.tenant_step(tid, tbs[tid], t)
+        est0[tid] = np.asarray(r.est).astype(bool).copy()
+        code0[tid] = np.asarray(r.code).copy()
+
+    def drive(done, t, label):
+        """Round-robin serve — ONE world (the default world or a tenant,
+        rotating) per maintenance tick — until done(); -> (t, wall
+        seconds)."""
+        i, n1, t0 = 0, len(tids) + 1, time.perf_counter()
+        while not done():
+            if i % n1 == 0:
+                dp.step(tr, t)
+            else:
+                tid = tids[i % n1 - 1]
+                dp.tenant_step(tid, tbs[tid], t)
+            dp.maintenance_tick(now=t)
+            t += 1
+            i += 1
+            if t > 1 << 20:
+                raise RuntimeError(f"{label} did not converge")
+        return t, time.perf_counter() - t0
+
+    def continuity(t):
+        """Per-tenant continuity across a flip: every lane keeps its
+        pre-resize verdict bitwise, and est retention = established
+        lanes still serving est (skip-replica evacuation re-misses the
+        dead replica's rows by design — they re-commit on the next
+        serve, verdict-identical, then re-establish)."""
+        kept = total = 0
+        ok = True
+        for tid in tids:
+            r = dp.tenant_step(tid, tbs[tid], t)
+            ok = ok and bool((np.asarray(r.code) == code0[tid]).all())
+            now_est = np.asarray(r.est).astype(bool)
+            kept += int(now_est[est0[tid]].sum())
+            total += int(est0[tid].sum())
+        return ok, round(kept / max(total, 1), 4)
+
+    def certify_ticks(begin_t, gen):
+        """Per-world cutover certify latency: ticks from the resize
+        begin to each world's own tenant-reshard-cutover (its canary
+        certification landing).  Keyed by generation so a wrapped
+        flight-recorder ring degrades the sample, never mixes flips."""
+        at = sorted(e["at"] - begin_t for e in dp.flightrecorder_events()
+                    if e["kind"] == "tenant-reshard-cutover"
+                    and e["topo_gen"] == gen)
+        if not at:
+            return {"worlds": 0}
+        return {"worlds": len(at), "p50_ticks": int(at[len(at) // 2]),
+                "max_ticks": int(at[-1])}
+
+    # -- grow 2 -> 4 with every world live ---------------------------------
+    st0 = dp.reshard_stats()
+    grow_begin = t
+    dp.reshard_begin(4)
+    t, dt_g = drive(lambda: dp.reshard_status() is None, t, "grow")
+    st1 = dp.reshard_stats()
+    if st1["aborts_total"] != st0["aborts_total"] or dp._n_data != 4:
+        raise RuntimeError(f"tenanted grow aborted instead of cutting "
+                           f"over: {st1}")
+    rows_g = st1["tenant_rows_total"] - st0["tenant_rows_total"]
+    grow_cert = certify_ticks(grow_begin, dp._topo_gen)
+    grow_ok, grow_kept = continuity(t)
+    t += 1
+
+    # -- failover-evacuate 4 -> 3: kill a replica; quarantine proceeds
+    # to the certified evacuation shrink with the worlds still serving
+    # (masked skip-replica ring until the flip).
+    plan = FaultPlan(seed=83)
+    plan.every("n0.replica_dead", 1, "r1", times=1 << 20)
+    dp.arm_failover_faults(plan, "n0")
+    evac_begin = t
+    t, dt_e = drive(
+        lambda: dp.failover_stats()["phase"] == "evacuated", t, "evacuate")
+    st2 = dp.reshard_stats()
+    if dp._n_data != 3:
+        raise RuntimeError(f"evacuation did not land on 3 replicas: "
+                           f"{dp.failover_stats()}")
+    rows_e = st2["tenant_rows_total"] - st1["tenant_rows_total"]
+    evac_cert = certify_ticks(evac_begin, dp._topo_gen)
+    # One settle pass re-commits the dead replica's re-missed rows,
+    # then measure: verdicts stay pinned, est coverage recovers.
+    continuity(t)
+    evac_ok, evac_kept = continuity(t + 1)
+
+    total_rows, total_dt = rows_g + rows_e, dt_g + dt_e
+    return {
+        "metric": "multitenant_reshard_rows_per_s",
+        "value": round(total_rows / max(total_dt, 1e-9), 1),
+        "unit": "rows/s",
+        "extra": {
+            "devices": D,
+            "n_tenants": MT_TENANTS,
+            "rule_count_min": int(min(rule_counts)),
+            "rule_count_max": int(max(rule_counts)),
+            "world_build_s": round(build_s, 3),
+            "grow": {"tenant_rows": int(rows_g),
+                     "seconds": round(dt_g, 4),
+                     "certify": grow_cert,
+                     "verdict_continuity_ok": grow_ok,
+                     "est_retention": grow_kept},
+            "evacuate": {"tenant_rows": int(rows_e),
+                         "seconds": round(dt_e, 4),
+                         "certify": evac_cert,
+                         "verdict_continuity_ok": evac_ok,
+                         "est_retention": evac_kept},
+            "tenant_vetoes_total": int(st2["tenant_vetoes_total"]),
+            "topology_generation": int(dp._topo_gen),
+            "smoke": smoke,
+        },
+    }
+
+
 def measure_serving_batched():
     """The round-18 batched-serving regime: the same MT_TENANTS uneven
     worlds, but driven by `gen_bursty` trickle arrivals THROUGH the
@@ -1267,6 +1455,7 @@ def main():
     multichip = measure_multichip(cps, svc, cluster.pod_ips, services)
     reshard = measure_reshard()
     multitenant = measure_multitenant()
+    multitenant_reshard = measure_multitenant_reshard()
     serving_batched = measure_serving_batched()
     _print_and_gate(pps, cold_pps, sh_pps, sh_overhead, churn_pps,
                     sh_cold_pps, async_churn_pps, q_overflows,
@@ -1280,6 +1469,7 @@ def main():
                     steady_telemetry_pps=steady_telemetry_pps,
                     attack_floor=attack_floor,
                     reshard=reshard, multitenant=multitenant,
+                    multitenant_reshard=multitenant_reshard,
                     serving_batched=serving_batched)
 
 
@@ -1306,7 +1496,7 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
                     steady_fused_pps=None, cold_fused_pps=None,
                     steady_telemetry_pps=None, attack_floor=None,
                     reshard=None, multitenant=None,
-                    serving_batched=None):
+                    multitenant_reshard=None, serving_batched=None):
     maint_overhead_pct = None
     if maint_churn_pps and async_churn_pps:
         maint_overhead_pct = round(
@@ -1427,6 +1617,13 @@ def _print_and_gate(pps, cold_pps, sh_pps=None, sh_overhead=None,
     # single-chip keys stay untouched for the r08 -> r09 comparison.
     if multitenant is not None:
         print(json.dumps(multitenant))
+    # The tenant-elasticity regime prints next (round 20): tenant
+    # migration rows/s through a live grow AND a replica-kill
+    # evacuation with 64 worlds serving, plus per-world certify
+    # latency and continuity — earlier keys stay untouched for the
+    # r19 -> r20 comparison.
+    if multitenant_reshard is not None:
+        print(json.dumps(multitenant_reshard))
     # The batched-serving regime prints fifth (round 18): aggregate pps
     # through the canonical-ladder batcher + the per-tenant p99 wait
     # price of the deadline knob — earlier keys stay untouched for the

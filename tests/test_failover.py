@@ -541,6 +541,9 @@ def test_operator_readmit_via_api_and_bundle_surfaces(world, mesh, batch,
         assert body["enabled"] == 1 and body["phase"] == "evacuated"
         assert body["quarantined_shard"] == 1 and body["n_shards"] == 2
         assert body["probe_history"]
+        # PR 20: the surface names worlds still awaiting the evacuation
+        # flip — an untenanted mesh serves the key with an empty list.
+        assert body["tenants_pending_evacuation"] == []
         kicked = json.loads(urllib.request.urlopen(
             srv.address + "/failover?readmit=1").read())
         assert kicked["phase"] == "readmitting"
@@ -590,5 +593,6 @@ def test_maintenance_stats_render_late_registered_tasks(world, mesh, batch):
         assert "reshard-migrate" in body["tasks"]
         fo = json.loads(rq.urlopen(srv.address + "/failover").read())
         assert fo["phase"] == "evacuating"
+        assert fo["tenants_pending_evacuation"] == []
     finally:
         srv.close()

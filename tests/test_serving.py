@@ -308,7 +308,8 @@ def test_step_traces_identically_with_batcher_configured():
 
 
 # ---------------------------------------------------------------------------
-# pillar 5: reshard-vs-tenant mutual refusal
+# pillar 5: reshard-vs-tenant composition (PR 20 — the PR 18 mutual
+# refusals are GONE; tests/test_tenant_reshard.py drives the full arcs)
 
 
 @pytest.fixture(scope="module")
@@ -322,39 +323,47 @@ def mesh_world():
     return MeshDatapath, cluster, services, mesh
 
 
-def test_reshard_refuses_with_tenants(mesh_world):
+def test_reshard_begin_accepts_tenants(mesh_world):
     MeshDatapath, cluster, services, mesh = mesh_world
     mdp = MeshDatapath(cluster.ps, services, mesh=mesh,
                        flow_slots=1 << 10, aff_slots=1 << 8,
                        canary_probes=16)
     c1 = gen_cluster(6, n_nodes=2, pods_per_node=4, seed=63)
-    mdp.tenant_create("t", copy.deepcopy(c1.ps), quota=QUOTA)
-    with pytest.raises(ConfigError, match="tenancy plane"):
-        mdp.reshard_begin(4)
-    assert mdp.reshard_status() is None  # refusal left nothing in flight
+    tid = mdp.tenant_create("t", copy.deepcopy(c1.ps), quota=QUOTA)
+    mdp.reshard_begin(4)  # the old tenancy-plane refusal is gone
+    assert mdp.reshard_status() is not None
+    assert mdp.reshard_stats()["tenant_worlds_migrating"] == 1
+    assert mdp.tenant_stats()[tid]["latched"] == 0
 
 
-def test_tenant_create_refuses_during_reshard(mesh_world):
+def test_tenant_create_adopts_during_reshard(mesh_world):
     MeshDatapath, cluster, services, mesh = mesh_world
     mdp = MeshDatapath(cluster.ps, services, mesh=mesh,
                        flow_slots=1 << 10, aff_slots=1 << 8,
                        canary_probes=16)
     mdp.reshard_begin(4)
     c1 = gen_cluster(6, n_nodes=2, pods_per_node=4, seed=64)
-    with pytest.raises(ConfigError, match="resharding plane"):
-        mdp.tenant_create("t", copy.deepcopy(c1.ps), quota=QUOTA)
+    # The old resharding-plane refusal is gone: the newborn world is
+    # adopted mid-flight (reshard.note_world_created) so the cutover
+    # flips and certifies it with the rest of the fleet.
+    tid = mdp.tenant_create("t", copy.deepcopy(c1.ps), quota=QUOTA)
+    assert mdp.reshard_status() is not None
+    assert mdp.reshard_stats()["tenant_worlds_migrating"] == 1
+    assert tid in mdp.tenant_stats()
 
 
 @pytest.mark.parametrize("cls", [TpuflowDatapath, OracleDatapath])
-def test_tenant_create_reshard_guard_both_engines(cls):
-    """The tenancy-side refusal is engine-generic: ANY in-flight reshard
-    marker blocks world creation with the typed plane-exclusion error."""
+def test_tenant_create_ignores_reshard_marker_both_engines(cls):
+    """The tenancy-side refusal is gone engine-generically: an in-flight
+    reshard marker no longer blocks world creation (the mesh plane
+    adopts via note_world_created; single-chip engines carry no plane to
+    join, so creation simply proceeds)."""
     c = gen_cluster(6, n_nodes=2, pods_per_node=4, seed=65)
     dp = _dp(cls, c)
-    dp._reshard = object()  # simulate an in-flight resize
+    dp._reshard = object()  # simulate an in-flight resize marker
     c1 = gen_cluster(6, n_nodes=2, pods_per_node=4, seed=66)
-    with pytest.raises(ConfigError, match="resharding plane"):
-        dp.tenant_create("t", copy.deepcopy(c1.ps), quota=QUOTA)
+    tid = dp.tenant_create("t", copy.deepcopy(c1.ps), quota=QUOTA)
+    assert tid in dp.tenant_stats()
 
 
 # ---------------------------------------------------------------------------

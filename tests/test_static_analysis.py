@@ -451,6 +451,44 @@ def test_telemetry_registry_pass_fires_on_seeded_violations(tree_template,
     assert "regime-undocumented:attack-shed" in objs3
 
 
+def test_reshard_world_migration_fires_on_seeded_violation(tree_template,
+                                                           tmp_path):
+    """The PR 20 tenant extension of the reshard pass: a NEW
+    _TENANT_WORLD_FIELDS member of the mesh engine assigned from a
+    sharded-state builder but absent from reshard.WORLD_MIGRATION is
+    flow loss for EVERY tenant at once — the pass must fire on it (and
+    on a stale rule naming no such field), and stay clean at HEAD."""
+    clean = run(tree_template, ["reshard"])
+    assert clean.clean, [f.render() for f in clean.findings] + clean.errors
+
+    # A sharded per-world field nobody taught the per-world migrator.
+    broken = tmp_path / "unmigrated-world"
+    shutil.copytree(tree_template, broken)
+    p = broken / "antrea_tpu" / "parallel" / "meshpath.py"
+    txt = p.read_text()
+    new = txt.replace('        "_fo_mask",\n',
+                      '        "_fo_mask", "_shadow_state",\n', 1)
+    assert new != txt
+    p.write_text(new + "\n\ndef _seeded(self, st):\n"
+                       "    self._shadow_state = self._pin_state(st)\n")
+    objs = {f.obj for f in run(broken, ["reshard"]).findings}
+    assert "unmigrated-world:_shadow_state" in objs
+
+    # A WORLD_MIGRATION rule whose field no longer exists: stale rule.
+    broken2 = tmp_path / "stale-world"
+    shutil.copytree(tree_template, broken2)
+    r = broken2 / "antrea_tpu" / "parallel" / "reshard.py"
+    txt = r.read_text()
+    new = txt.replace('WORLD_MIGRATION = {\n',
+                      'WORLD_MIGRATION = {\n'
+                      '    "_ghost_state": "row-migrate a field that '
+                      'no longer exists",\n', 1)
+    assert new != txt
+    r.write_text(new)
+    objs2 = {f.obj for f in run(broken2, ["reshard"]).findings}
+    assert "stale-world:_ghost_state" in objs2
+
+
 # ---------------------------------------------------------------------------
 # Baseline discipline: suppression works, staleness fails the build.
 # ---------------------------------------------------------------------------
